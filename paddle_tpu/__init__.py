@@ -1,0 +1,75 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle's
+capabilities (reference: yangyu18/Paddle), built on JAX/XLA/Pallas.
+
+Not a port: eager tensors are jax.Arrays, autograd is functional
+(`paddle_tpu.grad`), compilation is `paddle_tpu.jit.to_static` == jax.jit,
+and distribution is GSPMD mesh sharding instead of NCCL process groups.
+See SURVEY.md for the subsystem-by-subsystem mapping.
+"""
+import jax as _jax
+
+from . import dtypes
+from .dtypes import (bfloat16, bool_, float16, float32, float64, int8, int16,
+                     int32, int64, uint8)
+from .tensor import *  # noqa: F401,F403 — paddle flat namespace parity
+from .tensor import Tensor
+from .utils.rng import get_rng_state, seed, set_rng_state
+
+# functional transforms (TPU-first autograd surface)
+grad = _jax.grad
+value_and_grad = _jax.value_and_grad
+vmap = _jax.vmap
+jvp = _jax.jvp
+vjp = _jax.vjp
+
+
+def no_grad(fn=None):
+    """paddle.no_grad parity. In a functional-autograd world gradients only
+    flow where jax.grad is applied, so this is a stop_gradient marker used
+    for API compatibility (usable as decorator or context manager)."""
+    import contextlib
+    if fn is None:
+        return contextlib.nullcontext()
+    return fn
+
+
+def stop_gradient(x):
+    return _jax.lax.stop_gradient(x)
+
+
+from . import amp  # noqa: E402
+from . import distributed  # noqa: E402
+from . import io  # noqa: E402
+from . import jit  # noqa: E402
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from .checkpoint import load, save  # noqa: E402
+
+__version__ = "0.1.0"
+
+
+def device_count():
+    return len(_jax.devices())
+
+
+def get_device():
+    d = _jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return True  # TPU is the accelerator
+
+
+def set_default_dtype(dtype):
+    from .dtypes import to_dtype
+    _jax.config.update("jax_default_dtype_bits", "32")
+    return to_dtype(dtype)
+
+
+def get_default_dtype():
+    return float32

@@ -1,0 +1,148 @@
+"""Automatic mixed precision (reference: python/paddle/amp/*.py).
+
+TPU-first AMP: bfloat16 has fp32's exponent range, so the default TPU
+policy needs **no loss scaling** — `amp.auto_cast(dtype="bfloat16")` casts
+layer compute to bf16 and keeps normalization/softmax/reductions in fp32
+(our F.* norms already accumulate in fp32). GradScaler exists for fp16
+parity and is an identity when scaling is unnecessary.
+
+Levels (paddle parity):
+- O1: per-op cast — matmul/conv inputs to low precision, fp32 elsewhere.
+- O2: model weights in low precision + fp32 master weights in the optimizer
+  (optimizer(multi_precision=True)).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ..dtypes import to_dtype
+
+_amp_state = threading.local()
+
+
+def _dtype():
+    return getattr(_amp_state, "dtype", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, dtype="bfloat16", level="O1", custom_white_list=None,
+              custom_black_list=None):
+    """Context that makes Linear/Conv/Attention cast inputs to `dtype`."""
+    prev = _dtype()
+    _amp_state.dtype = to_dtype(dtype) if enable else None
+    _amp_state.level = level
+    try:
+        yield
+    finally:
+        _amp_state.dtype = prev
+
+
+amp_guard = auto_cast
+
+
+def amp_dtype():
+    """Queried by compute layers; None when AMP is off."""
+    return _dtype()
+
+
+def maybe_cast(x):
+    dt = _dtype()
+    if dt is not None and hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(dt)
+    return x
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None):
+    """paddle.amp.decorate parity: cast model params to `dtype`; the
+    optimizer keeps fp32 masters (multi_precision)."""
+    dt = to_dtype(dtype)
+    single = False
+    if models is not None and not isinstance(models, (list, tuple)):
+        models, single = [models], True
+    for m in models or []:
+        m.to(dtype=dt)
+    if optimizers is not None:
+        opts = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+        for o in opts:
+            o.multi_precision = True if master_weight is None else master_weight
+    if models is None:
+        return optimizers
+    out_models = models[0] if single else models
+    if optimizers is None:
+        return out_models
+    return out_models, optimizers
+
+
+class GradScaler:
+    """Loss scaling for fp16 (reference: python/paddle/amp/grad_scaler.py).
+    With bf16 (TPU default) scaling is unnecessary; enable=False makes all
+    methods identity passthroughs.
+
+    Functional usage inside a jitted step:
+        scaled = scaler.scale(loss)
+        ... grads of scaled loss ...
+        grads, found_inf = scaler.unscale(grads)
+        new_scale_state = scaler.update_state(found_inf)
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self.incr_ratio = incr_ratio
+        self.decr_ratio = decr_ratio
+        self.incr_every_n_steps = incr_every_n_steps
+        self.decr_every_n = decr_every_n_nan_or_inf
+        self.dynamic = use_dynamic_loss_scaling
+        self._scale = jnp.float32(init_loss_scaling if enable else 1.0)
+        self._growth_tracker = jnp.int32(0)
+
+    def is_enable(self):
+        return self._enable
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale(self, grads):
+        """Returns (unscaled_grads, found_inf[bool])."""
+        if not self._enable:
+            return grads, jnp.bool_(False)
+        inv = 1.0 / self._scale
+        unscaled = jax.tree.map(lambda g: g * inv, grads)
+        found_inf = jnp.any(jnp.stack([
+            jnp.any(~jnp.isfinite(g.astype(jnp.float32))) for g in jax.tree.leaves(unscaled)
+        ]))
+        return unscaled, found_inf
+
+    def update(self, found_inf=None):
+        if not (self._enable and self.dynamic) or found_inf is None:
+            return
+        if bool(found_inf):
+            self._scale = self._scale * self.decr_ratio
+            self._growth_tracker = jnp.int32(0)
+        else:
+            self._growth_tracker = self._growth_tracker + 1
+            if int(self._growth_tracker) >= self.incr_every_n_steps:
+                self._scale = self._scale * self.incr_ratio
+                self._growth_tracker = jnp.int32(0)
+
+    # paddle flow: scaler.step(optimizer) + scaler.update()
+    def step(self, optimizer, layer=None, grads=None):
+        grads, found_inf = self.unscale(grads)
+        if not bool(found_inf):
+            optimizer.step(grads=grads, layer=layer)
+        self.update(found_inf)
+
+    def state_dict(self):
+        return {"scale": self._scale, "growth_tracker": self._growth_tracker}
+
+    def load_state_dict(self, sd):
+        self._scale = jnp.float32(sd["scale"])
+        self._growth_tracker = jnp.int32(sd["growth_tracker"])

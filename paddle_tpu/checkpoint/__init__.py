@@ -1,0 +1,77 @@
+"""Checkpointing (reference: python/paddle/framework/io.py paddle.save/load;
+distributed checkpoint: python/paddle/distributed/checkpoint/*).
+
+Format: a directory (or single .pdt file) holding an npz of arrays plus a
+msgpack-free JSON manifest for non-array state. Distributed sharded
+checkpointing and async save live in `paddle_tpu.checkpoint.distributed_ckpt`
+(orbax-backed, see C14 in SURVEY.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_ARRAY_KEY = "__paddle_tpu_arrays__"
+
+
+def _split_state(obj, arrays, prefix=""):
+    """Replace arrays in a nested structure with placeholders, collecting
+    them into `arrays`."""
+    if isinstance(obj, (jax.Array, np.ndarray)):
+        key = f"a{len(arrays)}"
+        arrays[key] = np.asarray(obj)
+        return {_ARRAY_KEY: key}
+    if isinstance(obj, dict):
+        return {k: _split_state(v, arrays, f"{prefix}.{k}") for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        out = [_split_state(v, arrays, f"{prefix}[{i}]") for i, v in enumerate(obj)]
+        return out if isinstance(obj, list) else {"__tuple__": out}
+    return obj
+
+
+def _join_state(obj, arrays):
+    if isinstance(obj, dict):
+        if _ARRAY_KEY in obj:
+            return jnp.asarray(arrays[obj[_ARRAY_KEY]])
+        if "__tuple__" in obj:
+            return tuple(_join_state(v, arrays) for v in obj["__tuple__"])
+        return {k: _join_state(v, arrays) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_join_state(v, arrays) for v in obj]
+    return obj
+
+
+def save(obj: Any, path: str):
+    """paddle.save parity: accepts a state_dict (or any nested structure of
+    arrays + JSON-able scalars)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    try:
+        structure = _split_state(obj, arrays)
+        manifest = json.dumps(structure)
+    except TypeError:
+        # non-JSON-able python object: pickle fallback (paddle does the same)
+        with open(path, "wb") as f:
+            pickle.dump(jax.tree.map(np.asarray, obj), f)
+        return
+    np.savez(path + ".npz" if not path.endswith(".npz") else path,
+             __manifest__=np.frombuffer(manifest.encode(), dtype=np.uint8),
+             **arrays)
+
+
+def load(path: str):
+    """paddle.load parity."""
+    npz_path = path + ".npz" if not path.endswith(".npz") and os.path.exists(path + ".npz") else path
+    if os.path.exists(npz_path) and npz_path.endswith(".npz"):
+        data = np.load(npz_path)
+        manifest = json.loads(bytes(data["__manifest__"]).decode())
+        arrays = {k: data[k] for k in data.files if k != "__manifest__"}
+        return _join_state(manifest, arrays)
+    with open(path, "rb") as f:
+        return pickle.load(f)

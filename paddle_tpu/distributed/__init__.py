@@ -1,0 +1,7 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/__init__.py)."""
+from . import collective
+from .collective import (ReduceOp, all_gather, all_reduce, all_to_all,
+                         broadcast, eager_all_gather, eager_all_reduce,
+                         eager_broadcast, ppermute, reduce_scatter)
+from .env import (HYBRID_AXES, barrier, get_mesh, get_rank, get_world_size,
+                  has_mesh, init_parallel_env, replicated, set_mesh, sharding)

@@ -1,0 +1,107 @@
+"""Distributed environment (reference: python/paddle/distributed/parallel.py
+init_parallel_env, and fleet's topology management).
+
+TPU-native: one global `jax.sharding.Mesh` over all devices replaces the
+reference's process-group world. Axis names follow fleet's 4D hybrid
+terminology plus sequence/expert axes:
+
+    dp    — data parallel (pure replication of params, sharded batch)
+    fsdp  — sharded data parallel (ZeRO: params/opt-state sharded too)
+    tp    — tensor/model parallel (mp in fleet terms)
+    pp    — pipeline parallel
+    sp    — sequence/context parallel (ring attention)
+    ep    — expert parallel (MoE)
+
+Multi-host: jax.distributed.initialize handles DCN; the mesh should be
+laid out so tp/sp ride ICI within a host/pod slice and dp/pp cross DCN.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_lock = threading.Lock()
+_global_mesh: Optional[Mesh] = None
+
+HYBRID_AXES = ("dp", "fsdp", "pp", "sp", "ep", "tp")  # tp innermost: ICI-closest
+
+
+def init_parallel_env(mesh_shape: Optional[Dict[str, int]] = None,
+                      devices=None) -> Mesh:
+    """Create and install the global mesh.
+
+    mesh_shape maps axis name -> degree, e.g. {"dp": 2, "tp": 4}. Axes are
+    laid out in HYBRID_AXES order with tp fastest-varying so tensor-parallel
+    collectives ride the innermost (fastest) ICI links. Missing axes get
+    degree 1. With no arguments: pure data parallel over all devices.
+    """
+    global _global_mesh
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    mesh_shape = dict(mesh_shape or {})
+    degrees = [mesh_shape.get(a, 1) for a in HYBRID_AXES]
+    specified = int(np.prod([d for d in degrees if d > 0]))
+    if "dp" not in mesh_shape and specified < n and n % max(specified, 1) == 0:
+        mesh_shape["dp"] = n // specified  # absorb remaining devices into dp
+        degrees = [mesh_shape.get(a, 1) for a in HYBRID_AXES]
+    total = int(np.prod(degrees))
+    assert total == n, f"mesh {dict(zip(HYBRID_AXES, degrees))} != {n} devices"
+    arr = np.asarray(devices).reshape(degrees)
+    with _lock:
+        _global_mesh = Mesh(arr, HYBRID_AXES)
+    return _global_mesh
+
+
+def set_mesh(mesh: Mesh):
+    global _global_mesh
+    with _lock:
+        _global_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Mesh:
+    global _global_mesh
+    if _global_mesh is None:
+        init_parallel_env()
+    return _global_mesh
+
+
+def has_mesh() -> bool:
+    return _global_mesh is not None
+
+
+def get_world_size(axis: Optional[str] = None) -> int:
+    mesh = get_mesh()
+    if axis is None:
+        return mesh.size
+    return mesh.shape.get(axis, 1)
+
+
+def get_rank(axis: Optional[str] = None) -> int:
+    """Host-process rank (multi-host); inside shard_map use lax.axis_index."""
+    return jax.process_index()
+
+
+def sharding(*spec) -> NamedSharding:
+    """NamedSharding over the global mesh from a PartitionSpec-like tuple."""
+    return NamedSharding(get_mesh(), PartitionSpec(*spec))
+
+
+def replicated() -> NamedSharding:
+    return NamedSharding(get_mesh(), PartitionSpec())
+
+
+def is_initialized() -> bool:
+    return _global_mesh is not None
+
+
+def barrier():
+    """Cross-host barrier (reference: paddle.distributed.barrier)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")

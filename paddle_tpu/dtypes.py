@@ -1,0 +1,43 @@
+"""Dtype aliases mirroring paddle's dtype surface (reference: paddle/phi/common/data_type.h).
+
+TPU-first defaults: bfloat16 is the preferred compute dtype, float32 the
+accumulation/master dtype.
+"""
+import jax.numpy as jnp
+
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+
+_DTYPE_ALIASES = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "bool": bool_, "complex64": complex64,
+}
+
+
+def to_dtype(dtype):
+    """Normalize a paddle-style dtype spec (str or jnp dtype) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _DTYPE_ALIASES[dtype]
+        except KeyError:
+            raise ValueError(f"unknown dtype {dtype!r}") from None
+    return jnp.dtype(dtype)
+
+
+def default_float():
+    return float32

@@ -1,0 +1,7 @@
+"""paddle_tpu.io (reference: python/paddle/io/__init__.py)."""
+from .dataset import (ChainDataset, ConcatDataset, Dataset, IterableDataset,
+                      Subset, TensorDataset, random_split)
+from .dataloader import DataLoader, default_collate_fn
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
+                      Sampler, SequenceSampler, SubsetRandomSampler,
+                      WeightedRandomSampler)

@@ -1,0 +1,149 @@
+"""DataLoader (reference: python/paddle/io/dataloader/dataloader_iter.py —
+C++ BlockingQueue + worker pool).
+
+TPU-native: the accelerator is fed from the host over PCIe/ICI, so the
+loader's job is (1) overlap host batch assembly with device compute, and
+(2) pin a steady static batch shape. Default path: background prefetch
+threads (numpy collate releases the GIL for the heavy copies). When the
+native C++ pipeline (paddle_tpu/native) is built, `use_native=True` routes
+batch assembly through the C ring buffer; the Python fallback is always
+available.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays (mirrors paddle's default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.number)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if hasattr(sample, "__array__"):
+        return np.stack([np.asarray(b) for b in batch])
+    return batch
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, batch_size=1, shuffle=False,
+                 sampler=None, batch_sampler=None, num_workers=0,
+                 collate_fn: Optional[Callable] = None, drop_last=False,
+                 prefetch_factor=2, use_native=False, return_list=True,
+                 worker_init_fn=None, persistent_workers=False):  # noqa: ARG002
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 1)
+        self.use_native = use_native
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, sampler=sampler,
+                                              shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no length")
+        return len(self.batch_sampler)
+
+    # ------------------------------------------------------------ iteration
+    def _assemble(self, indices):
+        if self.use_native:
+            from ..native import loader as native_loader
+            if native_loader.available():
+                return native_loader.assemble(self.dataset, indices, self.collate_fn)
+        return self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_sync(self):
+        if self._iterable:
+            batch = []
+            for item in self.dataset:
+                batch.append(item)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+        else:
+            for indices in self.batch_sampler:
+                yield self._assemble(indices)
+
+    def _iter_prefetch(self):
+        """Background thread pool keeps `num_workers * prefetch_factor`
+        batches in flight ahead of the consumer."""
+        depth = self.num_workers * self.prefetch_factor
+        q: queue.Queue = queue.Queue(maxsize=depth)
+        sentinel = object()
+        stop = threading.Event()
+
+        class _WorkerError:
+            def __init__(self, exc):
+                self.exc = exc
+
+        def put(item):
+            """Bounded put that re-checks stop so an abandoned consumer
+            (early break) can't leave this thread parked on a full queue."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for batch in self._iter_sync():
+                    if not put(batch):
+                        return
+            except BaseException as e:  # propagate into consumer
+                put(_WorkerError(e))
+            finally:
+                while True:
+                    try:
+                        q.put_nowait(sentinel)
+                        break
+                    except queue.Full:  # consumer gone; drop one and retry
+                        try:
+                            q.get_nowait()
+                        except queue.Empty:
+                            pass
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                if isinstance(item, _WorkerError):
+                    raise item.exc
+                yield item
+        finally:
+            stop.set()
+
+    def __iter__(self):
+        if self.num_workers > 0:
+            return self._iter_prefetch()
+        return self._iter_sync()

@@ -1,0 +1,101 @@
+"""Dynamic-to-static + compiled execution (reference: python/paddle/jit/*,
+the static Program/Executor and the CINN compiler).
+
+TPU-native mapping:
+- `to_static(fn)` == trace-and-compile with jax.jit. XLA *is* the fusion
+  compiler (what CINN does for the reference, XLA does here, better, for
+  TPU).
+- A paddle `Program` == a captured ClosedJaxpr; `ProgramHolder` exposes it
+  for inspection/serialization.
+- `save`/`load` == AOT-compiled executable export via jax.export.
+- Layers: `to_static(layer)` wraps forward through the functional bridge so
+  the module tree stays out of the traced graph.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+
+from ..nn.layer import Layer
+
+
+class StaticFunction:
+    """Compiled callable with paddle.jit surface (concrete_program etc.)."""
+
+    def __init__(self, fn, static_argnums=(), donate_argnums=(), backend=None):
+        self._raw = fn
+        self._jitted = jax.jit(fn, static_argnums=static_argnums,
+                               donate_argnums=donate_argnums, backend=backend)
+
+    def __call__(self, *args, **kwargs):
+        return self._jitted(*args, **kwargs)
+
+    def concrete_program(self, *args, **kwargs):
+        """Return the captured jaxpr (the 'static Program')."""
+        return jax.make_jaxpr(self._raw)(*args, **kwargs)
+
+    def lowered(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    def compiled_ir(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs).compile()
+
+    def cost_analysis(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs).compile().cost_analysis()
+
+
+def to_static(fn_or_layer=None, input_spec=None, static_argnums=(),
+              donate_argnums=(), full_graph=True, backend=None):  # noqa: ARG001
+    """paddle.jit.to_static parity. Use as decorator or call."""
+    def wrap(obj):
+        if isinstance(obj, Layer):
+            orig_forward = obj.forward  # capture before we shadow it
+
+            def pure(p, *args, **kwargs):
+                with obj.bound(p):
+                    return orig_forward(*args, **kwargs)
+            jitted = jax.jit(pure, static_argnums=static_argnums)
+
+            @functools.wraps(orig_forward)
+            def layer_call(*args, **kwargs):
+                return jitted(dict(obj.named_parameters()), *args, **kwargs)
+            # shadow the instance forward so obj(x) runs the compiled program
+            object.__setattr__(obj, "forward", layer_call)
+            object.__setattr__(obj, "_static_fn", layer_call)
+            return obj
+        return StaticFunction(obj, static_argnums=static_argnums,
+                              donate_argnums=donate_argnums, backend=backend)
+    if fn_or_layer is None:
+        return wrap
+    return wrap(fn_or_layer)
+
+
+def not_to_static(fn):
+    fn.__not_to_static__ = True
+    return fn
+
+
+def save(static_fn, path: str, *example_args, **example_kwargs):
+    """AOT-export a compiled function (paddle.jit.save parity)."""
+    from jax import export as jax_export
+    fn = static_fn._jitted if isinstance(static_fn, StaticFunction) else jax.jit(static_fn)
+    exported = jax_export.export(fn)(*example_args, **example_kwargs)
+    data = exported.serialize()
+    with open(path if path.endswith(".jaxir") else path + ".jaxir", "wb") as f:
+        f.write(data)
+    return path
+
+
+def load(path: str):
+    """Load an AOT-exported function (paddle.jit.load parity)."""
+    from jax import export as jax_export
+    with open(path if path.endswith(".jaxir") else path + ".jaxir", "rb") as f:
+        data = f.read()
+    exported = jax_export.deserialize(data)
+    return exported.call
+
+
+def ignore_module(modules):  # paddle API parity; nothing to ignore under jax
+    return None

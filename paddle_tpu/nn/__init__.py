@@ -1,0 +1,25 @@
+"""paddle_tpu.nn — layer library (reference: python/paddle/nn/__init__.py)."""
+from . import functional
+from . import initializer
+from .activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink, Hardsigmoid,
+                         Hardswish, Hardtanh, LeakyReLU, LogSigmoid,
+                         LogSoftmax, Mish, PReLU, ReLU, ReLU6, Sigmoid, SiLU,
+                         Softmax, Softplus, Softshrink, Softsign, Swish, Tanh,
+                         Tanhshrink)
+from .common import (CosineSimilarity, Dropout, Dropout2D, Embedding, Flatten,
+                     Identity, Linear, Pad2D, PixelShuffle, Upsample)
+from .container import LayerDict, LayerList, ParameterList, Sequential
+from .conv import (AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool2D, Conv1D,
+                   Conv2D, Conv2DTranspose, Conv3D, MaxPool2D)
+from .layer import Buffer, Layer, Parameter, ParamMeta
+from .loss import (BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss,
+                   L1Loss, MSELoss, NLLLoss, SmoothL1Loss)
+from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                   GroupNorm, InstanceNorm2D, LayerNorm, RMSNorm,
+                   SyncBatchNorm)
+from .recompute import checkpoint_wrapper, recompute
+from .transformer import (MultiHeadAttention, Transformer, TransformerDecoder,
+                          TransformerDecoderLayer, TransformerEncoder,
+                          TransformerEncoderLayer)
+
+F = functional

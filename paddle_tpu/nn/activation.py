@@ -1,0 +1,58 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import functional as F
+from .layer import Layer, Parameter
+
+
+def _make(name, fn, **defaults):
+    class _Act(Layer):
+        def __init__(self, **kwargs):
+            super().__init__()
+            self._kwargs = {**defaults, **kwargs}
+
+        def forward(self, x):
+            return fn(x, **self._kwargs)
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _make("ReLU", F.relu)
+ReLU6 = _make("ReLU6", F.relu6)
+GELU = _make("GELU", F.gelu)
+SiLU = _make("SiLU", F.silu)
+Swish = _make("Swish", F.silu)
+Mish = _make("Mish", F.mish)
+Sigmoid = _make("Sigmoid", F.sigmoid)
+LogSigmoid = _make("LogSigmoid", F.log_sigmoid)
+Tanh = _make("Tanh", F.tanh)
+Tanhshrink = _make("Tanhshrink", F.tanhshrink)
+Hardswish = _make("Hardswish", F.hardswish)
+Hardsigmoid = _make("Hardsigmoid", F.hardsigmoid)
+Hardtanh = _make("Hardtanh", F.hardtanh)
+Hardshrink = _make("Hardshrink", F.hardshrink)
+Softshrink = _make("Softshrink", F.softshrink)
+Softplus = _make("Softplus", F.softplus)
+Softsign = _make("Softsign", F.softsign)
+ELU = _make("ELU", F.elu)
+SELU = _make("SELU", F.selu)
+CELU = _make("CELU", F.celu)
+LeakyReLU = _make("LeakyReLU", F.leaky_relu)
+Softmax = _make("Softmax", F.softmax)
+LogSoftmax = _make("LogSoftmax", F.log_softmax)
+GLU = _make("GLU", F.glu)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, name=None):
+        super().__init__(name)
+        self.weight = Parameter(jnp.full((num_parameters,), init))
+
+    def forward(self, x):
+        w = self.weight
+        if w.shape[0] > 1:  # per-channel (NCHW)
+            w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return F.prelu(x, w)

@@ -1,0 +1,137 @@
+"""Core layers (reference: python/paddle/nn/layer/common.py)."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..utils.rng import next_key
+from . import functional as F
+from . import initializer as I
+from .layer import Buffer, Layer, Parameter
+
+
+class Linear(Layer):
+    """y = x @ W + b, weight stored [in_features, out_features] (paddle
+    layout — the transpose of torch). TPU note: keep out_features a
+    multiple of 128 where possible so XLA tiles the MXU fully."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__(name)
+        self.in_features = in_features
+        self.out_features = out_features
+        w_init = weight_attr if isinstance(weight_attr, I.Initializer) else I.XavierNormal()
+        self.weight = Parameter(w_init(next_key(), (in_features, out_features)))
+        if bias_attr is not False:
+            b_init = bias_attr if isinstance(bias_attr, I.Initializer) else I.Constant(0.0)
+            self.bias = Parameter(b_init(next_key(), (out_features,)))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, getattr(self, "bias", None))
+
+    def extra_repr(self):
+        return f"in={self.in_features}, out={self.out_features}"
+
+
+class Embedding(Layer):
+    """Token embedding (reference: paddle.nn.Embedding). Lookup is a gather;
+    on TPU XLA lowers this to a dynamic-slice friendly form."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 padding_idx: Optional[int] = None, sparse: bool = False,
+                 weight_attr=None, name=None):
+        super().__init__(name)
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        init = weight_attr if isinstance(weight_attr, I.Initializer) else I.Normal(0.0, 1.0)
+        self.weight = Parameter(init(next_key(), (num_embeddings, embedding_dim)))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+
+    def extra_repr(self):
+        return f"{self.num_embeddings}, {self.embedding_dim}"
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__(name)
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        return F.dropout(x, self.p, training=True, key=next_key(), mode=self.mode)
+
+
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__(name)
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        return F.dropout2d(x, self.p, training=True, key=next_key())
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        from ..tensor import flatten
+        return flatten(x, self.start_axis, self.stop_axis)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.mode, self.align_corners = mode, align_corners
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, self.mode,
+                             self.align_corners)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0):
+        super().__init__()
+        self.padding, self.mode, self.value = padding, mode, value
+
+    def forward(self, x):
+        from ..tensor import pad
+        return pad(x, self.padding, self.mode, self.value)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, self.axis, self.eps)

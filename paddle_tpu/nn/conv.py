@@ -1,0 +1,143 @@
+"""Convolution & pooling layers (reference: python/paddle/nn/layer/conv.py,
+pooling.py). NCHW API surface; lowering through lax.conv_general_dilated
+lets XLA choose TPU-optimal layouts (convs run on the MXU as implicit
+GEMMs)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..utils.rng import next_key
+from . import functional as F
+from . import initializer as I
+from .layer import Layer, Parameter
+
+
+def _ntuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride, padding,
+                 dilation, groups, bias_attr, ndim, weight_attr=None, name=None):
+        super().__init__(name)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _ntuple(kernel_size, ndim)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self._ndim = ndim
+        shape = (out_channels, in_channels // groups) + self.kernel_size
+        fan_in = (in_channels // groups) * math.prod(self.kernel_size)
+        init = weight_attr if isinstance(weight_attr, I.Initializer) else \
+            I.KaimingUniform(fan_in=fan_in)
+        self.weight = Parameter(init(next_key(), shape))
+        if bias_attr is not False:
+            bound = 1 / math.sqrt(fan_in)
+            self.bias = Parameter(I.Uniform(-bound, bound)(next_key(), (out_channels,)))
+        else:
+            self.bias = None
+
+    def extra_repr(self):
+        return (f"{self.in_channels}, {self.out_channels}, k={self.kernel_size}, "
+                f"s={self.stride}, p={self.padding}, g={self.groups}")
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, bias_attr, 1, weight_attr, name)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, getattr(self, "bias", None),
+                        self.stride, self.padding, self.dilation, self.groups)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, bias_attr, 2, weight_attr, name)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, getattr(self, "bias", None),
+                        self.stride, self.padding, self.dilation, self.groups)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, bias_attr, 3, weight_attr, name)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, getattr(self, "bias", None),
+                        self.stride, self.padding, self.dilation, self.groups)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__(name)
+        self.in_channels, self.out_channels = in_channels, out_channels
+        self.kernel_size = _ntuple(kernel_size, 2)
+        self.stride, self.padding = stride, padding
+        self.output_padding, self.dilation, self.groups = output_padding, dilation, groups
+        shape = (in_channels, out_channels // groups) + self.kernel_size
+        fan_in = in_channels * math.prod(self.kernel_size) // groups
+        init = weight_attr if isinstance(weight_attr, I.Initializer) else \
+            I.KaimingUniform(fan_in=fan_in)
+        self.weight = Parameter(init(next_key(), shape))
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros((out_channels,)))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.conv2d_transpose(x, self.weight, getattr(self, "bias", None),
+                                  self.stride, self.padding,
+                                  self.output_padding, self.dilation, self.groups)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, name=None):
+        super().__init__(name)
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, name=None):
+        super().__init__(name)
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__(name)
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__(name)
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)

@@ -1,0 +1,585 @@
+"""paddle.nn.functional parity (reference: python/paddle/nn/functional/*.py,
+PHI kernels paddle/phi/kernels/*). All pure jnp/lax; XLA fuses the
+elementwise chains into surrounding matmuls/convs on TPU. Data layout for
+conv/pool follows paddle's NCHW signature but lowers through
+`lax.conv_general_dilated` with explicit dimension_numbers so XLA picks the
+TPU-optimal internal layout.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# ------------------------------------------------------------- activations
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def prelu(x, weight):
+    return jnp.where(x >= 0, x, weight * x)
+
+
+def elu(x, alpha=1.0):
+    return jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha=1.0):
+    return jnp.maximum(x, 0) + jnp.minimum(0, alpha * jnp.expm1(x / alpha))
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3, 0, 6) / 6
+
+
+def hardsigmoid(x, slope=1 / 6, offset=0.5):
+    return jnp.clip(slope * x + offset, 0, 1)
+
+
+def hardtanh(x, min=-1.0, max=1.0):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0)
+
+
+def softshrink(x, threshold=0.5):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - threshold, 0)
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(beta * x > threshold, x, jnp.log1p(jnp.exp(beta * x)) / beta)
+
+
+def softsign(x):
+    return x / (1 + jnp.abs(x))
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def softmax(x, axis=-1, dtype=None):
+    out = jax.nn.softmax(x.astype(dtype) if dtype else x, axis=axis)
+    return out
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, key=None):
+    assert key is not None, "gumbel_softmax needs an explicit PRNG key"
+    g = jax.random.gumbel(key, x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis)
+        hard_y = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+        y = hard_y + y - lax.stop_gradient(y)  # straight-through estimator
+    return y
+
+
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def swiglu(x, gate=None):
+    """Fused SwiGLU (reference: PHI fused swiglu kernel). Single-arg form
+    splits the last dim."""
+    if gate is None:
+        x, gate = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * gate
+
+
+# ------------------------------------------------------------------- linear
+def linear(x, weight, bias=None):
+    """paddle stores Linear weight as [in, out] (note: torch is [out, in])."""
+    out = x @ weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def embedding(ids, weight, padding_idx=None, sparse=False):  # noqa: ARG001
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+# ------------------------------------------------------------------- norms
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)
+    out = (x32 - mean) * lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """RMSNorm with fp32 accumulation (PHI fused_rms_norm parity)."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    out = (x32 * lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5):
+    """NCHW batch norm. Returns (out, new_mean, new_var) when training."""
+    axes = (0,) + tuple(range(2, x.ndim))
+    if training:
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=axes)
+        var = jnp.var(x32, axis=axes)
+        new_mean = momentum * running_mean + (1 - momentum) * mean
+        new_var = momentum * running_var + (1 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    out = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if training:
+        return out, new_mean, new_var
+    return out
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5):
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    g = x.reshape(n, num_groups, c // num_groups, *spatial).astype(jnp.float32)
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    g = (g - mean) * lax.rsqrt(var + epsilon)
+    out = g.reshape(x.shape).astype(x.dtype)
+    shape = (1, c) + (1,) * len(spatial)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    return group_norm(x, num_groups=x.shape[1], weight=weight, bias=bias, epsilon=epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, epsilon)
+
+
+# ----------------------------------------------------------------- dropout
+def dropout(x, p=0.5, training=True, key=None, mode="upscale_in_train"):
+    if not training or p == 0.0:
+        return x
+    assert key is not None, "dropout in training mode needs an explicit PRNG key"
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0).astype(x.dtype)
+    return jnp.where(mask, x, 0).astype(x.dtype)
+
+
+def dropout2d(x, p=0.5, training=True, key=None):
+    if not training or p == 0.0:
+        return x
+    assert key is not None
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape[:2] + (1,) * (x.ndim - 2))
+    return jnp.where(mask, x / keep, 0).astype(x.dtype)
+
+
+# -------------------------------------------------------------------- conv
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _conv_dn(ndim):
+    # paddle NCHW / weight OIHW
+    spatial = "".join(chr(ord("D") + i) for i in range(ndim))  # D, E, ...
+    lhs = "NC" + spatial
+    rhs = "OI" + spatial
+    return lax.conv_dimension_numbers((1, 1) + (1,) * ndim, (1, 1) + (1,) * ndim,
+                                      (lhs, rhs, lhs))
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 2)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 1)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 3)
+
+
+def _convnd(x, weight, bias, stride, padding, dilation, groups, n):
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    if isinstance(padding, str):
+        pad = padding.upper()  # SAME / VALID
+    else:
+        p = _norm_tuple(padding, n)
+        pad = [(pi, pi) for pi in p]
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=_conv_dn(n),
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
+    out = out.astype(x.dtype)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    n = 2
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    p = _norm_tuple(padding, n)
+    op = _norm_tuple(output_padding, n)
+    # paddle weight layout for transpose conv: [in_c, out_c/groups, kh, kw]
+    k = weight.shape[2:]
+    pads = []
+    for i in range(n):
+        eff_k = (k[i] - 1) * dilation[i] + 1
+        lo = eff_k - 1 - p[i]
+        hi = eff_k - 1 - p[i] + op[i]
+        pads.append((lo, hi))
+    w = jnp.swapaxes(weight, 0, 1)  # -> [out_c/groups, in_c, kh, kw]
+    w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+    if groups > 1:
+        # grouped transpose conv: swap produces [out_c/groups, in_c, ...];
+        # rearrange to [out_c, in_c/groups, ...]
+        ic, ocg = weight.shape[0], weight.shape[1]
+        w = weight.reshape(groups, ic // groups, ocg, *k)
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * ocg, ic // groups, *k)
+        w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1,) * n, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        feature_group_count=groups, dimension_numbers=_conv_dn(n))
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+# ------------------------------------------------------------------ pooling
+def max_pool2d(x, kernel_size, stride=None, padding=0):
+    k = _norm_tuple(kernel_size, 2)
+    s = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    p = _norm_tuple(padding, 2)
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(x, neg, lax.max, (1, 1) + k, (1, 1) + s, pads)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True):
+    k = _norm_tuple(kernel_size, 2)
+    s = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    p = _norm_tuple(padding, 2)
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    summed = lax.reduce_window(x, 0.0, lax.add, (1, 1) + k, (1, 1) + s, pads)
+    if exclusive and any(p):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, (1, 1) + k, (1, 1) + s, pads)
+        return summed / counts
+    return summed / math.prod(k)
+
+
+def adaptive_avg_pool2d(x, output_size):
+    out = _norm_tuple(output_size, 2)
+    n, c, h, w = x.shape
+    assert h % out[0] == 0 and w % out[1] == 0, "adaptive pool needs divisible sizes (static-shape TPU path)"
+    kh, kw = h // out[0], w // out[1]
+    return avg_pool2d(x, (kh, kw), (kh, kw))
+
+
+def adaptive_max_pool2d(x, output_size):
+    out = _norm_tuple(output_size, 2)
+    n, c, h, w = x.shape
+    assert h % out[0] == 0 and w % out[1] == 0
+    kh, kw = h // out[0], w // out[1]
+    return max_pool2d(x, (kh, kw), (kh, kw))
+
+
+def global_avg_pool2d(x):
+    return jnp.mean(x, axis=(2, 3), keepdims=True)
+
+
+# ------------------------------------------------------------ interpolation
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False):
+    n, c, h, w = x.shape
+    if size is None:
+        sf = _norm_tuple(scale_factor, 2)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    oh, ow = size
+    if not align_corners or mode == "nearest":
+        method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[mode]
+        return jax.image.resize(x, (n, c, oh, ow), method=method)
+    # align_corners=True: sample grid src = i*(h-1)/(oh-1) (corner pixels map
+    # exactly); jax.image.resize only does half-pixel, so gather explicitly.
+    if mode not in ("bilinear", "linear"):
+        raise NotImplementedError(f"align_corners=True with mode={mode!r}")
+    ys = jnp.linspace(0.0, h - 1, oh)
+    xs = jnp.linspace(0.0, w - 1, ow)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    from jax.scipy.ndimage import map_coordinates
+
+    def one(img):
+        return map_coordinates(img, [gy, gx], order=1)
+    return jax.vmap(jax.vmap(one))(x.astype(jnp.float32)).astype(x.dtype)
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor):
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+def unfold(x, kernel_size, stride=1, padding=0, dilation=1):
+    """im2col (paddle.nn.functional.unfold)."""
+    k = _norm_tuple(kernel_size, 2)
+    s = _norm_tuple(stride, 2)
+    p = _norm_tuple(padding, 2)
+    d = _norm_tuple(dilation, 2)
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+    oh = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+    ow = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+    patches = []
+    for i in range(k[0]):
+        for j in range(k[1]):
+            patch = x[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                      j * d[1]: j * d[1] + ow * s[1]: s[1]]
+            patches.append(patch)
+    out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+    return out.reshape(n, c * k[0] * k[1], oh * ow)
+
+
+# ------------------------------------------------------------------- losses
+def cross_entropy(logits, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  label_smoothing=0.0):
+    """paddle.nn.functional.cross_entropy parity (softmax+NLL fused).
+    Computes in fp32 regardless of input dtype (PHI kernel behavior)."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        target = label.astype(jnp.float32)
+        if label_smoothing > 0:
+            n = logits.shape[axis]
+            target = target * (1 - label_smoothing) + label_smoothing / n
+        loss = -jnp.sum(target * logp, axis=axis)
+        mask = None
+    else:
+        n = logits.shape[axis]
+        if label_smoothing > 0:
+            onehot = jax.nn.one_hot(label, n, axis=axis)
+            target = onehot * (1 - label_smoothing) + label_smoothing / n
+            loss = -jnp.sum(target * logp, axis=axis)
+        else:
+            loss = -jnp.take_along_axis(
+                logp, jnp.expand_dims(jnp.clip(label, 0, n - 1), axis), axis=axis
+            ).squeeze(axis)
+        mask = (label != ignore_index).astype(loss.dtype)
+        loss = loss * mask
+        if weight is not None:
+            w = jnp.take(weight, jnp.clip(label, 0, n - 1))
+            loss = loss * w
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if mask is not None:
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.sum(loss) / denom
+    return jnp.mean(loss)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1):
+    return cross_entropy(logits, label, soft_label=soft_label, axis=axis, reduction="none")
+
+
+def nll_loss(log_probs, label, weight=None, ignore_index=-100, reduction="mean"):
+    n = log_probs.shape[-1]
+    loss = -jnp.take_along_axis(log_probs, jnp.clip(label, 0, n - 1)[..., None], axis=-1).squeeze(-1)
+    mask = (label != ignore_index).astype(loss.dtype)
+    loss = loss * mask
+    if weight is not None:
+        loss = loss * jnp.take(weight, jnp.clip(label, 0, n - 1))
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def mse_loss(input, label, reduction="mean"):  # noqa: A002
+    loss = jnp.square(input - label)
+    return _reduce(loss, reduction)
+
+
+def l1_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):  # noqa: A002
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta, diff - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):  # noqa: A002
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps))
+             + (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None):
+    logit = logit.astype(jnp.float32)
+    neg_abs = -jnp.abs(logit)
+    loss = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(neg_abs))
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = loss * log_w
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean"):  # noqa: A002 (input is log-prob)
+    loss = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    return _reduce(loss, reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def _reduce(loss, reduction):
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return jnp.mean(loss)
+
+
+# --------------------------------------------------------------- attention
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, scale=None,
+                                 dropout_key=None):
+    """paddle.nn.functional.scaled_dot_product_attention parity.
+
+    Layout [batch, seq, heads, head_dim] (paddle convention). Dispatches to
+    the Pallas flash kernel on TPU for long sequences; falls back to the
+    XLA-fused reference path otherwise. fp32 softmax accumulation.
+    """
+    from ..ops.attention import dense_attention, flash_attention, use_flash
+    if use_flash(query, key, attn_mask, dropout_p):
+        return flash_attention(query, key, value, causal=is_causal, scale=scale)
+    return dense_attention(query, key, value, attn_mask=attn_mask,
+                           dropout_p=dropout_p, causal=is_causal, scale=scale,
+                           dropout_key=dropout_key)
+
+
+# ------------------------------------------------------------------ sparse
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def label_smooth(label, epsilon=0.1):
+    n = label.shape[-1]
+    return label * (1 - epsilon) + epsilon / n
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([x[:, 1:, :fold], jnp.zeros_like(x[:, :1, :fold])], axis=1)
+    right = jnp.concatenate([jnp.zeros_like(x[:, :1, fold:2 * fold]), x[:, :-1, fold:2 * fold]], axis=1)
+    rest = x[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)

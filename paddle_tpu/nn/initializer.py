@@ -1,0 +1,132 @@
+"""Weight initializers mirroring paddle.nn.initializer (reference:
+python/paddle/nn/initializer/*.py). Each initializer is a callable
+`init(key, shape, dtype) -> Array`, matching jax convention so they can be
+used inside jitted init functions too.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..dtypes import to_dtype
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels (paddle NCHW layout: [out_c, in_c, *spatial])
+    receptive = math.prod(shape[2:])
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype="float32"):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, key, shape, dtype="float32"):
+        return jnp.full(shape, self.value, dtype=to_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, key, shape, dtype="float32"):
+        dt = to_dtype(dtype)
+        return (self.mean + self.std * jax.random.normal(key, shape)).astype(dt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, key, shape, dtype="float32"):
+        dt = to_dtype(dtype)
+        x = jax.random.truncated_normal(key, self.a, self.b, shape)
+        return (self.mean + self.std * x).astype(dt)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, key, shape, dtype="float32"):
+        dt = to_dtype(dtype)
+        return jax.random.uniform(key, shape, minval=self.low, maxval=self.high).astype(dt)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, key, shape, dtype="float32"):
+        fan_in, fan_out = _fans(shape)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        return (std * jax.random.normal(key, shape)).astype(to_dtype(dtype))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, key, shape, dtype="float32"):
+        fan_in, fan_out = _fans(shape)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, minval=-limit, maxval=limit).astype(to_dtype(dtype))
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, key, shape, dtype="float32"):
+        fan_in = self.fan_in or _fans(shape)[0]
+        gain = math.sqrt(2.0 / (1 + self.slope ** 2)) if self.nonlinearity == "leaky_relu" else math.sqrt(2.0)
+        std = gain / math.sqrt(fan_in)
+        return (std * jax.random.normal(key, shape)).astype(to_dtype(dtype))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, key, shape, dtype="float32"):
+        fan_in = self.fan_in or _fans(shape)[0]
+        gain = math.sqrt(2.0 / (1 + self.slope ** 2)) if self.nonlinearity == "leaky_relu" else math.sqrt(2.0)
+        limit = gain * math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, minval=-limit, maxval=limit).astype(to_dtype(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, key, shape, dtype="float32"):
+        return (self.gain * jax.nn.initializers.orthogonal()(key, shape)).astype(to_dtype(dtype))
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, key, shape, dtype="float32"):
+        arr = jnp.asarray(self.value, dtype=to_dtype(dtype))
+        assert tuple(arr.shape) == tuple(shape), (arr.shape, shape)
+        return arr
+
+
+# paddle-style short aliases
+constant = Constant
+normal = Normal
+uniform = Uniform

@@ -1,0 +1,360 @@
+"""Module system: `Layer` mirrors paddle.nn.Layer's API (reference:
+python/paddle/nn/layer/layers.py) with a TPU-first execution model.
+
+Design
+------
+A Layer is a mutable tree of sublayers / parameters / buffers, exactly like
+paddle's. But instead of an eager autograd tape, training goes through the
+*functional bridge*: `layer.functional()` returns `(pure_fn, params)` where
+`pure_fn(params, *args)` temporarily binds `params` (a flat {name: Array}
+dict) into the tree and runs `forward`. Because binding happens during
+tracing, `jax.jit`/`jax.grad`/`shard_map` all compose with it — the layer
+tree itself never enters the jaxpr.
+
+Parameters are raw `jax.Array`s at use-sites (`self.weight` is an Array);
+metadata (trainable flag, sharding PartitionSpec) lives in `ParamMeta`
+side tables so the hot path stays pytree-clean.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class ParamMeta:
+    """Per-parameter metadata kept outside the pytree."""
+    trainable: bool = True
+    # logical dim names for GSPMD sharding, e.g. ("tp", None); resolved
+    # against the active Mesh by paddle_tpu.parallel.sharding.
+    partition: Optional[Tuple[Optional[str], ...]] = None
+    extras: dict = field(default_factory=dict)
+
+
+class Parameter:
+    """Declaration wrapper: assigning `Parameter(array)` to a Layer attribute
+    registers it as trainable state. Reading the attribute back yields the
+    raw Array (paddle code reads `self.weight` directly in forward)."""
+
+    __slots__ = ("value", "meta")
+
+    def __init__(self, value, trainable=True, partition=None):
+        self.value = jnp.asarray(value)
+        self.meta = ParamMeta(trainable=trainable, partition=partition)
+
+
+class Buffer:
+    """Non-trainable registered state (e.g. BatchNorm running stats)."""
+
+    __slots__ = ("value", "persistable")
+
+    def __init__(self, value, persistable=True):
+        self.value = jnp.asarray(value)
+        self.persistable = persistable
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None):
+        d = object.__setattr__
+        d(self, "_parameters", OrderedDict())   # name -> Array
+        d(self, "_param_meta", OrderedDict())   # name -> ParamMeta
+        d(self, "_buffers", OrderedDict())      # name -> Array
+        d(self, "_buffer_persist", OrderedDict())
+        d(self, "_sub_layers", OrderedDict())
+        d(self, "_forward_pre_hooks", OrderedDict())
+        d(self, "_forward_post_hooks", OrderedDict())
+        d(self, "training", True)
+        d(self, "_name_scope", name_scope or type(self).__name__)
+
+    # -------------------------------------------------------- attr routing
+    def __setattr__(self, name: str, value: Any) -> None:
+        if "_parameters" not in self.__dict__:  # before Layer.__init__
+            object.__setattr__(self, name, value)
+            return
+        if isinstance(value, Parameter):
+            self._parameters[name] = value.value
+            self._param_meta[name] = value.meta
+            self._buffers.pop(name, None)
+            self._sub_layers.pop(name, None)
+        elif isinstance(value, Buffer):
+            self._buffers[name] = value.value
+            self._buffer_persist[name] = value.persistable
+            self._parameters.pop(name, None)
+        elif isinstance(value, Layer):
+            self._sub_layers[name] = value
+            self._parameters.pop(name, None)
+        elif name in self._parameters:
+            if value is None:
+                del self._parameters[name]
+                del self._param_meta[name]
+            else:
+                self._parameters[name] = value  # rebind array (e.g. opt step)
+        elif name in self._buffers:
+            self._buffers[name] = value
+        elif name in self._sub_layers and not isinstance(value, Layer):
+            del self._sub_layers[name]
+            object.__setattr__(self, name, value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        # only called when normal lookup fails
+        for table in ("_parameters", "_buffers", "_sub_layers"):
+            t = self.__dict__.get(table)
+            if t is not None and name in t:
+                return t[name]
+        raise AttributeError(f"{type(self).__name__!r} has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for table in ("_parameters", "_buffers", "_sub_layers"):
+            t = self.__dict__.get(table)
+            if t is not None and name in t:
+                del t[name]
+                return
+        object.__delattr__(self, name)
+
+    # ---------------------------------------------------------- registration
+    def add_parameter(self, name: str, param) -> None:
+        if not isinstance(param, Parameter):
+            param = Parameter(param)
+        setattr(self, name, param)
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor, persistable: bool = True) -> None:
+        setattr(self, name, Buffer(tensor, persistable))
+
+    def create_parameter(self, shape, dtype="float32", default_initializer=None,
+                         is_bias=False, attr=None):  # noqa: ARG002 (paddle sig)
+        from .initializer import Constant, XavierNormal
+        from ..utils.rng import next_key
+        init = default_initializer or (Constant(0.0) if is_bias else XavierNormal())
+        value = init(next_key(), shape, dtype)
+        return Parameter(value)
+
+    # ------------------------------------------------------------- traversal
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            p = f"{prefix}.{name}" if prefix else name
+            yield p, sub
+            yield from sub.named_sublayers(prefix=p)
+
+    def sublayers(self, include_self: bool = False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_parameters(self, prefix: str = "") -> Iterator[Tuple[str, jax.Array]]:
+        for name, value in self._parameters.items():
+            yield (f"{prefix}.{name}" if prefix else name), value
+        for name, sub in self._sub_layers.items():
+            p = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_parameters(prefix=p)
+
+    def parameters(self):
+        return [v for _, v in self.named_parameters()]
+
+    def named_buffers(self, prefix: str = "", persistable_only: bool = False):
+        for name, value in self._buffers.items():
+            if persistable_only and not self._buffer_persist.get(name, True):
+                continue
+            yield (f"{prefix}.{name}" if prefix else name), value
+        for name, sub in self._sub_layers.items():
+            p = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_buffers(prefix=p, persistable_only=persistable_only)
+
+    def buffers(self):
+        return [v for _, v in self.named_buffers()]
+
+    def param_meta(self, prefix: str = "") -> Dict[str, ParamMeta]:
+        out = {}
+        for name, meta in self._param_meta.items():
+            out[f"{prefix}.{name}" if prefix else name] = meta
+        for name, sub in self._sub_layers.items():
+            p = f"{prefix}.{name}" if prefix else name
+            out.update(sub.param_meta(prefix=p))
+        return out
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for sub in self._sub_layers.values():
+            sub.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self, include_buffers: bool = True) -> "OrderedDict[str, jax.Array]":
+        out = OrderedDict(self.named_parameters())
+        if include_buffers:
+            out.update(self.named_buffers(persistable_only=True))
+        return out
+
+    def set_state_dict(self, state: Dict[str, Any], strict: bool = True):
+        own = self.state_dict()
+        missing = [k for k in own if k not in state]
+        unexpected = [k for k in state if k not in own]
+        if strict and (missing or unexpected):
+            raise KeyError(f"state_dict mismatch: missing={missing[:5]} unexpected={unexpected[:5]}")
+        for key, value in state.items():
+            if key in own:
+                self._set_by_path(key, jnp.asarray(value))
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    def _set_by_path(self, path: str, value) -> None:
+        parts = path.split(".")
+        layer = self
+        for p in parts[:-1]:
+            layer = layer._sub_layers[p]
+        leaf = parts[-1]
+        if leaf in layer._parameters:
+            layer._parameters[leaf] = value
+        elif leaf in layer._buffers:
+            layer._buffers[leaf] = value
+        else:
+            raise KeyError(path)
+
+    def _get_by_path(self, path: str):
+        parts = path.split(".")
+        layer = self
+        for p in parts[:-1]:
+            layer = layer._sub_layers[p]
+        leaf = parts[-1]
+        if leaf in layer._parameters:
+            return layer._parameters[leaf]
+        return layer._buffers[leaf]
+
+    # ------------------------------------------------------------ train/eval
+    def train(self):
+        def set_train(l):
+            object.__setattr__(l, "training", True)
+        return self.apply(set_train)
+
+    def eval(self):
+        def set_eval(l):
+            object.__setattr__(l, "training", False)
+        return self.apply(set_eval)
+
+    def stop_gradient_(self, value: bool = True):
+        """Mark every parameter in the subtree (non-)trainable."""
+        def set_tr(l):
+            for m in l._param_meta.values():
+                m.trainable = not value
+        return self.apply(set_tr)
+
+    # -------------------------------------------------------------- forward
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError(f"{type(self).__name__}.forward not implemented")
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, args)
+            if out is not None:
+                args = out if isinstance(out, tuple) else (out,)
+        result = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, args, result)
+            if out is not None:
+                result = out
+        return result
+
+    def register_forward_pre_hook(self, hook):
+        key = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[key] = hook
+        return key
+
+    def register_forward_post_hook(self, hook):
+        key = len(self._forward_post_hooks)
+        self._forward_post_hooks[key] = hook
+        return key
+
+    # ------------------------------------------------------ functional bridge
+    def bind(self, flat: Dict[str, jax.Array]) -> None:
+        """Write a flat {dotted_name: Array} dict into the tree in place."""
+        for key, value in flat.items():
+            self._set_by_path(key, value)
+
+    @contextlib.contextmanager
+    def bound(self, flat: Dict[str, jax.Array]):
+        saved = {k: self._get_by_path(k) for k in flat}
+        self.bind(flat)
+        try:
+            yield self
+        finally:
+            self.bind(saved)
+
+    def functional(self, with_buffers: bool = False):
+        """Return `(pure_fn, params)`.
+
+        `pure_fn(params, *args, **kwargs)` runs forward with `params` bound.
+        If `with_buffers`, params also carries persistable buffers (needed
+        when buffers are updated functionally, e.g. BatchNorm momentum —
+        then pure_fn returns `(out, new_buffers)`).
+        """
+        params = OrderedDict(self.named_parameters())
+        if not with_buffers:
+            def pure_fn(p, *args, **kwargs):
+                with self.bound(p):
+                    return self(*args, **kwargs)
+            return pure_fn, params
+
+        buffers = OrderedDict(self.named_buffers(persistable_only=True))
+
+        def pure_fn_b(p, b, *args, **kwargs):
+            merged = {**p, **b}
+            with self.bound(merged):
+                out = self(*args, **kwargs)
+                new_b = OrderedDict(self.named_buffers(persistable_only=True))
+            return out, new_b
+        return pure_fn_b, (params, buffers)
+
+    def trainable_parameters(self) -> "OrderedDict[str, jax.Array]":
+        meta = self.param_meta()
+        return OrderedDict((k, v) for k, v in self.named_parameters()
+                           if meta[k].trainable)
+
+    # ---------------------------------------------------------------- extras
+    def to(self, dtype=None, device=None):
+        from ..dtypes import to_dtype
+        dt = to_dtype(dtype)
+        def cast(l):
+            for k, v in l._parameters.items():
+                if dt is not None and jnp.issubdtype(v.dtype, jnp.floating):
+                    l._parameters[k] = v.astype(dt)
+            for k, v in l._buffers.items():
+                if dt is not None and jnp.issubdtype(v.dtype, jnp.floating):
+                    l._buffers[k] = v.astype(dt)
+        self.apply(cast)
+        if device is not None:
+            self.apply(lambda l: None)  # single logical device under jit; no-op
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def children(self):
+        return iter(self._sub_layers.values())
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        lines = [f"{type(self).__name__}({self.extra_repr()}"]
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {sub_repr}")
+        return "\n".join(lines) + ")" if len(lines) > 1 else lines[0] + ")"

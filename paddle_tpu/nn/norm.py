@@ -1,0 +1,131 @@
+"""Normalization layers (reference: python/paddle/nn/layer/norm.py).
+All norms accumulate in fp32 (PHI kernel behavior) and cast back to the
+input dtype — required for bf16 training stability on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import functional as F
+from .layer import Buffer, Layer, Parameter
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__(name)
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = Parameter(jnp.ones(self.normalized_shape))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros(self.normalized_shape))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape,
+                            getattr(self, "weight", None),
+                            getattr(self, "bias", None), self.epsilon)
+
+    def extra_repr(self):
+        return f"{self.normalized_shape}, eps={self.epsilon}"
+
+
+class RMSNorm(Layer):
+    """Root-mean-square norm (reference: PHI rms_norm fused kernel; used by
+    Llama/Qwen families)."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, name=None):
+        super().__init__(name)
+        self.hidden_size = hidden_size
+        self.epsilon = epsilon
+        self.weight = Parameter(jnp.ones((hidden_size,)))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+    def extra_repr(self):
+        return f"{self.hidden_size}, eps={self.epsilon}"
+
+
+class BatchNorm2D(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__(name)
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = Parameter(jnp.ones((num_features,)))
+        self.bias = Parameter(jnp.zeros((num_features,)))
+        self.register_buffer("_mean", jnp.zeros((num_features,)))
+        self.register_buffer("_variance", jnp.ones((num_features,)))
+
+    def forward(self, x):
+        if self.training:
+            out, new_mean, new_var = F.batch_norm(
+                x, self._mean, self._variance, self.weight, self.bias,
+                training=True, momentum=self.momentum, epsilon=self.epsilon)
+            # functional buffer update: rebinds the arrays; under the
+            # functional bridge with_buffers=True these flow out as state
+            self._mean = new_mean
+            self._variance = new_var
+            return out
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=False, epsilon=self.epsilon)
+
+    def extra_repr(self):
+        return f"{self.num_features}"
+
+
+BatchNorm1D = BatchNorm2D  # same math; shape handled by F.batch_norm axes
+BatchNorm3D = BatchNorm2D
+BatchNorm = BatchNorm2D
+
+
+class SyncBatchNorm(BatchNorm2D):
+    """On TPU, batch stats are computed over the global (sharded) batch by
+    construction under GSPMD — jnp.mean over a dp-sharded axis lowers to a
+    cross-replica reduction. So SyncBatchNorm == BatchNorm here (reference:
+    paddle.nn.SyncBatchNorm requires explicit NCCL allreduce)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__(name)
+        self.num_groups = num_groups
+        self.num_channels = num_channels
+        self.epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = Parameter(jnp.ones((num_channels,)))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros((num_channels,)))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, getattr(self, "weight", None),
+                            getattr(self, "bias", None), self.epsilon)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, name=None):
+        super().__init__(name)
+        self.num_features = num_features
+        self.epsilon = epsilon
+        self.weight = Parameter(jnp.ones((num_features,)))
+        self.bias = Parameter(jnp.zeros((num_features,)))
+
+    def forward(self, x):
+        return F.instance_norm(x, self.weight, self.bias, self.epsilon)

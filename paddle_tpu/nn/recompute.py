@@ -1,0 +1,44 @@
+"""Activation recompute (reference: paddle.distributed.fleet.utils.recompute,
+python/paddle/distributed/fleet/recompute/recompute.py).
+
+TPU-native: `jax.checkpoint` (rematerialization) — XLA re-executes the
+forward inside the backward instead of saving activations, trading FLOPs
+for HBM. Policies map paddle's selective-recompute lists onto jax's
+checkpoint_policies (e.g. keep matmul outputs = dots_saveable).
+"""
+from __future__ import annotations
+
+import jax
+
+POLICIES = {
+    "full": None,  # save nothing extra, recompute everything
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def recompute(function, *args, policy=None, **kwargs):
+    """paddle-style call-site recompute: runs `function(*args)` under
+    jax.checkpoint. Unlike paddle there is no RNG-state juggling: dropout
+    keys are explicit so replaying the forward is deterministic by
+    construction."""
+    pol = POLICIES.get(policy, policy) if isinstance(policy, str) else policy
+    fn = jax.checkpoint(function, policy=pol)
+    return fn(*args, **kwargs)
+
+
+def checkpoint_wrapper(layer_or_fn, policy=None):
+    """Wrap a Layer (or fn) so every call is rematerialized."""
+    pol = POLICIES.get(policy, policy) if isinstance(policy, str) else policy
+    if callable(layer_or_fn) and not hasattr(layer_or_fn, "forward"):
+        return jax.checkpoint(layer_or_fn, policy=pol)
+
+    layer = layer_or_fn
+    orig_forward = layer.forward
+
+    def wrapped(*args, **kwargs):
+        return jax.checkpoint(orig_forward, policy=pol)(*args, **kwargs)
+    object.__setattr__(layer, "forward", wrapped)
+    return layer

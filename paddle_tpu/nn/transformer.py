@@ -1,0 +1,187 @@
+"""Transformer layers (reference: python/paddle/nn/layer/transformer.py).
+MultiHeadAttention keeps paddle's [batch, seq, heads, dim] internal layout
+and dispatches through F.scaled_dot_product_attention → Pallas flash kernel
+on TPU."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..utils.rng import next_key
+from . import functional as F
+from .common import Dropout, Linear
+from .container import LayerList
+from .layer import Layer
+from .norm import LayerNorm
+
+
+class MultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
+                 need_weights=False, weight_attr=None, bias_attr=None, name=None):
+        super().__init__(name)
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim
+        self.dropout = dropout
+        self.need_weights = need_weights
+        kdim = kdim or embed_dim
+        vdim = vdim or embed_dim
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(kdim, embed_dim, weight_attr, bias_attr)
+        self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        key = query if key is None else key
+        value = query if value is None else value
+        b, sq, _ = query.shape
+        q = self.q_proj(query).reshape(b, sq, self.num_heads, self.head_dim)
+        k = self.k_proj(key).reshape(b, key.shape[1], self.num_heads, self.head_dim)
+        v = self.v_proj(value).reshape(b, value.shape[1], self.num_heads, self.head_dim)
+        if cache is not None:
+            k = jnp.concatenate([cache[0], k], axis=1)
+            v = jnp.concatenate([cache[1], v], axis=1)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.dropout if self.training else 0.0,
+            dropout_key=next_key() if (self.training and self.dropout > 0) else None)
+        out = out.reshape(b, sq, self.embed_dim)
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, (k, v)
+        return out
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, name=None):
+        super().__init__(name)
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead,
+                                            dropout=attn_dropout if attn_dropout is not None else dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.act_dropout = Dropout(act_dropout if act_dropout is not None else dropout)
+        self.activation = getattr(F, activation)
+
+    def forward(self, src, src_mask=None):
+        residual = src
+        x = self.norm1(src) if self.normalize_before else src
+        x = self.self_attn(x, attn_mask=src_mask)
+        x = residual + self.dropout1(x)
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = self.linear2(self.act_dropout(self.activation(self.linear1(y))))
+        y = residual + self.dropout2(y)
+        if not self.normalize_before:
+            y = self.norm2(y)
+        return y
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer_fn, num_layers, norm=None):
+        super().__init__()
+        if isinstance(encoder_layer_fn, Layer):
+            import copy
+            layers = [encoder_layer_fn] + [copy.deepcopy(encoder_layer_fn)
+                                           for _ in range(num_layers - 1)]
+        else:
+            layers = [encoder_layer_fn() for _ in range(num_layers)]
+        self.layers = LayerList(layers)
+        self.norm = norm
+
+    def forward(self, src, src_mask=None):
+        x = src
+        for layer in self.layers:
+            x = layer(x, src_mask=src_mask)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x
+
+
+class TransformerDecoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", normalize_before=False, name=None):
+        super().__init__(name)
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=dropout)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(dropout)
+        self.activation = getattr(F, activation)
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+        residual = tgt
+        x = self.norm1(tgt) if self.normalize_before else tgt
+        x = residual + self.dropout1(self.self_attn(x, attn_mask=tgt_mask))
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = residual + self.dropout2(self.cross_attn(y, memory, memory, attn_mask=memory_mask))
+        if not self.normalize_before:
+            y = self.norm2(y)
+        residual = y
+        z = self.norm3(y) if self.normalize_before else y
+        z = residual + self.dropout3(self.linear2(self.activation(self.linear1(z))))
+        if not self.normalize_before:
+            z = self.norm3(z)
+        return z
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, decoder_layer_fn, num_layers, norm=None):
+        super().__init__()
+        if isinstance(decoder_layer_fn, Layer):
+            import copy
+            layers = [decoder_layer_fn] + [copy.deepcopy(decoder_layer_fn)
+                                           for _ in range(num_layers - 1)]
+        else:
+            layers = [decoder_layer_fn() for _ in range(num_layers)]
+        self.layers = LayerList(layers)
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+        x = tgt
+        for layer in self.layers:
+            x = layer(x, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x
+
+
+class Transformer(Layer):
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", normalize_before=False):
+        super().__init__()
+        self.encoder = TransformerEncoder(
+            lambda: TransformerEncoderLayer(d_model, nhead, dim_feedforward,
+                                            dropout, activation,
+                                            normalize_before=normalize_before),
+            num_encoder_layers, LayerNorm(d_model) if normalize_before else None)
+        self.decoder = TransformerDecoder(
+            lambda: TransformerDecoderLayer(d_model, nhead, dim_feedforward,
+                                            dropout, activation, normalize_before),
+            num_decoder_layers, LayerNorm(d_model) if normalize_before else None)
+        self.d_model = d_model
+        self.nhead = nhead
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None, memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)

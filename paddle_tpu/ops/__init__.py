@@ -1,0 +1,5 @@
+"""paddle_tpu.ops — kernel library (reference: paddle/phi/kernels).
+jnp/lax lowerings live in the functional modules; Pallas TPU kernels in
+ops/pallas/."""
+from . import attention
+from .attention import flash_attention, naive_attention

@@ -1,0 +1,82 @@
+"""Attention kernels (reference: PHI fused attention kernels,
+paddle/phi/kernels/fusion/*flash_attn*). TPU path: a Pallas flash-attention
+kernel (online softmax, blocked over KV) used when shapes tile cleanly onto
+the MXU; otherwise an XLA-fused dense path.
+
+The Pallas kernel lands in `paddle_tpu/ops/pallas/flash_attention.py`;
+this module is the dispatch layer.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def _platform() -> str:
+    try:
+        return jax.devices()[0].platform
+    except Exception:  # pragma: no cover
+        return "cpu"
+
+
+@functools.lru_cache(maxsize=1)
+def _flash_enabled() -> bool:
+    if os.environ.get("PADDLE_TPU_DISABLE_FLASH"):
+        return False
+    return _platform() == "tpu"
+
+
+def use_flash(query, key, attn_mask, dropout_p) -> bool:
+    if not _flash_enabled() or attn_mask is not None or dropout_p > 0.0:
+        return False
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    # kernel tiles: seq multiples of 128, head_dim in {64, 128, 256}
+    return sq % 128 == 0 and sk % 128 == 0 and d in (64, 128, 256)
+
+
+def flash_attention(query, key, value, causal=False, scale=None):
+    """[b, s, h, d] flash attention; grouped-query aware."""
+    from .pallas.flash_attention import flash_attention_bshd
+    return flash_attention_bshd(query, key, value, causal=causal, scale=scale)
+
+
+def dense_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                    causal=False, scale=None, dropout_key=None):
+    """XLA-fused dense path, [b, s, h, d]; fp32 softmax; GQA-aware.
+    Single source of truth for the non-flash math (nn.functional's
+    scaled_dot_product_attention fallback routes here)."""
+    b, sq, h, d = query.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    q = jnp.swapaxes(query, 1, 2)
+    k = jnp.swapaxes(key, 1, 2)
+    v = jnp.swapaxes(value, 1, 2)
+    if k.shape[1] != h:
+        rep = h // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        sk = k.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, -jnp.inf)
+        else:
+            scores = scores + attn_mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1).astype(query.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = 1.0 - dropout_p
+        dmask = jax.random.bernoulli(dropout_key, keep, probs.shape)
+        probs = jnp.where(dmask, probs / keep, 0).astype(probs.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def naive_attention(query, key, value, causal=False, scale=None):
+    return dense_attention(query, key, value, causal=causal, scale=scale)

@@ -1,0 +1,177 @@
+"""Pallas TPU flash attention (reference: PHI flash_attn kernels,
+paddle/phi/kernels/gpu/flash_attn_kernel.cu — reimagined for TPU).
+
+Online-softmax blocked attention: grid = (batch*heads, q_blocks, kv_blocks)
+with the KV dimension innermost so the fp32 accumulator scratch carries
+across KV steps of one Q block. GQA is handled in the K/V index maps (no
+materialized head repeat). Causal blocks strictly above the diagonal are
+predicated off with @pl.when (their DMA still lands, compute is skipped).
+
+Backward: flash-style recompute via custom_vjp — the forward saves only
+(q, k, v, out, logsumexp); the backward recomputes probabilities blockwise.
+Round 1 uses a blocked-jnp backward (XLA-fused, fp32); a dedicated Pallas
+backward kernel is tracked for a later round.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
+                scale, causal, block_q, block_k, kv_blocks, causal_offset):
+    """causal_offset = sk - sq: bottom-right-aligned causal mask (matches
+    the naive path and the backward), so query i attends keys <= i+offset."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    run = True
+    if causal:
+        # block [qi] attends kv blocks whose start <= last query's diag pos
+        run = ki * block_k <= (qi + 1) * block_q - 1 + causal_offset
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, :, :]
+        k = k_ref[0, :, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_ids = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + qi * block_q
+            k_ids = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + ki * block_k
+            s = jnp.where(q_ids + causal_offset >= k_ids, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, :, :], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == kv_blocks - 1)
+    def _finalize():
+        safe_l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, :, :] = (acc[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, :, :] = jnp.broadcast_to(
+            m_scr[:, :1] + jnp.log(safe_l), (acc.shape[0], 128))
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    """q: [bh, sq, d]; k/v: [bh_kv, sk, d] with bh % bh_kv == 0."""
+    bh, sq, d = q.shape
+    bh_kv, sk, _ = k.shape
+    group = bh // bh_kv
+    q_blocks = sq // block_q
+    kv_blocks = sk // block_k
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, kv_blocks=kv_blocks, causal_offset=sk - sq)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, q_blocks, kv_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse[:, :, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    bh, sq, d = q.shape
+    bh_kv, sk, _ = k.shape
+    group = bh // bh_kv
+    kr = jnp.repeat(k, group, axis=0) if group > 1 else k
+    vr = jnp.repeat(v, group, axis=0) if group > 1 else v
+
+    qf = q.astype(jnp.float32)
+    kf = kr.astype(jnp.float32)
+    vf = vr.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    # p_ij = exp(q·k * scale - lse_i) — exact probabilities from saved lse
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse[:, :, None])
+    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+    dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    if group > 1:
+        dk = dk.reshape(bh_kv, group, sk, d).sum(axis=1)
+        dv = dv.reshape(bh_kv, group, sk, d).sum(axis=1)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention_bshd(query, key, value, causal=False, scale=None,
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Flash attention on [batch, seq, heads, head_dim] (paddle layout)."""
+    b, sq, h, d = query.shape
+    _, sk, hk, _ = key.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    q = jnp.swapaxes(query, 1, 2).reshape(b * h, sq, d)
+    k = jnp.swapaxes(key, 1, 2).reshape(b * hk, sk, d)
+    v = jnp.swapaxes(value, 1, 2).reshape(b * hk, sk, d)
+    out = _flash(q, k, v, scale, causal, block_q, block_k)
+    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)

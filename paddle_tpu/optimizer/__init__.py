@@ -1,0 +1,6 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/__init__.py)."""
+from . import lr
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+                   global_norm)
+from .optimizers import (SGD, Adafactor, Adagrad, Adam, AdamW, Lamb, Momentum,
+                         Optimizer, RMSProp)

@@ -1,0 +1,53 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py). Pure pytree
+transforms; ClipGradByGlobalNorm matches fleet's hybrid-parallel semantics
+under GSPMD automatically (the norm reduction spans all shards because the
+arrays are globally addressed)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class GradClipBase:
+    def __call__(self, grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(GradClipBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = max
+        self.min = min if min is not None else -max
+
+    def __call__(self, grads):
+        return jax.tree.map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(GradClipBase):
+    """Per-tensor norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        def clip(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g * scale).astype(g.dtype)
+        return jax.tree.map(clip, grads)
+
+
+class ClipGradByGlobalNorm(GradClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        leaves = jax.tree.leaves(grads)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                             for g in leaves))
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+        return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+
+
+def global_norm(grads):
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in jax.tree.leaves(grads)))

@@ -1,0 +1,245 @@
+"""LR schedulers (reference: python/paddle/optimizer/lr.py). Each scheduler
+is `sched(step) -> lr` in pure jnp so it traces into the jitted train step
+(no host round-trip per step). The stateful paddle API (`.step()`,
+`.get_lr()`) is layered on top for parity.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def __init__(self, learning_rate=0.1, last_epoch=-1):
+        self.base_lr = learning_rate
+        self.last_epoch = last_epoch
+        self.step()  # paddle semantics: init advances to epoch 0
+
+    # functional core — override this
+    def value_at(self, step):
+        return jnp.asarray(self.base_lr, dtype=jnp.float32)
+
+    # stateful facade
+    def step(self, epoch=None):
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+
+    def get_lr(self):
+        return float(self.value_at(jnp.asarray(max(self.last_epoch, 0))))
+
+    def __call__(self, step):
+        return self.value_at(step)
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch}
+
+    def set_state_dict(self, state):
+        self.last_epoch = state["last_epoch"]
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model, warmup_steps, learning_rate=1.0, last_epoch=-1):
+        self.d_model, self.warmup_steps = d_model, warmup_steps
+        super().__init__(learning_rate, last_epoch)
+
+    def value_at(self, step):
+        s = jnp.maximum(step.astype(jnp.float32) if hasattr(step, "astype") else jnp.float32(step), 1.0)
+        return self.base_lr * self.d_model ** -0.5 * jnp.minimum(
+            s ** -0.5, s * self.warmup_steps ** -1.5)
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries, values, last_epoch=-1):
+        self.boundaries = jnp.asarray(boundaries)
+        self.values = jnp.asarray(values, dtype=jnp.float32)
+        super().__init__(float(values[0]), last_epoch)
+
+    def value_at(self, step):
+        idx = jnp.searchsorted(self.boundaries, step, side="right")
+        return self.values[idx]
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch)
+
+    def value_at(self, step):
+        return self.base_lr * jnp.power(self.gamma, step)
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch)
+
+    def value_at(self, step):
+        return self.base_lr * jnp.exp(-self.gamma * step)
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch)
+
+    def value_at(self, step):
+        return self.base_lr / (1 + self.gamma * step)
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate, decay_steps, end_lr=0.0001, power=1.0,
+                 cycle=False, last_epoch=-1):
+        self.decay_steps, self.end_lr, self.power, self.cycle = \
+            decay_steps, end_lr, power, cycle
+        super().__init__(learning_rate, last_epoch)
+
+    def value_at(self, step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        if self.cycle:
+            decay_steps = self.decay_steps * jnp.maximum(
+                jnp.ceil(step / self.decay_steps), 1.0)
+        else:
+            decay_steps = self.decay_steps
+            step = jnp.minimum(step, decay_steps)
+        frac = (1 - step / decay_steps) ** self.power
+        return (self.base_lr - self.end_lr) * frac + self.end_lr
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, learning_rate, warmup_steps, start_lr=0.0, end_lr=None,
+                 last_epoch=-1):
+        self.inner = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr if end_lr is not None else (
+            self.inner.base_lr if self.inner else float(learning_rate))
+        base = self.inner.base_lr if self.inner else float(learning_rate)
+        super().__init__(base, last_epoch)
+
+    def value_at(self, step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * jnp.minimum(
+            step / max(self.warmup_steps, 1), 1.0)
+        if self.inner is not None:
+            after = self.inner.value_at(jnp.maximum(step - self.warmup_steps, 0))
+        else:
+            after = jnp.float32(self.end_lr)
+        return jnp.where(step < self.warmup_steps, warm, after)
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate, T_max, eta_min=0.0, last_epoch=-1):
+        self.T_max, self.eta_min = T_max, eta_min
+        super().__init__(learning_rate, last_epoch)
+
+    def value_at(self, step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        cos = jnp.cos(math.pi * jnp.minimum(step, self.T_max) / self.T_max)
+        return self.eta_min + (self.base_lr - self.eta_min) * (1 + cos) / 2
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    def __init__(self, learning_rate, T_0, T_mult=1, eta_min=0.0, last_epoch=-1):
+        self.T_0, self.T_mult, self.eta_min = T_0, T_mult, eta_min
+        super().__init__(learning_rate, last_epoch)
+
+    def value_at(self, step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        if self.T_mult == 1:
+            t_cur = jnp.mod(step, self.T_0)
+            t_i = self.T_0
+        else:
+            n = jnp.floor(jnp.log1p(step * (self.T_mult - 1) / self.T_0)
+                          / math.log(self.T_mult))
+            start = self.T_0 * (jnp.power(self.T_mult, n) - 1) / (self.T_mult - 1)
+            t_cur = step - start
+            t_i = self.T_0 * jnp.power(self.T_mult, n)
+        cos = jnp.cos(math.pi * t_cur / t_i)
+        return self.eta_min + (self.base_lr - self.eta_min) * (1 + cos) / 2
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1):
+        self.step_size, self.gamma = step_size, gamma
+        super().__init__(learning_rate, last_epoch)
+
+    def value_at(self, step):
+        return self.base_lr * jnp.power(self.gamma, jnp.floor_divide(step, self.step_size))
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1, last_epoch=-1):
+        self.milestones = jnp.asarray(milestones)
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch)
+
+    def value_at(self, step):
+        count = jnp.sum(self.milestones <= step)
+        return self.base_lr * jnp.power(self.gamma, count)
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch)
+
+    def value_at(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class OneCycleLR(LRScheduler):
+    def __init__(self, max_learning_rate, total_steps, divide_factor=25.0,
+                 end_learning_rate=1e-4, phase_pct=0.3, last_epoch=-1):
+        self.total_steps = total_steps
+        self.phase_pct = phase_pct
+        self.initial_lr = max_learning_rate / divide_factor
+        self.end_lr = end_learning_rate
+        super().__init__(max_learning_rate, last_epoch)
+
+    def value_at(self, step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        up_steps = self.phase_pct * self.total_steps
+        down_steps = self.total_steps - up_steps
+        up = self.initial_lr + (self.base_lr - self.initial_lr) * (
+            1 - jnp.cos(math.pi * jnp.minimum(step, up_steps) / up_steps)) / 2
+        t = jnp.clip((step - up_steps) / down_steps, 0, 1)
+        down = self.end_lr + (self.base_lr - self.end_lr) * (1 + jnp.cos(math.pi * t)) / 2
+        return jnp.where(step < up_steps, up, down)
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Metric-driven (host-side) schedule — inherently stateful; value_at
+    returns the current factor-scaled lr."""
+
+    def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
+                 threshold=1e-4, cooldown=0, min_lr=0.0, last_epoch=-1):
+        self.mode, self.factor, self.patience = mode, factor, patience
+        self.threshold, self.cooldown, self.min_lr = threshold, cooldown, min_lr
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_left = 0
+        self.current = learning_rate
+        super().__init__(learning_rate, last_epoch)
+
+    def value_at(self, step):
+        return jnp.float32(self.current)
+
+    def step(self, metrics=None, epoch=None):
+        self.last_epoch += 1
+        if metrics is None:
+            return
+        m = float(metrics)
+        better = (self.best is None or
+                  (self.mode == "min" and m < self.best - self.threshold) or
+                  (self.mode == "max" and m > self.best + self.threshold))
+        if better:
+            self.best = m
+            self.num_bad = 0
+        elif self.cooldown_left > 0:
+            self.cooldown_left -= 1
+        else:
+            self.num_bad += 1
+            if self.num_bad > self.patience:
+                self.current = max(self.current * self.factor, self.min_lr)
+                self.cooldown_left = self.cooldown
+                self.num_bad = 0

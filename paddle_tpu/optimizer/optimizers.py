@@ -1,0 +1,400 @@
+"""Optimizers (reference: python/paddle/optimizer/*.py — adamw.py, adam.py,
+momentum.py, lamb.py...).
+
+Design: each optimizer is a *functional* update rule
+    state = opt.init(params)
+    new_params, new_state = opt.apply(params, grads, state, step)
+operating on pytrees (dicts of Arrays), jit/shard_map safe; optimizer
+state inherits the sharding of its parameter (so ZeRO-style sharded
+optimizer state falls out of fsdp param sharding for free).
+
+The stateful paddle facade (`opt.step()` after grads are computed) is
+provided by `Optimizer.step(layer, grads)` which rebinds the layer's
+parameter arrays in place — used for eager experimentation; the Trainer
+uses the functional core.
+
+Master weights: when `multi_precision=True` (AMP O2), params may be bf16;
+the state keeps an fp32 master copy and casts down after each update.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from .clip import GradClipBase
+from .lr import LRScheduler
+
+
+def _lr_value(lr, step):
+    if isinstance(lr, LRScheduler):
+        return lr.value_at(step)
+    return jnp.asarray(lr, dtype=jnp.float32)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=0.0,
+                 grad_clip: Optional[GradClipBase] = None, multi_precision=False,
+                 name=None):
+        self._lr = learning_rate
+        self.weight_decay = weight_decay or 0.0
+        self.grad_clip = grad_clip
+        self.multi_precision = multi_precision
+        self._layer = None
+        self._step_count = 0
+        self._state = None
+        if parameters is not None and hasattr(parameters, "named_parameters"):
+            self._layer = parameters
+
+    # ---- functional core -------------------------------------------------
+    def init(self, params) -> Dict[str, Any]:
+        slots = jax.tree.map(self._init_slot, params)
+        if self.multi_precision:
+            master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+            return {"slots": slots, "master": master}
+        return {"slots": slots}
+
+    def apply(self, params, grads, state, step):
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+        lr = _lr_value(self._lr, step)
+        master = state.get("master")
+        work = master if master is not None else params
+        new_work, new_slots = self._update(work, grads, state["slots"], lr, step)
+        if master is not None:
+            new_params = jax.tree.map(lambda w, p: w.astype(p.dtype), new_work, params)
+            return new_params, {"slots": new_slots, "master": new_work}
+        return new_work, {"slots": new_slots}
+
+    def _init_slot(self, p):
+        raise NotImplementedError
+
+    def _update(self, params, grads, slots, lr, step):
+        raise NotImplementedError
+
+    # ---- stateful paddle facade -----------------------------------------
+    def step(self, grads=None, layer=None):
+        layer = layer or self._layer
+        assert layer is not None, "pass parameters=layer at construction or layer= here"
+        params = dict(layer.trainable_parameters())
+        if self._state is None:
+            self._state = self.init(params)
+        assert grads is not None, (
+            "functional autograd: compute grads with paddle_tpu.grad and pass them in")
+        grads = {k: grads[k] for k in params}
+        new_params, self._state = self.apply(params, grads, self._state,
+                                             jnp.asarray(self._step_count))
+        layer.bind(new_params)
+        self._step_count += 1
+
+    def clear_grad(self):  # gradient-free world: parity no-op
+        pass
+
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr.get_lr()
+        return float(self._lr)
+
+    def set_lr(self, lr):
+        self._lr = lr
+
+    def state_dict(self):
+        return {"state": self._state, "step": self._step_count}
+
+    def set_state_dict(self, sd):
+        self._state = sd["state"]
+        self._step_count = int(sd["step"])
+
+    # weight-decay helper: paddle applies decay only to params not in
+    # no_weight_decay lists; callers can pass a mask
+    def _decay(self, p, g, lr):
+        return g
+
+
+class SGD(Optimizer):
+    def _init_slot(self, p):
+        return ()
+
+    def _update(self, params, grads, slots, lr, step):
+        def upd(p, g):
+            if self.weight_decay:
+                g = g + self.weight_decay * p
+            return (p - lr * g).astype(p.dtype)
+        return jax.tree.map(upd, params, grads), slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=0.0, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def _init_slot(self, p):
+        return jnp.zeros_like(p, dtype=jnp.float32)
+
+    def _update(self, params, grads, slots, lr, step):
+        def upd(p, g, v):
+            g = g.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            v_new = self.momentum * v + g
+            if self.use_nesterov:
+                delta = g + self.momentum * v_new
+            else:
+                delta = v_new
+            return (p - lr * delta).astype(p.dtype), v_new
+        out = jax.tree.map(upd, params, grads, slots)
+        new_params = jax.tree.map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_slots = jax.tree.map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, new_slots
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.0,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None, apply_decay_param_fun=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.apply_decay_param_fun = apply_decay_param_fun
+        self._decoupled = False  # Adam: L2 reg in the gradient
+
+    def _init_slot(self, p):
+        return {"m": jnp.zeros_like(p, dtype=jnp.float32),
+                "v": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def _update(self, params, grads, slots, lr, step):
+        t = step.astype(jnp.float32) + 1.0
+        bc1 = 1.0 - self.beta1 ** t
+        bc2 = 1.0 - self.beta2 ** t
+
+        def upd(path, p, g, s):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            name = ".".join(str(p) for p in path)  # paddle passes the param name
+            decay_this = self.weight_decay and (
+                self.apply_decay_param_fun is None or self.apply_decay_param_fun(name))
+            if decay_this and not self._decoupled:
+                g = g + self.weight_decay * p32
+            m = self.beta1 * s["m"] + (1 - self.beta1) * g
+            v = self.beta2 * s["v"] + (1 - self.beta2) * jnp.square(g)
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + self.epsilon)
+            if decay_this and self._decoupled:
+                update = update + self.weight_decay * p32
+            return (p32 - lr * update).astype(p.dtype), {"m": m, "v": v}
+
+        flat_p = _flatten_with_path(params)
+        new_p, new_s = {}, {}
+        for path, p in flat_p.items():
+            np_, ns_ = upd(path, p, _get_path(grads, path), _get_path(slots, path))
+            _set_path(new_p, path, np_)
+            _set_path(new_s, path, ns_)
+        return _like(params, new_p), _like(slots, new_s)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, multi_precision=False, lr_ratio=None,
+                 apply_decay_param_fun=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, False, multi_precision, name,
+                         apply_decay_param_fun)
+        self._decoupled = True
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=0.0, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def _init_slot(self, p):
+        return jnp.full_like(p, self.initial_accumulator_value, dtype=jnp.float32)
+
+    def _update(self, params, grads, slots, lr, step):
+        def upd(p, g, acc):
+            g = g.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            acc_new = acc + jnp.square(g)
+            return (p - lr * g / (jnp.sqrt(acc_new) + self.epsilon)).astype(p.dtype), acc_new
+        out = jax.tree.map(upd, params, grads, slots)
+        return (jax.tree.map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple)),
+                jax.tree.map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple)))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=0.0, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.rho, self.epsilon, self.momentum, self.centered = rho, epsilon, momentum, centered
+
+    def _init_slot(self, p):
+        s = {"ms": jnp.zeros_like(p, dtype=jnp.float32),
+             "mom": jnp.zeros_like(p, dtype=jnp.float32)}
+        if self.centered:
+            s["mg"] = jnp.zeros_like(p, dtype=jnp.float32)
+        return s
+
+    def _update(self, params, grads, slots, lr, step):
+        def upd(p, g, s):
+            g = g.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            ms = self.rho * s["ms"] + (1 - self.rho) * jnp.square(g)
+            if self.centered:
+                mg = self.rho * s["mg"] + (1 - self.rho) * g
+                denom = jnp.sqrt(ms - jnp.square(mg) + self.epsilon)
+                new_s = {"ms": ms, "mg": mg}
+            else:
+                denom = jnp.sqrt(ms + self.epsilon)
+                new_s = {"ms": ms}
+            mom = self.momentum * s["mom"] + lr * g / denom
+            new_s["mom"] = mom
+            return (p - mom).astype(p.dtype), new_s
+        flat_p = _flatten_with_path(params)
+        new_p, new_s = {}, {}
+        for path, p in flat_p.items():
+            np_, ns_ = upd(p, _get_path(grads, path), _get_path(slots, path))
+            _set_path(new_p, path, np_)
+            _set_path(new_s, path, ns_)
+        return _like(params, new_p), _like(slots, new_s)
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference: python/paddle/optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip,
+                         multi_precision, name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slot(self, p):
+        return {"m": jnp.zeros_like(p, dtype=jnp.float32),
+                "v": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def _update(self, params, grads, slots, lr, step):
+        t = step.astype(jnp.float32) + 1.0
+        bc1 = 1.0 - self.beta1 ** t
+        bc2 = 1.0 - self.beta2 ** t
+        flat_p = _flatten_with_path(params)
+        new_p, new_s = {}, {}
+        for path, p in flat_p.items():
+            g = _get_path(grads, path).astype(jnp.float32)
+            s = _get_path(slots, path)
+            p32 = p.astype(jnp.float32)
+            m = self.beta1 * s["m"] + (1 - self.beta1) * g
+            v = self.beta2 * s["v"] + (1 - self.beta2) * jnp.square(g)
+            r = (m / bc1) / (jnp.sqrt(v / bc2) + self.epsilon)
+            name = ".".join(str(p) for p in path)
+            if self.weight_decay and not (self.exclude_fn and self.exclude_fn(name)):
+                r = r + self.weight_decay * p32
+            w_norm = jnp.linalg.norm(p32)
+            r_norm = jnp.linalg.norm(r)
+            trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+            _set_path(new_p, path, (p32 - lr * trust * r).astype(p.dtype))
+            _set_path(new_s, path, {"m": m, "v": v})
+        return _like(params, new_p), _like(slots, new_s)
+
+
+class Adafactor(Optimizer):
+    """Memory-factored optimizer for very large models (PaddleNLP uses this
+    for some recipes); row/col second-moment factorization."""
+
+    def __init__(self, learning_rate=0.001, beta1=None, decay_rate=0.8,
+                 epsilon1=1e-30, epsilon2=1e-3, clip_threshold=1.0,
+                 parameters=None, weight_decay=0.0, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.beta1 = beta1
+        self.decay_rate = decay_rate
+        self.eps1, self.eps2 = epsilon1, epsilon2
+        self.clip_threshold = clip_threshold
+
+    def _init_slot(self, p):
+        s = {}
+        if p.ndim >= 2:
+            s["vr"] = jnp.zeros(p.shape[:-1], dtype=jnp.float32)
+            s["vc"] = jnp.zeros(p.shape[:-2] + p.shape[-1:], dtype=jnp.float32)
+        else:
+            s["v"] = jnp.zeros_like(p, dtype=jnp.float32)
+        if self.beta1 is not None:
+            s["m"] = jnp.zeros_like(p, dtype=jnp.float32)
+        return s
+
+    def _update(self, params, grads, slots, lr, step):
+        t = step.astype(jnp.float32) + 1.0
+        rho = 1.0 - jnp.power(t, -self.decay_rate)
+        flat_p = _flatten_with_path(params)
+        new_p, new_s = {}, {}
+        for path, p in flat_p.items():
+            g = _get_path(grads, path).astype(jnp.float32)
+            s = dict(_get_path(slots, path))
+            g2 = jnp.square(g) + self.eps1
+            if p.ndim >= 2:
+                vr = rho * s["vr"] + (1 - rho) * jnp.mean(g2, axis=-1)
+                vc = rho * s["vc"] + (1 - rho) * jnp.mean(g2, axis=-2)
+                s["vr"], s["vc"] = vr, vc
+                denom = (vr / jnp.mean(vr, axis=-1, keepdims=True))[..., None] * vc[..., None, :]
+                update = g * jax.lax.rsqrt(denom + self.eps1)
+            else:
+                v = rho * s["v"] + (1 - rho) * g2
+                s["v"] = v
+                update = g * jax.lax.rsqrt(v + self.eps1)
+            rms = jnp.sqrt(jnp.mean(jnp.square(update)))
+            update = update / jnp.maximum(1.0, rms / self.clip_threshold)
+            if self.beta1 is not None:
+                m = self.beta1 * s["m"] + (1 - self.beta1) * update
+                s["m"] = m
+                update = m
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay:
+                update = update + self.weight_decay * p32
+            scaled_lr = lr * jnp.maximum(self.eps2, jnp.sqrt(jnp.mean(jnp.square(p32))))
+            _set_path(new_p, path, (p32 - scaled_lr * update).astype(p.dtype))
+            _set_path(new_s, path, s)
+        return _like(params, new_p), _like(slots, new_s)
+
+
+# --------------------------------------------------------- pytree helpers
+def _flatten_with_path(tree, prefix=()):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_with_path(v, prefix + (k,)))
+    else:
+        out[prefix] = tree
+    return out
+
+
+def _get_path(tree, path):
+    for p in path:
+        tree = tree[p]
+    return tree
+
+
+def _set_path(tree, path, value):
+    for p in path[:-1]:
+        tree = tree.setdefault(p, {})
+    tree[path[-1]] = value
+
+
+def _like(ref, flat_nested):
+    """Return flat_nested but with ref's dict class (e.g. OrderedDict)."""
+    if isinstance(ref, dict):
+        cls = type(ref)
+        return cls((k, _like(ref[k], flat_nested[k])) for k in ref)
+    return flat_nested

@@ -1,0 +1,714 @@
+"""Tensor creation and manipulation API mirroring paddle's tensor surface.
+
+Reference parity: paddle/tensor/{creation,manipulation,math,linalg,search,
+logic,stat}.py. Design divergence (TPU-first): a paddle_tpu "Tensor" *is* a
+`jax.Array` — there is no wrapper class. All functions here are pure and
+jit-traceable; autograd is functional (`paddle_tpu.grad` == `jax.grad`)
+rather than tape-based `.backward()`, which does not map to XLA's
+compile-once execution model.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .dtypes import to_dtype
+
+Tensor = jax.Array
+
+
+# ---------------------------------------------------------------- creation
+def to_tensor(data, dtype=None, stop_gradient=True):  # noqa: ARG001 (paddle sig)
+    return jnp.asarray(data, dtype=to_dtype(dtype))
+
+
+def zeros(shape, dtype="float32"):
+    return jnp.zeros(shape, dtype=to_dtype(dtype))
+
+
+def ones(shape, dtype="float32"):
+    return jnp.ones(shape, dtype=to_dtype(dtype))
+
+
+def full(shape, fill_value, dtype="float32"):
+    return jnp.full(shape, fill_value, dtype=to_dtype(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=to_dtype(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=to_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=to_dtype(dtype))
+
+
+def arange(start, end=None, step=1, dtype=None):
+    return jnp.arange(start, end, step, dtype=to_dtype(dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=to_dtype(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return jnp.eye(num_rows, num_columns, dtype=to_dtype(dtype))
+
+
+def empty(shape, dtype="float32"):
+    return jnp.zeros(shape, dtype=to_dtype(dtype))
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, diagonal)
+
+
+def diag(x, offset=0):
+    return jnp.diag(x, offset)
+
+
+def meshgrid(*args, **kwargs):
+    return jnp.meshgrid(*args, indexing=kwargs.get("indexing", "ij"))
+
+
+def clone(x):
+    return jnp.asarray(x).copy()
+
+
+def numpy(x):
+    return np.asarray(x)
+
+
+# ------------------------------------------------------------ manipulation
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def concat(xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def stack(xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    # paddle passes section sizes; jnp.split wants cut indices
+    sizes = list(num_or_sections)
+    if -1 in sizes:
+        known = builtins.sum(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = x.shape[axis] - known
+    cuts = np.cumsum(sizes)[:-1].tolist()
+    return jnp.split(x, cuts, axis=axis)
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+def unsqueeze(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def expand(x, shape):
+    # -1 keeps the existing dim; dims align from the right (paddle/broadcast
+    # semantics), so a leading -1 with ndim growth is invalid
+    shape = list(shape)
+    offset = len(shape) - x.ndim
+    out = []
+    for i, s in enumerate(shape):
+        if s == -1:
+            if i < offset:
+                raise ValueError("expand: -1 not allowed for a new leading dim")
+            out.append(x.shape[i - offset])
+        else:
+            out.append(s)
+    return jnp.broadcast_to(x, out)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    ndim = x.ndim
+    if ndim == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % ndim
+    stop = stop_axis % ndim
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, shape)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def put_along_axis(x, indices, values, axis):
+    return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+
+
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def slice(x, axes, starts, ends):  # noqa: A001 (paddle name)
+    out = x
+    for ax, s, e in zip(axes, starts, ends):
+        out = lax.slice_in_dim(out, s, builtins.min(e, out.shape[ax]), axis=ax)
+    return out
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def cast(x, dtype):
+    return x.astype(to_dtype(dtype))
+
+
+def astype(x, dtype):
+    return x.astype(to_dtype(dtype))
+
+
+def masked_select(x, mask):
+    return x[mask]
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return jnp.where(condition)
+    return jnp.where(condition, x, y)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def unbind(x, axis=0):
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def pad(x, pad_width, mode="constant", value=0.0):
+    if isinstance(pad_width, (list, tuple)) and pad_width and isinstance(pad_width[0], int):
+        # paddle flat format [l0, r0, l1, r1, ...] over trailing dims
+        pairs = [(pad_width[i], pad_width[i + 1]) for i in range(0, len(pad_width), 2)]
+        lead = [(0, 0)] * (x.ndim - len(pairs))
+        pad_width = lead + pairs
+    if mode == "constant":
+        return jnp.pad(x, pad_width, mode=mode, constant_values=value)
+    return jnp.pad(x, pad_width, mode=mode)
+
+
+# ------------------------------------------------------------------- math
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def einsum(eq, *operands):
+    return jnp.einsum(eq, *operands)
+
+
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+
+
+def pow(x, y):  # noqa: A001
+    return jnp.power(x, y)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def abs(x):  # noqa: A001
+    return jnp.abs(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def erf(x):
+    return lax.erf(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def round(x):  # noqa: A001
+    return jnp.round(x)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def clip(x, min=None, max=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):  # noqa: A002
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=to_dtype(dtype))
+
+
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=to_dtype(dtype))
+
+
+def logcumsumexp(x, axis=None):
+    return lax.cumlogsumexp(x, axis=axis if axis is not None else 0)
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+# -------------------------------------------------------------- reduction
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    return jnp.sum(x, axis=axis, dtype=to_dtype(dtype), keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=to_dtype(dtype))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+# ----------------------------------------------------------------- search
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmax(x, axis=axis, keepdims=keepdim).astype(to_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmin(x, axis=axis, keepdims=keepdim).astype(to_dtype(dtype))
+
+
+def argsort(x, axis=-1, descending=False):
+    idx = jnp.argsort(x, axis=axis, descending=descending)
+    return idx
+
+
+def sort(x, axis=-1, descending=False):
+    return jnp.sort(x, axis=axis, descending=descending)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+        v, i = topk(x, k, -1, largest, sorted)
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+    if largest:
+        return lax.top_k(x, k)
+    v, i = lax.top_k(-x, k)
+    return -v, i
+
+
+def kthvalue(x, k, axis=-1):
+    vals = jnp.sort(x, axis=axis)
+    v = jnp.take(vals, k - 1, axis=axis)
+    i = jnp.take(jnp.argsort(x, axis=axis), k - 1, axis=axis)
+    return v, i
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False):
+    return jnp.unique(x, return_index=return_index, return_inverse=return_inverse,
+                      return_counts=return_counts)
+
+
+def nonzero(x, as_tuple=False):
+    res = jnp.nonzero(x)
+    if as_tuple:
+        return res
+    return jnp.stack(res, axis=-1)
+
+
+def searchsorted(sorted_sequence, values, right=False):
+    return jnp.searchsorted(sorted_sequence, values, side="right" if right else "left")
+
+
+def bucketize(x, sorted_sequence, right=False):
+    return jnp.searchsorted(sorted_sequence, x, side="right" if right else "left")
+
+
+# ------------------------------------------------------------------ logic
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+# ----------------------------------------------------------------- linalg
+class linalg:
+    norm = staticmethod(jnp.linalg.norm)
+    inv = staticmethod(jnp.linalg.inv)
+    det = staticmethod(jnp.linalg.det)
+    svd = staticmethod(jnp.linalg.svd)
+    qr = staticmethod(jnp.linalg.qr)
+    eigh = staticmethod(jnp.linalg.eigh)
+    cholesky = staticmethod(jnp.linalg.cholesky)
+    solve = staticmethod(jnp.linalg.solve)
+    matrix_rank = staticmethod(jnp.linalg.matrix_rank)
+    pinv = staticmethod(jnp.linalg.pinv)
+
+
+def norm(x, p=2, axis=None, keepdim=False):
+    if p == "fro" or (p == 2 and axis is None):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p)
+
+
+def histogram(x, bins=100, min=0, max=0):  # noqa: A002
+    rng = None if min == 0 and max == 0 else (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=rng)
+    return hist
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def numel(x):
+    return x.size
+
+
+def shape(x):
+    return list(x.shape)

@@ -1,0 +1,3 @@
+"""paddle_tpu.utils."""
+from . import rng
+from .rng import fold_axis, next_key, rng_state, seed

@@ -1,0 +1,78 @@
+"""Seed control mirroring paddle.seed / paddle.framework.random (reference:
+python/paddle/framework/random.py) plus the model-parallel RNG state
+(reference: fleet.meta_parallel RNGStatesTracker).
+
+JAX RNG is explicit-key; this module provides the global stateful facade the
+paddle API expects, while everything inside jit receives keys explicitly.
+
+Model-parallel semantics: dropout inside tensor-parallel regions must use
+*different* streams per tp rank (activations are sharded) while weight init
+and data-order use the *same* stream everywhere. `rng_state(name)` scopes a
+named stream; "global" is replicated, "local" is folded with the process
+index.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _ensure():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.key(0)
+        _state.streams = {}
+        _state.stack = []
+
+
+def seed(s: int):
+    """paddle.seed equivalent: reset the global generator."""
+    _ensure()
+    _state.key = jax.random.key(int(s))
+    _state.streams = {}
+    return s
+
+
+def get_rng_state():
+    _ensure()
+    return {"key": _state.key, "streams": dict(_state.streams)}
+
+
+def set_rng_state(state):
+    _ensure()
+    _state.key = state["key"]
+    _state.streams = dict(state["streams"])
+
+
+def next_key(n: int = 0):
+    """Split a fresh key off the active stream (host-side, eager only)."""
+    _ensure()
+    name = _state.stack[-1] if _state.stack else None
+    if name is None:
+        _state.key, sub = jax.random.split(_state.key)
+        return sub
+    stream = _state.streams.setdefault(name, jax.random.fold_in(_state.key, hash(name) % (2**31)))
+    new, sub = jax.random.split(stream)
+    _state.streams[name] = new
+    return sub
+
+
+@contextlib.contextmanager
+def rng_state(name: str):
+    """Scope a named RNG stream (model-parallel tracker parity)."""
+    _ensure()
+    _state.stack.append(name)
+    try:
+        yield
+    finally:
+        _state.stack.pop()
+
+
+def fold_axis(key, axis_name: str):
+    """Inside shard_map/pjit: decorrelate a key across a mesh axis (for
+    dropout on sharded activations)."""
+    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))

@@ -1,0 +1,24 @@
+"""Test config: force an 8-virtual-device CPU platform so mesh/sharding
+tests run without TPU hardware (SURVEY.md §4)."""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+# The axon sitecustomize force-selects the TPU backend via jax.config, so a
+# plain JAX_PLATFORMS env var is not enough here.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as pt
+    pt.seed(0)
+    np.random.seed(0)
+    yield

@@ -1,0 +1,208 @@
+"""Layer numerics + module-system semantics (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def test_linear_numerics():
+    lin = nn.Linear(4, 3)
+    x = np.random.randn(2, 4).astype(np.float32)
+    w = pt.numpy(lin.weight)
+    b = pt.numpy(lin.bias)
+    out = pt.numpy(lin(pt.to_tensor(x)))
+    assert np.allclose(out, x @ w + b, atol=1e-5)
+
+
+def test_layernorm_matches_formula():
+    ln = nn.LayerNorm(8)
+    x = np.random.randn(2, 5, 8).astype(np.float32)
+    out = pt.numpy(ln(pt.to_tensor(x)))
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    want = (x - mean) / np.sqrt(var + 1e-5)
+    assert np.allclose(out, want, atol=1e-4)
+
+
+def test_rmsnorm():
+    rn = nn.RMSNorm(8)
+    x = np.random.randn(2, 8).astype(np.float32)
+    out = pt.numpy(rn(pt.to_tensor(x)))
+    want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    assert np.allclose(out, want, atol=1e-4)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = np.random.randn(4, 3, 5, 5).astype(np.float32) * 2 + 1
+    bn.train()
+    out = pt.numpy(bn(pt.to_tensor(x)))
+    assert abs(out.mean()) < 1e-4 and abs(out.std() - 1) < 1e-2
+    # running stats moved toward batch stats
+    assert not np.allclose(pt.numpy(bn._mean), 0)
+    bn.eval()
+    out_eval = bn(pt.to_tensor(x))
+    assert out_eval.shape == x.shape
+
+
+def test_conv2d_matches_torch():
+    torch = pytest.importorskip("torch")
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = np.random.randn(2, 3, 9, 9).astype(np.float32)
+    got = pt.numpy(conv(pt.to_tensor(x)))
+    with torch.no_grad():
+        want = torch.nn.functional.conv2d(
+            torch.from_numpy(x), torch.from_numpy(pt.numpy(conv.weight)),
+            torch.from_numpy(pt.numpy(conv.bias)), stride=2, padding=1).numpy()
+    assert np.allclose(got, want, atol=1e-4)
+
+
+def test_conv2d_grouped_and_dilated():
+    torch = pytest.importorskip("torch")
+    conv = nn.Conv2D(4, 8, 3, padding=2, dilation=2, groups=2)
+    x = np.random.randn(1, 4, 8, 8).astype(np.float32)
+    got = pt.numpy(conv(pt.to_tensor(x)))
+    with torch.no_grad():
+        want = torch.nn.functional.conv2d(
+            torch.from_numpy(x), torch.from_numpy(pt.numpy(conv.weight)),
+            torch.from_numpy(pt.numpy(conv.bias)), padding=2, dilation=2,
+            groups=2).numpy()
+    assert np.allclose(got, want, atol=1e-4)
+
+
+def test_conv_transpose_matches_torch():
+    torch = pytest.importorskip("torch")
+    conv = nn.Conv2DTranspose(3, 6, 4, stride=2, padding=1)
+    x = np.random.randn(2, 3, 5, 5).astype(np.float32)
+    got = pt.numpy(conv(pt.to_tensor(x)))
+    with torch.no_grad():
+        want = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(pt.numpy(conv.weight)),
+            torch.from_numpy(pt.numpy(conv.bias)), stride=2, padding=1).numpy()
+    assert got.shape == want.shape
+    assert np.allclose(got, want, atol=1e-4)
+
+
+def test_pooling():
+    x = np.random.randn(1, 2, 8, 8).astype(np.float32)
+    out = pt.numpy(F.max_pool2d(pt.to_tensor(x), 2))
+    assert out.shape == (1, 2, 4, 4)
+    assert np.allclose(out[0, 0, 0, 0], x[0, 0, :2, :2].max())
+    avg = pt.numpy(F.avg_pool2d(pt.to_tensor(x), 2))
+    assert np.allclose(avg[0, 0, 0, 0], x[0, 0, :2, :2].mean(), atol=1e-6)
+
+
+def test_cross_entropy_matches_torch():
+    torch = pytest.importorskip("torch")
+    logits = np.random.randn(8, 10).astype(np.float32)
+    labels = np.random.randint(0, 10, (8,))
+    got = float(F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(labels)))
+    want = float(torch.nn.functional.cross_entropy(
+        torch.from_numpy(logits), torch.from_numpy(labels)))
+    assert abs(got - want) < 1e-5
+
+
+def test_cross_entropy_ignore_index_and_smoothing():
+    torch = pytest.importorskip("torch")
+    logits = np.random.randn(8, 10).astype(np.float32)
+    labels = np.random.randint(0, 10, (8,))
+    labels[0] = -100
+    got = float(F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(labels),
+                                label_smoothing=0.1))
+    want = float(torch.nn.functional.cross_entropy(
+        torch.from_numpy(logits), torch.from_numpy(labels),
+        ignore_index=-100, label_smoothing=0.1))
+    assert abs(got - want) < 1e-4
+
+
+def test_attention_matches_reference():
+    q = np.random.randn(2, 16, 4, 8).astype(np.float32)
+    k = np.random.randn(2, 16, 4, 8).astype(np.float32)
+    v = np.random.randn(2, 16, 4, 8).astype(np.float32)
+    out = F.scaled_dot_product_attention(
+        pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v), is_causal=True)
+    # manual reference
+    scale = 1 / np.sqrt(8)
+    qt, kt, vt = [a.transpose(0, 2, 1, 3) for a in (q, k, v)]
+    s = np.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    mask = np.tril(np.ones((16, 16), dtype=bool))
+    s = np.where(mask, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, vt).transpose(0, 2, 1, 3)
+    assert np.allclose(pt.numpy(out), want, atol=1e-4)
+
+
+def test_gqa_attention():
+    q = np.random.randn(2, 8, 8, 16).astype(np.float32)
+    kv = np.random.randn(2, 8, 2, 16).astype(np.float32)
+    out = F.scaled_dot_product_attention(pt.to_tensor(q), pt.to_tensor(kv),
+                                         pt.to_tensor(kv))
+    assert out.shape == (2, 8, 8, 16)
+
+
+def test_state_dict_roundtrip():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = model.state_dict()
+    assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+    model2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model2.set_state_dict(sd)
+    x = pt.ones((1, 4))
+    assert np.allclose(pt.numpy(model(x)), pt.numpy(model2(x)))
+
+
+def test_train_eval_dropout():
+    d = nn.Dropout(0.5)
+    x = pt.ones((100,))
+    d.eval()
+    assert np.allclose(pt.numpy(d(x)), 1.0)
+    d.train()
+    out = pt.numpy(d(x))
+    assert (out == 0).any() and (out > 1).any()
+
+
+def test_sublayer_traversal_and_apply():
+    model = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+    names = [n for n, _ in model.named_parameters()]
+    assert "1.0.weight" in names
+    count = []
+    model.apply(lambda l: count.append(type(l).__name__))
+    assert "Linear" in count and "Sequential" in count
+
+
+def test_transformer_encoder_forward():
+    enc = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32,
+                                     dropout=0.0)
+    x = pt.to_tensor(np.random.randn(2, 5, 16).astype(np.float32))
+    out = enc(x)
+    assert out.shape == (2, 5, 16)
+
+
+def test_mha_cache():
+    mha = nn.MultiHeadAttention(16, 4)
+    mha.eval()
+    x = pt.to_tensor(np.random.randn(1, 3, 16).astype(np.float32))
+    k0 = pt.zeros((1, 0, 4, 4))
+    out, (k, v) = mha(x, cache=(k0, k0))
+    assert k.shape == (1, 3, 4, 4)
+
+
+def test_recompute_matches_plain():
+    lin = nn.Linear(8, 8)
+    x = pt.to_tensor(np.random.randn(2, 8).astype(np.float32))
+
+    def loss_plain(p):
+        with lin.bound(p):
+            return pt.sum(lin(x) ** 2)
+
+    def loss_remat(p):
+        with lin.bound(p):
+            return pt.sum(nn.recompute(lambda v: lin(v) ** 2, x))
+
+    params = dict(lin.named_parameters())
+    g1 = pt.grad(loss_plain)(params)
+    g2 = pt.grad(loss_remat)(params)
+    for k in g1:
+        assert np.allclose(pt.numpy(g1[k]), pt.numpy(g2[k]), atol=1e-5)

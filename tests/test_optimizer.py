@@ -1,0 +1,155 @@
+"""Optimizer/update-rule numerics vs torch (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer as opt
+from paddle_tpu.optimizer import lr as lr_mod
+
+
+def _quadratic_problem():
+    """min ||w - 3||^2 from w=6; every optimizer should converge toward 3.
+    (Start away from zero: Lamb's trust ratio scales steps by ||w||.)"""
+    w0 = {"w": pt.to_tensor(np.full(4, 6.0, dtype=np.float32))}
+
+    def loss_fn(p):
+        return pt.sum((p["w"] - 3.0) ** 2)
+    return w0, loss_fn
+
+
+@pytest.mark.parametrize("o", [
+    opt.SGD(learning_rate=0.1),
+    opt.Momentum(learning_rate=0.05, momentum=0.9),
+    opt.Adam(learning_rate=0.3),
+    opt.AdamW(learning_rate=0.3, weight_decay=0.0),
+    opt.Adagrad(learning_rate=1.0),
+    opt.RMSProp(learning_rate=0.05),
+    opt.Lamb(learning_rate=0.05, lamb_weight_decay=0.0),
+    opt.Adafactor(learning_rate=0.5),
+])
+def test_optimizers_converge(o):
+    params, loss_fn = _quadratic_problem()
+    state = o.init(params)
+    for step in range(60):
+        g = pt.grad(loss_fn)(params)
+        params, state = o.apply(params, g, state, pt.to_tensor(step))
+    assert float(loss_fn(params)) < 0.3, type(o).__name__
+
+
+def test_adamw_matches_torch():
+    torch = pytest.importorskip("torch")
+    w = np.random.randn(5, 3).astype(np.float32)
+    g = np.random.randn(5, 3).astype(np.float32)
+
+    tw = torch.nn.Parameter(torch.from_numpy(w.copy()))
+    topt = torch.optim.AdamW([tw], lr=0.01, betas=(0.9, 0.999), eps=1e-8,
+                             weight_decay=0.01)
+    o = opt.AdamW(learning_rate=0.01, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                  weight_decay=0.01)
+    params = {"w": pt.to_tensor(w.copy())}
+    state = o.init(params)
+    for step in range(5):
+        tw.grad = torch.from_numpy(g)
+        topt.step()
+        params, state = o.apply(params, {"w": pt.to_tensor(g)}, state,
+                                pt.to_tensor(step))
+    assert np.allclose(pt.numpy(params["w"]), tw.detach().numpy(), atol=1e-5)
+
+
+def test_momentum_matches_torch():
+    torch = pytest.importorskip("torch")
+    w = np.random.randn(4).astype(np.float32)
+    g = np.random.randn(4).astype(np.float32)
+    tw = torch.nn.Parameter(torch.from_numpy(w.copy()))
+    topt = torch.optim.SGD([tw], lr=0.1, momentum=0.9)
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9)
+    params = {"w": pt.to_tensor(w.copy())}
+    state = o.init(params)
+    for step in range(4):
+        tw.grad = torch.from_numpy(g)
+        topt.step()
+        params, state = o.apply(params, {"w": pt.to_tensor(g)}, state,
+                                pt.to_tensor(step))
+    assert np.allclose(pt.numpy(params["w"]), tw.detach().numpy(), atol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    clip = opt.ClipGradByGlobalNorm(1.0)
+    g = {"a": pt.to_tensor(np.full(4, 10.0, np.float32)),
+         "b": pt.to_tensor(np.full(4, 10.0, np.float32))}
+    clipped = clip(g)
+    norm = float(opt.global_norm(clipped))
+    assert abs(norm - 1.0) < 1e-5
+
+
+def test_stateful_step_api():
+    lin = nn.Linear(4, 2)
+    o = opt.SGD(learning_rate=0.1, parameters=lin)
+    x = pt.ones((3, 4))
+    pure, params = lin.functional()
+
+    def loss_fn(p):
+        return pt.mean(pure(p, x) ** 2)
+    before = float(loss_fn(dict(lin.named_parameters())))
+    for _ in range(20):
+        g = pt.grad(loss_fn)(dict(lin.named_parameters()))
+        o.step(grads=g)
+    after = float(loss_fn(dict(lin.named_parameters())))
+    assert after < before * 0.5
+
+
+def test_lr_schedules():
+    warm = lr_mod.LinearWarmup(
+        lr_mod.CosineAnnealingDecay(1.0, T_max=100), warmup_steps=10)
+    v0 = float(warm.value_at(0))
+    v10 = float(warm.value_at(10))
+    v110 = float(warm.value_at(110))
+    assert v0 < 0.2 and abs(v10 - 1.0) < 1e-5 and v110 < 0.05
+
+    step = lr_mod.StepDecay(0.1, step_size=10, gamma=0.5)
+    assert abs(float(step.value_at(25)) - 0.025) < 1e-6
+
+    noam = lr_mod.NoamDecay(d_model=64, warmup_steps=100)
+    assert float(noam.value_at(50)) < float(noam.value_at(100)) + 1e-6
+
+    poly = lr_mod.PolynomialDecay(0.1, decay_steps=100, end_lr=0.0)
+    assert abs(float(poly.value_at(50)) - 0.05) < 1e-6
+
+
+def test_multi_precision_master_weights():
+    o = opt.AdamW(learning_rate=0.1, multi_precision=True)
+    params = {"w": pt.to_tensor(np.ones(4), dtype="bfloat16")}
+    state = o.init(params)
+    assert state["master"]["w"].dtype == pt.float32
+    g = {"w": pt.to_tensor(np.full(4, 0.001), dtype="bfloat16")}
+    p2, s2 = o.apply(params, g, state, pt.to_tensor(0))
+    assert p2["w"].dtype == pt.bfloat16
+    # master keeps fp32 precision of the tiny update
+    assert not np.allclose(pt.numpy(s2["master"]["w"]), 1.0)
+
+
+def test_jitted_train_step():
+    """The full step (grad+clip+update) must be one traced program."""
+    import jax
+    lin = nn.Linear(8, 8)
+    o = opt.AdamW(learning_rate=1e-2,
+                  grad_clip=opt.ClipGradByGlobalNorm(1.0))
+    pure, params = lin.functional()
+    state = o.init(params)
+    x = pt.ones((4, 8))
+    traces = []
+
+    @jax.jit
+    def step(params, state, n):
+        traces.append(1)
+        def loss_fn(p):
+            return pt.mean(pure(p, x) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        new_p, new_s = o.apply(params, g, state, n)
+        return new_p, new_s, loss
+    losses = []
+    for i in range(5):
+        params, state, loss = step(params, state, pt.to_tensor(i))
+        losses.append(float(loss))
+    assert len(traces) == 1, "train step retraced"
+    assert losses[-1] < losses[0]

@@ -1,0 +1,100 @@
+"""Tensor API numerics vs numpy (SURVEY.md §4: numerics vs reference
+semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_creation():
+    assert pt.zeros((2, 3)).shape == (2, 3)
+    # x64 stays disabled (TPU-first): int64 requests canonicalize to int32
+    assert pt.ones((2,), dtype="int64").dtype in (pt.int64, pt.int32)
+    assert np.allclose(pt.numpy(pt.arange(5)), np.arange(5))
+    assert pt.full((2, 2), 7.0)[0, 0] == 7.0
+    assert pt.eye(3)[1, 1] == 1.0
+
+
+def test_manipulation():
+    x = pt.to_tensor(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    assert pt.reshape(x, (6, 4)).shape == (6, 4)
+    assert pt.transpose(x, (2, 0, 1)).shape == (4, 2, 3)
+    assert pt.concat([x, x], axis=0).shape == (4, 3, 4)
+    assert pt.stack([x, x]).shape == (2, 2, 3, 4)
+    parts = pt.split(x, [1, 2], axis=1)
+    assert parts[0].shape == (2, 1, 4) and parts[1].shape == (2, 2, 4)
+    parts = pt.split(x, [1, -1], axis=1)
+    assert parts[1].shape == (2, 2, 4)
+    assert pt.squeeze(pt.unsqueeze(x, 0), 0).shape == x.shape
+    assert pt.flatten(x, 1).shape == (2, 12)
+    assert pt.tile(x, (2, 1, 1)).shape == (4, 3, 4)
+    assert pt.expand(pt.ones((1, 3)), (5, 3)).shape == (5, 3)
+
+
+def test_math_matches_numpy():
+    a = np.random.randn(4, 5).astype(np.float32)
+    b = np.random.randn(5, 6).astype(np.float32)
+    assert np.allclose(pt.numpy(pt.matmul(pt.to_tensor(a), pt.to_tensor(b))),
+                       a @ b, atol=1e-5)
+    assert np.allclose(pt.numpy(pt.matmul(pt.to_tensor(a), pt.to_tensor(a),
+                                          transpose_y=True)), a @ a.T, atol=1e-5)
+    x = np.abs(np.random.randn(3, 4)).astype(np.float32) + 0.1
+    for name in ["exp", "log", "sqrt", "abs", "tanh", "floor", "ceil"]:
+        got = pt.numpy(getattr(pt, name)(pt.to_tensor(x)))
+        want = getattr(np, name)(x)
+        assert np.allclose(got, want, atol=1e-5), name
+    assert np.allclose(pt.numpy(pt.rsqrt(pt.to_tensor(x))), 1 / np.sqrt(x), atol=1e-5)
+
+
+def test_reductions():
+    x = np.random.randn(3, 4, 5).astype(np.float32)
+    t = pt.to_tensor(x)
+    assert np.allclose(pt.numpy(pt.sum(t, axis=1)), x.sum(1), atol=1e-5)
+    assert np.allclose(pt.numpy(pt.mean(t, axis=(0, 2))), x.mean((0, 2)), atol=1e-5)
+    assert np.allclose(pt.numpy(pt.max(t, axis=-1, keepdim=True)),
+                       x.max(-1, keepdims=True))
+    assert np.allclose(pt.numpy(pt.std(t)), x.std(ddof=1), atol=1e-5)
+    assert np.allclose(pt.numpy(pt.logsumexp(t, axis=1)),
+                       np.log(np.exp(x).sum(1)), atol=1e-4)
+
+
+def test_search_ops():
+    x = np.random.randn(4, 10).astype(np.float32)
+    t = pt.to_tensor(x)
+    v, i = pt.topk(t, 3)
+    want = np.sort(x, axis=-1)[:, ::-1][:, :3]
+    assert np.allclose(pt.numpy(v), want, atol=1e-6)
+    assert np.allclose(pt.numpy(pt.argmax(t, axis=1)), x.argmax(1))
+    assert np.allclose(pt.numpy(pt.sort(t, axis=1)), np.sort(x, axis=1))
+
+
+def test_indexing():
+    x = pt.to_tensor(np.arange(20).reshape(4, 5).astype(np.float32))
+    idx = pt.to_tensor(np.array([0, 2]))
+    assert pt.gather(x, idx, axis=0).shape == (2, 5)
+    out = pt.scatter(pt.zeros((4, 5)), idx, pt.ones((2, 5)))
+    assert pt.numpy(out).sum() == 10
+    mask = x > 10
+    assert np.allclose(pt.numpy(pt.masked_fill(x, mask, 0.0)).max(), 10)
+
+
+def test_logic():
+    a = pt.to_tensor([1.0, 2.0, 3.0])
+    b = pt.to_tensor([1.0, 2.0, 4.0])
+    assert not bool(pt.equal_all(a, b))
+    assert bool(pt.allclose(a, a))
+    assert pt.numpy(pt.equal(a, b)).tolist() == [True, True, False]
+
+
+def test_autograd_functional():
+    def f(x):
+        return pt.sum(pt.square(x))
+    g = pt.grad(f)(pt.to_tensor([1.0, 2.0, 3.0]))
+    assert np.allclose(pt.numpy(g), [2.0, 4.0, 6.0])
+
+
+def test_einsum_norm():
+    a = np.random.randn(3, 4).astype(np.float32)
+    assert np.allclose(pt.numpy(pt.einsum("ij->ji", pt.to_tensor(a))), a.T)
+    assert np.allclose(pt.numpy(pt.norm(pt.to_tensor(a))),
+                       np.linalg.norm(a), atol=1e-5)
