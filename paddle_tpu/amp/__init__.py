@@ -101,6 +101,7 @@ class GradScaler:
         self.dynamic = use_dynamic_loss_scaling
         self._scale = jnp.float32(init_loss_scaling if enable else 1.0)
         self._growth_tracker = jnp.int32(0)
+        self._nan_tracker = jnp.int32(0)
 
     def is_enable(self):
         return self._enable
@@ -122,12 +123,19 @@ class GradScaler:
         return unscaled, found_inf
 
     def update(self, found_inf=None):
+        """paddle update_loss_scaling semantics: a bad step zeroes the good
+        counter; scale shrinks only after decr_every_n accumulated bad steps;
+        a good step zeroes the bad counter."""
         if not (self._enable and self.dynamic) or found_inf is None:
             return
         if bool(found_inf):
-            self._scale = self._scale * self.decr_ratio
             self._growth_tracker = jnp.int32(0)
+            self._nan_tracker = self._nan_tracker + 1
+            if int(self._nan_tracker) >= self.decr_every_n:
+                self._scale = self._scale * self.decr_ratio
+                self._nan_tracker = jnp.int32(0)
         else:
+            self._nan_tracker = jnp.int32(0)
             self._growth_tracker = self._growth_tracker + 1
             if int(self._growth_tracker) >= self.incr_every_n_steps:
                 self._scale = self._scale * self.incr_ratio

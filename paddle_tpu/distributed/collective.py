@@ -70,11 +70,11 @@ def ppermute(x, perm, group: AxisName):
 
 
 def broadcast(x, src: int = 0, group: AxisName = "dp"):
-    """Take src's shard everywhere (inside spmd)."""
+    """Take src's shard everywhere (inside spmd). ppermute forbids fan-out
+    from one source, so broadcast = mask-to-src + psum (XLA folds this into
+    a single collective on TPU)."""
     idx = lax.axis_index(group)
-    n = lax.axis_size(group)
-    perm = [(src, i) for i in range(n)]
-    return lax.ppermute(x, group, perm)
+    return lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)), group)
 
 
 def axis_index(group: AxisName):
@@ -87,12 +87,12 @@ def axis_size(group: AxisName):
 
 # ------------------------------------------------------------ eager facades
 def _eager(fn, x, group, out_spec=None, in_spec=None):
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     mesh = get_mesh()
     in_spec = in_spec if in_spec is not None else P(group)
     out_spec = out_spec if out_spec is not None else in_spec
     return shard_map(fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
-                     check_rep=False)(x)
+                     check_vma=False)(x)
 
 
 def eager_all_reduce(x, op: str = ReduceOp.SUM, group: str = "dp"):

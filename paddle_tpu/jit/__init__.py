@@ -53,14 +53,20 @@ def to_static(fn_or_layer=None, input_spec=None, static_argnums=(),
         if isinstance(obj, Layer):
             orig_forward = obj.forward  # capture before we shadow it
 
-            def pure(p, *args, **kwargs):
-                with obj.bound(p):
+            def pure(p, *args, rng=None, **kwargs):
+                # rng: traced key threaded to Dropout etc. — without it a
+                # host key would bake into the program as a constant
+                # (next_key warns in that case).
+                import contextlib
+                from ..utils.rng import key_context
+                ctx = key_context(rng) if rng is not None else contextlib.nullcontext()
+                with ctx, obj.bound(p):
                     return orig_forward(*args, **kwargs)
             jitted = jax.jit(pure, static_argnums=static_argnums)
 
             @functools.wraps(orig_forward)
-            def layer_call(*args, **kwargs):
-                return jitted(dict(obj.named_parameters()), *args, **kwargs)
+            def layer_call(*args, rng=None, **kwargs):
+                return jitted(dict(obj.named_parameters()), *args, rng=rng, **kwargs)
             # shadow the instance forward so obj(x) runs the compiled program
             object.__setattr__(obj, "forward", layer_call)
             object.__setattr__(obj, "_static_fn", layer_call)

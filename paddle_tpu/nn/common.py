@@ -66,7 +66,7 @@ class Dropout(Layer):
 
     def forward(self, x):
         if not self.training or self.p == 0.0:
-            return x
+            return F.dropout(x, self.p, training=False, mode=self.mode)
         return F.dropout(x, self.p, training=True, key=next_key(), mode=self.mode)
 
 

@@ -138,10 +138,14 @@ def swiglu(x, gate=None):
 
 # ------------------------------------------------------------------- linear
 def linear(x, weight, bias=None):
-    """paddle stores Linear weight as [in, out] (note: torch is [out, in])."""
+    """paddle stores Linear weight as [in, out] (note: torch is [out, in]).
+    Under amp.auto_cast (O1), inputs/weights are cast to the AMP dtype so the
+    matmul runs on the MXU in bf16."""
+    from ..amp import maybe_cast
+    x, weight = maybe_cast(x), maybe_cast(weight)
     out = x @ weight
     if bias is not None:
-        out = out + bias
+        out = out + maybe_cast(bias)
     return out
 
 
@@ -234,6 +238,10 @@ def normalize(x, p=2, axis=1, epsilon=1e-12):
 # ----------------------------------------------------------------- dropout
 def dropout(x, p=0.5, training=True, key=None, mode="upscale_in_train"):
     if not training or p == 0.0:
+        # paddle's downscale_in_infer: train applies the raw mask, so infer
+        # must compensate by (1 - p)
+        if mode == "downscale_in_infer" and p > 0.0 and not training:
+            return (x * (1.0 - p)).astype(x.dtype)
         return x
     assert key is not None, "dropout in training mode needs an explicit PRNG key"
     keep = 1.0 - p
@@ -281,6 +289,8 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
 
 
 def _convnd(x, weight, bias, stride, padding, dilation, groups, n):
+    from ..amp import maybe_cast
+    x, weight = maybe_cast(x), maybe_cast(weight)
     stride = _norm_tuple(stride, n)
     dilation = _norm_tuple(dilation, n)
     if isinstance(padding, str):

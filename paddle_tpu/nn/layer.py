@@ -284,12 +284,19 @@ class Layer:
 
     @contextlib.contextmanager
     def bound(self, flat: Dict[str, jax.Array]):
+        # Snapshot ALL buffers too: layers like BatchNorm rebind running
+        # stats in forward; under tracing those writes would otherwise leak
+        # tracers into the module tree (use functional(with_buffers=True)
+        # to actually carry buffer updates out).
         saved = {k: self._get_by_path(k) for k in flat}
+        saved_buffers = OrderedDict(self.named_buffers())
         self.bind(flat)
         try:
             yield self
         finally:
             self.bind(saved)
+            for k, v in saved_buffers.items():
+                self._set_by_path(k, v)
 
     def functional(self, with_buffers: bool = False):
         """Return `(pure_fn, params)`.
@@ -299,18 +306,21 @@ class Layer:
         when buffers are updated functionally, e.g. BatchNorm momentum —
         then pure_fn returns `(out, new_buffers)`).
         """
+        from ..utils.rng import key_context
         params = OrderedDict(self.named_parameters())
         if not with_buffers:
-            def pure_fn(p, *args, **kwargs):
-                with self.bound(p):
+            def pure_fn(p, *args, rng=None, **kwargs):
+                ctx = key_context(rng) if rng is not None else contextlib.nullcontext()
+                with ctx, self.bound(p):
                     return self(*args, **kwargs)
             return pure_fn, params
 
         buffers = OrderedDict(self.named_buffers(persistable_only=True))
 
-        def pure_fn_b(p, b, *args, **kwargs):
+        def pure_fn_b(p, b, *args, rng=None, **kwargs):
             merged = {**p, **b}
-            with self.bound(merged):
+            ctx = key_context(rng) if rng is not None else contextlib.nullcontext()
+            with ctx, self.bound(merged):
                 out = self(*args, **kwargs)
                 new_b = OrderedDict(self.named_buffers(persistable_only=True))
             return out, new_b

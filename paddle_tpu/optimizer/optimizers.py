@@ -83,8 +83,14 @@ class Optimizer:
         assert grads is not None, (
             "functional autograd: compute grads with paddle_tpu.grad and pass them in")
         grads = {k: grads[k] for k in params}
-        new_params, self._state = self.apply(params, grads, self._state,
-                                             jnp.asarray(self._step_count))
+        # paddle idiom: a manually-driven LRScheduler (user calls
+        # scheduler.step()) governs the applied lr, so the facade evaluates
+        # at the scheduler's epoch, not the optimizer's step count.
+        if isinstance(self._lr, LRScheduler):
+            step_arg = jnp.asarray(max(self._lr.last_epoch, 0))
+        else:
+            step_arg = jnp.asarray(self._step_count)
+        new_params, self._state = self.apply(params, grads, self._state, step_arg)
         layer.bind(new_params)
         self._step_count += 1
 
@@ -169,7 +175,7 @@ class Adam(Optimizer):
                 "v": jnp.zeros_like(p, dtype=jnp.float32)}
 
     def _update(self, params, grads, slots, lr, step):
-        t = step.astype(jnp.float32) + 1.0
+        t = jnp.asarray(step, jnp.float32) + 1.0
         bc1 = 1.0 - self.beta1 ** t
         bc2 = 1.0 - self.beta2 ** t
 
@@ -288,7 +294,7 @@ class Lamb(Optimizer):
                 "v": jnp.zeros_like(p, dtype=jnp.float32)}
 
     def _update(self, params, grads, slots, lr, step):
-        t = step.astype(jnp.float32) + 1.0
+        t = jnp.asarray(step, jnp.float32) + 1.0
         bc1 = 1.0 - self.beta1 ** t
         bc2 = 1.0 - self.beta2 ** t
         flat_p = _flatten_with_path(params)
@@ -336,7 +342,7 @@ class Adafactor(Optimizer):
         return s
 
     def _update(self, params, grads, slots, lr, step):
-        t = step.astype(jnp.float32) + 1.0
+        t = jnp.asarray(step, jnp.float32) + 1.0
         rho = 1.0 - jnp.power(t, -self.decay_rate)
         flat_p = _flatten_with_path(params)
         new_p, new_s = {}, {}

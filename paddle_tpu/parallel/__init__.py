@@ -1,0 +1,7 @@
+"""paddle_tpu.parallel — hybrid-parallel building blocks (reference:
+paddle/distributed/fleet/meta_parallel/*)."""
+from .layers import (ColumnParallelLinear, RowParallelLinear,
+                     VocabParallelEmbedding, parallel_matmul)
+from .sharding import (ShardingError, constraint, param_shardings,
+                       partition_to_sharding, shard_layer, tree_shardings,
+                       validate_partition)

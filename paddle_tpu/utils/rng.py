@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import warnings
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +29,27 @@ def _ensure():
         _state.key = jax.random.key(0)
         _state.streams = {}
         _state.stack = []
+        _state.trace_key = None
+        _state.trace_count = 0
+        _state.warned_const_key = False
+
+
+def _trace_state_clean() -> bool:
+    try:
+        from jax._src.core import trace_state_clean
+        return trace_state_clean()
+    except Exception:
+        return True  # can't tell -> stay quiet
+
+
+def _stream_seed(name: str) -> int:
+    """Deterministic (process-stable) stream id: Python's hash() is salted
+    per process, which would make 'replicated' streams diverge across hosts
+    and runs. The 'local' stream is decorrelated per host by design."""
+    h = zlib.crc32(name.encode("utf-8"))
+    if name == "local":
+        h = (h + 0x9E3779B9 * (jax.process_index() + 1)) & 0xFFFFFFFF
+    return h % (2 ** 31)
 
 
 def seed(s: int):
@@ -49,16 +72,49 @@ def set_rng_state(state):
 
 
 def next_key(n: int = 0):
-    """Split a fresh key off the active stream (host-side, eager only)."""
+    """Split a fresh key off the active stream.
+
+    Host-side by default. Inside jit, an ambient host key would be baked
+    into the program as a constant (same dropout mask every step) — so
+    under tracing either a `key_context(traced_key)` must be active (the
+    functional bridge's `rng=` kwarg installs one) or we warn once.
+    """
     _ensure()
+    if _state.trace_key is not None:
+        sub = jax.random.fold_in(_state.trace_key, _state.trace_count)
+        _state.trace_count += 1
+        return sub
+    if not _trace_state_clean() and not _state.warned_const_key:
+        _state.warned_const_key = True
+        warnings.warn(
+            "paddle_tpu: next_key() called during jit tracing without a "
+            "key_context — the key is baked in as a constant (identical "
+            "dropout masks every step). Pass rng=<jax key> to the "
+            "functional-bridge pure_fn (or to_static layer call).",
+            stacklevel=2)
     name = _state.stack[-1] if _state.stack else None
     if name is None:
         _state.key, sub = jax.random.split(_state.key)
         return sub
-    stream = _state.streams.setdefault(name, jax.random.fold_in(_state.key, hash(name) % (2**31)))
+    stream = _state.streams.setdefault(
+        name, jax.random.fold_in(_state.key, _stream_seed(name)))
     new, sub = jax.random.split(stream)
     _state.streams[name] = new
     return sub
+
+
+@contextlib.contextmanager
+def key_context(key):
+    """Route next_key() through an explicit (possibly traced) key: every
+    call folds a fresh counter into `key`. This is how dropout gets a new
+    mask per step under jit."""
+    _ensure()
+    prev_key, prev_count = _state.trace_key, _state.trace_count
+    _state.trace_key, _state.trace_count = key, 0
+    try:
+        yield
+    finally:
+        _state.trace_key, _state.trace_count = prev_key, prev_count
 
 
 @contextlib.contextmanager

@@ -1,0 +1,118 @@
+"""Regression tests for review findings: AMP O1 casting, GradScaler
+counters, broadcast semantics, dropout infer modes, RNG determinism and
+traced keys, BatchNorm buffer hygiene under jit."""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import amp
+from paddle_tpu.distributed import collective, env
+from paddle_tpu.nn import functional as F
+from paddle_tpu.utils import rng
+
+
+def test_autocast_casts_linear_to_bf16():
+    layer = pt.nn.Linear(8, 8)
+    x = jnp.ones((2, 8), jnp.float32)
+    assert layer(x).dtype == jnp.float32
+    with amp.auto_cast(dtype="bfloat16"):
+        assert layer(x).dtype == jnp.bfloat16
+    assert layer(x).dtype == jnp.float32
+
+
+def test_gradscaler_decr_every_n():
+    s = amp.GradScaler(init_loss_scaling=1024.0, decr_every_n_nan_or_inf=2)
+    s.update(jnp.bool_(True))
+    assert float(s._scale) == 1024.0  # first bad step: counter only
+    s.update(jnp.bool_(True))
+    assert float(s._scale) == 512.0   # second consecutive: halve
+    s.update(jnp.bool_(True))
+    s.update(jnp.bool_(False))        # good step resets bad counter
+    s.update(jnp.bool_(True))
+    assert float(s._scale) == 512.0
+
+
+def test_eager_broadcast_correct():
+    env.init_parallel_env({})  # dp over all 8
+    n = env.get_world_size("dp")
+    x = jnp.arange(n * 4, dtype=jnp.float32).reshape(n, 4)
+    out = collective.eager_broadcast(x, src=2, group="dp")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x[2:3]))
+
+
+def test_dropout_downscale_in_infer():
+    x = jnp.ones((4, 4))
+    out = F.dropout(x, p=0.5, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(np.asarray(out), 0.5)
+    layer = pt.nn.Dropout(0.5, mode="downscale_in_infer")
+    layer.eval()
+    np.testing.assert_allclose(np.asarray(layer(x)), 0.5)
+    # upscale mode unchanged at eval
+    np.testing.assert_allclose(
+        np.asarray(F.dropout(x, 0.5, training=False, mode="upscale_in_train")), 1.0)
+
+
+def test_rng_stream_stable_and_local_distinct():
+    assert rng._stream_seed("global") == rng._stream_seed("global")
+    assert rng._stream_seed("global") != rng._stream_seed("local")
+
+
+def test_key_context_traced_dropout_varies_by_key():
+    model = pt.nn.Sequential(pt.nn.Linear(8, 8), pt.nn.Dropout(0.5))
+    model.train()
+    fn, params = model.functional()
+    jitted = jax.jit(fn)
+    x = jnp.ones((4, 8))
+    o1 = jitted(params, x, rng=jax.random.key(1))
+    o2 = jitted(params, x, rng=jax.random.key(2))
+    o1b = jitted(params, x, rng=jax.random.key(1))
+    assert not np.array_equal(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o1b))
+
+
+def test_next_key_warns_under_trace_without_context():
+    rng._ensure()
+    rng._state.warned_const_key = False
+    model = pt.nn.Sequential(pt.nn.Linear(8, 8), pt.nn.Dropout(0.5))
+    model.train()
+    fn, params = model.functional()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        jax.jit(fn)(params, jnp.ones((4, 8)))
+        assert any("baked" in str(w.message) for w in rec)
+
+
+def test_batchnorm_no_tracer_leak_under_jit():
+    bn = pt.nn.BatchNorm2D(3)
+    bn.train()
+    fn, params = bn.functional()
+    x = jnp.ones((2, 3, 4, 4))
+    jax.jit(fn)(params, x)  # traced forward rebinds stats...
+    mean = bn._buffers["_mean"]
+    assert isinstance(mean, jax.Array)  # ...but the tracer must not leak
+    bn.eval()
+    bn(x)  # would raise UnexpectedTracerError before the fix
+    # with_buffers path actually carries the stats update out
+    fnb, (params, bufs) = bn.functional(with_buffers=True)
+    bn.train()
+    out, new_bufs = jax.jit(fnb)(params, bufs, x)
+    assert not np.allclose(np.asarray(new_bufs["_mean"]), np.asarray(bufs["_mean"]))
+
+
+def test_scheduler_driven_optimizer_lr():
+    layer = pt.nn.Linear(4, 4)
+    sched = pt.optimizer.lr.ExponentialDecay(learning_rate=0.1, gamma=0.5)
+    opt = pt.optimizer.SGD(learning_rate=sched, parameters=layer)
+    grads = {k: jnp.ones_like(v) for k, v in layer.named_parameters()}
+    w0 = np.asarray(layer.weight)
+    opt.step(grads=grads)
+    w1 = np.asarray(layer.weight)
+    np.testing.assert_allclose(w0 - w1, 0.1, rtol=1e-6)  # epoch 0: lr=0.1
+    sched.step(); sched.step()  # epoch 2: lr=0.025
+    opt.step(grads=grads)
+    w2 = np.asarray(layer.weight)
+    np.testing.assert_allclose(w1 - w2, 0.025, rtol=1e-6)
