@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Headline bench (SURVEY.md §6): Llama train-step tokens/sec/chip + MFU on
+the local chip. Prints ONE JSON line; vs_baseline = achieved MFU / 0.40
+(the reference's Llama-3 pretraining MFU target in BASELINE.json)."""
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu.models import LlamaForCausalLM, LlamaConfig, causal_lm_loss  # noqa: E402
+
+# peak bf16 FLOP/s per chip by device kind
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # trillium
+}
+
+BATCH, SEQ = 8, 2048
+
+
+def bench_config() -> LlamaConfig:
+    """~470M-param Llama shaped to saturate a single v5e (16G HBM) with
+    remat; same code path as the 8B recipe."""
+    return LlamaConfig(
+        vocab_size=32768, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=8,
+        max_position_embeddings=SEQ, rope_theta=500000.0,
+        recompute=True, dtype=jnp.bfloat16)
+
+
+def main():
+    dev = jax.devices()[0]
+    peak = PEAK_FLOPS.get(dev.device_kind, 197e12)
+    pt.seed(0)
+    cfg = bench_config()
+    model = LlamaForCausalLM(cfg)
+    fn, params = model.functional()
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+
+    opt = pt.optimizer.AdamW(learning_rate=1e-4, multi_precision=True,
+                             grad_clip=pt.optimizer.ClipGradByGlobalNorm(1.0))
+    state = opt.init(params)
+    ids = jnp.asarray(np.random.randint(0, cfg.vocab_size, (BATCH, SEQ)))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, state, step, ids):
+        def loss_fn(p):
+            return causal_lm_loss(fn(p, ids), ids)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, state = opt.apply(params, grads, state, step)
+        return params, state, loss
+
+    # warmup/compile (float() forces a device->host transfer: on the axon
+    # tunnel block_until_ready alone returns before execution completes)
+    params, state, loss = train_step(params, state, jnp.int32(0), ids)
+    float(loss)
+
+    steps = 10
+    t0 = time.perf_counter()
+    for i in range(1, steps + 1):
+        params, state, loss = train_step(params, state, jnp.int32(i), ids)
+    float(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_sec = BATCH * SEQ / dt
+    # fwd+bwd matmul flops 6N/token + causal attention 6*L*s*h/token
+    flops_per_token = 6 * n_params + 6 * cfg.num_hidden_layers * SEQ * cfg.hidden_size
+    mfu = flops_per_token * tokens_per_sec / peak
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 3),
+        "mfu": round(mfu, 4),
+        "params": n_params,
+        "step_ms": round(dt * 1e3, 2),
+        "device": dev.device_kind,
+        "loss": round(float(loss), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+"""paddle_tpu.models — model zoo (reference: PaddleNLP/PaddleMIX recipes)."""
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel, causal_lm_loss,
+                    llama3_8b, llama_tiny)

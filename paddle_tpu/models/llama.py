@@ -1,0 +1,266 @@
+"""Llama-3 family (flagship; reference: PaddleNLP
+paddlenlp/transformers/llama/modeling.py — LlamaAttention/LlamaMLP/
+LlamaDecoderLayer/LlamaForCausalLM, fuse_attention_qkv and the
+mp/sp-parallel code paths).
+
+TPU-native design:
+- GQA attention over the Pallas flash kernel (training) / dense XLA path
+  with a static KV cache (decode) — no per-rank weight slicing: q/k/v/o are
+  Column/RowParallelLinear so GSPMD shards heads over ``tp``.
+- RoPE computed inline (fp32 angles, cast back) — XLA fuses it into the
+  surrounding matmuls; no precomputed position table to keep in HBM.
+- Activations sharded batch→("dp","fsdp"), seq→"sp" via constraint hints.
+- Per-layer `jax.checkpoint` (remat) when config.recompute is on.
+- bf16 params by default (fp32 master weights live in the optimizer).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn.layer import Layer, Parameter
+from ..ops.attention import dense_attention, flash_attention
+from ..parallel.layers import (ColumnParallelLinear, RowParallelLinear,
+                               VocabParallelEmbedding, parallel_matmul)
+from ..parallel.sharding import constraint
+from ..utils.rng import next_key
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+    recompute: bool = False
+    use_flash_attention: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama3_8b(**overrides) -> LlamaConfig:
+    return LlamaConfig(**overrides)
+
+
+def llama_tiny(**overrides) -> LlamaConfig:
+    """Test-scale config (fits CPU mesh; same code paths as 8B)."""
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=128,
+                rope_theta=10000.0, dtype=jnp.float32)
+    base.update(overrides)
+    return LlamaConfig(**base)
+
+
+# ------------------------------------------------------------------- RoPE
+def rotary_cos_sin(positions, head_dim: int, theta: float, dtype):
+    """positions [b, s] -> (cos, sin) [b, s, 1, head_dim/2], fp32 math."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                                / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [b,s,hd/2]
+    return (jnp.cos(angles)[:, :, None, :].astype(dtype),
+            jnp.sin(angles)[:, :, None, :].astype(dtype))
+
+
+def apply_rotary(x, cos, sin):
+    """x [b, s, h, d]; rotate-half convention (Llama/GPT-NeoX style)."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+# -------------------------------------------------------------- components
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h, kv = config.num_attention_heads, config.num_key_value_heads
+        d = config.head_dim
+        self.q_proj = ColumnParallelLinear(config.hidden_size, h * d,
+                                           has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(config.hidden_size, kv * d,
+                                           has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(config.hidden_size, kv * d,
+                                           has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(h * d, config.hidden_size,
+                                        has_bias=False, input_is_parallel=True)
+
+    def forward(self, x, positions, kv_cache: Optional[Tuple] = None,
+                cache_index=None, attn_mask=None):
+        cfg = self.config
+        b, s, _ = x.shape
+        q = self.q_proj(x).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
+        k = self.k_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+        v = self.v_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+        cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta, q.dtype)
+        q, k = apply_rotary(q, cos, sin), apply_rotary(k, cos, sin)
+        # heads sharded over tp
+        q = constraint(q, None, None, "tp", None)
+        k = constraint(k, None, None, "tp", None)
+        v = constraint(v, None, None, "tp", None)
+
+        new_cache = None
+        if kv_cache is not None:
+            # static-shape decode: write current k/v at cache_index
+            ck, cv = kv_cache
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                              (0, cache_index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                              (0, cache_index, 0, 0))
+            new_cache = (ck, cv)
+            # mask out positions beyond cache_index + s
+            total = ck.shape[1]
+            kpos = jnp.arange(total)[None, :]           # [1, T]
+            qpos = cache_index + jnp.arange(s)[:, None]  # [s, 1]
+            mask = (kpos <= qpos)[None, None]           # [1, 1, s, T]
+            out = dense_attention(q, ck, cv, attn_mask=mask)
+        elif cfg.use_flash_attention and attn_mask is None and s >= 128:
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            out = dense_attention(q, k, v, causal=attn_mask is None,
+                                  attn_mask=attn_mask)
+        out = out.reshape(b, s, cfg.num_attention_heads * cfg.head_dim)
+        out = self.o_proj(out)
+        return (out, new_cache) if kv_cache is not None else out
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.gate_proj = ColumnParallelLinear(config.hidden_size,
+                                              config.intermediate_size,
+                                              has_bias=False, gather_output=False)
+        self.up_proj = ColumnParallelLinear(config.hidden_size,
+                                            config.intermediate_size,
+                                            has_bias=False, gather_output=False)
+        self.down_proj = RowParallelLinear(config.intermediate_size,
+                                           config.hidden_size, has_bias=False,
+                                           input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, positions, kv_cache=None, cache_index=None,
+                attn_mask=None):
+        attn_out = self.self_attn(self.input_layernorm(x), positions,
+                                  kv_cache=kv_cache, cache_index=cache_index,
+                                  attn_mask=attn_mask)
+        new_cache = None
+        if kv_cache is not None:
+            attn_out, new_cache = attn_out
+        x = x + attn_out
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        x = constraint(x, ("dp", "fsdp"), "sp", None)
+        return (x, new_cache) if kv_cache is not None else x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        self.embed_tokens.weight = self.embed_tokens.weight.astype(config.dtype) \
+            * jnp.asarray(config.initializer_range / 0.02, config.dtype)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        if config.dtype != jnp.float32:
+            # compute-weight dtype (fp32 masters live in the optimizer)
+            self.to(dtype=config.dtype)
+
+    def forward(self, input_ids, positions=None, kv_caches=None,
+                cache_index=None, attn_mask=None):
+        b, s = input_ids.shape
+        if positions is None:
+            start = cache_index if cache_index is not None else 0
+            positions = start + jnp.arange(s)[None, :].repeat(b, axis=0)
+        x = self.embed_tokens(input_ids)
+        x = constraint(x, ("dp", "fsdp"), "sp", None)
+        new_caches = [] if kv_caches is not None else None
+        for i, layer in enumerate(self.layers):
+            cache_i = kv_caches[i] if kv_caches is not None else None
+            if self.config.recompute and kv_caches is None:
+                out = jax.checkpoint(
+                    lambda h, lyr=layer: lyr(h, positions, attn_mask=attn_mask),
+                    prevent_cse=False)(x)
+            else:
+                out = layer(x, positions, kv_cache=cache_i,
+                            cache_index=cache_index, attn_mask=attn_mask)
+            if kv_caches is not None:
+                x, nc = out
+                new_caches.append(nc)
+            else:
+                x = out
+        x = self.norm(x)
+        return (x, new_caches) if kv_caches is not None else x
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(config.hidden_size,
+                                                config.vocab_size,
+                                                has_bias=False,
+                                                gather_output=True)
+            if config.dtype != jnp.float32:
+                self.lm_head.to(dtype=config.dtype)
+
+    def forward(self, input_ids, positions=None, kv_caches=None,
+                cache_index=None, attn_mask=None):
+        out = self.model(input_ids, positions, kv_caches, cache_index, attn_mask)
+        caches = None
+        if kv_caches is not None:
+            out, caches = out
+        if self.config.tie_word_embeddings:
+            logits = parallel_matmul(out, self.model.embed_tokens.weight,
+                                     transpose_y=True)
+        else:
+            logits = self.lm_head(out)
+        logits = logits.astype(jnp.float32)  # CE in fp32 for stability
+        return (logits, caches) if kv_caches is not None else logits
+
+    def init_kv_caches(self, batch_size: int, max_len: int, dtype=None):
+        cfg = self.config
+        dtype = dtype or cfg.dtype
+        shape = (batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(cfg.num_hidden_layers)]
+
+
+def causal_lm_loss(logits, labels, ignore_index: int = -100):
+    """Shifted next-token CE: logits [b, s, v], labels [b, s]."""
+    shift_logits = logits[:, :-1]
+    shift_labels = labels[:, 1:]
+    return F.cross_entropy(shift_logits, shift_labels,
+                           ignore_index=ignore_index, reduction="mean")

@@ -40,7 +40,12 @@ def use_flash(query, key, attn_mask, dropout_p) -> bool:
 
 
 def flash_attention(query, key, value, causal=False, scale=None):
-    """[b, s, h, d] flash attention; grouped-query aware."""
+    """[b, s, h, d] flash attention; grouped-query aware. The Pallas kernel
+    is TPU-only; on other backends (CPU mesh tests, dryruns) this routes to
+    the numerically-identical dense XLA path."""
+    import jax
+    if jax.default_backend() not in ("tpu", "axon"):
+        return dense_attention(query, key, value, causal=causal, scale=scale)
     from .pallas.flash_attention import flash_attention_bshd
     return flash_attention_bshd(query, key, value, causal=causal, scale=scale)
 

@@ -1,0 +1,105 @@
+"""Llama end-to-end (SURVEY.md §4): tiny overfit, KV-cache decode parity,
+TP-sharded train step on the 8-device mesh."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import env
+from paddle_tpu.models import LlamaForCausalLM, causal_lm_loss, llama_tiny
+from paddle_tpu.parallel.sharding import shard_layer
+
+
+@pytest.fixture
+def tiny():
+    return LlamaForCausalLM(llama_tiny())
+
+
+def test_forward_shapes(tiny):
+    ids = jnp.asarray(np.random.randint(0, 256, (2, 16)))
+    logits = tiny(ids)
+    assert logits.shape == (2, 16, 256)
+    assert logits.dtype == jnp.float32
+
+
+def test_overfit_tiny(tiny):
+    """Memorize one batch: loss must collapse (autograd + model wiring)."""
+    ids = jnp.asarray(np.random.randint(0, 256, (4, 32)))
+    fn, params = tiny.functional()
+    opt = pt.optimizer.AdamW(learning_rate=3e-3)
+    state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, state, n):
+        def loss_fn(p):
+            return causal_lm_loss(fn(p, ids), ids)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, state = opt.apply(params, grads, state, n)
+        return params, state, loss
+
+    losses = []
+    for n in range(60):
+        params, state, loss = step(params, state, n)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.3, losses[::10]
+
+
+def test_kv_cache_decode_matches_full_forward(tiny):
+    """Prefill+decode through the cache must reproduce the full-context
+    logits (static shapes, lax-friendly)."""
+    tiny.eval()
+    ids = jnp.asarray(np.random.randint(0, 256, (1, 12)))
+    full_logits = tiny(ids)  # [1, 12, v]
+
+    caches = tiny.init_kv_caches(1, 16)
+    # prefill first 8 tokens
+    logits, caches = tiny(ids[:, :8], kv_caches=caches, cache_index=0)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full_logits[:, :8]),
+                               rtol=2e-3, atol=2e-3)
+    # decode tokens 8..11 one at a time
+    for t in range(8, 12):
+        logits, caches = tiny(ids[:, t:t + 1], kv_caches=caches, cache_index=t)
+        np.testing.assert_allclose(np.asarray(logits[:, 0]),
+                                   np.asarray(full_logits[:, t]),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_tp_sharded_train_step():
+    """Full train step with tp=4, dp=2: runs, loss finite, params sharded."""
+    env.init_parallel_env({"tp": 4, "dp": 2})
+    try:
+        model = LlamaForCausalLM(llama_tiny())
+        shardings = shard_layer(model)
+        assert "tp" in str(shardings["model.layers.0.self_attn.q_proj.weight"].spec)
+        fn, params = model.functional()
+        opt = pt.optimizer.AdamW(learning_rate=1e-3)
+        state = opt.init(params)
+        ids = jnp.asarray(np.random.randint(0, 256, (4, 32)))
+
+        @jax.jit
+        def step(params, state, ids):
+            def loss_fn(p):
+                return causal_lm_loss(fn(p, ids), ids)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            params, state = opt.apply(params, grads, state, 0)
+            return params, state, loss
+
+        params, state, loss = step(params, state, ids)
+        assert np.isfinite(float(loss))
+        spec = str(params["model.layers.0.self_attn.q_proj.weight"].sharding.spec)
+        assert "tp" in spec
+    finally:
+        env.init_parallel_env({})
+
+
+def test_recompute_same_loss(tiny):
+    ids = jnp.asarray(np.random.randint(0, 256, (2, 16)))
+    fn, params = tiny.functional()
+    loss_a = float(causal_lm_loss(jax.jit(fn)(params, ids), ids))
+    model_r = LlamaForCausalLM(llama_tiny(recompute=True))
+    fn_r, _ = model_r.functional()
+    loss_b = float(causal_lm_loss(jax.jit(fn_r)(params, ids), ids))
+    np.testing.assert_allclose(loss_a, loss_b, rtol=1e-5)
