@@ -57,6 +57,13 @@ def init_parallel_env(mesh_shape: Optional[Dict[str, int]] = None,
     return _global_mesh
 
 
+def clear_mesh():
+    """Uninstall the global mesh (single-device eager semantics return)."""
+    global _global_mesh
+    with _lock:
+        _global_mesh = None
+
+
 def set_mesh(mesh: Mesh):
     global _global_mesh
     with _lock:

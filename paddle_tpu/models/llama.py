@@ -48,6 +48,7 @@ class LlamaConfig:
     initializer_range: float = 0.02
     recompute: bool = False
     use_flash_attention: bool = True
+    sequence_parallel: bool = False  # ring attention over the sp axis
     dtype: Any = jnp.bfloat16
 
     @property
@@ -101,6 +102,11 @@ class LlamaAttention(Layer):
         self.o_proj = RowParallelLinear(h * d, config.hidden_size,
                                         has_bias=False, input_is_parallel=True)
 
+    @staticmethod
+    def _sp_degree() -> int:
+        from ..distributed.env import get_mesh, has_mesh
+        return get_mesh().shape.get("sp", 1) if has_mesh() else 1
+
     def forward(self, x, positions, kv_cache: Optional[Tuple] = None,
                 cache_index=None, attn_mask=None):
         cfg = self.config
@@ -130,6 +136,17 @@ class LlamaAttention(Layer):
             qpos = cache_index + jnp.arange(s)[:, None]  # [s, 1]
             mask = (kpos <= qpos)[None, None]           # [1, 1, s, T]
             out = dense_attention(q, ck, cv, attn_mask=mask)
+        elif cfg.sequence_parallel and attn_mask is None and self._sp_degree() > 1:
+            # ring attention: seq stays sp-sharded; KV blocks rotate on ICI
+            import functools
+            from jax.sharding import PartitionSpec as P
+            from ..distributed.env import get_mesh
+            from ..parallel.ring import ring_attention
+            spec = P(("dp", "fsdp"), "sp", "tp", None)
+            out = jax.shard_map(
+                functools.partial(ring_attention, axis_name="sp", causal=True),
+                mesh=get_mesh(), in_specs=(spec,) * 3, out_specs=spec,
+                check_vma=False)(q, k, v)
         elif cfg.use_flash_attention and attn_mask is None and s >= 128:
             out = flash_attention(q, k, v, causal=True)
         else:

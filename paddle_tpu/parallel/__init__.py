@@ -2,6 +2,9 @@
 paddle/distributed/fleet/meta_parallel/*)."""
 from .layers import (ColumnParallelLinear, RowParallelLinear,
                      VocabParallelEmbedding, parallel_matmul)
+from .moe import MoEMLP, top_k_routing
+from .pipeline import pipeline_apply, spmd_pipeline, stack_stage_params
+from .ring import ring_attention, ulysses_attention
 from .sharding import (ShardingError, constraint, param_shardings,
                        partition_to_sharding, shard_layer, tree_shardings,
                        validate_partition)

@@ -1,0 +1,134 @@
+"""Mixture-of-Experts with expert parallelism (reference: Paddle's
+incubate.distributed.models.moe + PaddleNLP Qwen2-MoE/DeepSeekMoE recipes —
+top-k gating, capacity dispatch, NCCL all_to_all over the expert group).
+
+TPU-native (GShard-style): experts live as *stacked* weights
+[E, in, out] sharded over the ``ep`` mesh axis; dispatch/combine are
+einsums against a capacity-bucketed one-hot, so XLA lowers the routing to
+all_to_all collectives over ICI — no hand-written NCCL plumbing, fully
+static shapes (dropped tokens beyond capacity, GShard semantics).
+
+Balancing: switch-style aux loss (mean router prob x mean token fraction
+x E) plus optional router z-loss; or "loss-free" bias balancing
+(DeepSeek-V3 style) via `update_loss_free_bias`.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, Parameter
+from ..utils.rng import next_key
+from .sharding import constraint
+
+
+def top_k_routing(router_logits, k: int, capacity: int,
+                  bias: Optional[jax.Array] = None):
+    """router_logits [T, E] -> (dispatch [T, E, C] bool, combine [T, E, C],
+    aux_loss scalar). GShard top-k with per-expert capacity C."""
+    T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    select_scores = probs if bias is None else probs + bias[None, :]
+    # top-k expert ids per token
+    _, expert_ids = jax.lax.top_k(select_scores, k)          # [T, k]
+    onehot = jax.nn.one_hot(expert_ids, E, dtype=jnp.float32)  # [T, k, E]
+    gates = probs[:, None, :] * onehot                        # gate per choice
+    # position of each token within its expert's bucket (over T*k choices,
+    # priority by choice rank then token order — GShard's policy)
+    flat = onehot.transpose(1, 0, 2).reshape(k * T, E)        # choice-major
+    pos = (jnp.cumsum(flat, axis=0) - flat)                   # [kT, E]
+    pos = pos.reshape(k, T, E).transpose(1, 0, 2)             # [T, k, E]
+    keep = (pos < capacity) * onehot                          # drop overflow
+    pos = jnp.minimum(pos, capacity - 1).astype(jnp.int32)
+    pos_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [T,k,E,C]
+    dispatch = jnp.einsum("tke,tkec->tec", keep, pos_onehot)
+    combine = jnp.einsum("tke,tkec->tec", gates * keep, pos_onehot)
+    # switch aux loss: E * sum_e mean_prob_e * mean_frac_e
+    frac = jnp.mean(onehot[:, 0, :], axis=0)   # fraction routed (top-1 choice)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+class MoEMLP(Layer):
+    """Drop-in replacement for a dense FFN: k-of-E expert SwiGLU MLPs with
+    optional always-on shared experts (Qwen2-MoE/DeepSeekMoE pattern)."""
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 num_experts: int, top_k: int = 2,
+                 capacity_factor: float = 1.25,
+                 num_shared_experts: int = 0,
+                 shared_intermediate_size: Optional[int] = None,
+                 aux_loss_weight: float = 0.01, name=None):
+        super().__init__(name)
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.aux_loss_weight = aux_loss_weight
+        E, h, m = num_experts, hidden_size, intermediate_size
+        init = I.XavierNormal()
+        self.gate = Parameter(init(next_key(), (h, E)))  # router, replicated
+        self.w_gate = Parameter(init(next_key(), (E, h, m)),
+                                partition=("ep", None, None))
+        self.w_up = Parameter(init(next_key(), (E, h, m)),
+                              partition=("ep", None, None))
+        self.w_down = Parameter(init(next_key(), (E, m, h)),
+                                partition=("ep", None, None))
+        # loss-free balancing bias (buffer: updated outside the grad path)
+        self.register_buffer("expert_bias", jnp.zeros((E,)), persistable=True)
+        self.shared = None
+        if num_shared_experts:
+            sm = shared_intermediate_size or m * num_shared_experts
+            self.shared_gate_proj = Parameter(init(next_key(), (h, sm)))
+            self.shared_up_proj = Parameter(init(next_key(), (h, sm)))
+            self.shared_down_proj = Parameter(init(next_key(), (sm, h)))
+            self.shared = True
+
+    def capacity(self, tokens: int) -> int:
+        c = int(math.ceil(self.capacity_factor * tokens * self.top_k
+                          / self.num_experts))
+        return max(c, 4)
+
+    def forward(self, x, return_aux: bool = False):
+        orig_shape = x.shape
+        h = self.hidden_size
+        xt = x.reshape(-1, h)                          # [T, h]
+        T = xt.shape[0]
+        C = self.capacity(T)
+        logits = xt.astype(jnp.float32) @ self.gate.astype(jnp.float32)
+        dispatch, combine, aux = top_k_routing(logits, self.top_k, C,
+                                               bias=self.expert_bias)
+        # dispatch to expert buckets: [E, C, h], sharded over ep
+        xe = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
+        xe = constraint(xe, "ep", None, None)
+        # per-expert SwiGLU, batched over E on the MXU
+        g = jnp.einsum("ech,ehm->ecm", xe, self.w_gate)
+        u = jnp.einsum("ech,ehm->ecm", xe, self.w_up)
+        ye = jnp.einsum("ecm,emh->ech", F.silu(g) * u, self.w_down)
+        ye = constraint(ye, "ep", None, None)
+        y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), ye)
+        if self.shared:
+            sg = F.silu(xt @ self.shared_gate_proj) * (xt @ self.shared_up_proj)
+            y = y + sg @ self.shared_down_proj
+        y = y.reshape(orig_shape)
+        if return_aux:
+            return y, self.aux_loss_weight * aux
+        return y
+
+    def update_loss_free_bias(self, router_logits, lr: float = 1e-3):
+        """DeepSeek-V3 loss-free balancing: nudge per-expert bias opposite
+        to its load error (host-side, outside the gradient path)."""
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        _, ids = jax.lax.top_k(probs + self.expert_bias[None, :], self.top_k)
+        load = jnp.mean(jax.nn.one_hot(ids, self.num_experts).sum(1), axis=0)
+        err = load - self.top_k / self.num_experts
+        self._buffers["expert_bias"] = self.expert_bias - lr * jnp.sign(err)
+        return self.expert_bias

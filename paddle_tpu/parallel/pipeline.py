@@ -1,0 +1,106 @@
+"""Pipeline parallelism (reference: fleet.meta_parallel.PipelineLayer +
+pp_utils: 1F1B interleaved schedule, NCCL p2p send/recv between stage
+ranks).
+
+TPU-native: SPMD pipelining inside `shard_map` over the ``pp`` axis.
+Stage weights are *stacked* on a leading [pp] dim (each device holds its
+stage's slice); activations hand off between neighbors with `lax.ppermute`
+(ICI p2p). The schedule is a static `lax.scan` over
+``n_micro + n_stages - 1`` ticks: at tick t, stage s computes microbatch
+``t - s`` (classic GPipe fill/drain). Because ppermute and scan are
+differentiable, `jax.grad` of the pipelined forward *is* the reverse-order
+pipeline — the 1F1B backward emerges from autodiff + XLA scheduling rather
+than a hand-maintained schedule.
+
+The GSPMD-only fallback (no shard_map) is simply running the stacked-stage
+scan with the stage dim sharded over pp — XLA overlaps stages across
+microbatches the same way.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..distributed.env import get_mesh
+
+
+def spmd_pipeline(stage_fn: Callable, axis_name: str = "pp"):
+    """Wrap `stage_fn(stage_params, x) -> y` into a pipelined
+    `fn(stacked_params, microbatches) -> outputs` to be called INSIDE
+    shard_map with in_specs P('pp') for params (leading stacked dim) and
+    replicated microbatches [n_micro, mb, ...].
+
+    Within shard_map each device sees stage_params with leading dim 1.
+    """
+
+    def pipelined(stacked_params, microbatches):
+        n_stages = lax.axis_size(axis_name)
+        stage = lax.axis_index(axis_name)
+        n_micro = microbatches.shape[0]
+        params = jax.tree.map(lambda p: p[0], stacked_params)  # my stage
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        ticks = n_micro + n_stages - 1
+
+        out_shape = jax.eval_shape(stage_fn, params, microbatches[0])
+        outputs0 = jnp.zeros((n_micro,) + out_shape.shape, out_shape.dtype)
+
+        def tick(carry, t):
+            recv, outputs = carry
+            # stage 0 pulls microbatch t from the feed; others use recv
+            mb_idx = jnp.clip(t, 0, n_micro - 1)
+            x_in = jnp.where(stage == 0,
+                             microbatches[mb_idx].astype(recv.dtype), recv)
+            y = stage_fn(params, x_in)
+            # mask ticks where this stage has no live microbatch
+            my_mb = t - stage
+            live = (my_mb >= 0) & (my_mb < n_micro)
+            y = jnp.where(live, y, jnp.zeros_like(y))
+            # last stage records its finished microbatch
+            write_idx = jnp.clip(my_mb, 0, n_micro - 1)
+            is_last = stage == n_stages - 1
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(live & is_last, y,
+                          lax.dynamic_index_in_dim(outputs, write_idx, 0,
+                                                   keepdims=False)),
+                write_idx, 0)
+            recv = lax.ppermute(y, axis_name, perm)
+            return (recv, outputs), None
+
+        recv0 = jnp.zeros(out_shape.shape, out_shape.dtype)
+        (_, outputs), _ = lax.scan(tick, (recv0, outputs0), jnp.arange(ticks))
+        # only the last stage holds real outputs; broadcast them ringwise
+        outputs = lax.psum(
+            jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name)
+        return outputs
+
+    return pipelined
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
+                   axis_name: str = "pp", mesh=None):
+    """Run the pipelined computation over the global mesh.
+
+    stacked_params: pytree with leading dim n_stages (sharded over pp).
+    microbatches: [n_micro, micro_batch, ...] (replicated).
+    Requires stage_fn's output shape == its input shape (transformer blocks).
+    """
+    mesh = mesh or get_mesh()
+    fn = spmd_pipeline(stage_fn, axis_name)
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis_name), stacked_params), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, microbatches)
+
+
+def stack_stage_params(per_stage_params: list):
+    """[{name: Array}, ...] per stage -> {name: Array[n_stages, ...]}."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)

@@ -1,0 +1,108 @@
+"""Ring attention — sequence/context parallelism for long sequences
+(reference: fleet's sep/context-parallel path in
+paddle/distributed/fleet/meta_parallel/, which shards the sequence over
+ranks and exchanges KV with NCCL send/recv).
+
+TPU-native: inside `shard_map` over the ``sp`` mesh axis, each device holds
+one sequence block of Q/K/V. KV blocks rotate around the ring with
+`lax.ppermute` (ICI neighbor exchange — bandwidth-optimal on a TPU torus)
+while each device accumulates its Q block's attention with an *online
+softmax* (running max + denominator), exactly the flash-attention
+recurrence across devices. Causality is enforced per (q-block, kv-block)
+pair, so blocks strictly in the future contribute nothing (their compute is
+masked; the rotation still happens to keep the schedule static).
+
+Differentiable end-to-end: ppermute has a transpose rule, so `jax.grad`
+through ring_attention yields the reverse ring — no hand-written backward.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    """q [b,sq,h,d], k [b,sk,kvh,d] -> scores [b,h,sq,sk] (fp32), GQA-aware."""
+    h, kvh = q.shape[2], k.shape[2]
+    if kvh != h:
+        k = jnp.repeat(k, h // kvh, axis=2)
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+
+
+def _block_pv(p, v, h):
+    kvh = v.shape[2]
+    if kvh != h:
+        v = jnp.repeat(v, h // kvh, axis=2)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Blockwise ring attention. Call inside shard_map with q/k/v
+    [b, s_local, h|kvh, d] sharded on the sequence dim over `axis_name`.
+    Returns [b, s_local, h, d] (the local Q block's full attention)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, step):
+        o, m, l, kc, vc = carry
+        kv_idx = (idx - step) % n  # whose sequence block we currently hold
+        s_scores = _block_scores(q, kc, scale)  # [b,h,sq,sk]
+        if causal:
+            qpos = idx * s + jnp.arange(s)[:, None]
+            kpos = kv_idx * s + jnp.arange(s)[None, :]
+            mask = (kpos <= qpos)[None, None]
+            s_scores = jnp.where(mask, s_scores, NEG_INF)
+        m_new = jnp.maximum(m, s_scores.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s_scores - m_new[..., None])
+        l_new = l * alpha + p.sum(axis=-1)
+        pv = _block_pv(p.astype(q.dtype), vc, h)  # [b,sq,h,d]
+        o_new = o * jnp.swapaxes(alpha, 1, 2)[..., None].astype(o.dtype) \
+            + pv.astype(o.dtype)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o_new, m_new, l_new, kc, vc), None
+
+    o0 = jnp.zeros((b, s, h, d), jnp.float32)
+    m0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(tick, (o0, m0, l0, k, v), jnp.arange(n))
+    denom = jnp.swapaxes(l, 1, 2)[..., None]  # [b,sq,h,1]
+    return (o / jnp.maximum(denom, 1e-20)).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                      scale: Optional[float] = None, attn_fn=None):
+    """DeepSpeed-Ulysses sequence parallelism (reference: sep_degree path):
+    all_to_all trades the sequence shard for a head shard, runs ordinary
+    (full-sequence) attention on h/n heads, and trades back. Cheaper than
+    ring when heads >= sp degree; requires num_heads % sp == 0."""
+    from ..ops.attention import dense_attention
+    attn_fn = attn_fn or functools.partial(dense_attention, scale=scale)
+    n = lax.axis_size(axis_name)
+
+    def swap_in(x):   # [b, s/n, h, d] -> [b, s, h/n, d]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def swap_out(x):  # [b, s, h/n, d] -> [b, s/n, h, d]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    kvh = k.shape[2]
+    if kvh < n:  # too few KV heads to split: replicate them up to sp degree
+        k = jnp.repeat(k, n // math.gcd(n, kvh), axis=2)
+        v = jnp.repeat(v, n // math.gcd(n, kvh), axis=2)
+    out = attn_fn(swap_in(q), swap_in(k), swap_in(v), causal=causal)
+    return swap_out(out)

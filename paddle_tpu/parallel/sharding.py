@@ -123,7 +123,9 @@ def shard_layer(layer: Layer, mesh: Optional[Mesh] = None, **kw) -> Dict[str, Na
 
 def constraint(x, *spec):
     """`lax.with_sharding_constraint` against the global mesh; no-op when no
-    mesh is installed or it is single-device (keeps layers usable eagerly)."""
+    mesh is installed or it is single-device (keeps layers usable eagerly).
+    Axes that don't evenly divide their dim are dropped (a hint must never
+    make a program invalid — e.g. a debug batch of 2 on an 8-way dp mesh)."""
     if not has_mesh():
         return x
     mesh = get_mesh()
@@ -132,8 +134,22 @@ def constraint(x, *spec):
     cleaned = _drop_dead_axes(tuple(spec), mesh)
     if not cleaned:
         return x
+    fitted = []
+    for dim, axes in enumerate(cleaned):
+        if axes is None:
+            fitted.append(None)
+            continue
+        tup = (axes,) if isinstance(axes, str) else tuple(axes)
+        degree = 1
+        for a in tup:
+            degree *= mesh.shape.get(a, 1)
+        fitted.append(axes if x.shape[dim] % degree == 0 else None)
+    while fitted and fitted[-1] is None:
+        fitted.pop()
+    if not fitted:
+        return x
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, P(*cleaned)))
+        x, NamedSharding(mesh, P(*fitted)))
 
 
 def tree_shardings(tree, like: Dict[str, NamedSharding], default=None):

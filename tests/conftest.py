@@ -19,6 +19,8 @@ import pytest
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as pt
+    from paddle_tpu.distributed import env
     pt.seed(0)
     np.random.seed(0)
     yield
+    env.clear_mesh()  # tests that install a mesh must not leak it

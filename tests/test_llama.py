@@ -103,3 +103,20 @@ def test_recompute_same_loss(tiny):
     fn_r, _ = model_r.functional()
     loss_b = float(causal_lm_loss(jax.jit(fn_r)(params, ids), ids))
     np.testing.assert_allclose(loss_a, loss_b, rtol=1e-5)
+
+
+def test_sequence_parallel_matches_dense():
+    """Llama with ring attention (sp=4) == same weights without sp."""
+    env.init_parallel_env({"sp": 4, "dp": 2})
+    try:
+        pt.seed(3)
+        model = LlamaForCausalLM(llama_tiny(sequence_parallel=True))
+        ids = jnp.asarray(np.random.randint(0, 256, (2, 32)))
+        fn, params = model.functional()
+        out_sp = jax.jit(fn)(params, ids)
+        model.config.sequence_parallel = False
+        out_dense = jax.jit(fn)(params, ids)
+        np.testing.assert_allclose(np.asarray(out_sp), np.asarray(out_dense),
+                                   rtol=2e-3, atol=2e-3)
+    finally:
+        env.init_parallel_env({})
