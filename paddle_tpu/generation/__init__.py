@@ -1,0 +1,162 @@
+"""Autoregressive generation (reference: PaddleNLP
+paddlenlp/generation/utils.py GenerationMixin.generate — greedy/sampling/
+beam search over a KV cache).
+
+TPU-native: ONE compiled program per (batch, prompt_len, max_len) bucket —
+prefill + a `lax.while_loop` decode over a static-shape KV cache. No
+per-token retracing, no dynamic shapes. Sampling params are traced scalars
+where possible so changing temperature does not recompile.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .sampling import sample_token
+
+__all__ = ["GenerationConfig", "generate", "beam_search"]
+
+
+@dataclass
+class GenerationConfig:
+    max_new_tokens: int = 64
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+    num_beams: int = 1
+    length_penalty: float = 1.0
+
+
+def generate(model, input_ids, config: Optional[GenerationConfig] = None,
+             key=None, params=None, **kwargs):
+    """Greedy/sampled decoding. `model` is a Layer with `init_kv_caches` and
+    forward(ids, kv_caches=, cache_index=) (the CausalLM contract).
+
+    Returns [b, prompt_len + max_new_tokens] token ids (right-padded with
+    pad_token_id after eos)."""
+    cfg = config or GenerationConfig(**kwargs)
+    if cfg.num_beams > 1:
+        return beam_search(model, input_ids, cfg, params=params)
+    key = key if key is not None else jax.random.key(0)
+    fn, model_params = model.functional()
+    params = params if params is not None else model_params
+    b, prompt_len = input_ids.shape
+    total = prompt_len + cfg.max_new_tokens
+    eos = cfg.eos_token_id
+
+    @functools.partial(jax.jit, static_argnums=())
+    def run(params, input_ids, key, temperature):
+        caches = model.init_kv_caches(b, total)
+        # prefill
+        logits, caches = fn(params, input_ids, kv_caches=caches, cache_index=0)
+        tokens = jnp.concatenate(
+            [input_ids,
+             jnp.full((b, cfg.max_new_tokens), cfg.pad_token_id,
+                      input_ids.dtype)], axis=1)
+        next_tok = sample_token(logits[:, -1], key,
+                                temperature=temperature, top_k=cfg.top_k,
+                                top_p=cfg.top_p, do_sample=cfg.do_sample)
+        tokens = tokens.at[:, prompt_len].set(next_tok)
+        done = jnp.zeros((b,), bool) if eos is None else (next_tok == eos)
+
+        def cond(state):
+            tokens, caches, cur, key, done = state
+            return (cur < total) & ~jnp.all(done)
+
+        def body(state):
+            tokens, caches, cur, key, done = state
+            ids = jax.lax.dynamic_slice_in_dim(tokens, cur - 1, 1, axis=1)
+            logits, caches = fn(params, ids, kv_caches=caches,
+                                cache_index=cur - 1)
+            key, sub = jax.random.split(key)
+            nxt = sample_token(logits[:, 0], sub, temperature=temperature,
+                               top_k=cfg.top_k, top_p=cfg.top_p,
+                               do_sample=cfg.do_sample)
+            nxt = jnp.where(done, jnp.asarray(cfg.pad_token_id, nxt.dtype), nxt)
+            tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None], (0, cur))
+            if eos is not None:
+                done = done | (nxt == eos)
+            return (tokens, caches, cur + 1, key, done)
+
+        state = (tokens, caches, jnp.asarray(prompt_len + 1), key, done)
+        tokens, *_ = jax.lax.while_loop(cond, body, state)
+        return tokens
+
+    return run(params, input_ids, key, jnp.float32(cfg.temperature))
+
+
+def beam_search(model, input_ids, config: GenerationConfig, params=None):
+    """Beam search (reference: PaddleNLP BeamSearchScorer). Beams live as an
+    expanded batch [b*beams]; the KV cache is gathered per step with the
+    beam indices — static shapes throughout."""
+    cfg = config
+    k = cfg.num_beams
+    fn, model_params = model.functional()
+    params = params if params is not None else model_params
+    b, prompt_len = input_ids.shape
+    total = prompt_len + cfg.max_new_tokens
+    eos = cfg.eos_token_id
+    vocab = model.config.vocab_size
+
+    @jax.jit
+    def run(params, input_ids):
+        # expand prompts to beams
+        ids = jnp.repeat(input_ids, k, axis=0)              # [b*k, L]
+        caches = model.init_kv_caches(b * k, total)
+        logits, caches = fn(params, ids, kv_caches=caches, cache_index=0)
+        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), -1)
+        logp = logp.reshape(b, k, vocab)
+        # first step: only beam 0 is live (identical beams would collapse)
+        first_mask = jnp.where(jnp.arange(k)[None, :, None] == 0, 0.0, -jnp.inf)
+        scores, idx = jax.lax.top_k((logp + first_mask).reshape(b, -1), k)
+        beam_src, next_tok = idx // vocab, idx % vocab      # [b, k]
+
+        tokens = jnp.concatenate(
+            [ids, jnp.full((b * k, cfg.max_new_tokens), cfg.pad_token_id,
+                           ids.dtype)], axis=1)
+        tokens = tokens.at[:, prompt_len].set(next_tok.reshape(-1))
+        done = jnp.zeros((b, k), bool) if eos is None else (next_tok == eos)
+
+        def gather_beams(tree, src):
+            flat_src = (src + jnp.arange(b)[:, None] * k).reshape(-1)
+            return jax.tree.map(lambda x: x[flat_src], tree)
+
+        def body(cur, state):
+            tokens, caches, scores, done = state
+            ids_t = jax.lax.dynamic_slice_in_dim(tokens, cur - 1, 1, axis=1)
+            logits, new_caches = fn(params, ids_t, kv_caches=caches,
+                                    cache_index=cur - 1)
+            logp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32), -1)
+            logp = logp.reshape(b, k, vocab)
+            # finished beams: freeze score, only pad continues
+            pad_only = jnp.full((vocab,), -jnp.inf).at[cfg.pad_token_id].set(0.0)
+            logp = jnp.where(done[..., None], pad_only[None, None], logp)
+            cand = scores[..., None] + logp                 # [b, k, v]
+            scores, idx = jax.lax.top_k(cand.reshape(b, -1), k)
+            beam_src, next_tok = idx // vocab, idx % vocab
+            tokens = gather_beams(tokens, beam_src)
+            caches = gather_beams(new_caches, beam_src)
+            done = jnp.take_along_axis(done, beam_src, axis=1)
+            nxt = jnp.where(done, cfg.pad_token_id, next_tok)
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, nxt.reshape(-1, 1), (0, cur))
+            if eos is not None:
+                done = done | (nxt == eos)
+            return (tokens, caches, scores, done)
+
+        state = (tokens, caches, scores, done)
+        state = jax.lax.fori_loop(prompt_len + 1, total,
+                                  lambda c, s: body(c, s), state)
+        tokens, _, scores, _ = state
+        # length penalty then best beam per batch row
+        best = jnp.argmax(scores, axis=1)
+        return tokens.reshape(b, k, total)[jnp.arange(b), best]
+
+    return run(params, input_ids)

@@ -1,0 +1,64 @@
+"""Logits processors for autoregressive decoding (reference: PaddleNLP
+paddlenlp/generation/logits_process.py — TopKProcess, TopPProcess,
+temperature, repetition penalty).
+
+All processors are pure jnp on static shapes so the whole decode loop
+compiles into one XLA program (`lax.while_loop`), never re-tracing per
+token. Filtering uses mask-to--inf (no dynamic shapes from sorting)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def apply_temperature(logits, temperature):
+    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    return logits / t
+
+
+def top_k_filter(logits, k: int):
+    """Keep the k highest logits per row; mask the rest to -inf. Static k."""
+    if k <= 0:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def top_p_filter(logits, p: float):
+    """Nucleus sampling: keep the smallest prefix of the sorted distribution
+    with cumulative prob >= p (always keeps the argmax)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # mask sorted positions whose *previous* cumulative already reached p
+    keep_sorted = (cum - probs) < p
+    # threshold = smallest kept logit
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+def repetition_penalty(logits, generated_mask, penalty: float):
+    """Divide (positive) / multiply (negative) logits of seen tokens
+    (generated_mask [b, vocab] counts>0)."""
+    if penalty == 1.0:
+        return logits
+    seen = generated_mask > 0
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
+def sample_token(logits, key, temperature=1.0, top_k=0, top_p=1.0,
+                 do_sample=True):
+    """logits [b, vocab] -> token ids [b]."""
+    logits = logits.astype(jnp.float32)
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    logits = apply_temperature(logits, temperature)
+    if top_k and top_k > 0:
+        logits = top_k_filter(logits, top_k)
+    if top_p < 1.0:
+        logits = top_p_filter(logits, top_p)
+    return jax.random.categorical(key, logits, axis=-1)

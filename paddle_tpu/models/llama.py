@@ -267,6 +267,10 @@ class LlamaForCausalLM(Layer):
         logits = logits.astype(jnp.float32)  # CE in fp32 for stability
         return (logits, caches) if kv_caches is not None else logits
 
+    def generate(self, input_ids, config=None, key=None, **kwargs):
+        from ..generation import generate as _generate
+        return _generate(self, input_ids, config=config, key=key, **kwargs)
+
     def init_kv_caches(self, batch_size: int, max_len: int, dtype=None):
         cfg = self.config
         dtype = dtype or cfg.dtype

@@ -1,0 +1,207 @@
+"""Trainer (reference: PaddleNLP paddlenlp/trainer/trainer.py — the
+train loop with gradient accumulation, hybrid-parallel awareness, AMP,
+checkpointing/auto-resume, callbacks, and eval).
+
+TPU-native: ONE jitted train step (loss -> grads -> clip -> optimizer)
+with donated (params, opt_state) so the update is in-place in HBM.
+Gradient accumulation folds into the same program via `lax.scan` over the
+microbatch dim — not N python-side steps. Hybrid parallelism is ambient:
+if a mesh is installed, params are sharded by their partition metadata
+(fleet.distributed_model) and the step compiles to SPMD; the loop itself
+is identical single-chip vs pod. Aux wiring: JSONL metrics (C21), NaN
+watchdog (C20), orbax auto-resume (C14)."""
+from __future__ import annotations
+
+import functools
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models.llama import causal_lm_loss
+from .nn.layer import Layer
+from .optimizer.optimizers import Optimizer
+from .utils.logging import LogWriter
+from .utils.watchdog import StepWatchdog
+
+
+@dataclass
+class TrainingArguments:
+    """Reference: paddlenlp.trainer.TrainingArguments (subset that matters)."""
+    output_dir: str = "output"
+    max_steps: int = 1000
+    gradient_accumulation_steps: int = 1
+    logging_steps: int = 10
+    save_steps: int = 0              # 0 = no periodic ckpt
+    eval_steps: int = 0
+    resume_from_checkpoint: bool = True
+    max_grad_norm: float = 1.0
+    seed: int = 42
+    nan_patience: int = 3
+    donate_state: bool = True
+
+
+class TrainerCallback:
+    def on_step_end(self, step: int, logs: Dict[str, float]):  # noqa: D401
+        pass
+
+    def on_save(self, step: int):
+        pass
+
+    def on_train_end(self, step: int):
+        pass
+
+
+class Trainer:
+    def __init__(self, model: Layer, optimizer: Optimizer,
+                 args: Optional[TrainingArguments] = None,
+                 loss_fn: Optional[Callable] = None,
+                 train_dataloader: Optional[Iterable] = None,
+                 eval_dataloader: Optional[Iterable] = None,
+                 callbacks: Optional[List[TrainerCallback]] = None):
+        self.model = model
+        self.optimizer = optimizer
+        self.args = args or TrainingArguments()
+        # loss_fn(pure_fn, params, batch) -> scalar; default: causal LM on
+        # a batch of token ids (the flagship recipe)
+        self.loss_fn = loss_fn or (
+            lambda fn, p, batch: causal_lm_loss(fn(p, batch), batch))
+        self.train_dataloader = train_dataloader
+        self.eval_dataloader = eval_dataloader
+        self.callbacks = callbacks or []
+        self.logger = LogWriter(os.path.join(self.args.output_dir, "runs"))
+        self.watchdog = StepWatchdog(nan_patience=self.args.nan_patience)
+        self._pure_fn, self._params = model.functional()
+        self._opt_state = None
+        self._step_fn = None
+        self.global_step = 0
+
+    # ------------------------------------------------------------ jit step
+    def _build_step(self):
+        fn, opt, args = self._pure_fn, self.optimizer, self.args
+        accum = args.gradient_accumulation_steps
+
+        def loss_of(p, batch):
+            return self.loss_fn(fn, p, batch)
+
+        if accum == 1:
+            def step(params, state, stepno, batch):
+                loss, grads = jax.value_and_grad(loss_of)(params, batch)
+                params, state = opt.apply(params, grads, state, stepno)
+                return params, state, loss
+        else:
+            def step(params, state, stepno, batch):
+                # batch leading dim = accum: scan microbatches, mean grads
+                def micro(carry, mb):
+                    gsum, lsum = carry
+                    loss, g = jax.value_and_grad(loss_of)(params, mb)
+                    gsum = jax.tree.map(jnp.add, gsum, g)
+                    return (gsum, lsum + loss), None
+                zeros = jax.tree.map(jnp.zeros_like, params)
+                (gsum, lsum), _ = jax.lax.scan(micro, (zeros, 0.0), batch)
+                grads = jax.tree.map(lambda g: g / accum, gsum)
+                params, state = opt.apply(params, grads, state, stepno)
+                return params, state, lsum / accum
+
+        donate = (0, 1) if args.donate_state else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    # ------------------------------------------------------------- train
+    def train(self, max_steps: Optional[int] = None):
+        args = self.args
+        max_steps = max_steps or args.max_steps
+        if self._opt_state is None:
+            self._opt_state = self.optimizer.init(self._params)
+        if args.resume_from_checkpoint and args.save_steps:
+            self._try_resume()
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+
+        assert self.train_dataloader is not None, "pass train_dataloader"
+        data = iter(self.train_dataloader)
+        t_last = time.perf_counter()
+        while self.global_step < max_steps:
+            try:
+                batch = next(data)
+            except StopIteration:
+                data = iter(self.train_dataloader)
+                batch = next(data)
+            batch = self._prep_batch(batch)
+            self._params, self._opt_state, loss = self._step_fn(
+                self._params, self._opt_state, jnp.int32(self.global_step),
+                batch)
+            self.global_step += 1
+            if self.global_step % args.logging_steps == 0 or \
+                    self.global_step == max_steps:
+                loss_val = float(loss)
+                self.watchdog.check_loss(loss_val, self.global_step)
+                now = time.perf_counter()
+                logs = {"loss": loss_val,
+                        "steps_per_sec": args.logging_steps / (now - t_last)}
+                t_last = now
+                self.logger.add_scalars(logs, self.global_step)
+                for cb in self.callbacks:
+                    cb.on_step_end(self.global_step, logs)
+            if args.save_steps and self.global_step % args.save_steps == 0:
+                self.save_checkpoint()
+            if args.eval_steps and self.eval_dataloader is not None and \
+                    self.global_step % args.eval_steps == 0:
+                self.evaluate()
+        for cb in self.callbacks:
+            cb.on_train_end(self.global_step)
+        # leave the module tree holding the trained weights
+        self.model.bind(self._params)
+        return self
+
+    def _prep_batch(self, batch):
+        accum = self.args.gradient_accumulation_steps
+        if accum > 1 and hasattr(batch, "shape"):
+            b = batch.shape[0]
+            assert b % accum == 0, f"batch {b} % accum {accum} != 0"
+            batch = batch.reshape((accum, b // accum) + batch.shape[1:])
+        return batch
+
+    # ------------------------------------------------------------- eval
+    def evaluate(self) -> float:
+        assert self.eval_dataloader is not None
+        fn = self._pure_fn
+        losses = []
+        eval_loss = jax.jit(lambda p, b: self.loss_fn(fn, p, b))
+        for batch in self.eval_dataloader:
+            losses.append(float(eval_loss(self._params, batch)))
+        mean = float(np.mean(losses)) if losses else float("nan")
+        self.logger.add_scalar("eval_loss", mean, self.global_step)
+        return mean
+
+    # --------------------------------------------------------- checkpoint
+    def _ckpt_dir(self):
+        return os.path.join(self.args.output_dir, "checkpoints")
+
+    def save_checkpoint(self, wait: bool = False):
+        from .checkpoint.distributed_ckpt import DistributedCheckpoint
+        ckpt = DistributedCheckpoint(self._ckpt_dir())
+        ckpt.save(self.global_step,
+                  {"params": dict(self._params),
+                   "opt_state": self._opt_state}, wait=wait)
+        ckpt.wait_until_finished() if wait else None
+        ckpt.close()
+        for cb in self.callbacks:
+            cb.on_save(self.global_step)
+
+    def _try_resume(self):
+        from .checkpoint.distributed_ckpt import DistributedCheckpoint
+        if not os.path.isdir(self._ckpt_dir()):
+            return
+        ckpt = DistributedCheckpoint(self._ckpt_dir())
+        step = ckpt.latest_complete_step()
+        if step is not None:
+            restored = ckpt.restore(step, like={
+                "params": dict(self._params), "opt_state": self._opt_state})
+            self._params = restored["params"]
+            self._opt_state = restored["opt_state"]
+            self.global_step = step
+        ckpt.close()

@@ -1,0 +1,103 @@
+"""Profiling (reference: paddle.profiler.Profiler — scheduler, timer_only
+mode, chrome-trace export).
+
+TPU-native: wraps `jax.profiler` (perfetto/xplane traces viewable in
+tensorboard or perfetto.dev) and adds the numbers people actually watch in
+training loops: step time, tokens/sec, and MFU against the chip's peak."""
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def device_peak_flops(device=None) -> float:
+    device = device or jax.devices()[0]
+    return PEAK_BF16_FLOPS.get(getattr(device, "device_kind", ""), 197e12)
+
+
+class Profiler:
+    """paddle.profiler.Profiler-shaped facade over jax.profiler."""
+
+    def __init__(self, logdir: str = "runs/profile", timer_only: bool = False):
+        self.logdir = logdir
+        self.timer_only = timer_only
+        self._active = False
+
+    def start(self):
+        if not self.timer_only:
+            jax.profiler.start_trace(self.logdir)
+        self._active = True
+
+    def stop(self):
+        if self._active and not self.timer_only:
+            jax.profiler.stop_trace()
+        self._active = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Trace annotation visible in the profile (reference:
+    paddle.profiler.RecordEvent)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@dataclass
+class StepTimer:
+    """Running step-time / throughput / MFU meter."""
+    flops_per_token: float = 0.0
+    peak_flops: float = field(default_factory=device_peak_flops)
+    _t0: Optional[float] = None
+    steps: int = 0
+    total_s: float = 0.0
+    total_tokens: int = 0
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self, tokens: int = 0):
+        assert self._t0 is not None
+        dt = time.perf_counter() - self._t0
+        self.steps += 1
+        self.total_s += dt
+        self.total_tokens += tokens
+        return dt
+
+    @property
+    def avg_step_s(self) -> float:
+        return self.total_s / max(self.steps, 1)
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.total_tokens / max(self.total_s, 1e-9)
+
+    @property
+    def mfu(self) -> float:
+        if not self.flops_per_token:
+            return 0.0
+        return self.flops_per_token * self.tokens_per_sec / self.peak_flops
+
+
+def llama_flops_per_token(n_params: int, num_layers: int, seq_len: int,
+                          hidden: int) -> float:
+    """6N matmul + causal-attention term (fwd+bwd)."""
+    return 6.0 * n_params + 6.0 * num_layers * seq_len * hidden

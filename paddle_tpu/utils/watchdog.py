@@ -1,0 +1,69 @@
+"""Failure detection (reference: paddle's elastic/fault-tolerant training —
+paddle.distributed.elastic, and the NaN/Inf checks in
+paddle.amp.debugging / check_numerics).
+
+TPU analogue: jit programs either run or raise — the failure modes that
+matter are (1) numeric divergence (NaN/Inf loss or grads) and (2) a hung
+step (stuck host callback / preempted TPU). `StepWatchdog` covers both:
+a NaN ring-buffer with a divergence threshold, and a wall-clock heartbeat
+a monitor thread checks. Auto-resume = Trainer reloads the
+latest-complete checkpoint (checkpoint.distributed_ckpt) on restart."""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable, Optional
+
+
+class DivergenceError(RuntimeError):
+    pass
+
+
+class StepWatchdog:
+    def __init__(self, nan_patience: int = 3,
+                 hang_timeout_s: Optional[float] = None,
+                 on_hang: Optional[Callable[[], None]] = None):
+        """nan_patience: consecutive non-finite losses tolerated before
+        raising DivergenceError (transient fp16 spikes are normal with a
+        GradScaler; persistent NaN is divergence)."""
+        self.nan_patience = nan_patience
+        self._nan_streak = 0
+        self._last_beat = time.monotonic()
+        self._hang_timeout = hang_timeout_s
+        self._on_hang = on_hang
+        self._monitor: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        if hang_timeout_s is not None:
+            self._monitor = threading.Thread(target=self._watch, daemon=True)
+            self._monitor.start()
+
+    # ------------------------------------------------------------- numeric
+    def check_loss(self, loss_value: float, step: int):
+        if math.isfinite(loss_value):
+            self._nan_streak = 0
+        else:
+            self._nan_streak += 1
+            if self._nan_streak >= self.nan_patience:
+                raise DivergenceError(
+                    f"loss non-finite for {self._nan_streak} consecutive "
+                    f"steps (last step {step}) — stopping; resume from the "
+                    f"latest checkpoint with a lower lr / loss scale")
+        self.beat()
+
+    # ------------------------------------------------------------ heartbeat
+    def beat(self):
+        self._last_beat = time.monotonic()
+
+    def seconds_since_beat(self) -> float:
+        return time.monotonic() - self._last_beat
+
+    def _watch(self):
+        while not self._stop.wait(min(self._hang_timeout / 4, 30.0)):
+            if self.seconds_since_beat() > self._hang_timeout:
+                if self._on_hang is not None:
+                    self._on_hang()
+                self._last_beat = time.monotonic()  # fire once per hang
+
+    def close(self):
+        self._stop.set()
