@@ -1,3 +1,14 @@
 """paddle_tpu.models — model zoo (reference: PaddleNLP/PaddleMIX recipes)."""
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel, causal_lm_loss,
                     llama3_8b, llama_tiny)
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel, gpt_tiny
+from .bert import (BertConfig, BertForPretraining,
+                   BertForSequenceClassification, BertModel, bert_tiny,
+                   pretraining_loss)
+from .ernie import (ErnieConfig, ErnieForMaskedLM,
+                    ErnieForSequenceClassification, ErnieModel, ernie_tiny)
+from .qwen2 import (Qwen2Config, Qwen2ForCausalLM, Qwen2Model, qwen2_7b,
+                    qwen2_tiny)
+from .qwen2_moe import (DeepseekMoeConfig, DeepseekMoeForCausalLM,
+                        Qwen2MoeConfig, Qwen2MoeForCausalLM, Qwen2MoeModel,
+                        deepseek_moe_tiny, moe_lm_loss, qwen2_moe_tiny)

@@ -1,0 +1,30 @@
+"""Shared causal-LM plumbing (reference: PaddleNLP's GenerationMixin on
+PretrainedModel — every *ForCausalLM gains generate() and cache setup).
+
+One implementation of the generation entry point and the static-shape KV
+cache allocator; models only differ in their KV head count, read off the
+config (GQA models set num_key_value_heads, MHA models fall back to
+num_attention_heads).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+
+
+class CausalLMBase(Layer):
+    """Base for *ForCausalLM heads: generation + KV-cache allocation."""
+
+    def generate(self, input_ids, config=None, key=None, **kwargs):
+        from ..generation import generate as _generate
+        return _generate(self, input_ids, config=config, key=key, **kwargs)
+
+    def init_kv_caches(self, batch_size: int, max_len: int, dtype=None):
+        cfg = self.config
+        dtype = dtype or cfg.dtype
+        kv_heads = getattr(cfg, "num_key_value_heads", None) \
+            or cfg.num_attention_heads
+        shape = (batch_size, max_len, kv_heads, cfg.head_dim)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(cfg.num_hidden_layers)]

@@ -1,0 +1,216 @@
+"""BERT family (reference: PaddleNLP paddlenlp/transformers/bert/
+modeling.py — BertModel/BertEmbeddings/BertPooler, BertForPretraining with
+masked-LM + next-sentence heads, BertForSequenceClassification).
+
+TPU-native design: bidirectional encoder of post-LN blocks; attention/MLP
+are Column/RowParallelLinear so GSPMD shards over ``tp``; the padding mask
+is an additive bias broadcast into the attention logits (static shapes —
+no dynamic-length branches under jit). MLM decoder ties to the word
+embedding table via a vocab-parallel matmul.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, Parameter
+from ..ops.attention import dense_attention
+from ..parallel.layers import (ColumnParallelLinear, RowParallelLinear,
+                               VocabParallelEmbedding, parallel_matmul)
+from ..parallel.sharding import constraint
+from ..utils.rng import next_key
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    hidden_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def bert_tiny(**overrides) -> BertConfig:
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=64, dtype=jnp.float32)
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+def padding_bias(attention_mask, dtype):
+    """[b, s] 1/0 mask -> additive [b, 1, 1, s] bias (-inf on pads)."""
+    bias = (1.0 - attention_mask.astype(jnp.float32)) * -1e9
+    return bias[:, None, None, :].astype(dtype)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(std=config.initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(config.vocab_size,
+                                                      config.hidden_size)
+        self.position_embeddings = Parameter(
+            init(next_key(), (config.max_position_embeddings,
+                              config.hidden_size)))
+        self.token_type_embeddings = Parameter(
+            init(next_key(), (config.type_vocab_size, config.hidden_size)))
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, positions=None):
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.arange(s)[None, :].repeat(b, axis=0)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings[positions]
+             + self.token_type_embeddings[token_type_ids])
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, has_bias=True,
+                                             gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, has_bias=True,
+                                          input_is_parallel=True)
+
+    def forward(self, x, attn_bias=None):
+        cfg = self.config
+        b, s, _ = x.shape
+        nh, d = cfg.num_attention_heads, cfg.head_dim
+        qkv = self.qkv_proj(x).reshape(b, s, 3, nh, d)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = constraint(q, None, None, "tp", None)
+        k = constraint(k, None, None, "tp", None)
+        v = constraint(v, None, None, "tp", None)
+        out = dense_attention(q, k, v, causal=False, attn_mask=attn_bias)
+        return self.out_proj(out.reshape(b, s, nh * d))
+
+
+class BertLayer(Layer):
+    """Post-LN transformer block (original BERT residual ordering)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        eps = config.layer_norm_eps
+        self.attention = BertSelfAttention(config)
+        self.attn_norm = nn.LayerNorm(config.hidden_size, epsilon=eps)
+        self.fc_in = ColumnParallelLinear(config.hidden_size,
+                                          config.intermediate_size,
+                                          has_bias=True, gather_output=False)
+        self.fc_out = RowParallelLinear(config.intermediate_size,
+                                        config.hidden_size, has_bias=True,
+                                        input_is_parallel=True)
+        self.out_norm = nn.LayerNorm(config.hidden_size, epsilon=eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attn_bias=None):
+        x = self.attn_norm(x + self.dropout(self.attention(x, attn_bias)))
+        h = self.fc_out(F.gelu(self.fc_in(x)))
+        x = self.out_norm(x + self.dropout(h))
+        return constraint(x, ("dp", "fsdp"), None, None)
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, x):
+        return jnp.tanh(self.dense(x[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig, with_pooler: bool = True):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.layers = nn.LayerList(
+            [BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = BertPooler(config) if with_pooler else None
+        if config.dtype != jnp.float32:
+            self.to(dtype=config.dtype)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                positions=None, extra_embeds=None):
+        x = self.embeddings(input_ids, token_type_ids, positions)
+        if extra_embeds is not None:  # e.g. ERNIE's task-type stream
+            x = x + extra_embeds
+        x = constraint(x, ("dp", "fsdp"), None, None)
+        bias = (padding_bias(attention_mask, x.dtype)
+                if attention_mask is not None else None)
+        for layer in self.layers:
+            x = layer(x, attn_bias=bias)
+        pooled = self.pooler(x) if self.pooler is not None else None
+        return x, pooled
+
+
+class BertForPretraining(Layer):
+    """Masked-LM (tied decoder) + next-sentence-prediction heads."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = nn.LayerNorm(config.hidden_size,
+                                           epsilon=config.layer_norm_eps)
+        self.mlm_bias = Parameter(jnp.zeros((config.vocab_size,)))
+        self.nsp_head = nn.Linear(config.hidden_size, 2)
+        if config.dtype != jnp.float32:
+            self.transform.to(dtype=config.dtype)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        mlm_logits = parallel_matmul(
+            h, self.bert.embeddings.word_embeddings.weight, transpose_y=True)
+        mlm_logits = mlm_logits.astype(jnp.float32) + self.mlm_bias
+        nsp_logits = self.nsp_head(pooled).astype(jnp.float32)
+        return mlm_logits, nsp_logits
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled)).astype(jnp.float32)
+
+
+def pretraining_loss(mlm_logits, mlm_labels, nsp_logits=None, nsp_labels=None,
+                     ignore_index: int = -100):
+    loss = F.cross_entropy(mlm_logits, mlm_labels, ignore_index=ignore_index,
+                           reduction="mean")
+    if nsp_logits is not None and nsp_labels is not None:
+        loss = loss + F.cross_entropy(nsp_logits, nsp_labels,
+                                      reduction="mean")
+    return loss

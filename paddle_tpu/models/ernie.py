@@ -1,0 +1,95 @@
+"""ERNIE family (reference: PaddleNLP paddlenlp/transformers/ernie/
+modeling.py — ErnieModel/ErnieForMaskedLM/ErnieForSequenceClassification;
+architecturally a BERT encoder plus a task-type embedding stream, with
+ERNIE's knowledge-masking pretraining recipe).
+
+TPU-native: reuses the BertModel encoder (post-LN blocks over tp-sharded
+Column/RowParallel projections) and adds the task-type embedding table;
+heads mirror the reference's MLM / classification heads.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, Parameter
+from ..parallel.layers import parallel_matmul
+from ..utils.rng import next_key
+from .bert import BertConfig, BertModel
+
+
+@dataclass
+class ErnieConfig(BertConfig):
+    vocab_size: int = 40000
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+
+
+def ernie_tiny(**overrides) -> ErnieConfig:
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=64, dtype=jnp.float32)
+    base.update(overrides)
+    return ErnieConfig(**base)
+
+
+class ErnieModel(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.encoder = BertModel(config)
+        if config.use_task_id:
+            init = I.Normal(std=config.initializer_range)
+            self.task_type_embeddings = Parameter(
+                init(next_key(), (config.task_type_vocab_size,
+                                  config.hidden_size)).astype(config.dtype))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None, positions=None):
+        # task-type stream adds onto the shared embedding sum (ERNIE 2.0+)
+        extra = None
+        if self.config.use_task_id and task_type_ids is not None:
+            extra = self.task_type_embeddings[task_type_ids]
+        return self.encoder(input_ids, token_type_ids, attention_mask,
+                            positions, extra_embeds=extra)
+
+
+class ErnieForMaskedLM(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.ernie = ErnieModel(config)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = nn.LayerNorm(config.hidden_size,
+                                           epsilon=config.layer_norm_eps)
+        self.mlm_bias = Parameter(jnp.zeros((config.vocab_size,)))
+        if config.dtype != jnp.float32:
+            self.transform.to(dtype=config.dtype)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, attention_mask,
+                            task_type_ids)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        word_w = self.ernie.encoder.embeddings.word_embeddings.weight
+        logits = parallel_matmul(h, word_w, transpose_y=True)
+        return logits.astype(jnp.float32) + self.mlm_bias
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, attention_mask,
+                               task_type_ids)
+        return self.classifier(self.dropout(pooled)).astype(jnp.float32)

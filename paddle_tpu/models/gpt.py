@@ -1,0 +1,208 @@
+"""GPT-2/3 family (reference: PaddleNLP paddlenlp/transformers/gpt/
+modeling.py — GPTModel/GPTForCausalLM/GPTLMHeadModel, MultiHeadAttention
+with fused qkv, learned positional embeddings, pre-LN blocks).
+
+TPU-native design:
+- fused qkv projection as a single ColumnParallelLinear (one big MXU
+  matmul, heads sharded over ``tp``), RowParallel output projection.
+- learned positional embedding table (GPT convention) added at embed time;
+  static-shape KV cache decode identical to the Llama path.
+- pre-LN residual blocks, gelu MLP; activations batch-sharded
+  over ("dp","fsdp") with sequence on "sp" via constraint hints.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn.layer import Layer, Parameter
+from ..nn import initializer as I
+from ..ops.attention import dense_attention, flash_attention, use_flash
+from ..parallel.layers import (ColumnParallelLinear, RowParallelLinear,
+                               VocabParallelEmbedding, parallel_matmul)
+from ..parallel.sharding import constraint
+from ..utils.rng import next_key
+from .base import CausalLMBase
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 2048
+    intermediate_size: int = 8192
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+    recompute: bool = False
+    use_flash_attention: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def gpt_tiny(**overrides) -> GPTConfig:
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=128, dtype=jnp.float32)
+    base.update(overrides)
+    return GPTConfig(**base)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        # fused qkv: one column-parallel matmul, split after
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, has_bias=True,
+                                             gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, has_bias=True,
+                                          input_is_parallel=True)
+
+    def forward(self, x, kv_cache: Optional[Tuple] = None, cache_index=None,
+                attn_mask=None):
+        cfg = self.config
+        b, s, _ = x.shape
+        nh, d = cfg.num_attention_heads, cfg.head_dim
+        qkv = self.qkv_proj(x).reshape(b, s, 3, nh, d)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = constraint(q, None, None, "tp", None)
+        k = constraint(k, None, None, "tp", None)
+        v = constraint(v, None, None, "tp", None)
+
+        new_cache = None
+        if kv_cache is not None:
+            ck, cv = kv_cache
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                              (0, cache_index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                              (0, cache_index, 0, 0))
+            new_cache = (ck, cv)
+            total = ck.shape[1]
+            kpos = jnp.arange(total)[None, :]
+            qpos = cache_index + jnp.arange(s)[:, None]
+            mask = (kpos <= qpos)[None, None]
+            out = dense_attention(q, ck, cv, attn_mask=mask)
+        elif cfg.use_flash_attention and attn_mask is None and use_flash(q, k, None, 0.0):
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            out = dense_attention(q, k, v, causal=attn_mask is None,
+                                  attn_mask=attn_mask)
+        out = self.out_proj(out.reshape(b, s, nh * d))
+        return (out, new_cache) if kv_cache is not None else out
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.fc_in = ColumnParallelLinear(config.hidden_size,
+                                          config.intermediate_size,
+                                          has_bias=True, gather_output=False)
+        self.fc_out = RowParallelLinear(config.intermediate_size,
+                                        config.hidden_size, has_bias=True,
+                                        input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+
+
+class GPTDecoderLayer(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        eps = config.layer_norm_epsilon
+        self.ln_1 = nn.LayerNorm(config.hidden_size, epsilon=eps)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size, epsilon=eps)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x, kv_cache=None, cache_index=None, attn_mask=None):
+        attn_out = self.attn(self.ln_1(x), kv_cache=kv_cache,
+                             cache_index=cache_index, attn_mask=attn_mask)
+        new_cache = None
+        if kv_cache is not None:
+            attn_out, new_cache = attn_out
+        x = x + attn_out
+        x = x + self.mlp(self.ln_2(x))
+        x = constraint(x, ("dp", "fsdp"), "sp", None)
+        return (x, new_cache) if kv_cache is not None else x
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        init = I.Normal(std=config.initializer_range)
+        self.embed_positions = Parameter(
+            init(next_key(), (config.max_position_embeddings,
+                              config.hidden_size)))
+        self.layers = nn.LayerList(
+            [GPTDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+        if config.dtype != jnp.float32:
+            self.to(dtype=config.dtype)
+
+    def forward(self, input_ids, positions=None, kv_caches=None,
+                cache_index=None, attn_mask=None):
+        b, s = input_ids.shape
+        if positions is None:
+            start = cache_index if cache_index is not None else 0
+            positions = start + jnp.arange(s)[None, :].repeat(b, axis=0)
+        x = self.embed_tokens(input_ids) + self.embed_positions[positions]
+        x = constraint(x, ("dp", "fsdp"), "sp", None)
+        new_caches = [] if kv_caches is not None else None
+        for i, layer in enumerate(self.layers):
+            cache_i = kv_caches[i] if kv_caches is not None else None
+            if self.config.recompute and kv_caches is None:
+                x = jax.checkpoint(
+                    lambda h, lyr=layer: lyr(h, attn_mask=attn_mask),
+                    prevent_cse=False)(x)
+            elif kv_caches is not None:
+                x, nc = layer(x, kv_cache=cache_i, cache_index=cache_index,
+                              attn_mask=attn_mask)
+                new_caches.append(nc)
+            else:
+                x = layer(x, attn_mask=attn_mask)
+        x = self.ln_f(x)
+        return (x, new_caches) if kv_caches is not None else x
+
+
+class GPTForCausalLM(CausalLMBase):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.model = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(config.hidden_size,
+                                                config.vocab_size,
+                                                has_bias=False,
+                                                gather_output=True)
+            if config.dtype != jnp.float32:
+                self.lm_head.to(dtype=config.dtype)
+
+    def forward(self, input_ids, positions=None, kv_caches=None,
+                cache_index=None, attn_mask=None):
+        out = self.model(input_ids, positions, kv_caches, cache_index,
+                         attn_mask)
+        caches = None
+        if kv_caches is not None:
+            out, caches = out
+        if self.config.tie_word_embeddings:
+            logits = parallel_matmul(out, self.model.embed_tokens.weight,
+                                     transpose_y=True)
+        else:
+            logits = self.lm_head(out)
+        logits = logits.astype(jnp.float32)
+        return (logits, caches) if kv_caches is not None else logits

@@ -26,11 +26,12 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from ..nn.layer import Layer, Parameter
-from ..ops.attention import dense_attention, flash_attention
+from ..ops.attention import dense_attention, flash_attention, use_flash
 from ..parallel.layers import (ColumnParallelLinear, RowParallelLinear,
                                VocabParallelEmbedding, parallel_matmul)
 from ..parallel.sharding import constraint
 from ..utils.rng import next_key
+from .base import CausalLMBase
 
 
 @dataclass
@@ -45,6 +46,7 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 500000.0
     tie_word_embeddings: bool = False
+    attention_bias: bool = False       # Qwen2 uses biased q/k/v projections
     initializer_range: float = 0.02
     recompute: bool = False
     use_flash_attention: bool = True
@@ -93,12 +95,13 @@ class LlamaAttention(Layer):
         self.config = config
         h, kv = config.num_attention_heads, config.num_key_value_heads
         d = config.head_dim
+        qkv_bias = config.attention_bias
         self.q_proj = ColumnParallelLinear(config.hidden_size, h * d,
-                                           has_bias=False, gather_output=False)
+                                           has_bias=qkv_bias, gather_output=False)
         self.k_proj = ColumnParallelLinear(config.hidden_size, kv * d,
-                                           has_bias=False, gather_output=False)
+                                           has_bias=qkv_bias, gather_output=False)
         self.v_proj = ColumnParallelLinear(config.hidden_size, kv * d,
-                                           has_bias=False, gather_output=False)
+                                           has_bias=qkv_bias, gather_output=False)
         self.o_proj = RowParallelLinear(h * d, config.hidden_size,
                                         has_bias=False, input_is_parallel=True)
 
@@ -147,7 +150,7 @@ class LlamaAttention(Layer):
                 functools.partial(ring_attention, axis_name="sp", causal=True),
                 mesh=get_mesh(), in_specs=(spec,) * 3, out_specs=spec,
                 check_vma=False)(q, k, v)
-        elif cfg.use_flash_attention and attn_mask is None and s >= 128:
+        elif cfg.use_flash_attention and attn_mask is None and use_flash(q, k, None, 0.0):
             out = flash_attention(q, k, v, causal=True)
         else:
             out = dense_attention(q, k, v, causal=attn_mask is None,
@@ -240,7 +243,7 @@ class LlamaModel(Layer):
         return (x, new_caches) if kv_caches is not None else x
 
 
-class LlamaForCausalLM(Layer):
+class LlamaForCausalLM(CausalLMBase):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -266,17 +269,6 @@ class LlamaForCausalLM(Layer):
             logits = self.lm_head(out)
         logits = logits.astype(jnp.float32)  # CE in fp32 for stability
         return (logits, caches) if kv_caches is not None else logits
-
-    def generate(self, input_ids, config=None, key=None, **kwargs):
-        from ..generation import generate as _generate
-        return _generate(self, input_ids, config=config, key=key, **kwargs)
-
-    def init_kv_caches(self, batch_size: int, max_len: int, dtype=None):
-        cfg = self.config
-        dtype = dtype or cfg.dtype
-        shape = (batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim)
-        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-                for _ in range(cfg.num_hidden_layers)]
 
 
 def causal_lm_loss(logits, labels, ignore_index: int = -100):

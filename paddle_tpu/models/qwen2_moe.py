@@ -1,0 +1,194 @@
+"""Qwen2-MoE / DeepSeek-MoE families (reference: PaddleNLP
+paddlenlp/transformers/qwen2_moe/modeling.py — Qwen2MoeSparseMoeBlock with
+shared_expert + shared_expert_gate, and deepseek_v2/modeling.py —
+DeepseekV2MoE with first_k_dense_replace and fine-grained experts).
+
+TPU-native: the dense Llama/Qwen2 decoder backbone with the FFN swapped
+for `parallel.moe.MoEMLP` — GShard capacity dispatch lowered to
+all_to_all over the ``ep`` mesh axis, stacked [E, h, m] expert weights
+batched on the MXU, switch aux loss threaded functionally through the
+forward (no mutable state under jit).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn.layer import Layer
+from ..parallel.layers import (ColumnParallelLinear, VocabParallelEmbedding,
+                               parallel_matmul)
+from ..parallel.moe import MoEMLP
+from ..parallel.sharding import constraint
+from .base import CausalLMBase
+from .llama import LlamaAttention, LlamaConfig, LlamaMLP, causal_lm_loss
+
+
+@dataclass
+class Qwen2MoeConfig(LlamaConfig):
+    vocab_size: int = 151936
+    hidden_size: int = 2048
+    intermediate_size: int = 5632          # dense-layer FFN width
+    moe_intermediate_size: int = 1408      # per-expert FFN width
+    num_experts: int = 60
+    num_experts_per_tok: int = 4
+    num_shared_experts: int = 1            # always-on shared expert(s)
+    shared_expert_intermediate_size: Optional[int] = 5632
+    first_k_dense_replace: int = 0         # DeepSeekMoE: first k layers dense
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.001
+    attention_bias: bool = True
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1000000.0
+
+
+def qwen2_moe_tiny(**overrides) -> Qwen2MoeConfig:
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                moe_intermediate_size=64, num_experts=4,
+                num_experts_per_tok=2, num_shared_experts=1,
+                shared_expert_intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=128,
+                rope_theta=10000.0, dtype=jnp.float32)
+    base.update(overrides)
+    return Qwen2MoeConfig(**base)
+
+
+def deepseek_moe_tiny(**overrides) -> Qwen2MoeConfig:
+    """DeepSeekMoE pattern: first layer dense, fine-grained experts."""
+    base = dict(first_k_dense_replace=1, num_experts=8,
+                num_experts_per_tok=2, num_shared_experts=2,
+                shared_expert_intermediate_size=64, attention_bias=False)
+    base.update(overrides)
+    return qwen2_moe_tiny(**base)
+
+
+class Qwen2MoeDecoderLayer(Layer):
+    def __init__(self, config: Qwen2MoeConfig, layer_idx: int):
+        super().__init__()
+        self.config = config
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   config.rms_norm_eps)
+        self.is_dense = layer_idx < config.first_k_dense_replace
+        if self.is_dense:
+            self.mlp = LlamaMLP(config)
+        else:
+            self.mlp = MoEMLP(
+                config.hidden_size, config.moe_intermediate_size,
+                num_experts=config.num_experts,
+                top_k=config.num_experts_per_tok,
+                capacity_factor=config.capacity_factor,
+                num_shared_experts=config.num_shared_experts,
+                shared_intermediate_size=config.shared_expert_intermediate_size,
+                aux_loss_weight=config.aux_loss_weight)
+
+    def forward(self, x, positions, kv_cache=None, cache_index=None,
+                attn_mask=None):
+        attn_out = self.self_attn(self.input_layernorm(x), positions,
+                                  kv_cache=kv_cache, cache_index=cache_index,
+                                  attn_mask=attn_mask)
+        new_cache = None
+        if kv_cache is not None:
+            attn_out, new_cache = attn_out
+        x = x + attn_out
+        h = self.post_attention_layernorm(x)
+        if self.is_dense:
+            x, aux = x + self.mlp(h), jnp.zeros((), jnp.float32)
+        else:
+            y, aux = self.mlp(h, return_aux=True)
+            x = x + y
+        x = constraint(x, ("dp", "fsdp"), "sp", None)
+        if kv_cache is not None:
+            return x, aux, new_cache
+        return x, aux
+
+
+class Qwen2MoeModel(Layer):
+    def __init__(self, config: Qwen2MoeConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        self.layers = nn.LayerList(
+            [Qwen2MoeDecoderLayer(config, i)
+             for i in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        if config.dtype != jnp.float32:
+            self.to(dtype=config.dtype)
+
+    def forward(self, input_ids, positions=None, kv_caches=None,
+                cache_index=None, attn_mask=None):
+        b, s = input_ids.shape
+        if positions is None:
+            start = cache_index if cache_index is not None else 0
+            positions = start + jnp.arange(s)[None, :].repeat(b, axis=0)
+        x = self.embed_tokens(input_ids)
+        x = constraint(x, ("dp", "fsdp"), "sp", None)
+        aux_total = jnp.zeros((), jnp.float32)
+        new_caches = [] if kv_caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if kv_caches is not None:
+                x, aux, nc = layer(x, positions, kv_cache=kv_caches[i],
+                                   cache_index=cache_index,
+                                   attn_mask=attn_mask)
+                new_caches.append(nc)
+            elif self.config.recompute:
+                x, aux = jax.checkpoint(
+                    lambda h, lyr=layer: lyr(h, positions,
+                                             attn_mask=attn_mask),
+                    prevent_cse=False)(x)
+            else:
+                x, aux = layer(x, positions, attn_mask=attn_mask)
+            aux_total = aux_total + aux
+        x = self.norm(x)
+        if kv_caches is not None:
+            return x, aux_total, new_caches
+        return x, aux_total
+
+
+class Qwen2MoeForCausalLM(CausalLMBase):
+    def __init__(self, config: Qwen2MoeConfig):
+        super().__init__()
+        self.config = config
+        self.model = Qwen2MoeModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(config.hidden_size,
+                                                config.vocab_size,
+                                                has_bias=False,
+                                                gather_output=True)
+            if config.dtype != jnp.float32:
+                self.lm_head.to(dtype=config.dtype)
+
+    def forward(self, input_ids, positions=None, kv_caches=None,
+                cache_index=None, attn_mask=None, return_aux: bool = False):
+        out = self.model(input_ids, positions, kv_caches, cache_index,
+                         attn_mask)
+        caches = None
+        if kv_caches is not None:
+            h, aux, caches = out
+        else:
+            h, aux = out
+        if self.config.tie_word_embeddings:
+            logits = parallel_matmul(h, self.model.embed_tokens.weight,
+                                     transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        logits = logits.astype(jnp.float32)
+        if kv_caches is not None:
+            return (logits, aux, caches) if return_aux else (logits, caches)
+        return (logits, aux) if return_aux else logits
+
+
+def moe_lm_loss(logits, aux_loss, labels, ignore_index: int = -100):
+    """Next-token CE + router balancing aux loss."""
+    return causal_lm_loss(logits, labels, ignore_index) + aux_loss
+
+
+DeepseekMoeConfig = Qwen2MoeConfig
+DeepseekMoeForCausalLM = Qwen2MoeForCausalLM

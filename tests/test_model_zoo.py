@@ -1,0 +1,160 @@
+"""LLM model zoo (SURVEY.md C22): GPT, BERT, ERNIE, Qwen2, Qwen2-MoE —
+forward shapes, overfit sanity, KV-cache decode parity, MoE aux loss."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import (BertForPretraining,
+                               BertForSequenceClassification,
+                               ErnieForMaskedLM, GPTForCausalLM,
+                               Qwen2ForCausalLM, Qwen2MoeForCausalLM,
+                               bert_tiny, causal_lm_loss, deepseek_moe_tiny,
+                               ernie_tiny, gpt_tiny, moe_lm_loss,
+                               qwen2_moe_tiny, qwen2_tiny)
+
+
+def _overfit(model, loss_of_params, steps=50, lr=3e-3, factor=0.5):
+    fn, params = model.functional()
+    opt = pt.optimizer.AdamW(learning_rate=lr)
+    state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, state, n):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_of_params(fn, p))(params)
+        params, state = opt.apply(params, grads, state, n)
+        return params, state, loss
+
+    losses = []
+    for n in range(steps):
+        params, state, loss = step(params, state, n)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * factor, losses[::10]
+    return losses
+
+
+# ------------------------------------------------------------------- GPT
+def test_gpt_forward_and_overfit():
+    model = GPTForCausalLM(gpt_tiny())
+    ids = jnp.asarray(np.random.randint(0, 256, (4, 32)))
+    logits = model(ids)
+    assert logits.shape == (4, 32, 256) and logits.dtype == jnp.float32
+    _overfit(model, lambda fn, p: causal_lm_loss(fn(p, ids), ids))
+
+
+def test_gpt_kv_cache_decode_parity():
+    model = GPTForCausalLM(gpt_tiny())
+    ids = jnp.asarray(np.random.randint(0, 256, (2, 12)))
+    full = model(ids)
+    caches = model.init_kv_caches(2, 16)
+    logits, caches = model(ids[:, :8], kv_caches=caches, cache_index=0)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, :8]),
+                               rtol=2e-4, atol=2e-4)
+    for t in range(8, 12):
+        logits, caches = model(ids[:, t:t + 1], kv_caches=caches,
+                               cache_index=t)
+        np.testing.assert_allclose(np.asarray(logits[:, 0]),
+                                   np.asarray(full[:, t]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+# ------------------------------------------------------------------- BERT
+def test_bert_pretraining_shapes_and_mask():
+    model = BertForPretraining(bert_tiny())
+    ids = jnp.asarray(np.random.randint(0, 256, (2, 16)))
+    mask = jnp.ones((2, 16), jnp.int32).at[:, 12:].set(0)
+    mlm, nsp = model(ids, attention_mask=mask)
+    assert mlm.shape == (2, 16, 256) and nsp.shape == (2, 2)
+    # masking out pad positions must not change non-pad logits' finiteness
+    assert np.isfinite(np.asarray(mlm)).all()
+
+
+def test_bert_classifier_overfit():
+    model = BertForSequenceClassification(bert_tiny(), num_classes=2)
+    ids = jnp.asarray(np.random.randint(0, 256, (8, 12)))
+    labels = jnp.asarray(np.arange(8) % 2)
+
+    def loss(fn, p):
+        return pt.nn.functional.cross_entropy(fn(p, ids), labels,
+                                              reduction="mean")
+    _overfit(model, loss, steps=60)
+
+
+# ------------------------------------------------------------------ ERNIE
+def test_ernie_mlm_forward():
+    model = ErnieForMaskedLM(ernie_tiny())
+    ids = jnp.asarray(np.random.randint(0, 256, (2, 16)))
+    task = jnp.zeros((2, 16), jnp.int32)
+    logits = model(ids, task_type_ids=task)
+    assert logits.shape == (2, 16, 256)
+    # task-type stream participates: different task ids change the output
+    logits2 = model(ids, task_type_ids=task + 1)
+    assert not np.allclose(np.asarray(logits), np.asarray(logits2))
+
+
+# ------------------------------------------------------------------ Qwen2
+def test_qwen2_has_qkv_bias_and_overfits():
+    model = Qwen2ForCausalLM(qwen2_tiny())
+    assert model.model.layers[0].self_attn.q_proj.bias is not None
+    ids = jnp.asarray(np.random.randint(0, 256, (4, 32)))
+    _overfit(model, lambda fn, p: causal_lm_loss(fn(p, ids), ids))
+
+
+def test_qwen2_kv_cache_decode_parity():
+    model = Qwen2ForCausalLM(qwen2_tiny())
+    ids = jnp.asarray(np.random.randint(0, 256, (2, 10)))
+    full = model(ids)
+    caches = model.init_kv_caches(2, 12)
+    logits, caches = model(ids[:, :6], kv_caches=caches, cache_index=0)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, :6]),
+                               rtol=2e-4, atol=2e-4)
+    for t in range(6, 10):  # incremental decode must match full forward
+        logits, caches = model(ids[:, t:t + 1], kv_caches=caches,
+                               cache_index=t)
+        np.testing.assert_allclose(np.asarray(logits[:, 0]),
+                                   np.asarray(full[:, t]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+# -------------------------------------------------------------- Qwen2-MoE
+def test_qwen2_moe_forward_aux_and_overfit():
+    model = Qwen2MoeForCausalLM(qwen2_moe_tiny())
+    ids = jnp.asarray(np.random.randint(0, 256, (4, 32)))
+    logits, aux = model(ids, return_aux=True)
+    assert logits.shape == (4, 32, 256)
+    assert float(aux) > 0.0  # switch aux loss is positive
+    _overfit(model,
+             lambda fn, p: moe_lm_loss(*fn(p, ids, return_aux=True), ids),
+             factor=0.6)
+
+
+def test_deepseek_moe_first_dense_layer():
+    cfg = deepseek_moe_tiny()
+    model = Qwen2MoeForCausalLM(cfg)
+    assert model.model.layers[0].is_dense
+    assert not model.model.layers[1].is_dense
+    ids = jnp.asarray(np.random.randint(0, 256, (2, 16)))
+    logits = model(ids)
+    assert logits.shape == (2, 16, 256)
+
+
+def test_qwen2_moe_kv_cache_decode():
+    model = Qwen2MoeForCausalLM(qwen2_moe_tiny())
+    ids = jnp.asarray(np.random.randint(0, 256, (2, 10)))
+    full = model(ids)
+    caches = model.init_kv_caches(2, 12)
+    logits, caches = model(ids[:, :8], kv_caches=caches, cache_index=0)
+    # MoE routing capacity differs between prefill widths, so compare with
+    # loose tolerance (dropped-token sets can differ at bucket boundaries)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, :8]),
+                               rtol=5e-2, atol=5e-2)
+    for t in range(8, 10):
+        step, caches = model(ids[:, t:t + 1], kv_caches=caches,
+                             cache_index=t)
+        np.testing.assert_allclose(np.asarray(step[:, 0]),
+                                   np.asarray(full[:, t]),
+                                   rtol=5e-2, atol=5e-2)
