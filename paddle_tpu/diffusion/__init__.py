@@ -1,0 +1,4 @@
+"""paddle_tpu.diffusion — schedulers + training/sampling loops
+(reference: PaddleMIX ppdiffusers/schedulers)."""
+from .schedulers import (DDIMScheduler, DDPMScheduler, FlowMatchScheduler,
+                         diffusion_loss, make_betas, sample_loop)

@@ -1,0 +1,236 @@
+"""Diffusion noise schedulers (reference: PaddleMIX ppdiffusers/schedulers
+— scheduling_ddpm.py, scheduling_ddim.py,
+scheduling_flow_match_euler_discrete.py).
+
+TPU-native design: schedulers are immutable dataclasses whose tables
+(betas/alphas/sigmas) are precomputed fp32 arrays; ``step`` is a pure
+function of (state, t, model_out) so the whole sampling loop rolls into one
+``lax.scan``/``fori_loop`` — no per-step host sync, one compiled program
+for any number of steps.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def make_betas(num_train_timesteps: int, schedule: str = "linear",
+               beta_start: float = 1e-4, beta_end: float = 0.02):
+    if schedule == "linear":
+        return jnp.linspace(beta_start, beta_end, num_train_timesteps,
+                            dtype=jnp.float32)
+    if schedule == "scaled_linear":  # stable-diffusion parameterisation
+        return jnp.linspace(beta_start ** 0.5, beta_end ** 0.5,
+                            num_train_timesteps, dtype=jnp.float32) ** 2
+    if schedule == "squaredcos_cap_v2":  # improved-DDPM cosine
+        t = jnp.arange(num_train_timesteps + 1, dtype=jnp.float32) \
+            / num_train_timesteps
+        f = jnp.cos((t + 0.008) / 1.008 * math.pi / 2) ** 2
+        betas = 1.0 - f[1:] / f[:-1]
+        return jnp.clip(betas, 0.0, 0.999)
+    raise ValueError(f"unknown beta schedule {schedule!r}")
+
+
+def _extract(table, t, ndim):
+    """Gather per-sample coefficients and broadcast to sample rank."""
+    v = table[t].astype(jnp.float32)
+    return v.reshape(v.shape + (1,) * (ndim - 1))
+
+
+@dataclass(frozen=True)
+class DDPMScheduler:
+    """Ancestral sampling / q(x_t|x_0) forward process."""
+
+    num_train_timesteps: int = 1000
+    beta_schedule: str = "linear"
+    beta_start: float = 1e-4
+    beta_end: float = 0.02
+    prediction_type: str = "epsilon"      # epsilon | v_prediction | sample
+    clip_sample: bool = False
+    betas: Any = None
+    alphas_cumprod: Any = None
+
+    def __post_init__(self):
+        if self.betas is None:
+            betas = make_betas(self.num_train_timesteps, self.beta_schedule,
+                               self.beta_start, self.beta_end)
+            object.__setattr__(self, "betas", betas)
+            object.__setattr__(self, "alphas_cumprod",
+                               jnp.cumprod(1.0 - betas))
+
+    # ---------------------------------------------------------- training
+    def add_noise(self, x0, noise, t):
+        ac = _extract(self.alphas_cumprod, t, x0.ndim)
+        return jnp.sqrt(ac) * x0 + jnp.sqrt(1.0 - ac) * noise
+
+    def velocity(self, x0, noise, t):
+        """v-prediction target: v = sqrt(ac) eps - sqrt(1-ac) x0."""
+        ac = _extract(self.alphas_cumprod, t, x0.ndim)
+        return jnp.sqrt(ac) * noise - jnp.sqrt(1.0 - ac) * x0
+
+    def training_target(self, x0, noise, t):
+        if self.prediction_type == "epsilon":
+            return noise
+        if self.prediction_type == "v_prediction":
+            return self.velocity(x0, noise, t)
+        return x0
+
+    # ---------------------------------------------------------- sampling
+    def timesteps(self, num_inference_steps: int):
+        """Descending timestep grid. DDPM's ancestral step always moves
+        t → t-1, so a subsampled grid is a coarse approximation (use
+        DDIMScheduler for proper few-step sampling)."""
+        step = max(self.num_train_timesteps // num_inference_steps, 1)
+        return (jnp.arange(num_inference_steps) * step)[::-1]
+
+    def _pred_x0(self, model_out, sample, t):
+        ac = _extract(self.alphas_cumprod, t, sample.ndim)
+        if self.prediction_type == "epsilon":
+            x0 = (sample - jnp.sqrt(1.0 - ac) * model_out) / jnp.sqrt(ac)
+        elif self.prediction_type == "v_prediction":
+            x0 = jnp.sqrt(ac) * sample - jnp.sqrt(1.0 - ac) * model_out
+        else:
+            x0 = model_out
+        return jnp.clip(x0, -1.0, 1.0) if self.clip_sample else x0
+
+    def step(self, model_out, t, sample, key: Optional[jax.Array] = None):
+        """One reverse step x_t → x_{t-1} (DDPM posterior mean + noise)."""
+        ac_t = _extract(self.alphas_cumprod, t, sample.ndim)
+        prev = jnp.maximum(t - 1, 0)
+        ac_prev = jnp.where(
+            _extract(jnp.arange(self.num_train_timesteps), t, sample.ndim) > 0,
+            _extract(self.alphas_cumprod, prev, sample.ndim), 1.0)
+        beta_t = 1.0 - ac_t / ac_prev
+        x0 = self._pred_x0(model_out, sample, t)
+        # posterior q(x_{t-1} | x_t, x_0)
+        coef_x0 = jnp.sqrt(ac_prev) * beta_t / (1.0 - ac_t)
+        coef_xt = jnp.sqrt(ac_t / ac_prev) * (1.0 - ac_prev) / (1.0 - ac_t)
+        mean = coef_x0 * x0 + coef_xt * sample
+        var = beta_t * (1.0 - ac_prev) / (1.0 - ac_t)
+        if key is not None:
+            noise = jax.random.normal(key, sample.shape, jnp.float32)
+            nonzero = (_extract(jnp.arange(self.num_train_timesteps), t,
+                                sample.ndim) > 0).astype(jnp.float32)
+            mean = mean + nonzero * jnp.sqrt(jnp.maximum(var, 1e-20)) * noise
+        return mean.astype(sample.dtype)
+
+
+@dataclass(frozen=True)
+class DDIMScheduler(DDPMScheduler):
+    """Deterministic (eta=0) or stochastic DDIM steps over a subsampled
+    timestep grid."""
+
+    eta: float = 0.0
+
+    def timesteps(self, num_inference_steps: int):
+        step = self.num_train_timesteps // num_inference_steps
+        return (jnp.arange(num_inference_steps) * step)[::-1]
+
+    def step(self, model_out, t, sample, prev_t=None,
+             key: Optional[jax.Array] = None):
+        if prev_t is None:
+            prev_t = t - 1
+        prev_t = jnp.asarray(prev_t)
+        ac_t = _extract(self.alphas_cumprod, t, sample.ndim)
+        ac_prev = _extract(self.alphas_cumprod, jnp.maximum(prev_t, 0),
+                           sample.ndim)
+        # prev_t < 0 marks the final step: alpha-bar_{-1} == 1
+        is_final = jnp.reshape(prev_t < 0, (-1,) + (1,) * (sample.ndim - 1))
+        ac_prev = jnp.where(is_final, 1.0, ac_prev)
+        x0 = self._pred_x0(model_out, sample, t)
+        eps = (sample - jnp.sqrt(ac_t) * x0) / jnp.sqrt(1.0 - ac_t)
+        sigma = self.eta * jnp.sqrt((1 - ac_prev) / (1 - ac_t)) \
+            * jnp.sqrt(1 - ac_t / ac_prev)
+        dir_xt = jnp.sqrt(jnp.maximum(1.0 - ac_prev - sigma ** 2, 0.0)) * eps
+        prev = jnp.sqrt(ac_prev) * x0 + dir_xt
+        if key is not None and self.eta > 0:
+            prev = prev + sigma * jax.random.normal(key, sample.shape,
+                                                    jnp.float32)
+        return prev.astype(sample.dtype)
+
+
+@dataclass(frozen=True)
+class FlowMatchScheduler:
+    """Rectified flow / flow matching (SD3): x_t = (1-sigma) x0 + sigma eps,
+    model predicts the velocity (eps - x0); Euler integration. ``shift``
+    is SD3's resolution-dependent timestep shift."""
+
+    num_train_timesteps: int = 1000
+    shift: float = 1.0
+
+    def sigmas_for(self, t):
+        """t in [0, num_train_timesteps) → shifted sigma in (0, 1]."""
+        s = (t.astype(jnp.float32) + 1.0) / self.num_train_timesteps
+        return self.shift * s / (1.0 + (self.shift - 1.0) * s)
+
+    def add_noise(self, x0, noise, t):
+        sigma = self.sigmas_for(t).reshape((-1,) + (1,) * (x0.ndim - 1))
+        return (1.0 - sigma) * x0 + sigma * noise
+
+    def training_target(self, x0, noise, t):  # noqa: ARG002 (sig parity)
+        return noise - x0
+
+    def timesteps(self, num_inference_steps: int):
+        # descending grid; last entry steps to sigma=0
+        return jnp.linspace(self.num_train_timesteps - 1, 0,
+                            num_inference_steps).astype(jnp.int32)
+
+    def step(self, model_out, t, sample, prev_t=None):
+        sigma = self.sigmas_for(t).reshape((-1,) + (1,) * (sample.ndim - 1))
+        if prev_t is None:
+            sigma_prev = jnp.zeros_like(sigma)
+        else:
+            sigma_prev = self.sigmas_for(prev_t).reshape(
+                (-1,) + (1,) * (sample.ndim - 1))
+        return (sample + (sigma_prev - sigma) * model_out.astype(jnp.float32)
+                ).astype(sample.dtype)
+
+
+def diffusion_loss(scheduler, model_fn, x0, t, noise, *cond):
+    """Standard denoising MSE against the scheduler's training target
+    (reference: ppdiffusers training examples train_*.py)."""
+    noisy = scheduler.add_noise(x0, noise, t)
+    pred = model_fn(noisy, t, *cond)
+    target = scheduler.training_target(x0, noise, t)
+    if pred.shape[1] == 2 * target.shape[1]:
+        pred = pred[:, :target.shape[1]]   # learn_sigma: drop variance half
+    return jnp.mean((pred.astype(jnp.float32)
+                     - target.astype(jnp.float32)) ** 2)
+
+
+def sample_loop(scheduler, model_fn, shape, num_inference_steps: int,
+                key, *cond, dtype=jnp.float32):
+    """Full reverse-process sampler rolled into ``lax.scan`` — one XLA
+    program regardless of step count."""
+    key, init_key = jax.random.split(key)
+    x = jax.random.normal(init_key, shape, dtype)
+    ts = scheduler.timesteps(num_inference_steps)
+    prev_ts = jnp.concatenate([ts[1:], jnp.array([-1], ts.dtype)])
+
+    def body(carry, t_pair):
+        x, key = carry
+        t, prev_t = t_pair
+        key, step_key = jax.random.split(key)
+        tb = jnp.full((shape[0],), t, jnp.int32)
+        out = model_fn(x, tb, *cond)
+        if isinstance(scheduler, FlowMatchScheduler):
+            pb = jnp.full((shape[0],), jnp.maximum(prev_t, 0), jnp.int32)
+            sig_prev = jnp.where(prev_t < 0, jnp.zeros((shape[0],)),
+                                 scheduler.sigmas_for(pb))
+            sig = scheduler.sigmas_for(tb)
+            d = (sig_prev - sig).reshape((-1,) + (1,) * (x.ndim - 1))
+            x = (x + d * out.astype(jnp.float32)).astype(x.dtype)
+        elif isinstance(scheduler, DDIMScheduler):
+            x = scheduler.step(out, tb, x,
+                               prev_t=jnp.full((shape[0],), prev_t),
+                               key=step_key)
+        else:
+            x = scheduler.step(out, tb, x, key=step_key)
+        return (x, key), None
+
+    (x, _), _ = jax.lax.scan(body, (x, key), (ts, prev_ts))
+    return x

@@ -12,3 +12,15 @@ from .qwen2 import (Qwen2Config, Qwen2ForCausalLM, Qwen2Model, qwen2_7b,
 from .qwen2_moe import (DeepseekMoeConfig, DeepseekMoeForCausalLM,
                         Qwen2MoeConfig, Qwen2MoeForCausalLM, Qwen2MoeModel,
                         deepseek_moe_tiny, moe_lm_loss, qwen2_moe_tiny)
+from .resnet import (ResNet, ResNetConfig, resnet18, resnet34, resnet50,
+                     resnet50_vd, resnet_tiny)
+from .vit import (ViTConfig, ViTForImageClassification, ViTModel, vit_tiny,
+                  vit_base_patch16_224, vit_large_patch14_224)
+from .clip import (CLIPConfig, CLIPModel, CLIPTextConfig, CLIPTextModel,
+                   clip_contrastive_loss, clip_tiny, gather_features)
+from .dit import (DiT, DiTConfig, MMDiT, MMDiTConfig, dit_tiny, dit_xl_2,
+                  mmdit_tiny)
+from .vae import (AutoencoderKL, DiagonalGaussian, VAEConfig, vae_loss,
+                  vae_tiny)
+from .ppocr import (DBNet, DBNetConfig, SVTRConfig, SVTRNet, ctc_greedy_decode,
+                    ctc_rec_loss, db_loss, dbnet_tiny, svtr_tiny)

@@ -169,6 +169,28 @@ class BertModel(Layer):
         return x, pooled
 
 
+class TiedMLMHead(Layer):
+    """Transform + LayerNorm + vocab-tied decoder matmul, shared by BERT
+    and ERNIE pretraining heads (reference: BertLMPredictionHead). The
+    whole head runs in config.dtype so the [b,s,h]x[h,V] decoder matmul
+    stays on the bf16 MXU path; only the final logits are fp32."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = nn.LayerNorm(config.hidden_size,
+                                           epsilon=config.layer_norm_eps)
+        self.mlm_bias = Parameter(jnp.zeros((config.vocab_size,)))
+        if config.dtype != jnp.float32:
+            self.transform.to(dtype=config.dtype)
+            self.transform_norm.to(dtype=config.dtype)
+
+    def forward(self, seq, word_embedding_weight):
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        logits = parallel_matmul(h, word_embedding_weight, transpose_y=True)
+        return logits.astype(jnp.float32) + self.mlm_bias
+
+
 class BertForPretraining(Layer):
     """Masked-LM (tied decoder) + next-sentence-prediction heads."""
 
@@ -176,20 +198,13 @@ class BertForPretraining(Layer):
         super().__init__()
         self.config = config
         self.bert = BertModel(config)
-        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
-        self.transform_norm = nn.LayerNorm(config.hidden_size,
-                                           epsilon=config.layer_norm_eps)
-        self.mlm_bias = Parameter(jnp.zeros((config.vocab_size,)))
+        self.mlm_head = TiedMLMHead(config)
         self.nsp_head = nn.Linear(config.hidden_size, 2)
-        if config.dtype != jnp.float32:
-            self.transform.to(dtype=config.dtype)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
-        h = self.transform_norm(F.gelu(self.transform(seq)))
-        mlm_logits = parallel_matmul(
-            h, self.bert.embeddings.word_embeddings.weight, transpose_y=True)
-        mlm_logits = mlm_logits.astype(jnp.float32) + self.mlm_bias
+        mlm_logits = self.mlm_head(
+            seq, self.bert.embeddings.word_embeddings.weight)
         nsp_logits = self.nsp_head(pooled).astype(jnp.float32)
         return mlm_logits, nsp_logits
 

@@ -1,0 +1,180 @@
+"""CLIP (reference: PaddleMIX paddlemix/models/clip/ — EVA-CLIP style
+dual tower: causal text transformer + ViT image tower, learned projections,
+temperature-scaled contrastive loss).
+
+TPU-native design: the image tower reuses ``ViTModel``; the text tower is a
+causal pre-LN stack over the same parallel projections. The contrastive
+loss is written for data parallelism: logits are computed against the
+*globally gathered* counterpart features (``all_gather`` over dp) so the
+in-batch negatives match the reference's multi-GPU semantics.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, Parameter
+from ..ops.attention import dense_attention
+from ..parallel.layers import ColumnParallelLinear, RowParallelLinear
+from ..utils.rng import next_key
+from .vit import ViTConfig, ViTModel
+
+
+@dataclass
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    max_position_embeddings: int = 77
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 8
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+@dataclass
+class CLIPConfig:
+    text: CLIPTextConfig = field(default_factory=CLIPTextConfig)
+    vision: ViTConfig = field(default_factory=lambda: ViTConfig(num_classes=0))
+    projection_dim: int = 512
+    logit_scale_init: float = math.log(1 / 0.07)
+    dtype: Any = jnp.float32
+
+
+def clip_tiny(**overrides) -> CLIPConfig:
+    base = dict(
+        text=CLIPTextConfig(vocab_size=128, max_position_embeddings=16,
+                            hidden_size=32, intermediate_size=64,
+                            num_hidden_layers=2, num_attention_heads=2),
+        vision=ViTConfig(image_size=16, patch_size=8, hidden_size=32,
+                         intermediate_size=64, num_hidden_layers=2,
+                         num_attention_heads=2, num_classes=0),
+        projection_dim=32)
+    base.update(overrides)
+    return CLIPConfig(**base)
+
+
+class CLIPTextBlock(Layer):
+    def __init__(self, config: CLIPTextConfig):
+        super().__init__()
+        self.config = config
+        h, eps = config.hidden_size, config.layer_norm_eps
+        self.norm1 = nn.LayerNorm(h, epsilon=eps)
+        self.qkv = ColumnParallelLinear(h, 3 * h, has_bias=True,
+                                        gather_output=False)
+        self.proj = RowParallelLinear(h, h, has_bias=True,
+                                      input_is_parallel=True)
+        self.norm2 = nn.LayerNorm(h, epsilon=eps)
+        self.fc1 = ColumnParallelLinear(h, config.intermediate_size,
+                                        has_bias=True, gather_output=False)
+        self.fc2 = RowParallelLinear(config.intermediate_size, h,
+                                     has_bias=True, input_is_parallel=True)
+
+    def forward(self, x):
+        cfg = self.config
+        b, s, _ = x.shape
+        nh, d = cfg.num_attention_heads, cfg.head_dim
+        h = self.norm1(x)
+        qkv = self.qkv(h).reshape(b, s, 3, nh, d)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = dense_attention(q, k, v, causal=True)  # CLIP text is causal
+        x = x + self.proj(attn.reshape(b, s, nh * d))
+        # quick-gelu matches OpenAI/EVA CLIP numerics
+        h = self.fc1(self.norm2(x))
+        x = x + self.fc2(h * F.sigmoid(1.702 * h))
+        return x
+
+
+class CLIPTextModel(Layer):
+    def __init__(self, config: CLIPTextConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(std=0.02)
+        self.token_embedding = nn.Embedding(config.vocab_size,
+                                            config.hidden_size)
+        self.position_embedding = Parameter(
+            init(next_key(), (config.max_position_embeddings,
+                              config.hidden_size)))
+        self.blocks = nn.LayerList(
+            [CLIPTextBlock(config) for _ in range(config.num_hidden_layers)])
+        self.final_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        if config.dtype != jnp.float32:
+            self.to(dtype=config.dtype)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        x = self.token_embedding(input_ids) \
+            + self.position_embedding[None, :s].astype(self.config.dtype)
+        for block in self.blocks:
+            x = block(x)
+        x = self.final_norm(x)
+        # pooled = feature at the EOT token (highest token id, per CLIP)
+        eot = jnp.argmax(input_ids, axis=-1)
+        pooled = x[jnp.arange(x.shape[0]), eot]
+        return x, pooled
+
+
+class CLIPModel(Layer):
+    def __init__(self, config: CLIPConfig):
+        super().__init__()
+        self.config = config
+        self.text_model = CLIPTextModel(config.text)
+        self.vision_model = ViTModel(config.vision)
+        init = I.Normal(std=0.02)
+        self.text_projection = Parameter(
+            init(next_key(), (config.text.hidden_size,
+                              config.projection_dim)))
+        self.visual_projection = Parameter(
+            init(next_key(), (config.vision.hidden_size,
+                              config.projection_dim)))
+        self.logit_scale = Parameter(
+            jnp.asarray(config.logit_scale_init, jnp.float32))
+
+    def encode_text(self, input_ids):
+        _, pooled = self.text_model(input_ids)
+        return pooled.astype(jnp.float32) @ self.text_projection
+
+    def encode_image(self, pixel_values):
+        x = self.vision_model(pixel_values)
+        pooled = x[:, 0] if self.config.vision.use_class_token \
+            else x.mean(axis=1)
+        return pooled.astype(jnp.float32) @ self.visual_projection
+
+    def forward(self, input_ids, pixel_values):
+        t = F.normalize(self.encode_text(input_ids), axis=-1)
+        v = F.normalize(self.encode_image(pixel_values), axis=-1)
+        scale = jnp.exp(jnp.clip(self.logit_scale, a_max=math.log(100.0)))
+        logits_per_image = scale * v @ t.T
+        return logits_per_image, logits_per_image.T
+
+
+def clip_contrastive_loss(logits_per_image, logits_per_text,
+                          dp_axis: Optional[str] = None):
+    """Symmetric InfoNCE. With ``dp_axis`` inside shard_map, the label
+    offset accounts for this shard's slot in the gathered global batch
+    (reference semantics: paddlemix clip_loss with gathered features)."""
+    n = logits_per_image.shape[0]
+    labels = jnp.arange(n)
+    if dp_axis is not None:
+        labels = labels + jax.lax.axis_index(dp_axis) * n
+    li = F.cross_entropy(logits_per_image, labels, reduction="mean")
+    lt = F.cross_entropy(logits_per_text, labels, reduction="mean")
+    return 0.5 * (li + lt)
+
+
+def gather_features(feats, dp_axis: str):
+    """all_gather counterpart features over dp for global in-batch
+    negatives (use inside shard_map; no-op outside)."""
+    return jax.lax.all_gather(feats, dp_axis, axis=0, tiled=True)

@@ -1,0 +1,343 @@
+"""DiT and SD3-style MMDiT (reference: PaddleMIX ppdiffusers/models/
+transformer_2d.py DiTTransformer2DModel and sd3_transformer_2d.py —
+adaLN-Zero diffusion transformer; MMDiT joint image/text blocks).
+
+TPU-native design: patchify = strided conv (MXU GEMM); adaLN modulation is
+a fused per-block 6-way linear off the pooled conditioning vector; MMDiT
+runs ONE attention over the concatenated [text; image] token streams
+(static split sizes) so XLA sees a single big matmul instead of two
+cross-attending towers.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, Parameter
+from ..ops.attention import dense_attention
+from ..parallel.layers import ColumnParallelLinear, RowParallelLinear
+from ..utils.rng import next_key
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal timestep features, fp32 (reference: ppdiffusers
+    embeddings.get_timestep_embedding)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class TimestepEmbedder(Layer):
+    def __init__(self, hidden_size: int, freq_dim: int = 256):
+        super().__init__()
+        self.freq_dim = freq_dim
+        self.fc1 = nn.Linear(freq_dim, hidden_size)
+        self.fc2 = nn.Linear(hidden_size, hidden_size)
+
+    def forward(self, t):
+        h = timestep_embedding(t, self.freq_dim)
+        return self.fc2(F.silu(self.fc1(h)))
+
+
+class LabelEmbedder(Layer):
+    """Class conditioning with a learned null class for CFG dropout."""
+
+    def __init__(self, num_classes: int, hidden_size: int):
+        super().__init__()
+        self.num_classes = num_classes
+        self.table = nn.Embedding(num_classes + 1, hidden_size)
+
+    def forward(self, labels, drop_mask=None):
+        if drop_mask is not None:  # 1 → replace with null class
+            labels = jnp.where(drop_mask, self.num_classes, labels)
+        return self.table(labels)
+
+
+def modulate(x, shift, scale):
+    return x * (1 + scale[:, None]) + shift[:, None]
+
+
+@dataclass
+class DiTConfig:
+    input_size: int = 32          # latent spatial size
+    patch_size: int = 2
+    in_channels: int = 4
+    hidden_size: int = 1152
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 16
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000
+    learn_sigma: bool = True
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def out_channels(self) -> int:
+        return self.in_channels * (2 if self.learn_sigma else 1)
+
+
+def dit_tiny(**overrides) -> DiTConfig:
+    base = dict(input_size=8, patch_size=2, in_channels=4, hidden_size=64,
+                num_hidden_layers=2, num_attention_heads=4, num_classes=10)
+    base.update(overrides)
+    return DiTConfig(**base)
+
+
+def dit_xl_2(**overrides) -> DiTConfig:
+    return DiTConfig(**overrides)
+
+
+class DiTBlock(Layer):
+    """adaLN-Zero block: 6 modulation signals from the conditioning vector;
+    gates initialised to zero so each block starts as identity."""
+
+    def __init__(self, config: DiTConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        mlp = int(h * config.mlp_ratio)
+        self.norm1 = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                                  bias_attr=False)
+        self.qkv = ColumnParallelLinear(h, 3 * h, has_bias=True,
+                                        gather_output=False)
+        self.proj = RowParallelLinear(h, h, has_bias=True,
+                                      input_is_parallel=True)
+        self.norm2 = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                                  bias_attr=False)
+        self.fc1 = ColumnParallelLinear(h, mlp, has_bias=True,
+                                        gather_output=False)
+        self.fc2 = RowParallelLinear(mlp, h, has_bias=True,
+                                     input_is_parallel=True)
+        self.ada = nn.Linear(h, 6 * h,
+                             weight_attr=I.Constant(0.0),
+                             bias_attr=I.Constant(0.0))
+
+    def _attn(self, x):
+        cfg = self.config
+        b, s, _ = x.shape
+        nh, d = cfg.num_attention_heads, cfg.head_dim
+        qkv = self.qkv(x).reshape(b, s, 3, nh, d)
+        out = dense_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                              causal=False)
+        return self.proj(out.reshape(b, s, nh * d))
+
+    def forward(self, x, cond):
+        m = self.ada(F.silu(cond))
+        sh1, sc1, g1, sh2, sc2, g2 = jnp.split(m, 6, axis=-1)
+        x = x + g1[:, None] * self._attn(modulate(self.norm1(x), sh1, sc1))
+        h = modulate(self.norm2(x), sh2, sc2)
+        x = x + g2[:, None] * self.fc2(F.gelu(self.fc1(h), approximate=True))
+        return x
+
+
+class DiT(Layer):
+    def __init__(self, config: DiTConfig):
+        super().__init__()
+        self.config = config
+        p, h = config.patch_size, config.hidden_size
+        self.patch_embed = nn.Conv2D(config.in_channels, h, p, stride=p)
+        grid = config.input_size // p
+        self.pos_embed = Parameter(
+            I.TruncatedNormal(std=0.02)(next_key(), (1, grid * grid, h)),
+            trainable=False)
+        self.t_embedder = TimestepEmbedder(h)
+        self.y_embedder = LabelEmbedder(config.num_classes, h)
+        self.blocks = nn.LayerList(
+            [DiTBlock(config) for _ in range(config.num_hidden_layers)])
+        self.final_norm = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                                       bias_attr=False)
+        self.final_ada = nn.Linear(h, 2 * h, weight_attr=I.Constant(0.0),
+                                   bias_attr=I.Constant(0.0))
+        self.final_proj = nn.Linear(h, p * p * config.out_channels,
+                                    weight_attr=I.Constant(0.0),
+                                    bias_attr=I.Constant(0.0))
+        if config.dtype != jnp.float32:
+            self.to(dtype=config.dtype)
+
+    def unpatchify(self, x):
+        cfg = self.config
+        p, c = cfg.patch_size, cfg.out_channels
+        g = cfg.input_size // p
+        b = x.shape[0]
+        x = x.reshape(b, g, g, p, p, c)
+        x = jnp.einsum("bhwpqc->bchpwq", x)
+        return x.reshape(b, c, g * p, g * p)
+
+    def forward(self, latents, timesteps, labels, drop_mask=None):
+        x = self.patch_embed(latents)
+        b, c = x.shape[:2]
+        x = x.reshape(b, c, -1).transpose(0, 2, 1) + \
+            self.pos_embed.astype(latents.dtype)
+        cond = self.t_embedder(timesteps) + self.y_embedder(labels, drop_mask)
+        cond = cond.astype(x.dtype)
+        for block in self.blocks:
+            x = block(x, cond)
+        sh, sc = jnp.split(self.final_ada(F.silu(cond)), 2, axis=-1)
+        x = self.final_proj(modulate(self.final_norm(x), sh, sc))
+        return self.unpatchify(x)
+
+
+# --------------------------------------------------------------- SD3 MMDiT
+
+@dataclass
+class MMDiTConfig:
+    input_size: int = 64
+    patch_size: int = 2
+    in_channels: int = 16
+    hidden_size: int = 1536
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 24
+    context_dim: int = 4096        # T5/CLIP joint text embedding width
+    pooled_dim: int = 2048         # pooled CLIP vector width
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def out_channels(self) -> int:
+        return self.in_channels
+
+
+def mmdit_tiny(**overrides) -> MMDiTConfig:
+    base = dict(input_size=8, patch_size=2, in_channels=4, hidden_size=64,
+                num_hidden_layers=2, num_attention_heads=4, context_dim=48,
+                pooled_dim=32)
+    base.update(overrides)
+    return MMDiTConfig(**base)
+
+
+class _StreamParams(Layer):
+    """Per-stream (image or text) weights of one MMDiT joint block."""
+
+    def __init__(self, h: int, n_mod: int):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                                  bias_attr=False)
+        self.qkv = nn.Linear(h, 3 * h)
+        self.proj = nn.Linear(h, h)
+        self.norm2 = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                                  bias_attr=False)
+        self.fc1 = nn.Linear(h, 4 * h)
+        self.fc2 = nn.Linear(4 * h, h)
+        self.ada = nn.Linear(h, n_mod * h, weight_attr=I.Constant(0.0),
+                             bias_attr=I.Constant(0.0))
+
+
+class MMDiTBlock(Layer):
+    """Joint block: both streams project QKV with their own weights, then a
+    single attention runs over the concatenation (reference: SD3
+    JointTransformerBlock)."""
+
+    def __init__(self, config: MMDiTConfig, context_last: bool = False):
+        super().__init__()
+        self.config = config
+        self.context_last = context_last  # last block: text stream unused after attn
+        self.img = _StreamParams(config.hidden_size, 6)
+        self.txt = _StreamParams(config.hidden_size, 2 if context_last else 6)
+
+    def _qkv(self, stream: _StreamParams, x, sh, sc):
+        cfg = self.config
+        b, s, _ = x.shape
+        h = modulate(stream.norm1(x), sh, sc)
+        qkv = stream.qkv(h).reshape(b, s, 3, cfg.num_attention_heads,
+                                    cfg.head_dim)
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    def forward(self, x_img, x_txt, cond):
+        cfg = self.config
+        s_txt = x_txt.shape[1]
+        mi = self.img.ada(F.silu(cond))
+        i_sh1, i_sc1, i_g1, i_sh2, i_sc2, i_g2 = jnp.split(mi, 6, axis=-1)
+        mt = self.txt.ada(F.silu(cond))
+        if self.context_last:
+            t_sh1, t_sc1 = jnp.split(mt, 2, axis=-1)
+        else:
+            t_sh1, t_sc1, t_g1, t_sh2, t_sc2, t_g2 = jnp.split(mt, 6, axis=-1)
+
+        qi, ki, vi = self._qkv(self.img, x_img, i_sh1, i_sc1)
+        qt, kt, vt = self._qkv(self.txt, x_txt, t_sh1, t_sc1)
+        q = jnp.concatenate([qt, qi], axis=1)
+        k = jnp.concatenate([kt, ki], axis=1)
+        v = jnp.concatenate([vt, vi], axis=1)
+        out = dense_attention(q, k, v, causal=False)
+        b = out.shape[0]
+        out = out.reshape(b, out.shape[1], -1)
+        a_txt, a_img = out[:, :s_txt], out[:, s_txt:]
+
+        x_img = x_img + i_g1[:, None] * self.img.proj(a_img)
+        h = modulate(self.img.norm2(x_img), i_sh2, i_sc2)
+        x_img = x_img + i_g2[:, None] * self.img.fc2(
+            F.gelu(self.img.fc1(h), approximate=True))
+
+        if self.context_last:
+            return x_img, x_txt
+        x_txt = x_txt + t_g1[:, None] * self.txt.proj(a_txt)
+        h = modulate(self.txt.norm2(x_txt), t_sh2, t_sc2)
+        x_txt = x_txt + t_g2[:, None] * self.txt.fc2(
+            F.gelu(self.txt.fc1(h), approximate=True))
+        return x_img, x_txt
+
+
+class MMDiT(Layer):
+    """SD3 core: conditioned on timestep + pooled text; the sequence text
+    embedding rides along as the second stream."""
+
+    def __init__(self, config: MMDiTConfig):
+        super().__init__()
+        self.config = config
+        p, h = config.patch_size, config.hidden_size
+        self.patch_embed = nn.Conv2D(config.in_channels, h, p, stride=p)
+        grid = config.input_size // p
+        self.pos_embed = Parameter(
+            I.TruncatedNormal(std=0.02)(next_key(), (1, grid * grid, h)),
+            trainable=False)
+        self.t_embedder = TimestepEmbedder(h)
+        self.pooled_proj = nn.Sequential(
+            nn.Linear(config.pooled_dim, h), nn.SiLU(), nn.Linear(h, h))
+        self.context_proj = nn.Linear(config.context_dim, h)
+        self.blocks = nn.LayerList(
+            [MMDiTBlock(config,
+                        context_last=(i == config.num_hidden_layers - 1))
+             for i in range(config.num_hidden_layers)])
+        self.final_norm = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                                       bias_attr=False)
+        self.final_ada = nn.Linear(h, 2 * h, weight_attr=I.Constant(0.0),
+                                   bias_attr=I.Constant(0.0))
+        self.final_proj = nn.Linear(h, p * p * config.out_channels,
+                                    weight_attr=I.Constant(0.0),
+                                    bias_attr=I.Constant(0.0))
+        if config.dtype != jnp.float32:
+            self.to(dtype=config.dtype)
+
+    def forward(self, latents, timesteps, context, pooled):
+        cfg = self.config
+        x = self.patch_embed(latents)
+        b, c = x.shape[:2]
+        x = x.reshape(b, c, -1).transpose(0, 2, 1) + \
+            self.pos_embed.astype(latents.dtype)
+        cond = self.t_embedder(timesteps) + \
+            self.pooled_proj(pooled.astype(jnp.float32))
+        cond = cond.astype(x.dtype)
+        txt = self.context_proj(context).astype(x.dtype)
+        for block in self.blocks:
+            x, txt = block(x, txt, cond)
+        sh, sc = jnp.split(self.final_ada(F.silu(cond)), 2, axis=-1)
+        x = self.final_proj(modulate(self.final_norm(x), sh, sc))
+        p = cfg.patch_size
+        g = cfg.input_size // p
+        x = x.reshape(b, g, g, p, p, cfg.out_channels)
+        x = jnp.einsum("bhwpqc->bchpwq", x)
+        return x.reshape(b, cfg.out_channels, g * p, g * p)

@@ -15,12 +15,10 @@ from typing import Any, Optional
 import jax.numpy as jnp
 
 from .. import nn
-from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.layer import Layer, Parameter
-from ..parallel.layers import parallel_matmul
 from ..utils.rng import next_key
-from .bert import BertConfig, BertModel
+from .bert import BertConfig, BertModel, TiedMLMHead
 
 
 @dataclass
@@ -51,9 +49,13 @@ class ErnieModel(Layer):
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 task_type_ids=None, positions=None):
-        # task-type stream adds onto the shared embedding sum (ERNIE 2.0+)
+        # task-type stream adds onto the shared embedding sum (ERNIE 2.0+);
+        # reference defaults task_type_ids to zeros when use_task_id is on,
+        # so task 0's embedding is always added — not silently skipped.
         extra = None
-        if self.config.use_task_id and task_type_ids is not None:
+        if self.config.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = jnp.zeros_like(input_ids)
             extra = self.task_type_embeddings[task_type_ids]
         return self.encoder(input_ids, token_type_ids, attention_mask,
                             positions, extra_embeds=extra)
@@ -64,21 +66,14 @@ class ErnieForMaskedLM(Layer):
         super().__init__()
         self.config = config
         self.ernie = ErnieModel(config)
-        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
-        self.transform_norm = nn.LayerNorm(config.hidden_size,
-                                           epsilon=config.layer_norm_eps)
-        self.mlm_bias = Parameter(jnp.zeros((config.vocab_size,)))
-        if config.dtype != jnp.float32:
-            self.transform.to(dtype=config.dtype)
+        self.mlm_head = TiedMLMHead(config)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 task_type_ids=None):
         seq, _ = self.ernie(input_ids, token_type_ids, attention_mask,
                             task_type_ids)
-        h = self.transform_norm(F.gelu(self.transform(seq)))
         word_w = self.ernie.encoder.embeddings.word_embeddings.weight
-        logits = parallel_matmul(h, word_w, transpose_y=True)
-        return logits.astype(jnp.float32) + self.mlm_bias
+        return self.mlm_head(seq, word_w)
 
 
 class ErnieForSequenceClassification(Layer):

@@ -1,0 +1,266 @@
+"""PP-OCR models (reference: PaddleOCR ppocr/modeling — det_db.py DBNet
+{backbone→DBFPN→DBHead}, rec_svtrnet.py SVTR {conv stem, local/global
+mixing blocks, CTC head}; losses det_db_loss.py / rec_ctc_loss.py).
+
+TPU-native design: DBNet rides the shared ResNet backbone; its FPN and
+head are plain conv stacks (MXU GEMMs). Hard-negative mining in the DB
+loss is rewritten shape-statically: instead of a data-dependent top-k
+gather, negatives are ranked with a differentiable sort mask so the jit
+program has one shape for every batch. SVTR's mixing blocks reuse
+``dense_attention``; height is collapsed by strided convs so the CTC time
+axis is the image width — all static.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..ops.attention import dense_attention
+from .resnet import ResNet, ResNetConfig
+
+
+# ------------------------------------------------------------------- DBNet
+
+@dataclass
+class DBNetConfig:
+    backbone: ResNetConfig = field(
+        default_factory=lambda: ResNetConfig(depth=18))
+    fpn_channels: int = 256
+    head_channels: int = 64
+    k: float = 50.0               # differentiable-binarization steepness
+    dtype: Any = jnp.float32
+
+
+def dbnet_tiny(**overrides) -> DBNetConfig:
+    base = dict(backbone=ResNetConfig(depth=18, stem_width=8,
+                                      layers=[1, 1, 1, 1]),
+                fpn_channels=16, head_channels=8)
+    base.update(overrides)
+    return DBNetConfig(**base)
+
+
+class DBFPN(Layer):
+    """Top-down FPN: lateral 1x1 → upsample-add → per-level 3x3 smooth to
+    C/4 channels → upsample all to 1/4 scale and concat."""
+
+    def __init__(self, in_channels: List[int], out_ch: int):
+        super().__init__()
+        self.lateral = nn.LayerList(
+            [nn.Conv2D(c, out_ch, 1, bias_attr=False) for c in in_channels])
+        self.smooth = nn.LayerList(
+            [nn.Conv2D(out_ch, out_ch // 4, 3, padding=1, bias_attr=False)
+             for _ in in_channels])
+
+    def forward(self, feats):
+        lat = [conv(f) for conv, f in zip(self.lateral, feats)]
+        for i in range(len(lat) - 2, -1, -1):
+            lat[i] = lat[i] + F.interpolate(lat[i + 1], scale_factor=2,
+                                            mode="nearest")
+        outs = []
+        for i, (conv, f) in enumerate(zip(self.smooth, lat)):
+            o = conv(f)
+            if i > 0:
+                o = F.interpolate(o, scale_factor=2 ** i, mode="nearest")
+            outs.append(o)
+        return jnp.concatenate(outs, axis=1)
+
+
+class DBHead(Layer):
+    """conv-BN-relu → 2x deconv → 2x deconv → sigmoid map (shared shape for
+    the probability and threshold branches)."""
+
+    def __init__(self, in_ch: int, mid_ch: int):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, mid_ch, 3, padding=1, bias_attr=False)
+        self.bn = nn.BatchNorm2D(mid_ch)
+        self.up1 = nn.Conv2DTranspose(mid_ch, mid_ch, 2, stride=2)
+        self.bn1 = nn.BatchNorm2D(mid_ch)
+        self.up2 = nn.Conv2DTranspose(mid_ch, 1, 2, stride=2)
+
+    def forward(self, x):
+        x = F.relu(self.bn(self.conv(x)))
+        x = F.relu(self.bn1(self.up1(x)))
+        return F.sigmoid(self.up2(x))
+
+
+class DBNet(Layer):
+    def __init__(self, config: DBNetConfig):
+        super().__init__()
+        self.config = config
+        self.backbone = ResNet(config.backbone)
+        self.fpn = DBFPN(self.backbone.out_channels, config.fpn_channels)
+        self.prob_head = DBHead(config.fpn_channels, config.head_channels)
+        self.thresh_head = DBHead(config.fpn_channels, config.head_channels)
+        if config.dtype != jnp.float32:
+            self.to(dtype=config.dtype)
+
+    def forward(self, images):
+        feats = self.backbone(images, return_feats=True)
+        fused = self.fpn(feats)
+        prob = self.prob_head(fused)
+        thresh = self.thresh_head(fused)
+        # differentiable binarization: B = 1 / (1 + exp(-k (P - T)))
+        binary = F.sigmoid(self.config.k * (prob - thresh))
+        return {"maps": jnp.concatenate([prob, thresh, binary], axis=1)}
+
+
+def db_loss(pred, shrink_map, shrink_mask, thresh_map, thresh_mask,
+            alpha: float = 5.0, beta: float = 10.0, ohem_ratio: float = 3.0):
+    """DB loss = BCE(shrink, hard-negative-mined) + alpha*dice(binary)
+    + beta*L1(threshold). The OHEM top-k over negatives is done with a
+    static-shape rank mask (sorted losses + cutoff index) instead of a
+    dynamic gather (reference: ppocr det_basic_loss BalanceLoss)."""
+    maps = pred["maps"].astype(jnp.float32)
+    prob, thresh, binary = maps[:, 0], maps[:, 1], maps[:, 2]
+
+    eps = 1e-6
+    bce = -(shrink_map * jnp.log(prob + eps)
+            + (1 - shrink_map) * jnp.log(1 - prob + eps))
+    pos = shrink_map * shrink_mask
+    neg = (1 - shrink_map) * shrink_mask
+    n_pos = jnp.sum(pos, axis=(1, 2))
+    n_neg_keep = jnp.minimum(jnp.sum(neg, axis=(1, 2)),
+                             n_pos * ohem_ratio).astype(jnp.int32)
+    neg_loss = (bce * neg).reshape(bce.shape[0], -1)
+    ranked = jnp.sort(neg_loss, axis=1)[:, ::-1]       # descending
+    idx = jnp.arange(ranked.shape[1])[None, :]
+    kept = jnp.where(idx < n_neg_keep[:, None], ranked, 0.0)
+    balance_bce = (jnp.sum(bce * pos, axis=(1, 2)) + jnp.sum(kept, axis=1)) \
+        / (n_pos + n_neg_keep + eps)
+
+    inter = jnp.sum(binary * shrink_map * shrink_mask, axis=(1, 2))
+    union = jnp.sum(binary * shrink_mask, axis=(1, 2)) \
+        + jnp.sum(shrink_map * shrink_mask, axis=(1, 2))
+    dice = 1.0 - 2.0 * inter / (union + eps)
+
+    l1 = jnp.sum(jnp.abs(thresh - thresh_map) * thresh_mask, axis=(1, 2)) \
+        / (jnp.sum(thresh_mask, axis=(1, 2)) + eps)
+
+    return jnp.mean(balance_bce + alpha * dice + beta * l1)
+
+
+# -------------------------------------------------------------------- SVTR
+
+@dataclass
+class SVTRConfig:
+    img_height: int = 32
+    img_width: int = 128
+    in_channels: int = 3
+    hidden_size: int = 96
+    num_hidden_layers: int = 6
+    num_attention_heads: int = 3
+    mlp_ratio: float = 4.0
+    num_classes: int = 6625       # charset + blank at index 0
+    local_window: int = 7
+    mixer: List[str] = field(default_factory=list)  # per-layer Local/Global
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def svtr_tiny(**overrides) -> SVTRConfig:
+    base = dict(img_height=16, img_width=32, hidden_size=24,
+                num_hidden_layers=2, num_attention_heads=2, num_classes=40)
+    base.update(overrides)
+    return SVTRConfig(**base)
+
+
+class SVTRMixingBlock(Layer):
+    """Pre-LN block; 'Local' mixing restricts attention to a sliding
+    window with a static additive mask, 'Global' is full attention."""
+
+    def __init__(self, cfg: SVTRConfig, mixer: str, seq_len: int):
+        super().__init__()
+        self.cfg, self.mixer = cfg, mixer
+        h = cfg.hidden_size
+        self.norm1 = nn.LayerNorm(h, epsilon=1e-6)
+        self.qkv = nn.Linear(h, 3 * h)
+        self.proj = nn.Linear(h, h)
+        self.norm2 = nn.LayerNorm(h, epsilon=1e-6)
+        mlp = int(h * cfg.mlp_ratio)
+        self.fc1 = nn.Linear(h, mlp)
+        self.fc2 = nn.Linear(mlp, h)
+        if mixer == "Local":
+            idx = jnp.arange(seq_len)
+            band = jnp.abs(idx[:, None] - idx[None, :]) <= cfg.local_window // 2
+            self.register_buffer(
+                "local_bias",
+                jnp.where(band, 0.0, -1e9)[None, None].astype(jnp.float32),
+                persistable=False)
+
+    def forward(self, x):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        nh, d = cfg.num_attention_heads, cfg.head_dim
+        qkv = self.qkv(self.norm1(x)).reshape(b, s, 3, nh, d)
+        mask = self.local_bias if self.mixer == "Local" else None
+        out = dense_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                              causal=False, attn_mask=mask)
+        x = x + self.proj(out.reshape(b, s, nh * d))
+        return x + self.fc2(F.gelu(self.fc1(self.norm2(x))))
+
+
+class SVTRNet(Layer):
+    """Recognition backbone + CTC head. The conv stem downsamples H by 4
+    and W by 4; tokens are the H/4 x W/4 grid; a final height-collapse
+    pooling leaves [b, W/4, C] for CTC over the width axis."""
+
+    def __init__(self, config: SVTRConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.stem = nn.Sequential(
+            nn.Conv2D(config.in_channels, h // 2, 3, stride=2, padding=1),
+            nn.GELU(),
+            nn.Conv2D(h // 2, h, 3, stride=2, padding=1),
+            nn.GELU())
+        gh, gw = config.img_height // 4, config.img_width // 4
+        self.grid = (gh, gw)
+        from ..nn import initializer as I
+        from ..nn.layer import Parameter
+        from ..utils.rng import next_key
+        self.pos_embed = Parameter(
+            I.TruncatedNormal(std=0.02)(next_key(), (1, gh * gw, h)))
+        mixers = config.mixer or (
+            ["Local"] * (config.num_hidden_layers // 2)
+            + ["Global"] * (config.num_hidden_layers
+                            - config.num_hidden_layers // 2))
+        self.blocks = nn.LayerList(
+            [SVTRMixingBlock(config, m, gh * gw) for m in mixers])
+        self.norm = nn.LayerNorm(h, epsilon=1e-6)
+        self.head = nn.Linear(h, config.num_classes)
+        if config.dtype != jnp.float32:
+            self.to(dtype=config.dtype)
+
+    def forward(self, images):
+        x = self.stem(images)                  # [b, h, gh, gw]
+        b, c, gh, gw = x.shape
+        x = x.reshape(b, c, gh * gw).transpose(0, 2, 1) + \
+            self.pos_embed.astype(x.dtype)
+        for block in self.blocks:
+            x = block(x)
+        x = self.norm(x)
+        x = x.reshape(b, gh, gw, c).mean(axis=1)   # collapse height
+        return self.head(x).astype(jnp.float32)    # [b, gw, num_classes]
+
+
+def ctc_rec_loss(logits, labels, label_lengths=None):
+    """Recognition loss (reference: ppocr rec_ctc_loss)."""
+    return F.ctc_loss(logits, labels, label_lengths=label_lengths, blank=0)
+
+
+def ctc_greedy_decode(logits):
+    """Best-path decode: argmax → collapse repeats → drop blanks. Returns
+    (ids, mask) with static shapes; mask marks surviving positions."""
+    ids = jnp.argmax(logits, axis=-1)
+    prev = jnp.pad(ids, ((0, 0), (1, 0)), constant_values=-1)[:, :-1]
+    keep = (ids != 0) & (ids != prev)
+    return ids, keep

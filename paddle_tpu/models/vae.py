@@ -1,0 +1,198 @@
+"""AutoencoderKL (reference: PaddleMIX ppdiffusers/models/autoencoder_kl.py
+— the SD/SD3 latent VAE: GroupNorm+SiLU resnet stacks, spatial attention
+mid-block, diagonal-Gaussian posterior).
+
+TPU-native design: NCHW convs lowered via lax (implicit MXU GEMMs); the
+spatial attention block flattens H*W into a token axis and calls the same
+``dense_attention`` primitive as the transformers, so XLA fuses QKV into
+one matmul. Sampling uses an explicit key (no global RNG state under jit).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..ops.attention import dense_attention
+
+
+@dataclass
+class VAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 4
+    base_channels: int = 128
+    channel_multipliers: List[int] = field(default_factory=lambda: [1, 2, 4, 4])
+    layers_per_block: int = 2
+    norm_groups: int = 32
+    scaling_factor: float = 0.18215   # SD1/2 latent scale
+    dtype: Any = jnp.float32
+
+
+def vae_tiny(**overrides) -> VAEConfig:
+    base = dict(base_channels=16, channel_multipliers=[1, 2],
+                layers_per_block=1, norm_groups=4, latent_channels=4)
+    base.update(overrides)
+    return VAEConfig(**base)
+
+
+class ResnetBlock(Layer):
+    def __init__(self, in_ch: int, out_ch: int, groups: int):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(groups, in_ch)
+        self.conv1 = nn.Conv2D(in_ch, out_ch, 3, padding=1)
+        self.norm2 = nn.GroupNorm(groups, out_ch)
+        self.conv2 = nn.Conv2D(out_ch, out_ch, 3, padding=1)
+        self.short = nn.Conv2D(in_ch, out_ch, 1) if in_ch != out_ch else None
+
+    def forward(self, x):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = self.conv2(F.silu(self.norm2(h)))
+        s = self.short(x) if self.short is not None else x
+        return s + h
+
+
+class AttnBlock(Layer):
+    """Single-head spatial self-attention over flattened H*W tokens."""
+
+    def __init__(self, channels: int, groups: int):
+        super().__init__()
+        self.norm = nn.GroupNorm(groups, channels)
+        self.qkv = nn.Linear(channels, 3 * channels)
+        self.proj = nn.Linear(channels, channels)
+
+    def forward(self, x):
+        b, c, h, w = x.shape
+        t = self.norm(x).reshape(b, c, h * w).transpose(0, 2, 1)
+        qkv = self.qkv(t).reshape(b, h * w, 3, 1, c)
+        out = dense_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                              causal=False)
+        out = self.proj(out.reshape(b, h * w, c))
+        return x + out.transpose(0, 2, 1).reshape(b, c, h, w)
+
+
+class Downsample(Layer):
+    def __init__(self, channels: int):
+        super().__init__()
+        self.conv = nn.Conv2D(channels, channels, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample(Layer):
+    def __init__(self, channels: int):
+        super().__init__()
+        self.conv = nn.Conv2D(channels, channels, 3, padding=1)
+
+    def forward(self, x):
+        x = F.interpolate(x, scale_factor=2, mode="nearest")
+        return self.conv(x)
+
+
+class Encoder(Layer):
+    def __init__(self, cfg: VAEConfig):
+        super().__init__()
+        g = cfg.norm_groups
+        ch = cfg.base_channels
+        self.conv_in = nn.Conv2D(cfg.in_channels, ch, 3, padding=1)
+        downs = []
+        in_ch = ch
+        for i, mult in enumerate(cfg.channel_multipliers):
+            out_ch = ch * mult
+            for _ in range(cfg.layers_per_block):
+                downs.append(ResnetBlock(in_ch, out_ch, g))
+                in_ch = out_ch
+            if i != len(cfg.channel_multipliers) - 1:
+                downs.append(Downsample(in_ch))
+        self.down = nn.Sequential(*downs)
+        self.mid = nn.Sequential(ResnetBlock(in_ch, in_ch, g),
+                                 AttnBlock(in_ch, g),
+                                 ResnetBlock(in_ch, in_ch, g))
+        self.norm_out = nn.GroupNorm(g, in_ch)
+        self.conv_out = nn.Conv2D(in_ch, 2 * cfg.latent_channels, 3, padding=1)
+
+    def forward(self, x):
+        h = self.mid(self.down(self.conv_in(x)))
+        return self.conv_out(F.silu(self.norm_out(h)))  # [b, 2*zc, h', w']
+
+
+class Decoder(Layer):
+    def __init__(self, cfg: VAEConfig):
+        super().__init__()
+        g = cfg.norm_groups
+        ch = cfg.base_channels
+        in_ch = ch * cfg.channel_multipliers[-1]
+        self.conv_in = nn.Conv2D(cfg.latent_channels, in_ch, 3, padding=1)
+        self.mid = nn.Sequential(ResnetBlock(in_ch, in_ch, g),
+                                 AttnBlock(in_ch, g),
+                                 ResnetBlock(in_ch, in_ch, g))
+        ups = []
+        for i, mult in enumerate(reversed(cfg.channel_multipliers)):
+            out_ch = ch * mult
+            for _ in range(cfg.layers_per_block + 1):
+                ups.append(ResnetBlock(in_ch, out_ch, g))
+                in_ch = out_ch
+            if i != len(cfg.channel_multipliers) - 1:
+                ups.append(Upsample(in_ch))
+        self.up = nn.Sequential(*ups)
+        self.norm_out = nn.GroupNorm(g, in_ch)
+        self.conv_out = nn.Conv2D(in_ch, cfg.in_channels, 3, padding=1)
+
+    def forward(self, z):
+        h = self.up(self.mid(self.conv_in(z)))
+        return self.conv_out(F.silu(self.norm_out(h)))
+
+
+class DiagonalGaussian:
+    """Posterior q(z|x); moments split from the encoder output."""
+
+    def __init__(self, moments):
+        self.mean, logvar = jnp.split(moments, 2, axis=1)
+        self.logvar = jnp.clip(logvar, -30.0, 20.0)
+        self.std = jnp.exp(0.5 * self.logvar)
+
+    def sample(self, key):
+        return self.mean + self.std * jax.random.normal(
+            key, self.mean.shape, self.mean.dtype)
+
+    def kl(self):
+        return 0.5 * jnp.sum(
+            jnp.square(self.mean) + jnp.exp(self.logvar) - 1.0 - self.logvar,
+            axis=(1, 2, 3))
+
+    def mode(self):
+        return self.mean
+
+
+class AutoencoderKL(Layer):
+    def __init__(self, config: VAEConfig):
+        super().__init__()
+        self.config = config
+        self.encoder = Encoder(config)
+        self.decoder = Decoder(config)
+        zc = config.latent_channels
+        self.quant_conv = nn.Conv2D(2 * zc, 2 * zc, 1)
+        self.post_quant_conv = nn.Conv2D(zc, zc, 1)
+        if config.dtype != jnp.float32:
+            self.to(dtype=config.dtype)
+
+    def encode(self, x) -> DiagonalGaussian:
+        return DiagonalGaussian(self.quant_conv(self.encoder(x)))
+
+    def decode(self, z):
+        return self.decoder(self.post_quant_conv(z))
+
+    def forward(self, x, key: Optional[jax.Array] = None):
+        posterior = self.encode(x)
+        z = posterior.sample(key) if key is not None else posterior.mode()
+        return self.decode(z), posterior
+
+
+def vae_loss(recon, x, posterior: DiagonalGaussian, kl_weight: float = 1e-6):
+    rec = jnp.mean(jnp.abs(recon.astype(jnp.float32) - x.astype(jnp.float32)))
+    return rec + kl_weight * jnp.mean(posterior.kl())

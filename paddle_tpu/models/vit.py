@@ -1,0 +1,172 @@
+"""Vision Transformer (reference: PaddleClas ppcls/arch/backbone/
+model_zoo/vision_transformer.py and PaddleMIX ViT encoders — patch embed,
+class token, learned position embeddings, pre-LN encoder).
+
+TPU-native design: patchify is a strided Conv2D (an implicit GEMM on the
+MXU); the encoder reuses the same Column/RowParallel projections as the LLM
+stack so a big ViT shards over ``tp`` identically. All shapes static; the
+class token is concatenated once at trace time.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, Parameter
+from ..ops.attention import dense_attention
+from ..parallel.layers import ColumnParallelLinear, RowParallelLinear
+from ..parallel.sharding import constraint
+from ..utils.rng import next_key
+
+
+@dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    in_channels: int = 3
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    num_classes: int = 1000
+    dropout_prob: float = 0.0
+    layer_norm_eps: float = 1e-6
+    use_class_token: bool = True
+    global_pool: bool = False      # mean-pool instead of CLS for the head
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def vit_tiny(**overrides) -> ViTConfig:
+    base = dict(image_size=32, patch_size=8, hidden_size=64,
+                intermediate_size=128, num_hidden_layers=2,
+                num_attention_heads=4, num_classes=10)
+    base.update(overrides)
+    return ViTConfig(**base)
+
+
+def vit_base_patch16_224(**overrides) -> ViTConfig:
+    return ViTConfig(**overrides)
+
+
+def vit_large_patch14_224(**overrides) -> ViTConfig:
+    base = dict(patch_size=14, hidden_size=1024, intermediate_size=4096,
+                num_hidden_layers=24, num_attention_heads=16)
+    base.update(overrides)
+    return ViTConfig(**base)
+
+
+class PatchEmbed(Layer):
+    def __init__(self, config: ViTConfig):
+        super().__init__()
+        self.proj = nn.Conv2D(config.in_channels, config.hidden_size,
+                              config.patch_size, stride=config.patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)                       # [b, h, gh, gw]
+        b, c = x.shape[:2]
+        return x.reshape(b, c, -1).transpose(0, 2, 1)   # [b, n, h]
+
+
+class ViTAttention(Layer):
+    def __init__(self, config: ViTConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.qkv = ColumnParallelLinear(h, 3 * h, has_bias=True,
+                                        gather_output=False)
+        self.proj = RowParallelLinear(h, h, has_bias=True,
+                                      input_is_parallel=True)
+
+    def forward(self, x):
+        cfg = self.config
+        b, s, _ = x.shape
+        nh, d = cfg.num_attention_heads, cfg.head_dim
+        qkv = self.qkv(x).reshape(b, s, 3, nh, d)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = dense_attention(q, k, v, causal=False)
+        return self.proj(out.reshape(b, s, nh * d))
+
+
+class ViTBlock(Layer):
+    """Pre-LN transformer encoder block."""
+
+    def __init__(self, config: ViTConfig):
+        super().__init__()
+        eps = config.layer_norm_eps
+        self.norm1 = nn.LayerNorm(config.hidden_size, epsilon=eps)
+        self.attn = ViTAttention(config)
+        self.norm2 = nn.LayerNorm(config.hidden_size, epsilon=eps)
+        self.fc1 = ColumnParallelLinear(config.hidden_size,
+                                        config.intermediate_size,
+                                        has_bias=True, gather_output=False)
+        self.fc2 = RowParallelLinear(config.intermediate_size,
+                                     config.hidden_size, has_bias=True,
+                                     input_is_parallel=True)
+        self.dropout = nn.Dropout(config.dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.norm1(x)))
+        x = x + self.dropout(self.fc2(F.gelu(self.fc1(self.norm2(x)))))
+        return constraint(x, ("dp", "fsdp"), None, None)
+
+
+class ViTModel(Layer):
+    def __init__(self, config: ViTConfig):
+        super().__init__()
+        self.config = config
+        self.patch_embed = PatchEmbed(config)
+        n_tokens = config.num_patches + int(config.use_class_token)
+        init = I.TruncatedNormal(std=0.02)
+        self.pos_embed = Parameter(
+            init(next_key(), (1, n_tokens, config.hidden_size)))
+        if config.use_class_token:
+            self.cls_token = Parameter(
+                jnp.zeros((1, 1, config.hidden_size)))
+        self.blocks = nn.LayerList(
+            [ViTBlock(config) for _ in range(config.num_hidden_layers)])
+        self.norm = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_eps)
+        if config.dtype != jnp.float32:
+            self.to(dtype=config.dtype)
+
+    def forward(self, pixel_values):
+        cfg = self.config
+        x = self.patch_embed(pixel_values)
+        if cfg.use_class_token:
+            cls = jnp.broadcast_to(self.cls_token,
+                                   (x.shape[0], 1, x.shape[2]))
+            x = jnp.concatenate([cls.astype(x.dtype), x], axis=1)
+        x = x + self.pos_embed.astype(x.dtype)
+        x = constraint(x, ("dp", "fsdp"), None, None)
+        for block in self.blocks:
+            x = block(x)
+        return self.norm(x)          # [b, n(+1), h]
+
+
+class ViTForImageClassification(Layer):
+    def __init__(self, config: ViTConfig):
+        super().__init__()
+        self.config = config
+        self.vit = ViTModel(config)
+        self.head = nn.Linear(config.hidden_size, config.num_classes)
+
+    def forward(self, pixel_values):
+        x = self.vit(pixel_values)
+        if self.config.global_pool or not self.config.use_class_token:
+            pooled = x.mean(axis=1)
+        else:
+            pooled = x[:, 0]
+        return self.head(pooled).astype(jnp.float32)

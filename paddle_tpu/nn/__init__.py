@@ -12,7 +12,7 @@ from .container import LayerDict, LayerList, ParameterList, Sequential
 from .conv import (AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool2D, Conv1D,
                    Conv2D, Conv2DTranspose, Conv3D, MaxPool2D)
 from .layer import Buffer, Layer, Parameter, ParamMeta
-from .loss import (BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss,
+from .loss import (BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, CTCLoss, KLDivLoss,
                    L1Loss, MSELoss, NLLLoss, SmoothL1Loss)
 from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
                    GroupNorm, InstanceNorm2D, LayerNorm, RMSNorm,

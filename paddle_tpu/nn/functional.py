@@ -349,7 +349,10 @@ def max_pool2d(x, kernel_size, stride=None, padding=0):
     s = _norm_tuple(stride if stride is not None else kernel_size, 2)
     p = _norm_tuple(padding, 2)
     pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
-    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    # -inf (the max-monoid identity) lets JAX recognise this as
+    # reduce_window_max, which has a transpose rule; finfo.min would fall
+    # into the generic reduce_window with no reverse-mode autodiff.
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
     return lax.reduce_window(x, neg, lax.max, (1, 1) + k, (1, 1) + s, pads)
 
 
@@ -593,3 +596,75 @@ def temporal_shift(x, seg_num, shift_ratio=0.25):
     right = jnp.concatenate([jnp.zeros_like(x[:, :1, fold:2 * fold]), x[:, :-1, fold:2 * fold]], axis=1)
     rest = x[:, :, 2 * fold:]
     return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+
+# --------------------------------------------------------------------- CTC
+def ctc_loss(log_probs, labels, input_lengths=None, label_lengths=None,
+             blank: int = 0, reduction: str = "mean"):
+    """Connectionist temporal classification loss (reference: paddle.nn
+    CTCLoss backed by warpctc). TPU-native: the alpha recursion is a
+    `lax.scan` over time in log space — static shapes, batched, no host
+    callbacks.
+
+    Args:
+        log_probs: [B, T, C] log-softmax outputs (pass raw logits and they
+            are normalised here).
+        labels: [B, L] int targets, padded arbitrarily past label_lengths.
+    """
+    lp = log_softmax(log_probs, axis=-1)
+    b, t, _ = lp.shape
+    l = labels.shape[1]
+    if input_lengths is None:
+        input_lengths = jnp.full((b,), t, jnp.int32)
+    if label_lengths is None:
+        label_lengths = jnp.full((b,), l, jnp.int32)
+
+    s = 2 * l + 1
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((b, s), blank, labels.dtype).at[:, 1::2].set(labels)
+    neg_inf = jnp.float32(-1e30)
+    pos = jnp.arange(s)[None, :]
+    # transition from i-2 allowed when ext[i] != blank and ext[i] != ext[i-2]
+    ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=blank)[:, :s]
+    allow_skip = (ext != blank) & (ext != ext_prev2) & (pos >= 2)
+    # emissions gathered per extended position: [B, T, S]
+    emit = jnp.take_along_axis(lp.astype(jnp.float32),
+                               ext[:, None, :].astype(jnp.int32).repeat(t, 1),
+                               axis=2)
+
+    alpha0 = jnp.full((b, s), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(emit[:, 0, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(l > 0, emit[:, 0, 1], neg_inf))
+
+    def step(alpha, inputs):
+        emit_t, t_idx = inputs
+        a1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=neg_inf)[:, :s]
+        a2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=neg_inf)[:, :s]
+        a2 = jnp.where(allow_skip, a2, neg_inf)
+        stacked = jnp.stack([alpha, a1, a2], axis=0)
+        m = jnp.max(stacked, axis=0)
+        tot = m + jnp.log(jnp.sum(jnp.exp(stacked - m[None]), axis=0))
+        new = jnp.where(m <= neg_inf / 2, neg_inf, tot) + emit_t
+        # freeze rows whose input sequence already ended
+        new = jnp.where((t_idx < input_lengths)[:, None], new, alpha)
+        return new, None
+
+    xs = (emit.transpose(1, 0, 2)[1:], jnp.arange(1, t))
+    alpha, _ = jax.lax.scan(step, alpha0, xs)
+
+    # final prob = alpha[2*label_len] + alpha[2*label_len - 1]
+    last = 2 * label_lengths
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, jnp.maximum(last - 1, 0)[:, None],
+                                 axis=1)[:, 0]
+    # empty target (label_length == 0): only the all-blank path counts —
+    # the clamped index would otherwise alias a_last and double-count it
+    a_prev = jnp.where(last == 0, neg_inf, a_prev)
+    m = jnp.maximum(a_last, a_prev)
+    ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
+    loss = -jnp.where(m <= neg_inf / 2, neg_inf, ll)
+    if reduction == "mean":  # paddle/warpctc averages by label length
+        return jnp.mean(loss / jnp.maximum(label_lengths, 1))
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss

@@ -86,3 +86,17 @@ class KLDivLoss(Layer):
 
     def forward(self, input, label):  # noqa: A002
         return F.kl_div(input, label, self.reduction)
+
+
+class CTCLoss(Layer):
+    """Reference: paddle.nn.CTCLoss (warpctc-backed). Here a lax.scan
+    alpha recursion — see functional.ctc_loss."""
+
+    def __init__(self, blank: int = 0, reduction: str = "mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths=None,
+                label_lengths=None):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction)
