@@ -99,11 +99,12 @@ class DDPMScheduler:
 
     def step(self, model_out, t, sample, key: Optional[jax.Array] = None):
         """One reverse step x_t → x_{t-1} (DDPM posterior mean + noise)."""
+        t_b = jnp.reshape(t, (-1,) + (1,) * (sample.ndim - 1))
         ac_t = _extract(self.alphas_cumprod, t, sample.ndim)
-        prev = jnp.maximum(t - 1, 0)
-        ac_prev = jnp.where(
-            _extract(jnp.arange(self.num_train_timesteps), t, sample.ndim) > 0,
-            _extract(self.alphas_cumprod, prev, sample.ndim), 1.0)
+        ac_prev = jnp.where(t_b > 0,
+                            _extract(self.alphas_cumprod,
+                                     jnp.maximum(t - 1, 0), sample.ndim),
+                            1.0)
         beta_t = 1.0 - ac_t / ac_prev
         x0 = self._pred_x0(model_out, sample, t)
         # posterior q(x_{t-1} | x_t, x_0)
@@ -113,8 +114,7 @@ class DDPMScheduler:
         var = beta_t * (1.0 - ac_prev) / (1.0 - ac_t)
         if key is not None:
             noise = jax.random.normal(key, sample.shape, jnp.float32)
-            nonzero = (_extract(jnp.arange(self.num_train_timesteps), t,
-                                sample.ndim) > 0).astype(jnp.float32)
+            nonzero = (t_b > 0).astype(jnp.float32)
             mean = mean + nonzero * jnp.sqrt(jnp.maximum(var, 1e-20)) * noise
         return mean.astype(sample.dtype)
 
@@ -126,9 +126,7 @@ class DDIMScheduler(DDPMScheduler):
 
     eta: float = 0.0
 
-    def timesteps(self, num_inference_steps: int):
-        step = self.num_train_timesteps // num_inference_steps
-        return (jnp.arange(num_inference_steps) * step)[::-1]
+    # timesteps() inherited from DDPMScheduler (same subsampled grid)
 
     def step(self, model_out, t, sample, prev_t=None,
              key: Optional[jax.Array] = None):
@@ -218,12 +216,9 @@ def sample_loop(scheduler, model_fn, shape, num_inference_steps: int,
         tb = jnp.full((shape[0],), t, jnp.int32)
         out = model_fn(x, tb, *cond)
         if isinstance(scheduler, FlowMatchScheduler):
-            pb = jnp.full((shape[0],), jnp.maximum(prev_t, 0), jnp.int32)
-            sig_prev = jnp.where(prev_t < 0, jnp.zeros((shape[0],)),
-                                 scheduler.sigmas_for(pb))
-            sig = scheduler.sigmas_for(tb)
-            d = (sig_prev - sig).reshape((-1,) + (1,) * (x.ndim - 1))
-            x = (x + d * out.astype(jnp.float32)).astype(x.dtype)
+            # sigmas_for(-1) == 0 exactly, so the final step integrates to 0
+            x = scheduler.step(out, tb, x,
+                               prev_t=jnp.full((shape[0],), prev_t))
         elif isinstance(scheduler, DDIMScheduler):
             x = scheduler.step(out, tb, x,
                                prev_t=jnp.full((shape[0],), prev_t),

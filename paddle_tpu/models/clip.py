@@ -155,7 +155,7 @@ class CLIPModel(Layer):
     def forward(self, input_ids, pixel_values):
         t = F.normalize(self.encode_text(input_ids), axis=-1)
         v = F.normalize(self.encode_image(pixel_values), axis=-1)
-        scale = jnp.exp(jnp.clip(self.logit_scale, a_max=math.log(100.0)))
+        scale = jnp.exp(jnp.minimum(self.logit_scale, math.log(100.0)))
         logits_per_image = scale * v @ t.T
         return logits_per_image, logits_per_image.T
 

@@ -22,7 +22,6 @@ from ..nn import initializer as I
 from ..nn.layer import Layer, Parameter
 from ..ops.attention import dense_attention
 from ..parallel.layers import ColumnParallelLinear, RowParallelLinear
-from ..utils.rng import next_key
 
 
 def timestep_embedding(t, dim: int, max_period: float = 10000.0):
@@ -33,6 +32,24 @@ def timestep_embedding(t, dim: int, max_period: float = 10000.0):
                     * jnp.arange(half, dtype=jnp.float32) / half)
     args = t.astype(jnp.float32)[:, None] * freqs[None]
     return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def sincos_pos_embed_2d(grid: int, dim: int):
+    """Fixed 2D sin-cos position table [1, grid*grid, dim] (reference:
+    DiT's non-learned get_2d_sincos_pos_embed). Half the channels encode
+    the row coordinate, half the column; each half is sin‖cos."""
+    assert dim % 4 == 0, "sincos embed needs dim divisible by 4"
+    quarter = dim // 4
+    omega = 1.0 / (10000.0 ** (jnp.arange(quarter, dtype=jnp.float32)
+                               / quarter))
+    coords = jnp.arange(grid, dtype=jnp.float32)
+    ys, xs = jnp.meshgrid(coords, coords, indexing="ij")
+
+    def encode(pos):          # [g*g] → [g*g, dim/2]
+        args = pos.reshape(-1)[:, None] * omega[None]
+        return jnp.concatenate([jnp.sin(args), jnp.cos(args)], axis=-1)
+
+    return jnp.concatenate([encode(ys), encode(xs)], axis=-1)[None]
 
 
 class TimestepEmbedder(Layer):
@@ -148,9 +165,9 @@ class DiT(Layer):
         p, h = config.patch_size, config.hidden_size
         self.patch_embed = nn.Conv2D(config.in_channels, h, p, stride=p)
         grid = config.input_size // p
-        self.pos_embed = Parameter(
-            I.TruncatedNormal(std=0.02)(next_key(), (1, grid * grid, h)),
-            trainable=False)
+        # fixed (non-learned) sin-cos table, exactly as reference DiT
+        self.pos_embed = Parameter(sincos_pos_embed_2d(grid, h),
+                                   trainable=False)
         self.t_embedder = TimestepEmbedder(h)
         self.y_embedder = LabelEmbedder(config.num_classes, h)
         self.blocks = nn.LayerList(
@@ -220,18 +237,21 @@ def mmdit_tiny(**overrides) -> MMDiTConfig:
 
 
 class _StreamParams(Layer):
-    """Per-stream (image or text) weights of one MMDiT joint block."""
+    """Per-stream (image or text) weights of one MMDiT joint block.
+    ``attn_only`` (SD3's context_pre_only) skips the post-attention
+    weights the final text stream never uses."""
 
-    def __init__(self, h: int, n_mod: int):
+    def __init__(self, h: int, n_mod: int, attn_only: bool = False):
         super().__init__()
         self.norm1 = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
                                   bias_attr=False)
         self.qkv = nn.Linear(h, 3 * h)
-        self.proj = nn.Linear(h, h)
-        self.norm2 = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
-                                  bias_attr=False)
-        self.fc1 = nn.Linear(h, 4 * h)
-        self.fc2 = nn.Linear(4 * h, h)
+        if not attn_only:
+            self.proj = nn.Linear(h, h)
+            self.norm2 = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                                      bias_attr=False)
+            self.fc1 = nn.Linear(h, 4 * h)
+            self.fc2 = nn.Linear(4 * h, h)
         self.ada = nn.Linear(h, n_mod * h, weight_attr=I.Constant(0.0),
                              bias_attr=I.Constant(0.0))
 
@@ -246,7 +266,8 @@ class MMDiTBlock(Layer):
         self.config = config
         self.context_last = context_last  # last block: text stream unused after attn
         self.img = _StreamParams(config.hidden_size, 6)
-        self.txt = _StreamParams(config.hidden_size, 2 if context_last else 6)
+        self.txt = _StreamParams(config.hidden_size, 2 if context_last else 6,
+                                 attn_only=context_last)
 
     def _qkv(self, stream: _StreamParams, x, sh, sc):
         cfg = self.config
@@ -301,9 +322,8 @@ class MMDiT(Layer):
         p, h = config.patch_size, config.hidden_size
         self.patch_embed = nn.Conv2D(config.in_channels, h, p, stride=p)
         grid = config.input_size // p
-        self.pos_embed = Parameter(
-            I.TruncatedNormal(std=0.02)(next_key(), (1, grid * grid, h)),
-            trainable=False)
+        self.pos_embed = Parameter(sincos_pos_embed_2d(grid, h),
+                                   trainable=False)
         self.t_embedder = TimestepEmbedder(h)
         self.pooled_proj = nn.Sequential(
             nn.Linear(config.pooled_dim, h), nn.SiLU(), nn.Linear(h, h))

@@ -1,0 +1,6 @@
+"""paddle_tpu.quant — quantization (reference: PaddleSlim / paddle.nn.quant
+weight_only_linear, llm.int8; PaddleNLP quantization configs)."""
+from .weight_only import (QuantizedLinear, dequantize_weight,
+                          quantize_blockwise, quantize_model,
+                          weight_only_linear)
+from .qat import FakeQuantLinear, fake_quant

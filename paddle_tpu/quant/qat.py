@@ -1,0 +1,44 @@
+"""Quantization-aware training stubs (reference: PaddleSlim QAT —
+fake-quant observers inserted around matmuls, straight-through gradients).
+
+TPU-native: fake_quant is a pure function with a straight-through
+estimator, so it rides inside the normal jitted train step; no observer
+state machinery — scale is computed from the current tensor (dynamic) the
+way PaddleSlim's moving-average observers converge to.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..nn import functional as F
+
+
+def fake_quant(x, bits: int = 8, axis=None):
+    """Simulated symmetric quantization with straight-through gradient."""
+    qmax = 2.0 ** (bits - 1) - 1
+    if axis is None:
+        scale = jnp.max(jnp.abs(x)) / qmax
+    else:
+        scale = jnp.max(jnp.abs(x), axis=axis, keepdims=True) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(x / scale), -qmax, qmax) * scale
+    return x + jax.lax.stop_gradient(q - x)   # STE
+
+
+class FakeQuantLinear(Layer):
+    """Linear with fake-quantized weights (+ optionally activations) for
+    QAT fine-tuning; export via quant.quantize_blockwise afterwards."""
+
+    def __init__(self, linear, bits: int = 8, quant_activations: bool = False):
+        super().__init__()
+        self.inner = linear
+        self.bits = bits
+        self.quant_activations = quant_activations
+
+    def forward(self, x):
+        if self.quant_activations:
+            x = fake_quant(x, self.bits)
+        w = fake_quant(self.inner.weight, self.bits, axis=0)
+        return F.linear(x, w, getattr(self.inner, "bias", None))

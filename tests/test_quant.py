@@ -1,0 +1,165 @@
+"""Weight-only quantization tests (C17): roundtrip error bounds, packed
+int4 correctness, model-tree swapping, QAT straight-through grads.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import nn
+from paddle_tpu.quant import (FakeQuantLinear, QuantizedLinear,
+                              dequantize_weight, fake_quant,
+                              quantize_blockwise, quantize_model,
+                              weight_only_linear)
+
+
+def _rand_w(din, dout, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), (din, dout)) * 0.05
+
+
+class TestBlockwise:
+    def test_int8_roundtrip_error(self):
+        w = _rand_w(256, 64)
+        q, s = quantize_blockwise(w, bits=8, block_size=128)
+        assert q.dtype == jnp.int8 and q.shape == (256, 64)
+        assert s.shape == (2, 64)
+        deq = dequantize_weight(q, s, bits=8, block_size=128,
+                                dtype=jnp.float32)
+        # symmetric int8: rounding error ≤ scale/2, plus bf16 scale
+        # storage adds ~2^-8 relative error on the weight magnitude
+        max_scale = float(s.astype(jnp.float32).max())
+        max_w = float(jnp.abs(w).max())
+        assert float(jnp.abs(deq - w).max()) <= \
+            max_scale * 0.51 + max_w * 2 ** -7
+
+    def test_int4_pack_unpack_exact(self):
+        """Quantize→pack→unpack must reproduce the unpacked int values."""
+        w = _rand_w(128, 16, seed=1)
+        q8, s = quantize_blockwise(w, bits=4, block_size=128)
+        assert q8.shape == (64, 16)   # two rows per byte
+        deq = dequantize_weight(q8, s, bits=4, block_size=128,
+                                dtype=jnp.float32)
+        # independently compute the unpacked reference
+        wf = np.asarray(w, np.float32).reshape(1, 128, 16)
+        scales = np.abs(wf).max(axis=1) / 7.0
+        qref = np.clip(np.round(wf / scales[:, None]), -7, 7).reshape(128, 16)
+        ref = (qref * np.asarray(s, np.float32).repeat(128, 0).reshape(128, 16))
+        np.testing.assert_allclose(np.asarray(deq), ref, atol=1e-2)
+
+    def test_int4_negative_values_sign_extend(self):
+        w = jnp.ones((128, 4)) * -0.5   # all negative → all nibbles negative
+        q, s = quantize_blockwise(w, bits=4, block_size=128)
+        deq = dequantize_weight(q, s, bits=4, block_size=128,
+                                dtype=jnp.float32)
+        assert float(deq.max()) < 0, "sign extension broken"
+        np.testing.assert_allclose(np.asarray(deq), np.asarray(w), rtol=0.01)
+
+    def test_matmul_close_to_dense(self):
+        w = _rand_w(256, 32, seed=2)
+        x = jax.random.normal(jax.random.PRNGKey(3), (4, 256))
+        dense = x @ w
+        for bits, tol in ((8, 2e-2), (4, 2e-1)):
+            q, s = quantize_blockwise(w, bits=bits)
+            out = weight_only_linear(x, q, s, bits=bits)
+            err = float(jnp.abs(out - dense).max()) / float(jnp.abs(dense).max())
+            assert err < tol, f"bits={bits}: rel err {err}"
+
+
+class TestQuantizedLinear:
+    def test_from_linear_forward(self):
+        lin = nn.Linear(128, 16)
+        qlin = QuantizedLinear.from_linear(lin, bits=8)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 128))
+        np.testing.assert_allclose(np.asarray(qlin(x)), np.asarray(lin(x)),
+                                   atol=5e-2)
+
+    def test_quantize_model_swaps_and_skips(self):
+        model = nn.Sequential(nn.Linear(128, 64), nn.GELU(),
+                              nn.Linear(64, 128))  # 64 not divisible by 128
+        n = quantize_model(model, bits=8, block_size=128)
+        assert n == 1   # second layer skipped (in_features=64)
+        kinds = [type(l).__name__ for l in model.sublayers()]
+        assert "QuantizedLinear" in kinds
+
+    def test_quantize_model_skip_patterns(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.body = nn.Linear(128, 8)
+                self.lm_head = nn.Linear(128, 8)
+        m = M()
+        n = quantize_model(m, skip=["lm_head"])
+        assert n == 1
+        assert type(m._sub_layers["lm_head"]).__name__ == "Linear"
+
+    def test_jit_and_memory_dtype(self):
+        lin = nn.Linear(256, 64)
+        qlin = QuantizedLinear.from_linear(lin, bits=4)
+        fn, params = qlin.functional()
+        assert params["qweight"].dtype == jnp.int8
+        out = jax.jit(fn)(params, jnp.ones((1, 256)))
+        assert out.shape == (1, 64) and bool(jnp.all(jnp.isfinite(out)))
+
+
+class TestQAT:
+    def test_fake_quant_ste_gradient(self):
+        x = jnp.linspace(-1, 1, 32)
+        g = jax.grad(lambda v: jnp.sum(fake_quant(v) ** 2))(x)
+        # STE: gradient flows as if identity → d/dx sum(q(x)^2) ≈ 2q(x)
+        assert bool(jnp.all(jnp.isfinite(g)))
+        assert float(jnp.abs(g).sum()) > 0
+
+    def test_fake_quant_linear_trains(self):
+        lin = nn.Linear(16, 4)
+        fq = FakeQuantLinear(lin, bits=8)
+        fn, params = fq.functional()
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 16))
+        y = jnp.ones((8, 4))
+
+        def loss(p):
+            return jnp.mean((fn(p, x) - y) ** 2)
+
+        grads = jax.grad(loss)(params)
+        assert float(jnp.abs(grads["inner.weight"]).sum()) > 0
+
+    def test_fake_quant_idempotent_scale(self):
+        x = jnp.array([0.0, 0.0, 0.0])   # all-zero: scale guard
+        out = fake_quant(x)
+        assert bool(jnp.all(out == 0))
+
+
+class TestParallelQuant:
+    def test_partition_metadata_preserved(self):
+        from paddle_tpu.parallel.layers import (ColumnParallelLinear,
+                                                RowParallelLinear)
+        col = ColumnParallelLinear(128, 64, gather_output=False)
+        q = QuantizedLinear.from_linear(col, bits=8)
+        meta = q.param_meta()
+        assert meta["qweight"].partition == (None, "tp")
+        assert meta["scales"].partition == (None, "tp")
+        assert q.output_parallel_axis == "tp"
+
+        row = RowParallelLinear(128, 64, input_is_parallel=True)
+        qr = QuantizedLinear.from_linear(row, bits=4)
+        assert qr.param_meta()["qweight"].partition == ("tp", None)
+        assert qr.input_parallel_axis == "tp"
+
+    def test_quantized_tp_matches_dense_on_mesh(self):
+        """8-virtual-device mesh: quantized TP layer == same layer dense."""
+        import jax
+        from paddle_tpu.parallel.layers import ColumnParallelLinear
+        from paddle_tpu.distributed import env
+        from paddle_tpu.parallel.sharding import shard_layer
+        col = ColumnParallelLinear(128, 64, gather_output=True)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 128))
+        q = QuantizedLinear.from_linear(col, bits=8)
+        ref = np.asarray(q(x))
+        env.init_parallel_env({"tp": 8})
+        try:
+            shard_layer(q)
+            fn, params = q.functional()
+            out = jax.jit(fn)(params, x)
+            spec = params["qweight"].sharding.spec
+            assert "tp" in str(spec), f"qweight not tp-sharded: {spec}"
+        finally:
+            env.init_parallel_env({})
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
