@@ -120,15 +120,10 @@ class DataLoader:
             except BaseException as e:  # propagate into consumer
                 put(_WorkerError(e))
             finally:
-                while True:
-                    try:
-                        q.put_nowait(sentinel)
-                        break
-                    except queue.Full:  # consumer gone; drop one and retry
-                        try:
-                            q.get_nowait()
-                        except queue.Empty:
-                            pass
+                # bounded put: waits for space while the consumer drains;
+                # bails out via `stop` if the consumer abandoned the
+                # iterator. Never discards a queued batch.
+                put(sentinel)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()

@@ -1,0 +1,73 @@
+"""Native-accelerated batch assembly for paddle_tpu.io.DataLoader
+(reference: Paddle's C++ DataLoader worker pool; dataloader_iter.py routes
+here when use_native=True).
+
+Python still runs Dataset.__getitem__ (arbitrary user code), but the
+byte-moving half of collate — stacking N samples into one contiguous
+batch — runs on the native pthread pool, writing into the page-aligned
+staging arena that feeds jax.device_put.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+import numpy as np
+
+from . import ThreadPool, StagingArena, available, gather_stack
+
+_state = threading.local()
+
+
+def _pool() -> ThreadPool:
+    if not hasattr(_state, "pool"):
+        _state.pool = ThreadPool()
+    return _state.pool
+
+
+def _arena() -> StagingArena:
+    if not hasattr(_state, "arena"):
+        _state.arena = StagingArena(1 << 28)   # 256 MB staging slab
+        _state.live = []                       # weakrefs to handed-out views
+    return _state.arena
+
+
+def _stack(items):
+    first = items[0]
+    if isinstance(first, np.ndarray) and first.nbytes >= 4096:
+        arena = _arena()
+        need = first.nbytes * len(items) + 64 * len(items)
+        if arena.used() + need > arena.capacity:
+            # recycle only when no prior batch view is still alive —
+            # prefetch queues may hold views into this slab
+            _state.live = [r for r in _state.live if r() is not None]
+            if _state.live:
+                return None      # plain numpy copy this batch
+            arena.reset()
+        out = gather_stack(_pool(), items, arena)
+        _state.live.append(weakref.ref(out))
+        return out
+    return None  # too small to win, or not an ndarray
+
+
+def assemble(dataset, indices, collate_fn):
+    """Gather + collate one batch, using native stack for ndarray leaves."""
+    batch = [dataset[i] for i in indices]
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        out = _stack(batch)
+        if out is not None:
+            return out
+    elif isinstance(sample, (list, tuple)):
+        cols = []
+        native_ok = True
+        for i in range(len(sample)):
+            col = [b[i] for b in batch]
+            out = _stack(col) if isinstance(col[0], np.ndarray) else None
+            if out is None:
+                native_ok = False
+                break
+            cols.append(out)
+        if native_ok:
+            return type(sample)(cols)
+    return collate_fn(batch)

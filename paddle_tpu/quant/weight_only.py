@@ -13,10 +13,8 @@ memory-bound autoregressive decoding.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
-import jax
 import jax.numpy as jnp
 
 from ..nn.layer import Layer, Parameter
@@ -94,10 +92,14 @@ class QuantizedLinear(Layer):
         self.output_parallel_axis = output_parallel_axis
         self.qweight = Parameter(qweight, trainable=False,
                                  partition=weight_partition)
-        # scales are [in/block, out]: dims align with the weight's, so the
-        # same partition spec shards them alongside their blocks
+        # scales are [in/block, out]: keep only the out-dim sharding. The
+        # block dim is in/block_size, usually NOT divisible by the tp
+        # degree, and the table is tiny — replicating it is free while
+        # sharding it would fail mesh validation.
+        scales_partition = (None, weight_partition[1]) \
+            if weight_partition else None
         self.scales = Parameter(scales, trainable=False,
-                                partition=weight_partition)
+                                partition=scales_partition)
         if bias is not None:
             self.bias = Parameter(bias, trainable=False,
                                   partition=bias_partition)

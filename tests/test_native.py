@@ -1,0 +1,213 @@
+"""Native C++ runtime tests (C26): arena, pool, gather/stack/pad, ring,
+tokenizer, and the DataLoader native path. Skips cleanly when the shared
+library can't be built (no compiler)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native runtime not built")
+
+
+class TestArena:
+    def test_alloc_alignment_and_reset(self):
+        arena = native.StagingArena(1 << 20)
+        a = arena.alloc(1000, np.float32, (250,))
+        b = arena.alloc(1000, np.float32, (250,))
+        assert a.ctypes.data % 64 == 0 and b.ctypes.data % 64 == 0
+        assert b.ctypes.data >= a.ctypes.data + 1000
+        used = arena.used()
+        assert used >= 2000
+        arena.reset()
+        assert arena.used() == 0
+        c = arena.alloc(64, np.uint8, (64,))
+        assert c.ctypes.data == a.ctypes.data   # slab recycled
+
+    def test_exhaustion(self):
+        arena = native.StagingArena(4096)
+        arena.alloc(4096, np.uint8, (4096,))
+        with pytest.raises(MemoryError):
+            arena.alloc(64, np.uint8, (64,))
+
+    def test_writes_visible(self):
+        arena = native.StagingArena(1 << 16)
+        v = arena.alloc(400, np.float32, (100,))
+        v[:] = np.arange(100)
+        w = np.asarray(v)
+        np.testing.assert_array_equal(w, np.arange(100, dtype=np.float32))
+
+
+class TestGather:
+    def test_stack_matches_numpy(self):
+        pool = native.ThreadPool(4)
+        items = [np.random.randn(64, 32).astype(np.float32)
+                 for _ in range(16)]
+        out = native.gather_stack(pool, items)
+        np.testing.assert_array_equal(out, np.stack(items))
+
+    def test_stack_into_arena(self):
+        pool = native.ThreadPool(2)
+        arena = native.StagingArena(1 << 20)
+        items = [np.full((128,), i, np.int32) for i in range(8)]
+        out = native.gather_stack(pool, items, arena)
+        np.testing.assert_array_equal(out, np.stack(items))
+        assert arena.used() >= out.nbytes
+
+    def test_gather_pad(self):
+        pool = native.ThreadPool(2)
+        seqs = [np.array([1, 2, 3]), np.array([4]), np.array([5, 6])]
+        out = native.gather_pad(pool, seqs, max_len=4, pad_value=-1)
+        expect = np.array([[1, 2, 3, -1], [4, -1, -1, -1], [5, 6, -1, -1]],
+                          np.int32)
+        np.testing.assert_array_equal(out, expect)
+
+    def test_gather_pad_truncates(self):
+        pool = native.ThreadPool(1)
+        out = native.gather_pad(pool, [np.arange(10)], max_len=4)
+        np.testing.assert_array_equal(out[0], np.arange(4))
+
+
+class TestRing:
+    def test_fifo(self):
+        ring = native.Ring(4)
+        for v in (10, 20, 30):
+            assert ring.push(v)
+        assert len(ring) == 3
+        assert [ring.pop() for _ in range(3)] == [10, 20, 30]
+
+    def test_blocking_producer_consumer(self):
+        ring = native.Ring(2)
+        got = []
+
+        def consumer():
+            while True:
+                v = ring.pop()
+                if v is None:
+                    return
+                got.append(v)
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        for v in range(20):
+            ring.push(v)
+        ring.close()
+        t.join(timeout=5)
+        assert got == list(range(20))
+
+    def test_close_unblocks_pop(self):
+        ring = native.Ring(2)
+        result = {}
+
+        def popper():
+            result["v"] = ring.pop()
+
+        t = threading.Thread(target=popper)
+        t.start()
+        time.sleep(0.05)
+        ring.close()
+        t.join(timeout=5)
+        assert not t.is_alive() and result["v"] is None
+
+    def test_pop_timeout(self):
+        ring = native.Ring(1)
+        with pytest.raises(TimeoutError):
+            ring.pop(timeout_ms=30)
+
+
+class TestTokenizer:
+    def test_longest_match(self):
+        tok = native.Tokenizer(["<unk>", "a", "b", "ab", "abc"], unk_id=0)
+        assert tok.vocab_size == 5
+        np.testing.assert_array_equal(tok.encode("abc"), [4])
+        np.testing.assert_array_equal(tok.encode("abab"), [3, 3])
+        np.testing.assert_array_equal(tok.encode("ba"), [2, 1])
+
+    def test_unknown_bytes(self):
+        tok = native.Tokenizer(["<unk>", "x"], unk_id=0)
+        np.testing.assert_array_equal(tok.encode("xyx"), [1, 0, 1])
+
+    def test_encode_batch_padded(self):
+        tok = native.Tokenizer(["<pad>", "hello", " ", "world"], unk_id=0)
+        pool = native.ThreadPool(2)
+        out, lens = tok.encode_batch(["hello world", "world"], pool,
+                                     max_len=5, pad_id=0)
+        np.testing.assert_array_equal(out[0], [1, 2, 3, 0, 0])
+        np.testing.assert_array_equal(out[1], [3, 0, 0, 0, 0])
+        assert lens.tolist() == [3, 1]
+
+    def test_multibyte_utf8(self):
+        tok = native.Tokenizer(["<unk>", "日本", "語"], unk_id=0)
+        np.testing.assert_array_equal(tok.encode("日本語"), [1, 2])
+
+
+class TestLoaderIntegration:
+    def test_dataloader_native_path(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        X = np.random.randn(64, 32, 8).astype(np.float32)
+        Y = np.random.randint(0, 10, (64,)).astype(np.int64)
+        ds = TensorDataset([X, Y])
+        loader = DataLoader(ds, batch_size=16, use_native=True)
+        ref = DataLoader(ds, batch_size=16, use_native=False)
+        for (xb, yb), (xr, yr) in zip(loader, ref):
+            np.testing.assert_array_equal(np.asarray(xb), xr)
+            np.testing.assert_array_equal(np.asarray(yb), yr)
+
+    def test_native_with_workers(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        X = np.arange(32 * 16, dtype=np.float32).reshape(32, 16)
+        ds = TensorDataset([X])
+        loader = DataLoader(ds, batch_size=8, use_native=True, num_workers=2)
+        seen = np.concatenate([np.asarray(b[0]) for b in loader])
+        np.testing.assert_array_equal(np.sort(seen.ravel()),
+                                      np.sort(X.ravel()))
+
+
+class TestArenaSafety:
+    def test_no_reset_while_views_alive(self):
+        """Exhaust the slab while holding a batch view: the loader must
+        fall back to fresh numpy memory, never recycle under the view."""
+        from paddle_tpu.native import loader as L
+        import paddle_tpu.native as native_mod
+
+        class DS:
+            def __getitem__(self, i):
+                return np.full((2048,), i, np.float32)
+
+        # shrink the thread-local arena so two batches overflow it
+        L._state.arena = native_mod.StagingArena(3 * 16 * 2048 * 4 // 2)
+        L._state.live = []
+        ds = DS()
+        b1 = L.assemble(ds, range(16), lambda b: np.stack(b))
+        snapshot = b1.copy()
+        b2 = L.assemble(ds, range(16, 32), lambda b: np.stack(b))
+        b3 = L.assemble(ds, range(32, 48), lambda b: np.stack(b))
+        np.testing.assert_array_equal(b1, snapshot)   # b1 untouched
+        np.testing.assert_array_equal(b3[0], np.full((2048,), 32, np.float32))
+        del L._state.arena, L._state.live             # restore default
+
+    def test_views_keep_arena_alive(self):
+        """A batch view must pin its arena: simulate the producer thread
+        dying (thread-local released) while the view is queued."""
+        import gc
+        import weakref
+        arena = native.StagingArena(1 << 16)
+        ref = weakref.ref(arena)
+        v = arena.alloc(4096, np.float32, (1024,))
+        v[:] = 7.0
+        del arena
+        gc.collect()
+        assert ref() is not None, "arena freed under a live view"
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.full(1024, 7.0, np.float32))
+        del v
+        gc.collect()
+        assert ref() is None, "arena leaked after views died"
+
+    def test_gather_stack_rejects_ragged(self):
+        pool = native.ThreadPool(1)
+        with pytest.raises(ValueError):
+            native.gather_stack(pool, [np.zeros(4), np.zeros(3)])
