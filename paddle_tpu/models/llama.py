@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from ..nn.layer import Layer, Parameter
+from ..nn.recompute import POLICIES
 from ..ops.attention import dense_attention, flash_attention, use_flash
 from ..parallel.layers import (ColumnParallelLinear, RowParallelLinear,
                                VocabParallelEmbedding, parallel_matmul)
@@ -49,6 +50,11 @@ class LlamaConfig:
     attention_bias: bool = False       # Qwen2 uses biased q/k/v projections
     initializer_range: float = 0.02
     recompute: bool = False
+    # jax.checkpoint policy name (see nn.recompute.POLICIES): "full"
+    # reruns everything; "dots_with_no_batch_dims_saveable" keeps weight
+    # matmul outputs in HBM and reruns only the cheap elementwise chains —
+    # the usual MFU winner when memory allows.
+    recompute_policy: str = "full"
     use_flash_attention: bool = True
     sequence_parallel: bool = False  # ring attention over the sp axis
     dtype: Any = jnp.bfloat16
@@ -230,7 +236,8 @@ class LlamaModel(Layer):
             if self.config.recompute and kv_caches is None:
                 out = jax.checkpoint(
                     lambda h, lyr=layer: lyr(h, positions, attn_mask=attn_mask),
-                    prevent_cse=False)(x)
+                    prevent_cse=False,
+                    policy=POLICIES[self.config.recompute_policy])(x)
             else:
                 out = layer(x, positions, kv_cache=cache_i,
                             cache_index=cache_index, attn_mask=attn_mask)
