@@ -43,6 +43,7 @@ from . import io  # noqa: E402
 from . import jit  # noqa: E402
 from . import nn  # noqa: E402
 from . import optimizer  # noqa: E402
+from . import inference  # noqa: E402
 from . import quant  # noqa: E402
 from .checkpoint import load, save  # noqa: E402
 

@@ -2,3 +2,4 @@
 (reference: PaddleMIX ppdiffusers/schedulers)."""
 from .schedulers import (DDIMScheduler, DDPMScheduler, FlowMatchScheduler,
                          diffusion_loss, make_betas, sample_loop)
+from .pipelines import DiTPipeline, StableDiffusion3Pipeline

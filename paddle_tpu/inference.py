@@ -1,0 +1,91 @@
+"""Inference predictor (reference: paddle.inference.Predictor /
+paddle/fluid/inference/api — config + predictor over an optimized program;
+PaddleNLP's llm/predict/predictor.py for the LLM path).
+
+TPU-native: the "optimized program" is a cached jax.jit of the model's
+functional form with donated weights left on device; optional weight-only
+quantization at load (C17). One Predictor == one compiled engine per input
+shape, the same mental model as the reference's shape-bucketed engines.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Config:
+    """paddle.inference.Config parity surface (the knobs that matter on
+    TPU: dtype, quantization, generation defaults)."""
+
+    def __init__(self, model_path: Optional[str] = None):
+        self.model_path = model_path
+        self.dtype = jnp.bfloat16
+        self.quant_bits: Optional[int] = None     # 8 / 4 / None
+        self.quant_skip = ["lm_head", "embed"]
+        self.max_batch_size = 8
+
+    def enable_weight_only_quant(self, bits: int = 8):
+        self.quant_bits = bits
+        return self
+
+    def set_dtype(self, dtype):
+        self.dtype = dtype
+        return self
+
+
+class Predictor:
+    """Wraps a Layer for serving: jit-cached forward per input signature,
+    optional PTQ at load, state kept on device."""
+
+    def __init__(self, model, config: Optional[Config] = None):
+        self.config = config or Config()
+        self.model = model
+        if self.config.quant_bits:
+            from .quant import quantize_model
+            quantize_model(model, bits=self.config.quant_bits,
+                           skip=self.config.quant_skip)
+        model.eval()
+        self._fn, self._params = model.functional()
+        # weights live on device once; every run reuses them
+        self._params = jax.device_put(self._params)
+        self._engines: Dict[Tuple, Callable] = {}
+
+    def _engine(self, treedef, shapes):
+        key = (treedef, shapes)
+        if key not in self._engines:
+            self._engines[key] = jax.jit(self._fn)
+        return self._engines[key]
+
+    def run(self, *inputs):
+        """Eager-looking predict: inputs are host arrays; returns device
+        outputs (np.asarray them for host use)."""
+        args = tuple(jnp.asarray(x) for x in inputs)
+        treedef = jax.tree.structure(args)
+        shapes = tuple((a.shape, str(a.dtype)) for a in args)
+        return self._engine(treedef, shapes)(self._params, *args)
+
+    __call__ = run
+
+    def generate(self, input_ids, **kwargs):
+        """Autoregressive generation with the model's KV cache path."""
+        return self.model.generate(jnp.asarray(input_ids), **kwargs)
+
+    @classmethod
+    def from_checkpoint(cls, model_factory: Callable[[], Any], path: str,
+                        config: Optional[Config] = None):
+        """Build model, load weights (paddle_tpu.load), wrap."""
+        from .checkpoint import load
+        model = model_factory()
+        model.set_state_dict(load(path))
+        return cls(model, config)
+
+
+def create_predictor(config: Config, model=None):
+    """paddle.inference.create_predictor parity."""
+    if model is None:
+        raise ValueError("paddle_tpu predictor needs the model object "
+                         "(graph serialization comes via jit.to_static AOT)")
+    return Predictor(model, config)

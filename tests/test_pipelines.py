@@ -1,0 +1,102 @@
+"""Diffusion pipelines + inference Predictor tests (C24 depth, serving)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.diffusion import (DDIMScheduler, DiTPipeline,
+                                  FlowMatchScheduler,
+                                  StableDiffusion3Pipeline)
+from paddle_tpu.inference import Config, Predictor
+from paddle_tpu.models import (DiT, MMDiT, AutoencoderKL, dit_tiny,
+                               mmdit_tiny, vae_tiny)
+
+
+class TestDiTPipeline:
+    def test_latents_shape_finite(self):
+        pipe = DiTPipeline(DiT(dit_tiny()))
+        out = pipe([0, 1], num_inference_steps=4, key=jax.random.PRNGKey(0))
+        assert out.shape == (2, 4, 8, 8)
+        assert bool(jnp.all(jnp.isfinite(out)))
+
+    def test_vae_decode_stage(self):
+        vae = AutoencoderKL(vae_tiny())
+        pipe = DiTPipeline(DiT(dit_tiny()), vae=vae)
+        img = pipe([1], num_inference_steps=2, key=jax.random.PRNGKey(1))
+        assert img.shape == (1, 3, 16, 16)   # one VAE upsample stage from 8
+
+    def test_guidance_changes_output(self):
+        pipe = DiTPipeline(DiT(dit_tiny()))
+        # zero-init final layer → output 0 → cfg has no effect on eps, but
+        # perturb params so cond/uncond differ
+        for k in pipe._params:
+            if "final_proj" in k or "ada" in k:
+                pipe._params[k] = jax.random.normal(
+                    jax.random.PRNGKey(0), pipe._params[k].shape) * 0.02
+        a = pipe([0], num_inference_steps=3, guidance_scale=1.0,
+                 key=jax.random.PRNGKey(2))
+        b = pipe([0], num_inference_steps=3, guidance_scale=8.0,
+                 key=jax.random.PRNGKey(2))
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+class TestSD3Pipeline:
+    def test_flow_sampling(self):
+        cfg = mmdit_tiny()
+        pipe = StableDiffusion3Pipeline(MMDiT(cfg))
+        ctx = jnp.ones((1, 6, cfg.context_dim))
+        pooled = jnp.ones((1, cfg.pooled_dim))
+        out = pipe(ctx, pooled, num_inference_steps=4)
+        assert out.shape == (1, 4, 8, 8)
+        assert bool(jnp.all(jnp.isfinite(out)))
+
+    def test_negative_prompt_embeddings(self):
+        cfg = mmdit_tiny()
+        pipe = StableDiffusion3Pipeline(MMDiT(cfg))
+        ctx = jnp.ones((1, 6, cfg.context_dim))
+        pooled = jnp.ones((1, cfg.pooled_dim))
+        out = pipe(ctx, pooled, neg_context=ctx * 0.5, neg_pooled=pooled,
+                   num_inference_steps=2)
+        assert out.shape == (1, 4, 8, 8)
+
+
+class TestPredictor:
+    def _model(self):
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+        return LlamaForCausalLM(llama_tiny(hidden_size=128,
+                                           intermediate_size=256))
+
+    def test_run_and_engine_cache(self):
+        pred = Predictor(self._model())
+        out1 = pred.run(np.array([[1, 2, 3, 4]]))
+        assert out1.shape == (1, 4, 256)
+        n_engines = len(pred._engines)
+        pred.run(np.array([[5, 6, 7, 8]]))        # same shape → same engine
+        assert len(pred._engines) == n_engines
+        pred.run(np.array([[1, 2, 3, 4, 5, 6, 7, 8]]))  # new shape
+        assert len(pred._engines) == n_engines + 1
+
+    def test_quantized_predictor(self):
+        pred = Predictor(self._model(),
+                         Config().enable_weight_only_quant(8))
+        ref = Predictor(self._model())
+        kinds = [type(l).__name__ for l in pred.model.sublayers()]
+        assert "QuantizedLinear" in kinds
+        out = pred.run(np.array([[1, 2, 3]]))
+        assert bool(jnp.all(jnp.isfinite(out)))
+
+    def test_generate(self):
+        pred = Predictor(self._model())
+        out = pred.generate(np.array([[1, 2, 3, 4]]), max_new_tokens=4,
+                            key=jax.random.PRNGKey(0))
+        tok = out[0] if isinstance(out, tuple) else out
+        assert tok.shape == (1, 8)
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        m = self._model()
+        path = str(tmp_path / "m.ckpt")
+        pt.save(m.state_dict(), path)
+        pred = Predictor.from_checkpoint(self._model, path)
+        ids = np.array([[1, 2, 3]])
+        np.testing.assert_allclose(np.asarray(pred.run(ids)),
+                                   np.asarray(m.eval()(ids)), atol=1e-5)
