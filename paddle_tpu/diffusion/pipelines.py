@@ -3,10 +3,15 @@ pipeline_dit.py DiTPipeline, pipeline_stable_diffusion_3.py
 StableDiffusion3Pipeline).
 
 TPU-native design: a pipeline is a thin orchestrator whose entire
-denoising loop is ONE jitted program (`lax.scan` over steps, CFG as a
-doubled batch so the conditional/unconditional passes share every matmul).
-No per-step host round trips — the host submits one XLA computation and
-gets final latents back.
+denoising loop is ONE jitted program — `schedulers.sample_loop`'s
+`lax.scan` is the single implementation of the reverse process, and
+classifier-free guidance is a model_fn wrapper that doubles the batch so
+the conditional/unconditional passes share every matmul. No per-step host
+round trips.
+
+Jit engines are cached per pipeline INSTANCE (keyed by step count and the
+current scheduler), so dropping a pipeline frees its weights and swapping
+`pipe.scheduler` takes effect on the next call.
 """
 from __future__ import annotations
 
@@ -17,78 +22,72 @@ import jax.numpy as jnp
 
 from ..models.dit import DiT, MMDiT
 from ..models.vae import AutoencoderKL
-from .schedulers import DDIMScheduler, FlowMatchScheduler
+from .schedulers import DDIMScheduler, FlowMatchScheduler, sample_loop
 
 
-class DiTPipeline:
+class _PipelineBase:
+    def __init__(self, backbone, vae):
+        self.vae = vae
+        self._fn, self._params = backbone.functional()
+        self._engines = {}
+        if vae is not None:
+            vae.eval()
+
+    def _engine(self, num_steps: int, build):
+        key = (num_steps, id(self.scheduler))
+        if key not in self._engines:
+            self._engines[key] = jax.jit(build(num_steps))
+        return self._engines[key]
+
+    def _decode(self, latents):
+        if self.vae is None:
+            return latents
+        return self.vae.decode(latents / self.vae.config.scaling_factor)
+
+
+class DiTPipeline(_PipelineBase):
     """Class-conditional latent diffusion with a DiT backbone
     (reference: ppdiffusers DiTPipeline: DiT + AutoencoderKL + DDIM)."""
 
     def __init__(self, dit: DiT, vae: Optional[AutoencoderKL] = None,
                  scheduler: Optional[DDIMScheduler] = None):
+        super().__init__(dit, vae)
         self.dit = dit
-        self.vae = vae
         self.scheduler = scheduler or DDIMScheduler(num_train_timesteps=1000)
-        self._fn, self._params = dit.functional()
-        self._vae_fn = None
-        if vae is not None:
-            vae.eval()
 
     def __call__(self, class_labels, num_inference_steps: int = 50,
                  guidance_scale: float = 4.0, key=None):
         """Returns decoded images [b, c, h, w] (latents if no VAE)."""
         key = key if key is not None else jax.random.PRNGKey(0)
         labels = jnp.asarray(class_labels)
-        latents = self._sample(self._params, labels,
-                               jnp.float32(guidance_scale),
-                               jnp.int32(num_inference_steps), key)
-        if self.vae is None:
-            return latents
-        return self.vae.decode(latents / self.vae.config.scaling_factor)
 
-    def _sample(self, params, labels, cfg_scale, num_steps, key):
-        # one compiled program per (batch, steps) shape
-        return _dit_sample_jit(self, params, labels, cfg_scale,
-                               int(num_steps), key)
+        def build(n_steps):
+            def sampler(params, labels, cfg_scale, key):
+                cfg = self.dit.config
+                b = labels.shape[0]
+                shape = (b, cfg.in_channels, cfg.input_size, cfg.input_size)
+                labels2 = jnp.concatenate([labels, labels])
+                null_mask = jnp.concatenate(
+                    [jnp.zeros(b, bool), jnp.ones(b, bool)])
 
+                def model_fn(x, t):
+                    out = self._fn(params, jnp.concatenate([x, x]),
+                                   jnp.concatenate([t, t]), labels2,
+                                   null_mask)
+                    eps = out[:, :cfg.in_channels]   # drop learned sigma
+                    cond, uncond = eps[:b], eps[b:]
+                    return uncond + cfg_scale * (cond - uncond)
 
-def _dit_sample(pipe: DiTPipeline, params, labels, cfg_scale, num_steps,
-                key):
-    dit_cfg = pipe.dit.config
-    b = labels.shape[0]
-    shape = (b, dit_cfg.in_channels, dit_cfg.input_size, dit_cfg.input_size)
-    sched = pipe.scheduler
-    key, init_key = jax.random.split(key)
-    x = jax.random.normal(init_key, shape, jnp.float32)
-    ts = sched.timesteps(num_steps)
-    prev_ts = jnp.concatenate([ts[1:], jnp.array([-1], ts.dtype)])
-    # CFG: run cond + uncond in one doubled batch
-    null_mask = jnp.concatenate([jnp.zeros(b, bool), jnp.ones(b, bool)])
-    labels2 = jnp.concatenate([labels, labels])
+                return sample_loop(self.scheduler, model_fn, shape,
+                                   n_steps, key)
+            return sampler
 
-    def body(carry, t_pair):
-        x, key = carry
-        t, prev_t = t_pair
-        key, sk = jax.random.split(key)
-        tb = jnp.full((2 * b,), t, jnp.int32)
-        x2 = jnp.concatenate([x, x])
-        out = pipe._fn(params, x2, tb, labels2, null_mask)
-        eps = out[:, :dit_cfg.in_channels]          # drop learned sigma
-        cond, uncond = eps[:b], eps[b:]
-        eps = uncond + cfg_scale * (cond - uncond)
-        x = sched.step(eps, jnp.full((b,), t), x,
-                       prev_t=jnp.full((b,), prev_t), key=sk)
-        return (x, key), None
-
-    (x, _), _ = jax.lax.scan(body, (x, key), (ts, prev_ts))
-    return x
+        latents = self._engine(num_inference_steps, build)(
+            self._params, labels, jnp.float32(guidance_scale), key)
+        return self._decode(latents)
 
 
-_dit_sample_jit = jax.jit(_dit_sample,
-                          static_argnums=(0, 4))  # pipe, num_steps static
-
-
-class StableDiffusion3Pipeline:
+class StableDiffusion3Pipeline(_PipelineBase):
     """SD3-style text-to-image: MMDiT + flow matching + VAE (reference:
     ppdiffusers StableDiffusion3Pipeline). Text encoders are pluggable —
     pass precomputed (context, pooled) embeddings, the way the reference's
@@ -96,12 +95,9 @@ class StableDiffusion3Pipeline:
 
     def __init__(self, mmdit: MMDiT, vae: Optional[AutoencoderKL] = None,
                  scheduler: Optional[FlowMatchScheduler] = None):
+        super().__init__(mmdit, vae)
         self.mmdit = mmdit
-        self.vae = vae
         self.scheduler = scheduler or FlowMatchScheduler(shift=3.0)
-        self._fn, self._params = mmdit.functional()
-        if vae is not None:
-            vae.eval()
 
     def __call__(self, context, pooled, neg_context=None, neg_pooled=None,
                  num_inference_steps: int = 28, guidance_scale: float = 7.0,
@@ -111,42 +107,26 @@ class StableDiffusion3Pipeline:
             neg_context = jnp.zeros_like(context)
         if neg_pooled is None:
             neg_pooled = jnp.zeros_like(pooled)
-        latents = _sd3_sample_jit(self, self._params, context, pooled,
-                                  neg_context, neg_pooled,
-                                  jnp.float32(guidance_scale),
-                                  int(num_inference_steps), key)
-        if self.vae is None:
-            return latents
-        return self.vae.decode(latents / self.vae.config.scaling_factor)
 
+        def build(n_steps):
+            def sampler(params, ctx, pool, nctx, npool, cfg_scale, key):
+                cfg = self.mmdit.config
+                b = ctx.shape[0]
+                shape = (b, cfg.in_channels, cfg.input_size, cfg.input_size)
+                ctx2 = jnp.concatenate([ctx, nctx])
+                pool2 = jnp.concatenate([pool, npool])
 
-def _sd3_sample(pipe, params, context, pooled, neg_context, neg_pooled,
-                cfg_scale, num_steps, key):
-    cfg = pipe.mmdit.config
-    b = context.shape[0]
-    shape = (b, cfg.in_channels, cfg.input_size, cfg.input_size)
-    sched = pipe.scheduler
-    key, init_key = jax.random.split(key)
-    x = jax.random.normal(init_key, shape, jnp.float32)
-    ts = sched.timesteps(num_steps)
-    prev_ts = jnp.concatenate([ts[1:], jnp.array([-1], ts.dtype)])
-    ctx2 = jnp.concatenate([context, neg_context])
-    pool2 = jnp.concatenate([pooled, neg_pooled])
+                def model_fn(x, t):
+                    v = self._fn(params, jnp.concatenate([x, x]),
+                                 jnp.concatenate([t, t]), ctx2, pool2)
+                    cond, uncond = v[:b], v[b:]
+                    return uncond + cfg_scale * (cond - uncond)
 
-    def body(carry, t_pair):
-        x, = carry
-        t, prev_t = t_pair
-        tb = jnp.full((2 * b,), t, jnp.int32)
-        x2 = jnp.concatenate([x, x])
-        v = pipe._fn(params, x2, tb, ctx2, pool2)
-        cond, uncond = v[:b], v[b:]
-        v = uncond + cfg_scale * (cond - uncond)
-        x = sched.step(v, jnp.full((b,), t), x,
-                       prev_t=jnp.full((b,), prev_t))
-        return (x,), None
+                return sample_loop(self.scheduler, model_fn, shape,
+                                   n_steps, key)
+            return sampler
 
-    (x,), _ = jax.lax.scan(body, (x,), (ts, prev_ts))
-    return x
-
-
-_sd3_sample_jit = jax.jit(_sd3_sample, static_argnums=(0, 7))
+        latents = self._engine(num_inference_steps, build)(
+            self._params, context, pooled, neg_context, neg_pooled,
+            jnp.float32(guidance_scale), key)
+        return self._decode(latents)

@@ -9,23 +9,21 @@ shape, the same mental model as the reference's shape-bucketed engines.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 class Config:
     """paddle.inference.Config parity surface (the knobs that matter on
-    TPU: dtype, quantization, generation defaults)."""
+    TPU: dtype, quantization)."""
 
     def __init__(self, model_path: Optional[str] = None):
         self.model_path = model_path
-        self.dtype = jnp.bfloat16
+        self.dtype = None                         # None = keep model dtype
         self.quant_bits: Optional[int] = None     # 8 / 4 / None
         self.quant_skip = ["lm_head", "embed"]
-        self.max_batch_size = 8
 
     def enable_weight_only_quant(self, bits: int = 8):
         self.quant_bits = bits
@@ -37,12 +35,15 @@ class Config:
 
 
 class Predictor:
-    """Wraps a Layer for serving: jit-cached forward per input signature,
-    optional PTQ at load, state kept on device."""
+    """Wraps a Layer for serving: one jitted engine (jax.jit's own cache
+    handles per-shape retraces), optional dtype cast + PTQ at load, state
+    kept on device."""
 
     def __init__(self, model, config: Optional[Config] = None):
         self.config = config or Config()
         self.model = model
+        if self.config.dtype is not None:
+            model.to(dtype=self.config.dtype)
         if self.config.quant_bits:
             from .quant import quantize_model
             quantize_model(model, bits=self.config.quant_bits,
@@ -51,21 +52,13 @@ class Predictor:
         self._fn, self._params = model.functional()
         # weights live on device once; every run reuses them
         self._params = jax.device_put(self._params)
-        self._engines: Dict[Tuple, Callable] = {}
-
-    def _engine(self, treedef, shapes):
-        key = (treedef, shapes)
-        if key not in self._engines:
-            self._engines[key] = jax.jit(self._fn)
-        return self._engines[key]
+        self._engine = jax.jit(self._fn)
 
     def run(self, *inputs):
         """Eager-looking predict: inputs are host arrays; returns device
         outputs (np.asarray them for host use)."""
         args = tuple(jnp.asarray(x) for x in inputs)
-        treedef = jax.tree.structure(args)
-        shapes = tuple((a.shape, str(a.dtype)) for a in args)
-        return self._engine(treedef, shapes)(self._params, *args)
+        return self._engine(self._params, *args)
 
     __call__ = run
 

@@ -66,15 +66,16 @@ class TestPredictor:
         return LlamaForCausalLM(llama_tiny(hidden_size=128,
                                            intermediate_size=256))
 
-    def test_run_and_engine_cache(self):
+    def test_run_shapes_and_trace_cache(self):
         pred = Predictor(self._model())
         out1 = pred.run(np.array([[1, 2, 3, 4]]))
         assert out1.shape == (1, 4, 256)
-        n_engines = len(pred._engines)
-        pred.run(np.array([[5, 6, 7, 8]]))        # same shape → same engine
-        assert len(pred._engines) == n_engines
-        pred.run(np.array([[1, 2, 3, 4, 5, 6, 7, 8]]))  # new shape
-        assert len(pred._engines) == n_engines + 1
+        pred.run(np.array([[5, 6, 7, 8]]))        # same shape → cached trace
+        n_traces = pred._engine._cache_size()
+        pred.run(np.array([[9, 9, 9, 9]]))
+        assert pred._engine._cache_size() == n_traces
+        pred.run(np.array([[1, 2, 3, 4, 5, 6, 7, 8]]))  # new shape → retrace
+        assert pred._engine._cache_size() == n_traces + 1
 
     def test_quantized_predictor(self):
         pred = Predictor(self._model(),
