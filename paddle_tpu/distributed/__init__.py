@@ -1,5 +1,7 @@
 """paddle_tpu.distributed (reference: python/paddle/distributed/__init__.py)."""
 from . import collective
+from . import launch
+from .launch import init_distributed
 from .collective import (ReduceOp, all_gather, all_reduce, all_to_all,
                          broadcast, eager_all_gather, eager_all_reduce,
                          eager_broadcast, ppermute, reduce_scatter)

@@ -102,3 +102,17 @@ def test_step_timer_mfu():
     t.stop(tokens=1000)
     assert 0 < t.mfu < 120  # sanity: mfu = 1e12*tok_rate/1e12
     assert t.tokens_per_sec > 0
+
+
+def test_launch_local_mode(tmp_path):
+    """init_distributed on a single host is a no-op that still reports
+    topology; launch() runs a script in-process with argv wired."""
+    from paddle_tpu.distributed.launch import init_distributed, launch
+    info = init_distributed()
+    assert info["process_count"] == 1 and info["global_devices"] >= 1
+    script = tmp_path / "train.py"
+    script.write_text("import sys, json, pathlib\n"
+                      "pathlib.Path(sys.argv[1]).write_text('ran')\n")
+    out = tmp_path / "out.txt"
+    assert launch([str(script), str(out)]) == 0
+    assert out.read_text() == "ran"
