@@ -54,9 +54,15 @@ def init_distributed(coordinator_address: Optional[str] = None,
     pid = process_id if process_id is not None else _env(
         "PADDLE_TPU_PROCESS_ID", "PADDLE_TRAINER_ID", "RANK")
 
-    on_pod = _env("TPU_WORKER_HOSTNAMES", "TPU_SKIP_MDS_QUERY",
+    from jax._src import xla_bridge
+    on_pod = _env("TPU_WORKER_HOSTNAMES",
                   "MEGASCALE_COORDINATOR_ADDRESS") is not None
-    if coord is not None and nproc is not None and pid is not None:
+    if xla_bridge.backends_are_initialized():
+        # too late to wire the distributed runtime (e.g. called from a
+        # notebook after a jax op, or a single-host test session) — report
+        # the live topology instead of crashing mid-script
+        pass
+    elif coord is not None and nproc is not None and pid is not None:
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=int(nproc),
                                    process_id=int(pid))
