@@ -44,6 +44,8 @@ from . import jit  # noqa: E402
 from . import nn  # noqa: E402
 from . import optimizer  # noqa: E402
 from . import inference  # noqa: E402
+from . import metric  # noqa: E402
+from . import vision  # noqa: E402
 from . import quant  # noqa: E402
 from .checkpoint import load, save  # noqa: E402
 

@@ -54,20 +54,29 @@ def init_distributed(coordinator_address: Optional[str] = None,
     pid = process_id if process_id is not None else _env(
         "PADDLE_TPU_PROCESS_ID", "PADDLE_TRAINER_ID", "RANK")
 
-    from jax._src import xla_bridge
     on_pod = _env("TPU_WORKER_HOSTNAMES",
                   "MEGASCALE_COORDINATOR_ADDRESS") is not None
-    if xla_bridge.backends_are_initialized():
-        # too late to wire the distributed runtime (e.g. called from a
-        # notebook after a jax op, or a single-host test session) — report
-        # the live topology instead of crashing mid-script
-        pass
-    elif coord is not None and nproc is not None and pid is not None:
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=int(nproc),
-                                   process_id=int(pid))
-    elif on_pod:
-        jax.distributed.initialize()  # Cloud TPU metadata autodetect
+    explicit = coord is not None and nproc is not None and pid is not None
+    if explicit or on_pod:
+        try:
+            if explicit:
+                jax.distributed.initialize(coordinator_address=coord,
+                                           num_processes=int(nproc),
+                                           process_id=int(pid))
+            else:
+                jax.distributed.initialize()  # Cloud TPU metadata autodetect
+        except RuntimeError as e:
+            # initialize() raises when a jax op already touched the backend
+            # (notebook, test session). Multi-host intent was stated, so a
+            # silent single-host fallback would fan out N independent jobs
+            # clobbering each other — make it loud.
+            import warnings
+            warnings.warn(
+                f"init_distributed: multi-host setup requested but the XLA "
+                f"backend is already initialized ({e}); continuing with the "
+                f"EXISTING topology ({jax.process_count()} process(es)). "
+                f"Call init_distributed() before any jax operation.",
+                RuntimeWarning, stacklevel=2)
     # else: single host — nothing to initialize
 
     info = {

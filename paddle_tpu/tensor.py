@@ -712,3 +712,76 @@ def numel(x):
 
 def shape(x):
     return list(x.shape)
+
+
+# ----------------------------------------------------------------- random
+# (reference: paddle/tensor/random.py — global-generator sampling ops.
+# Keys come from the utils.rng seed tree: deterministic under pt.seed,
+# per-call streams; pass key= explicitly inside jit.)
+def _rand_key(key):
+    from .utils.rng import next_key
+    return key if key is not None else next_key()
+
+
+def rand(shape, dtype=jnp.float32, key=None):  # noqa: A002
+    return jax.random.uniform(_rand_key(key), tuple(shape), dtype)
+
+
+def randn(shape, dtype=jnp.float32, key=None):  # noqa: A002
+    return jax.random.normal(_rand_key(key), tuple(shape), dtype)
+
+
+standard_normal = randn
+
+
+def randint(low, high=None, shape=(1,), dtype=jnp.int64, key=None):  # noqa: A002
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_rand_key(key), tuple(shape), low, high,
+                              dtype=jnp.int32).astype(dtype)
+
+
+def randperm(n, dtype=jnp.int64, key=None):
+    return jax.random.permutation(_rand_key(key), n).astype(dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=(1,), key=None):  # noqa: A002
+    return mean + std * jax.random.normal(_rand_key(key), tuple(shape))
+
+
+def uniform(shape, dtype=jnp.float32, min=-1.0, max=1.0, key=None):  # noqa: A002
+    return jax.random.uniform(_rand_key(key), tuple(shape), dtype,
+                              minval=min, maxval=max)
+
+
+def bernoulli(x, key=None):
+    return (jax.random.uniform(_rand_key(key), x.shape) < x).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False, key=None):
+    """Sample category indices from unnormalised probabilities [.., k]."""
+    probs = jnp.asarray(x, jnp.float32)
+    logits = jnp.where(probs > 0, jnp.log(jnp.maximum(probs, 1e-30)),
+                       -jnp.inf)
+    k = _rand_key(key)
+    if replacement:
+        return jax.random.categorical(k, logits, axis=-1,
+                                      shape=(num_samples,) + logits.shape[:-1]
+                                      ).T if logits.ndim > 1 else \
+            jax.random.categorical(k, logits, shape=(num_samples,))
+    # without replacement: Gumbel top-k trick. paddle errors when asking
+    # for more distinct categories than have non-zero probability; check
+    # eagerly (outside jit — a tracer can't be data-inspected).
+    if not isinstance(probs, jax.core.Tracer):
+        n_support = int(jnp.min(jnp.sum(probs > 0, axis=-1)))
+        if num_samples > n_support:
+            raise ValueError(
+                f"multinomial(replacement=False): num_samples="
+                f"{num_samples} exceeds the {n_support} categories with "
+                f"non-zero probability")
+    g = jax.random.gumbel(k, logits.shape)
+    return jnp.argsort(logits + g, axis=-1)[..., ::-1][..., :num_samples]
+
+
+def poisson(x, key=None):
+    return jax.random.poisson(_rand_key(key), x).astype(jnp.float32)

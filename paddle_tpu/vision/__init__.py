@@ -1,0 +1,5 @@
+"""paddle_tpu.vision — transforms + model re-exports (reference:
+python/paddle/vision: transforms, models)."""
+from . import transforms
+from ..models.resnet import ResNet, resnet18, resnet34, resnet50, resnet50_vd
+from ..models.vit import ViTForImageClassification

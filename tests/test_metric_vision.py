@@ -1,0 +1,101 @@
+"""paddle.metric + paddle.vision.transforms parity tests."""
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu import metric
+from paddle_tpu.vision import transforms as T
+
+
+class TestAccuracy:
+    def test_top1_top5(self):
+        m = metric.Accuracy(topk=(1, 2))
+        pred = jnp.asarray([[0.1, 0.9, 0.0],
+                            [0.8, 0.1, 0.1],
+                            [0.3, 0.3, 0.4]])
+        label = jnp.asarray([[1], [2], [2]])
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert abs(top1 - 2 / 3) < 1e-6
+        assert abs(top2 - 1.0) < 1e-6
+
+    def test_streaming(self):
+        m = metric.Accuracy()
+        pred = jnp.asarray([[0.9, 0.1]])
+        m.update(m.compute(pred, jnp.asarray([[0]])))
+        m.update(m.compute(pred, jnp.asarray([[1]])))
+        assert abs(m.accumulate() - 0.5) < 1e-6
+        m.reset()
+        assert m.accumulate() == 0.0
+
+
+class TestPrecisionRecallAuc:
+    def test_precision_recall(self):
+        p, r = metric.Precision(), metric.Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6   # tp=2 fp=1
+        assert abs(r.accumulate() - 2 / 3) < 1e-6   # tp=2 fn=1
+
+    def test_auc_perfect_and_random(self):
+        a = metric.Auc()
+        scores = np.concatenate([np.random.uniform(0.6, 1.0, 500),
+                                 np.random.uniform(0.0, 0.4, 500)])
+        labels = np.concatenate([np.ones(500), np.zeros(500)])
+        a.update(scores, labels)
+        assert a.accumulate() > 0.99
+        a.reset()
+        rng = np.random.default_rng(0)
+        scores = rng.uniform(size=2000)
+        labels = rng.integers(0, 2, 2000)
+        a.update(scores, labels)
+        assert 0.45 < a.accumulate() < 0.55
+
+
+class TestTransforms:
+    def test_resize_shapes_and_nearest(self):
+        img = np.arange(16, dtype=np.uint8).reshape(4, 4)
+        out = T.resize(img, 8, "nearest")
+        assert out.shape == (8, 8)
+        assert out[0, 0] == img[0, 0] and out[-1, -1] == img[-1, -1]
+
+    def test_resize_bilinear_constant(self):
+        img = np.full((10, 10, 3), 7, np.uint8)
+        out = T.resize(img, (5, 7))
+        assert out.shape == (5, 7, 3)
+        assert np.all(out == 7)   # constant image stays constant
+
+    def test_totensor_contract(self):
+        img = np.full((4, 6, 3), 255, np.uint8)
+        t = T.ToTensor()(img)
+        assert t.shape == (3, 4, 6) and t.dtype == np.float32
+        assert float(t.max()) == 1.0
+
+    def test_normalize(self):
+        chw = np.ones((3, 2, 2), np.float32)
+        out = T.Normalize(mean=[1, 1, 1], std=[2, 2, 2])(chw)
+        assert np.allclose(out, 0.0)
+
+    def test_compose_pipeline(self):
+        pipe = T.Compose([
+            T.Resize(8), T.CenterCrop(6),
+            T.RandomHorizontalFlip(prob=1.0),
+            T.ToTensor(),
+            T.Normalize([0.5] * 3, [0.5] * 3)])
+        img = np.random.randint(0, 255, (16, 16, 3), dtype=np.uint8)
+        out = pipe(img)
+        assert out.shape == (3, 6, 6)
+        assert float(np.abs(out).max()) <= 1.0 + 1e-6
+
+    def test_random_resized_crop(self):
+        rrc = T.RandomResizedCrop(8, rng=np.random.default_rng(0))
+        out = rrc(np.zeros((32, 32, 3), np.uint8))
+        assert out.shape == (8, 8, 3)
+
+    def test_crop_determinism_with_rng(self):
+        a = T.RandomCrop(4, rng=np.random.default_rng(1))(
+            np.arange(64, dtype=np.uint8).reshape(8, 8))
+        b = T.RandomCrop(4, rng=np.random.default_rng(1))(
+            np.arange(64, dtype=np.uint8).reshape(8, 8))
+        np.testing.assert_array_equal(a, b)
