@@ -66,10 +66,13 @@ def init_distributed(coordinator_address: Optional[str] = None,
             else:
                 jax.distributed.initialize()  # Cloud TPU metadata autodetect
         except RuntimeError as e:
-            # initialize() raises when a jax op already touched the backend
-            # (notebook, test session). Multi-host intent was stated, so a
-            # silent single-host fallback would fan out N independent jobs
-            # clobbering each other — make it loud.
+            # initialize() raises this specific error when a jax op already
+            # touched the backend (notebook, test session); only THAT case
+            # degrades to a warning. Any other failure (coordinator
+            # unreachable, barrier timeout, bad world size) must stay fatal
+            # or N hosts would silently fan out as independent jobs.
+            if "must be called before" not in str(e):
+                raise
             import warnings
             warnings.warn(
                 f"init_distributed: multi-host setup requested but the XLA "

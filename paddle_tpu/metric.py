@@ -7,8 +7,9 @@ epoch-scale state, not step-scale compute — keeping them out of jit avoids
 recompiles)."""
 from __future__ import annotations
 
-import numpy as np
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class Metric:
@@ -37,11 +38,14 @@ class Accuracy(Metric):
         self._total = 0
 
     def compute(self, pred, label):
-        """Returns per-sample correctness for each k (paddle's compute)."""
+        """Returns per-sample correctness for each k (paddle's compute).
+        Accepts class-index labels [n] / [n, 1] or one-hot [n, classes]."""
         maxk = max(self.topk)
-        top = jnp.argsort(pred, axis=-1)[..., ::-1][..., :maxk]
+        _, top = jax.lax.top_k(pred, maxk)
         label = label.reshape(label.shape[0], -1)
-        hits = top == label[:, :1]
+        if label.shape[1] > 1:                  # one-hot / soft labels
+            label = jnp.argmax(label, axis=-1, keepdims=True)
+        hits = top == label
         return jnp.stack([hits[..., :k].any(axis=-1) for k in self.topk],
                          axis=-1)
 

@@ -1,6 +1,6 @@
 """paddle_tpu.models — model zoo (reference: PaddleNLP/PaddleMIX recipes)."""
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel, causal_lm_loss,
-                    llama3_8b, llama_tiny)
+                    llama3_8b, llama3_70b, llama_tiny)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, gpt_tiny
 from .bert import (BertConfig, BertForPretraining,
                    BertForSequenceClassification, BertModel, bert_tiny,

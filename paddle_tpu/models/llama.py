@@ -68,6 +68,14 @@ def llama3_8b(**overrides) -> LlamaConfig:
     return LlamaConfig(**overrides)
 
 
+def llama3_70b(**overrides) -> LlamaConfig:
+    base = dict(hidden_size=8192, intermediate_size=28672,
+                num_hidden_layers=80, num_attention_heads=64,
+                num_key_value_heads=8)
+    base.update(overrides)
+    return LlamaConfig(**base)
+
+
 def llama_tiny(**overrides) -> LlamaConfig:
     """Test-scale config (fits CPU mesh; same code paths as 8B)."""
     base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,

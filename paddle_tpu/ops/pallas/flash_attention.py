@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +34,11 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    """Run the kernels in Pallas interpret mode (CPU testing)."""
+    return bool(os.environ.get("PADDLE_TPU_PALLAS_INTERPRET"))
 
 
 def pick_block(seq: int, preferred: int) -> int:
@@ -146,6 +152,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
+        interpret=_interpret(),
     )(q, k, v)
     return out, lse[:, :, :1]   # [bh, sq, 1]
 
@@ -263,6 +270,7 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k):
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
     )(q, k, v, g, lse, delta)
 
     dk, dv = pl.pallas_call(
@@ -302,6 +310,7 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
+        interpret=_interpret(),
     )(q, k, v, g, lse, delta)
     return dq, dk, dv
 
@@ -338,3 +347,72 @@ def flash_attention_bshd(query, key, value, causal=False, scale=None,
     v = jnp.swapaxes(value, 1, 2).reshape(b * hk, sk, d)
     out = _flash(q, k, v, scale, causal, block_q, block_k)
     return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+
+
+# --------------------------------------------------- flash with exposed lse
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return out, lse[:, :, 0]
+
+
+def _flash_lse_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return (out, lse[:, :, 0]), (q, k, v, out, lse)
+
+
+def _flash_lse_vjp_bwd(scale, causal, block_q, block_k, res, gs):
+    """Backward with cotangents for BOTH outputs. d lse_i / d s_ij = p_ij,
+    so the lse cotangent folds into delta: ds = p (dp - (delta - g_lse))."""
+    q, k, v, out, lse = res
+    g_out, g_lse = gs
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g_out, scale, causal,
+                            block_q, block_k)
+    # lse cotangent: d lse_i / d s_ij = p_ij, so
+    # d/dq sum_i g_lse_i lse_i = g_lse_i * p_ij * k_j * scale (and sym. dk)
+    dq2, dk2 = _lse_grad_terms(q, k, lse[:, :, 0], g_lse, scale, causal)
+    dq = (dq.astype(jnp.float32) + dq2).astype(q.dtype)
+    dk = (dk.astype(jnp.float32) + dk2).astype(k.dtype)
+    return dq, dk, dv
+
+
+def _lse_grad_terms(q, k, lse, g_lse, scale, causal):
+    """Dense fallback for the lse-cotangent term (used only by ring
+    attention's combine, where per-shard sequences are modest)."""
+    bh, sq, d = q.shape
+    bh_kv, sk, _ = k.shape
+    group = bh // bh_kv
+    kr = jnp.repeat(k, group, axis=0) if group > 1 else k
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   kr.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse[:, :, None])
+    w = p * g_lse[:, :, None] * scale
+    dq = jnp.einsum("bqk,bkd->bqd", w, kr.astype(jnp.float32))
+    dk = jnp.einsum("bqk,bqd->bkd", w, q.astype(jnp.float32))
+    if group > 1:
+        dk = dk.reshape(bh_kv, group, sk, d).sum(axis=1)
+    return dq, dk
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def flash_attention_with_lse(query, key, value, causal=False, scale=None,
+                             block_q=DEFAULT_BLOCK_Q,
+                             block_k=DEFAULT_BLOCK_K):
+    """[b, s, h, d] flash attention returning (out, lse[b, h, s]) — the
+    building block for cross-device softmax merging (ring attention)."""
+    b, sq, h, d = query.shape
+    _, sk, hk, _ = key.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = pick_block(sq, block_q)
+    block_k = pick_block(sk, block_k)
+    q = jnp.swapaxes(query, 1, 2).reshape(b * h, sq, d)
+    k = jnp.swapaxes(key, 1, 2).reshape(b * hk, sk, d)
+    v = jnp.swapaxes(value, 1, 2).reshape(b * hk, sk, d)
+    out, lse = _flash_lse(q, k, v, scale, causal, block_q, block_k)
+    out = jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+    return out, lse.reshape(b, h, sq)

@@ -106,3 +106,67 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         v = jnp.repeat(v, n // math.gcd(n, kvh), axis=2)
     out = attn_fn(swap_in(q), swap_in(k), swap_in(v), causal=causal)
     return swap_out(out)
+
+
+def ring_flash_attention(q, k, v, axis_name: str = "sp",
+                         causal: bool = False, scale: Optional[float] = None):
+    """Ring attention with the Pallas flash kernel doing each block pair
+    (reference semantics identical to `ring_attention`; this is the fast
+    path for long sequences on TPU).
+
+    Per-device blocks merge across ring steps by logsumexp reweighting —
+    the same recurrence flash uses internally, lifted to the ring level.
+    The ring is unrolled in Python (n is static): step 0 is the diagonal
+    (causal within the block); later steps are full block attention taken
+    only by devices whose block is in the past (`lax.cond` per device).
+    Differentiable end-to-end: flash exposes lse with a custom VJP and
+    ppermute transposes to the reverse rotation.
+
+    Note: call inside `shard_map(..., check_vma=False)` — pallas_call
+    does not yet declare varying-across-mesh info for its outputs.
+    """
+    from ..ops.pallas.flash_attention import flash_attention_with_lse
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def merge(o, lse, o_i, lse_i):
+        # o, o_i are each NORMALIZED softmax outputs of their blocks;
+        # reweight by each block's probability mass and renormalize
+        m = jnp.maximum(lse, lse_i)
+        w = jnp.exp(lse - m)                    # [b,h,s]
+        w_i = jnp.exp(lse_i - m)
+        wq = jnp.swapaxes(w, 1, 2)[..., None]   # [b,s,h,1]
+        wq_i = jnp.swapaxes(w_i, 1, 2)[..., None]
+        o_new = (o * wq + o_i.astype(jnp.float32) * wq_i) / (wq + wq_i)
+        lse_new = m + jnp.log(w + w_i)
+        return o_new, lse_new
+
+    # step 0: own block, causal if requested
+    o_i, lse_i = flash_attention_with_lse(q, k, v, causal=causal,
+                                          scale=scale)
+    o = o_i.astype(jnp.float32)
+    lse = lse_i
+    kc, vc = k, v
+    for step in range(1, n):
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        if causal:
+            # kv block is in this device's past iff idx >= step
+            def take(q=q, kc=kc, vc=vc, o=o, lse=lse):
+                o_b, lse_b = flash_attention_with_lse(q, kc, vc,
+                                                      causal=False,
+                                                      scale=scale)
+                return merge(o, lse, o_b, lse_b)
+
+            def skip(o=o, lse=lse):
+                return o, lse
+
+            o, lse = lax.cond(idx >= step, take, skip)
+        else:
+            o_b, lse_b = flash_attention_with_lse(q, kc, vc, causal=False,
+                                                  scale=scale)
+            o, lse = merge(o, lse, o_b, lse_b)
+    return o.astype(q.dtype)

@@ -77,28 +77,53 @@ class Resize:
         return resize(img, self.size, self.interpolation)
 
 
+def _pad_to(img, ch, cw):
+    h, w = img.shape[:2]
+    ph, pw = max(ch - h, 0), max(cw - w, 0)
+    if ph or pw:
+        pads = ((ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2)) \
+            + ((0, 0),) * (img.ndim - 2)
+        img = np.pad(img, pads)
+    return img
+
+
+def _check_crop(img, ch, cw, pad_if_needed, name):
+    h, w = img.shape[:2]
+    if h < ch or w < cw:
+        if not pad_if_needed:
+            raise ValueError(f"{name}: image {h}x{w} smaller than crop "
+                             f"{ch}x{cw} (set pad_if_needed=True to pad)")
+        img = _pad_to(img, ch, cw)
+    return img
+
+
 class CenterCrop:
-    def __init__(self, size):
+    def __init__(self, size, pad_if_needed: bool = False):
         self.size = _size2d(size)
+        self.pad_if_needed = pad_if_needed
 
     def __call__(self, img):
-        h, w = img.shape[:2]
         ch, cw = self.size
-        top, left = max((h - ch) // 2, 0), max((w - cw) // 2, 0)
+        img = _check_crop(img, ch, cw, self.pad_if_needed, "CenterCrop")
+        h, w = img.shape[:2]
+        top, left = (h - ch) // 2, (w - cw) // 2
         return img[top:top + ch, left:left + cw]
 
 
 class RandomCrop:
-    def __init__(self, size, rng: Optional[np.random.Generator] = None):
+    def __init__(self, size, pad_if_needed: bool = False,
+                 rng: Optional[np.random.Generator] = None):
         self.size = _size2d(size)
+        self.pad_if_needed = pad_if_needed
         self.rng = rng
 
     def __call__(self, img):
-        h, w = img.shape[:2]
         ch, cw = self.size
+        img = _check_crop(img, ch, cw, self.pad_if_needed, "RandomCrop")
+        h, w = img.shape[:2]
         r = _rng(self.rng)
-        top = int(r.integers(0, max(h - ch, 0) + 1))
-        left = int(r.integers(0, max(w - cw, 0) + 1))
+        top = int(r.integers(0, h - ch + 1))
+        left = int(r.integers(0, w - cw + 1))
         return img[top:top + ch, left:left + cw]
 
 

@@ -10,8 +10,8 @@ class TestAccuracy:
     def test_top1_top5(self):
         m = metric.Accuracy(topk=(1, 2))
         pred = jnp.asarray([[0.1, 0.9, 0.0],
-                            [0.8, 0.1, 0.1],
-                            [0.3, 0.3, 0.4]])
+                            [0.8, 0.05, 0.15],
+                            [0.3, 0.2, 0.5]])
         label = jnp.asarray([[1], [2], [2]])
         m.update(m.compute(pred, label))
         top1, top2 = m.accumulate()
@@ -99,3 +99,23 @@ class TestTransforms:
         b = T.RandomCrop(4, rng=np.random.default_rng(1))(
             np.arange(64, dtype=np.uint8).reshape(8, 8))
         np.testing.assert_array_equal(a, b)
+
+
+class TestReviewRegressions:
+    def test_accuracy_one_hot_labels(self):
+        m = metric.Accuracy()
+        pred = jnp.asarray([[0.1, 0.9], [0.8, 0.2]])
+        onehot = jnp.asarray([[0, 1], [1, 0]])
+        m.update(m.compute(pred, onehot))
+        assert abs(m.accumulate() - 1.0) < 1e-6
+
+    def test_crop_smaller_image_raises_or_pads(self):
+        import pytest
+        small = np.zeros((20, 20, 3), np.uint8)
+        with pytest.raises(ValueError):
+            T.RandomCrop(32)(small)
+        out = T.RandomCrop(32, pad_if_needed=True,
+                           rng=np.random.default_rng(0))(small)
+        assert out.shape == (32, 32, 3)
+        out = T.CenterCrop(32, pad_if_needed=True)(small)
+        assert out.shape == (32, 32, 3)
