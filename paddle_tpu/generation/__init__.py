@@ -66,12 +66,8 @@ def generate(model, input_ids, config: Optional[GenerationConfig] = None,
         tokens = tokens.at[:, prompt_len].set(next_tok)
         done = jnp.zeros((b,), bool) if eos is None else (next_tok == eos)
 
-        def cond(state):
-            tokens, caches, cur, key, done = state
-            return (cur < total) & ~jnp.all(done)
-
-        def body(state):
-            tokens, caches, cur, key, done = state
+        def step(state, cur):
+            tokens, caches, key, done = state
             ids = jax.lax.dynamic_slice_in_dim(tokens, cur - 1, 1, axis=1)
             logits, caches = fn(params, ids, kv_caches=caches,
                                 cache_index=cur - 1)
@@ -83,10 +79,25 @@ def generate(model, input_ids, config: Optional[GenerationConfig] = None,
             tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None], (0, cur))
             if eos is not None:
                 done = done | (nxt == eos)
-            return (tokens, caches, cur + 1, key, done)
+            return (tokens, caches, key, done)
 
-        state = (tokens, caches, jnp.asarray(prompt_len + 1), key, done)
-        tokens, *_ = jax.lax.while_loop(cond, body, state)
+        state = (tokens, caches, key, done)
+        if eos is None:
+            # static trip count: fori lowers without a dynamic predicate,
+            # letting XLA pipeline iterations (while_loop can't)
+            state = jax.lax.fori_loop(
+                prompt_len + 1, total, lambda c, s: step(s, c), state)
+        else:
+            def cond(s):
+                _, _, _, done = s[0]
+                return (s[1] < total) & ~jnp.all(done)
+
+            def body(s):
+                return (step(s[0], s[1]), s[1] + 1)
+
+            (state, _) = jax.lax.while_loop(
+                cond, body, (state, jnp.asarray(prompt_len + 1)))
+        tokens = state[0]
         return tokens
 
     return run(params, input_ids, key, jnp.float32(cfg.temperature))
