@@ -1,9 +1,16 @@
 #!/usr/bin/env python
 """Headline bench (SURVEY.md §6): Llama train-step tokens/sec/chip + MFU on
 the local chip. Prints ONE JSON line; vs_baseline = achieved MFU / 0.40
-(the reference's Llama-3 pretraining MFU target in BASELINE.json)."""
+(the reference's Llama-3 pretraining MFU target in BASELINE.json).
+
+Environment-proof (VERDICT r1 weak#2): TPU backend init over the axon
+tunnel can fail transiently with UNAVAILABLE; a failed init is sticky
+within a jax process, so the retry re-execs the bench in a fresh child
+process (3x, backoff) rather than retrying in-process."""
 import functools
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -38,6 +45,13 @@ def bench_config() -> LlamaConfig:
 
 
 def main():
+    # persistent compilation cache: the ~470M-model compile is the slow part
+    # over the axon tunnel; cache it across bench attempts/processes.
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
     dev = jax.devices()[0]
     peak = PEAK_FLOPS.get(dev.device_kind, 197e12)
     pt.seed(0)
@@ -72,15 +86,23 @@ def main():
     dt = (time.perf_counter() - t0) / steps
 
     tokens_per_sec = BATCH * SEQ / dt
-    # fwd+bwd matmul flops 6N/token + causal attention 6*L*s*h/token
-    flops_per_token = 6 * n_params + 6 * cfg.num_hidden_layers * SEQ * cfg.hidden_size
+    # Honest 6N (VERDICT r1 weak#3): the input-embedding forward is a
+    # gather, not a matmul, so its params don't belong in 6N; lm_head does
+    # (it IS a matmul). mfu_legacy keeps round 1's all-params formula once
+    # for continuity.
+    embed_params = cfg.vocab_size * cfg.hidden_size
+    matmul_params = n_params - embed_params
+    attn_flops = 6 * cfg.num_hidden_layers * SEQ * cfg.hidden_size
+    flops_per_token = 6 * matmul_params + attn_flops
     mfu = flops_per_token * tokens_per_sec / peak
+    mfu_legacy = (6 * n_params + attn_flops) * tokens_per_sec / peak
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 3),
         "mfu": round(mfu, 4),
+        "mfu_legacy": round(mfu_legacy, 4),
         "params": n_params,
         "step_ms": round(dt * 1e3, 2),
         "device": dev.device_kind,
@@ -89,4 +111,29 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("_PADDLE_TPU_BENCH_CHILD") == "1":
+        main()
+        sys.exit(0)
+    # parent: run the bench in a fresh process; retry transient backend
+    # failures with backoff (child inherits stdout so the JSON line flows).
+    # Each attempt is time-bounded: backend init over the axon tunnel can
+    # HANG (observed r1/r2), not just fail, and a hung attempt must not eat
+    # the driver's whole budget.
+    rc = 1
+    for attempt in range(3):
+        try:
+            rc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env={**os.environ, "_PADDLE_TPU_BENCH_CHILD": "1"},
+                timeout=float(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT",
+                                             420))).returncode
+        except subprocess.TimeoutExpired:
+            rc = 124
+        if rc == 0:
+            break
+        print(f"bench attempt {attempt + 1} failed rc={rc}", file=sys.stderr)
+        if attempt < 2:
+            wait = 15 * (attempt + 1)
+            print(f"retrying in {wait}s", file=sys.stderr)
+            time.sleep(wait)
+    sys.exit(rc)

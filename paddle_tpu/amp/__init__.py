@@ -83,17 +83,23 @@ class GradScaler:
     With bf16 (TPU default) scaling is unnecessary; enable=False makes all
     methods identity passthroughs.
 
-    Functional usage inside a jitted step:
-        scaled = scaler.scale(loss)
+    Functional usage inside ONE jitted step (no host sync anywhere):
+        sstate = scaler.init_state()                       # outside jit
+        scaled = scaler.scale(loss, sstate)
         ... grads of scaled loss ...
-        grads, found_inf = scaler.unscale(grads)
-        new_scale_state = scaler.update_state(found_inf)
+        grads, found_inf = scaler.unscale(grads, sstate)
+        sstate = scaler.update_state(sstate, found_inf)    # pure, branchless
+        params = scaler.select(found_inf, skipped=old, applied=new)
+
+    The legacy mutating `update()` routes through `update_state` and then
+    host-syncs to store — fine eagerly, never inside jit.
     """
 
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
                  decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
         self._enable = enable
+        self.init_loss_scaling = init_loss_scaling
         self.incr_ratio = incr_ratio
         self.decr_ratio = decr_ratio
         self.incr_every_n_steps = incr_every_n_steps
@@ -106,40 +112,68 @@ class GradScaler:
     def is_enable(self):
         return self._enable
 
-    def scale(self, loss):
+    # ------------------------------------------------- functional (jittable)
+    def init_state(self):
+        """Scaler state pytree — thread it through the jitted train step."""
+        return {"scale": jnp.float32(self.init_loss_scaling if self._enable
+                                     else 1.0),
+                "growth_tracker": jnp.int32(0),
+                "nan_tracker": jnp.int32(0)}
+
+    def scale(self, loss, state=None):
         if not self._enable:
             return loss
-        return loss * self._scale
+        scale = self._scale if state is None else state["scale"]
+        return loss * scale
 
-    def unscale(self, grads):
+    def unscale(self, grads, state=None):
         """Returns (unscaled_grads, found_inf[bool])."""
         if not self._enable:
             return grads, jnp.bool_(False)
-        inv = 1.0 / self._scale
+        scale = self._scale if state is None else state["scale"]
+        inv = 1.0 / scale
         unscaled = jax.tree.map(lambda g: g * inv, grads)
         found_inf = jnp.any(jnp.stack([
             jnp.any(~jnp.isfinite(g.astype(jnp.float32))) for g in jax.tree.leaves(unscaled)
         ]))
         return unscaled, found_inf
 
+    def update_state(self, state, found_inf):
+        """Pure, branchless paddle update_loss_scaling semantics: a bad step
+        zeroes the good counter; scale shrinks only after decr_every_n
+        accumulated bad steps; a good step zeroes the bad counter. Safe under
+        jit — no data-dependent Python control flow."""
+        if not (self._enable and self.dynamic):
+            return state
+        growth = jnp.where(found_inf, 0, state["growth_tracker"] + 1)
+        nan = jnp.where(found_inf, state["nan_tracker"] + 1, 0)
+        decr = nan >= self.decr_every_n
+        incr = growth >= self.incr_every_n_steps
+        scale = (state["scale"]
+                 * jnp.where(decr, jnp.float32(self.decr_ratio), 1.0)
+                 * jnp.where(incr, jnp.float32(self.incr_ratio), 1.0))
+        return {"scale": scale,
+                "growth_tracker": jnp.where(incr, 0, growth),
+                "nan_tracker": jnp.where(decr, 0, nan)}
+
+    @staticmethod
+    def select(found_inf, skipped, applied):
+        """Pick `skipped` (old) trees on an inf step, `applied` otherwise —
+        the jittable form of 'skip the optimizer update'."""
+        return jax.tree.map(
+            lambda old, new: jnp.where(found_inf, old, new), skipped, applied)
+
+    # --------------------------------------------------- eager (host-synced)
     def update(self, found_inf=None):
-        """paddle update_loss_scaling semantics: a bad step zeroes the good
-        counter; scale shrinks only after decr_every_n accumulated bad steps;
-        a good step zeroes the bad counter."""
+        """Mutating wrapper over update_state (eager use only)."""
         if not (self._enable and self.dynamic) or found_inf is None:
             return
-        if bool(found_inf):
-            self._growth_tracker = jnp.int32(0)
-            self._nan_tracker = self._nan_tracker + 1
-            if int(self._nan_tracker) >= self.decr_every_n:
-                self._scale = self._scale * self.decr_ratio
-                self._nan_tracker = jnp.int32(0)
-        else:
-            self._nan_tracker = jnp.int32(0)
-            self._growth_tracker = self._growth_tracker + 1
-            if int(self._growth_tracker) >= self.incr_every_n_steps:
-                self._scale = self._scale * self.incr_ratio
-                self._growth_tracker = jnp.int32(0)
+        state = {"scale": self._scale, "growth_tracker": self._growth_tracker,
+                 "nan_tracker": self._nan_tracker}
+        state = self.update_state(state, jnp.bool_(found_inf))
+        self._scale = state["scale"]
+        self._growth_tracker = state["growth_tracker"]
+        self._nan_tracker = state["nan_tracker"]
 
     # paddle flow: scaler.step(optimizer) + scaler.update()
     def step(self, optimizer, layer=None, grads=None):
@@ -149,8 +183,10 @@ class GradScaler:
         self.update(found_inf)
 
     def state_dict(self):
-        return {"scale": self._scale, "growth_tracker": self._growth_tracker}
+        return {"scale": self._scale, "growth_tracker": self._growth_tracker,
+                "nan_tracker": self._nan_tracker}
 
     def load_state_dict(self, sd):
         self._scale = jnp.float32(sd["scale"])
         self._growth_tracker = jnp.int32(sd["growth_tracker"])
+        self._nan_tracker = jnp.int32(sd.get("nan_tracker", 0))

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from ..nn import functional as F
@@ -95,7 +96,25 @@ class VocabParallelEmbedding(Layer):
                                 partition=("tp", None))
 
     def forward(self, x):
-        y = F.embedding(x, self.weight)
+        # Dispatch resolves against the ambient mesh at TRACE time (like
+        # `constraint`). A program traced pre-mesh keeps the gather path in
+        # its executable — but installing a mesh means re-device_putting
+        # params with NamedShardings (shard_layer), which changes jit's
+        # input shardings and forces a retrace, re-resolving this branch.
+        from ..distributed.env import get_mesh, has_mesh
+        tp = get_mesh().shape.get("tp", 1) if has_mesh() else 1
+        if tp > 1:
+            # One-hot matmul dispatch (the TPU "iota embed" trick): a plain
+            # gather against the vocab-sharded table forces SPMD into a full
+            # replicate-then-repartition under tp×sp meshes, and its backward
+            # is a scatter-add — both HBM cliffs. As a matmul contracting the
+            # vocab dim, GSPMD partitions it over tp with one psum, and the
+            # backward is a matmul too. XLA fuses the iota/eq one-hot into
+            # the MXU loop; the [.., vocab] operand never fully materializes.
+            oh = jax.nn.one_hot(x, self.num_embeddings, dtype=self.weight.dtype)
+            y = oh @ self.weight
+        else:
+            y = F.embedding(x, self.weight)
         return constraint(y, *([None] * (y.ndim - 1)), None)
 
     def extra_repr(self):

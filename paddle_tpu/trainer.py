@@ -62,7 +62,8 @@ class Trainer:
                  loss_fn: Optional[Callable] = None,
                  train_dataloader: Optional[Iterable] = None,
                  eval_dataloader: Optional[Iterable] = None,
-                 callbacks: Optional[List[TrainerCallback]] = None):
+                 callbacks: Optional[List[TrainerCallback]] = None,
+                 scaler=None):
         self.model = model
         self.optimizer = optimizer
         self.args = args or TrainingArguments()
@@ -78,34 +79,56 @@ class Trainer:
         self._pure_fn, self._params = model.functional()
         self._opt_state = None
         self._step_fn = None
+        self._eval_fn = None
+        # fp16 loss scaling (amp.GradScaler); scaler state lives INSIDE the
+        # jitted step — inf steps skip the update branchlessly (C6).
+        self.scaler = scaler if (scaler is not None and scaler.is_enable()) \
+            else None
+        self._scaler_state = (self.scaler.init_state() if self.scaler
+                              else None)
         self.global_step = 0
 
     # ------------------------------------------------------------ jit step
     def _build_step(self):
         fn, opt, args = self._pure_fn, self.optimizer, self.args
+        scaler = self.scaler
         accum = args.gradient_accumulation_steps
 
         def loss_of(p, batch):
             return self.loss_fn(fn, p, batch)
 
-        if accum == 1:
-            def step(params, state, stepno, batch):
-                loss, grads = jax.value_and_grad(loss_of)(params, batch)
-                params, state = opt.apply(params, grads, state, stepno)
-                return params, state, loss
-        else:
-            def step(params, state, stepno, batch):
+        def scaled_loss(p, mb, sstate):
+            loss = loss_of(p, mb)
+            scaled = scaler.scale(loss, sstate) if scaler else loss
+            return scaled, loss
+
+        def step(params, state, sstate, stepno, batch):
+            if accum == 1:
+                (_, loss), grads = jax.value_and_grad(
+                    scaled_loss, has_aux=True)(params, batch, sstate)
+            else:
                 # batch leading dim = accum: scan microbatches, mean grads
                 def micro(carry, mb):
                     gsum, lsum = carry
-                    loss, g = jax.value_and_grad(loss_of)(params, mb)
+                    (_, loss), g = jax.value_and_grad(
+                        scaled_loss, has_aux=True)(params, mb, sstate)
                     gsum = jax.tree.map(jnp.add, gsum, g)
                     return (gsum, lsum + loss), None
                 zeros = jax.tree.map(jnp.zeros_like, params)
                 (gsum, lsum), _ = jax.lax.scan(micro, (zeros, 0.0), batch)
                 grads = jax.tree.map(lambda g: g / accum, gsum)
+                loss = lsum / accum
+            if scaler is None:
                 params, state = opt.apply(params, grads, state, stepno)
-                return params, state, lsum / accum
+                return params, state, sstate, loss
+            # fp16: unscale, branchlessly skip the update on inf/nan grads,
+            # and advance the dynamic loss scale — all inside this one jit.
+            grads, found_inf = scaler.unscale(grads, sstate)
+            new_params, new_state = opt.apply(params, grads, state, stepno)
+            params = scaler.select(found_inf, params, new_params)
+            state = scaler.select(found_inf, state, new_state)
+            sstate = scaler.update_state(sstate, found_inf)
+            return params, state, sstate, loss
 
         donate = (0, 1) if args.donate_state else ()
         return jax.jit(step, donate_argnums=donate)
@@ -131,9 +154,10 @@ class Trainer:
                 data = iter(self.train_dataloader)
                 batch = next(data)
             batch = self._prep_batch(batch)
-            self._params, self._opt_state, loss = self._step_fn(
-                self._params, self._opt_state, jnp.int32(self.global_step),
-                batch)
+            self._params, self._opt_state, self._scaler_state, loss = \
+                self._step_fn(self._params, self._opt_state,
+                              self._scaler_state, jnp.int32(self.global_step),
+                              batch)
             self.global_step += 1
             if self.global_step % args.logging_steps == 0 or \
                     self.global_step == max_steps:
@@ -170,9 +194,10 @@ class Trainer:
         assert self.eval_dataloader is not None
         fn = self._pure_fn
         losses = []
-        eval_loss = jax.jit(lambda p, b: self.loss_fn(fn, p, b))
+        if self._eval_fn is None:  # build once; jit caches per batch shape
+            self._eval_fn = jax.jit(lambda p, b: self.loss_fn(fn, p, b))
         for batch in self.eval_dataloader:
-            losses.append(float(eval_loss(self._params, batch)))
+            losses.append(float(self._eval_fn(self._params, batch)))
         mean = float(np.mean(losses)) if losses else float("nan")
         self.logger.add_scalar("eval_loss", mean, self.global_step)
         return mean
@@ -184,9 +209,10 @@ class Trainer:
     def save_checkpoint(self, wait: bool = False):
         from .checkpoint.distributed_ckpt import DistributedCheckpoint
         ckpt = DistributedCheckpoint(self._ckpt_dir())
-        ckpt.save(self.global_step,
-                  {"params": dict(self._params),
-                   "opt_state": self._opt_state}, wait=wait)
+        tree = {"params": dict(self._params), "opt_state": self._opt_state}
+        if self._scaler_state is not None:
+            tree["scaler"] = self._scaler_state
+        ckpt.save(self.global_step, tree, wait=wait)
         ckpt.wait_until_finished() if wait else None
         ckpt.close()
         for cb in self.callbacks:
@@ -199,9 +225,28 @@ class Trainer:
         ckpt = DistributedCheckpoint(self._ckpt_dir())
         step = ckpt.latest_complete_step()
         if step is not None:
-            restored = ckpt.restore(step, like={
-                "params": dict(self._params), "opt_state": self._opt_state})
+            base = {"params": dict(self._params),
+                    "opt_state": self._opt_state}
+            # the checkpoint may or may not contain scaler state (run
+            # restarted with/without fp16): try the matching tree first,
+            # fall back to the other shape rather than aborting resume.
+            likes = [base]
+            if self._scaler_state is not None:
+                likes.insert(0, {**base, "scaler": self._scaler_state})
+            else:
+                from .amp import GradScaler
+                likes.append({**base, "scaler": GradScaler().init_state()})
+            restored = None
+            for i, like in enumerate(likes):
+                try:
+                    restored = ckpt.restore(step, like=like)
+                    break
+                except Exception:
+                    if i == len(likes) - 1:
+                        raise
             self._params = restored["params"]
             self._opt_state = restored["opt_state"]
+            if self._scaler_state is not None and "scaler" in restored:
+                self._scaler_state = restored["scaler"]
             self.global_step = step
         ckpt.close()
