@@ -121,17 +121,29 @@ if __name__ == "__main__":
     # the driver's whole budget.
     rc = 1
     for attempt in range(3):
+        transient = False
         try:
-            rc = subprocess.run(
+            proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
                 env={**os.environ, "_PADDLE_TPU_BENCH_CHILD": "1"},
+                stderr=subprocess.PIPE,
                 timeout=float(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT",
-                                             420))).returncode
-        except subprocess.TimeoutExpired:
-            rc = 124
+                                             420)))
+            rc = proc.returncode
+            err = proc.stderr.decode(errors="replace")
+            sys.stderr.write(err)
+            transient = any(sig in err for sig in
+                            ("UNAVAILABLE", "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED",
+                             "failed to connect", "Socket closed"))
+        except subprocess.TimeoutExpired as e:
+            rc, transient = 124, True  # hung backend init
+            if e.stderr:
+                sys.stderr.write(e.stderr.decode(errors="replace"))
         if rc == 0:
             break
         print(f"bench attempt {attempt + 1} failed rc={rc}", file=sys.stderr)
+        if not transient:
+            break  # deterministic failure: retrying wastes driver budget
         if attempt < 2:
             wait = 15 * (attempt + 1)
             print(f"retrying in {wait}s", file=sys.stderr)

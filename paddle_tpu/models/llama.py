@@ -271,6 +271,10 @@ class LlamaForCausalLM(CausalLMBase):
             if config.dtype != jnp.float32:
                 self.lm_head.to(dtype=config.dtype)
 
+    def pipeline_functional(self, pp: int):
+        """1F1B pipeline train step over ``pp`` stages (Trainer pp path)."""
+        return llama_pipeline_functional(self, pp)
+
     def forward(self, input_ids, positions=None, kv_caches=None,
                 cache_index=None, attn_mask=None):
         out = self.model(input_ids, positions, kv_caches, cache_index, attn_mask)
@@ -292,3 +296,83 @@ def causal_lm_loss(logits, labels, ignore_index: int = -100):
     shift_labels = labels[:, 1:]
     return F.cross_entropy(shift_logits, shift_labels,
                            ignore_index=ignore_index, reduction="mean")
+
+
+# ------------------------------------------------------- pipeline parallel
+def llama_pipeline_functional(model: "LlamaForCausalLM", pp: int):
+    """Wire a LlamaForCausalLM into the 1F1B pipeline (reference:
+    fleet.meta_parallel.PipelineLayer's LayerDesc segmentation — embedding
+    at stage 0, ``num_hidden_layers/pp`` LlamaDecoderLayers per stage,
+    final-norm+lm_head at the last stage).
+
+    Returns ``vag(flat_params, tokens[M, b, s]) -> (loss, flat_grads)``:
+    flat params stay the single source of truth (optimizer/checkpoint
+    layout unchanged); the stage re-stack to [pp, layers_per_stage, ...]
+    happens inside the jitted step, where XLA turns it into resharding.
+    """
+    from jax import lax as _lax
+
+    from ..parallel.pipeline import pipeline_value_and_grad
+
+    cfg = model.config
+    L = cfg.num_hidden_layers
+    if L % pp != 0:
+        raise ValueError(f"num_hidden_layers {L} % pp {pp} != 0")
+    if cfg.tie_word_embeddings:
+        raise ValueError("pipeline requires untied embeddings (the tied "
+                         "table would live on two stages)")
+    n_per = L // pp
+    layer_fn, layer_p0 = model.model.layers[0].functional()
+    embed_fn, _ = model.model.embed_tokens.functional()
+    norm_fn, _ = model.model.norm.functional()
+    lm_fn, _ = model.lm_head.functional()
+    rel_keys = list(layer_p0)
+
+    def split(flat):
+        stages = {k: jnp.stack([
+            jnp.stack([flat[f"model.layers.{g * n_per + i}.{k}"]
+                       for i in range(n_per)]) for g in range(pp)])
+            for k in rel_keys}
+        embed = {k[len("model.embed_tokens."):]: v for k, v in flat.items()
+                 if k.startswith("model.embed_tokens.")}
+        head = {"norm": {k[len("model.norm."):]: v for k, v in flat.items()
+                         if k.startswith("model.norm.")},
+                "lm": {k[len("lm_head."):]: v for k, v in flat.items()
+                       if k.startswith("lm_head.")}}
+        return {"embed": embed, "stages": stages, "head": head}
+
+    def merge(pp_grads):
+        flat = {}
+        for k, v in pp_grads["stages"].items():
+            for g in range(pp):
+                for i in range(n_per):
+                    flat[f"model.layers.{g * n_per + i}.{k}"] = v[g, i]
+        flat.update({f"model.embed_tokens.{k}": v
+                     for k, v in pp_grads["embed"].items()})
+        flat.update({f"model.norm.{k}": v
+                     for k, v in pp_grads["head"]["norm"].items()})
+        flat.update({f"lm_head.{k}": v
+                     for k, v in pp_grads["head"]["lm"].items()})
+        return flat
+
+    def stage_fn(sp, x):
+        b, sl = x.shape[0], x.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(sl)[None, :], (b, sl))
+
+        def one(xx, lp):
+            return layer_fn(lp, xx, positions), None
+        y, _ = _lax.scan(one, x, sp)
+        return y
+
+    def head_loss_fn(hp, y, labels):
+        h = norm_fn(hp["norm"], y)
+        logits = lm_fn(hp["lm"], h).astype(jnp.float32)
+        return causal_lm_loss(logits, labels)
+
+    run = pipeline_value_and_grad(embed_fn, stage_fn, head_loss_fn, pp)
+
+    def vag(flat_params, tokens):
+        loss, grads = run(split(flat_params), tokens, tokens)
+        return loss, merge(grads)
+
+    return vag

@@ -104,3 +104,178 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
 def stack_stage_params(per_stage_params: list):
     """[{name: Array}, ...] per stage -> {name: Array[n_stages, ...]}."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+# --------------------------------------------------------------------- 1F1B
+def _tree_add_where(mask, acc, new):
+    return jax.tree.map(
+        lambda a, n: a + jnp.where(mask, n, jnp.zeros_like(n)).astype(a.dtype),
+        acc, new)
+
+
+def pipeline_value_and_grad(embed_fn: Callable, stage_fn: Callable,
+                            head_loss_fn: Callable, n_stages: int,
+                            axis_name: str = "pp", dp_axis: str = "dp",
+                            mesh=None):
+    """True 1F1B pipeline train step (reference:
+    paddle/distributed/fleet/meta_parallel/pipeline_parallel.py — the
+    non-interleaved 1F1B microbatch schedule with p2p send/recv and grad
+    accumulation across microbatches).
+
+    TPU-native: ONE SPMD program inside `shard_map` over the ``pp`` axis.
+    Each lockstep tick, a stage runs (at most) one microbatch FORWARD and
+    one microbatch BACKWARD; activations hand off downstream and cotangents
+    upstream with `lax.ppermute` (ICI p2p). The backward recomputes the
+    stage forward via `jax.vjp` from the saved stage *input* (per-stage
+    remat), so the only stored state is a ring of boundary activations —
+    at stage s at most ``2*pp - 1 - 2*s`` of them, INDEPENDENT of the
+    number of microbatches (the 1F1B memory property; GPipe stores all
+    n_micro). Schedule, 0-indexed stage s of pp, microbatch m of M:
+
+        forward(m, s)  at tick  m + s
+        backward(m, s) at tick  2*pp - 1 + m - s
+        total ticks    T = 2*pp + M - 1   (bubble ~ 2*pp/T)
+
+    The loss head (final norm + lm_head + CE) runs at the LAST stage's
+    backward tick to seed the cotangent; the embedding backward runs at
+    stage 0. Because the program is SPMD-lockstep, every stage traces the
+    embed/head compute and masks the result — the cost is scheduled, the
+    result discarded off-stage (the price of single-program pipelining; the
+    reference instead runs different per-rank programs).
+
+    Args:
+      embed_fn(embed_params, tokens[mb, s]) -> x [mb, s, h]
+      stage_fn(stage_params, x) -> y (same shape; a group of decoder layers)
+      head_loss_fn(head_params, y, labels[mb, s]) -> scalar mean loss
+      n_stages: pp degree (static).
+
+    Returns fn(params, tokens, labels) -> (loss, grads):
+      params = {"embed":…, "stages": pytree with leading [pp, …],
+                "head":…};   tokens/labels: [n_micro, micro_b, seq].
+      grads has the same structure; loss is the mean over microbatches.
+    Composes with ``dp`` (microbatch rows sharded over dp, grads psum'd);
+    tp/sp/fsdp inside a pipeline stage need manual collectives and are
+    rejected by `validate_pp_mesh`.
+    """
+    from .sharding import manual_mode
+
+    def run(params, tokens, labels):
+        m = mesh or get_mesh()
+        validate_pp_mesh(m, axis_name, dp_axis)
+        pp = n_stages
+        stage_specs = jax.tree.map(lambda _: P(axis_name), params["stages"])
+        in_specs = ({"embed": jax.tree.map(lambda _: P(), params["embed"]),
+                     "stages": stage_specs,
+                     "head": jax.tree.map(lambda _: P(), params["head"])},
+                    P(None, dp_axis), P(None, dp_axis))
+        out_specs = (P(),
+                     {"embed": jax.tree.map(lambda _: P(), params["embed"]),
+                      "stages": stage_specs,
+                      "head": jax.tree.map(lambda _: P(), params["head"])})
+
+        def body(prm, toks, labs):
+            with manual_mode():
+                return _pp_body(prm, toks, labs)
+
+        def _pp_body(prm, toks, labs):
+            sparams = jax.tree.map(lambda p: p[0], prm["stages"])
+            eparams, hparams = prm["embed"], prm["head"]
+            s = lax.axis_index(axis_name)
+            is_first, is_last = s == 0, s == pp - 1
+            M = toks.shape[0]
+            K = 2 * pp  # activation ring: liveness <= 2*pp - 1 < K
+            T = 2 * pp + M - 1
+
+            x_sd = jax.eval_shape(embed_fn, eparams, toks[0])
+            xdt = x_sd.dtype
+
+            def tick(c, t):
+                # ---------------------------------------------- forward
+                mf = t - s
+                live_f = (mf >= 0) & (mf < M)
+                mf_c = jnp.clip(mf, 0, M - 1)
+                tok_f = lax.dynamic_index_in_dim(toks, mf_c, 0, keepdims=False)
+                x0 = embed_fn(eparams, tok_f).astype(xdt)
+                x_in = jnp.where(is_first, x0, c["recv_f"])
+                y = stage_fn(sparams, x_in)
+                y = jnp.where(live_f, y, jnp.zeros_like(y))
+                slot_f = mf_c % K
+                old = lax.dynamic_index_in_dim(c["xbuf"], slot_f, 0,
+                                               keepdims=False)
+                xbuf = lax.dynamic_update_index_in_dim(
+                    c["xbuf"], jnp.where(live_f, x_in, old), slot_f, 0)
+
+                # ---------------------------------------------- backward
+                mb = t - (2 * pp - 1) + s
+                live_b = (mb >= 0) & (mb < M)
+                mb_c = jnp.clip(mb, 0, M - 1)
+                x_sv = lax.dynamic_index_in_dim(xbuf, mb_c % K, 0,
+                                                keepdims=False)
+                tok_b = lax.dynamic_index_in_dim(toks, mb_c, 0, keepdims=False)
+                lab_b = lax.dynamic_index_in_dim(labs, mb_c, 0, keepdims=False)
+                # per-stage remat: recompute fwd, get the stage vjp
+                y_b, stage_vjp = jax.vjp(stage_fn, sparams, x_sv)
+                loss_m, head_vjp = jax.vjp(
+                    lambda hp, yy: head_loss_fn(hp, yy, lab_b), hparams, y_b)
+                g_h_m, dy_head = head_vjp(jnp.ones((), loss_m.dtype))
+                dy = jnp.where(is_last, dy_head.astype(xdt), c["recv_b"])
+                g_st_m, dx = stage_vjp(dy)
+                x0_b, embed_vjp = jax.vjp(embed_fn, eparams, tok_b)
+                g_e_m = embed_vjp(dx.astype(x0_b.dtype))[0]
+
+                c = dict(
+                    xbuf=xbuf,
+                    g_st=_tree_add_where(live_b, c["g_st"], g_st_m),
+                    g_h=_tree_add_where(live_b & is_last, c["g_h"], g_h_m),
+                    g_e=_tree_add_where(live_b & is_first, c["g_e"], g_e_m),
+                    loss=c["loss"] + jnp.where(live_b & is_last,
+                                               loss_m.astype(jnp.float32), 0.0),
+                    # ring handoffs: activations downstream, cotangents up
+                    recv_f=lax.ppermute(y, axis_name,
+                                        [(i, (i + 1) % pp) for i in range(pp)]),
+                    recv_b=lax.ppermute(jnp.where(live_b, dx, jnp.zeros_like(dx)),
+                                        axis_name,
+                                        [(i, (i - 1) % pp) for i in range(pp)]),
+                )
+                return c, None
+
+            carry0 = dict(
+                xbuf=jnp.zeros((K,) + x_sd.shape, xdt),
+                g_st=jax.tree.map(jnp.zeros_like, sparams),
+                g_h=jax.tree.map(jnp.zeros_like, hparams),
+                g_e=jax.tree.map(jnp.zeros_like, eparams),
+                loss=jnp.float32(0.0),
+                recv_f=jnp.zeros(x_sd.shape, xdt),
+                recv_b=jnp.zeros(x_sd.shape, xdt),
+            )
+            c, _ = lax.scan(tick, carry0, jnp.arange(T))
+
+            def _mean(g):
+                return lax.pmean(g / M, dp_axis)
+            grads = {
+                "stages": jax.tree.map(lambda g: _mean(g)[None], c["g_st"]),
+                "head": jax.tree.map(lambda g: _mean(lax.psum(g, axis_name)),
+                                     c["g_h"]),
+                "embed": jax.tree.map(lambda g: _mean(lax.psum(g, axis_name)),
+                                      c["g_e"]),
+            }
+            loss = lax.pmean(lax.psum(c["loss"], axis_name) / M, dp_axis)
+            return loss, grads
+
+        return jax.shard_map(body, mesh=m, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)(
+                                 params, tokens, labels)
+
+    return run
+
+
+def validate_pp_mesh(mesh, axis_name: str = "pp", dp_axis: str = "dp"):
+    """The SPMD 1F1B body is fully manual: stage compute must not need
+    collectives on other model axes. Reject tp/sp/fsdp/ep > 1."""
+    for ax, deg in mesh.shape.items():
+        if ax in (axis_name, dp_axis):
+            continue
+        if deg > 1:
+            raise ValueError(
+                f"pipeline_value_and_grad composes with {axis_name}+{dp_axis} "
+                f"only; mesh axis {ax!r} has degree {deg}")

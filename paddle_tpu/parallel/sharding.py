@@ -18,6 +18,8 @@ sharding-resolution time rather than at runtime.
 """
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -30,6 +32,26 @@ from ..nn.layer import Layer
 
 class ShardingError(ValueError):
     """Invalid partition: unknown mesh axis or non-divisible dimension."""
+
+
+_manual_state = threading.local()
+
+
+@contextlib.contextmanager
+def manual_mode():
+    """Trace-time flag: inside a fully-manual `shard_map` region (e.g. the
+    1F1B pipeline body) GSPMD sharding hints are invalid — `constraint`
+    becomes a no-op while this context is active."""
+    prev = getattr(_manual_state, "on", False)
+    _manual_state.on = True
+    try:
+        yield
+    finally:
+        _manual_state.on = prev
+
+
+def in_manual_mode() -> bool:
+    return getattr(_manual_state, "on", False)
 
 
 def validate_partition(shape: Tuple[int, ...], partition, mesh: Mesh,
@@ -126,7 +148,7 @@ def constraint(x, *spec):
     mesh is installed or it is single-device (keeps layers usable eagerly).
     Axes that don't evenly divide their dim are dropped (a hint must never
     make a program invalid — e.g. a debug batch of 2 on an 8-way dp mesh)."""
-    if not has_mesh():
+    if in_manual_mode() or not has_mesh():
         return x
     mesh = get_mesh()
     if mesh.size == 1:

@@ -69,6 +69,7 @@ class Trainer:
         self.args = args or TrainingArguments()
         # loss_fn(pure_fn, params, batch) -> scalar; default: causal LM on
         # a batch of token ids (the flagship recipe)
+        self._default_loss = loss_fn is None
         self.loss_fn = loss_fn or (
             lambda fn, p, batch: causal_lm_loss(fn(p, batch), batch))
         self.train_dataloader = train_dataloader
@@ -89,10 +90,42 @@ class Trainer:
         self.global_step = 0
 
     # ------------------------------------------------------------ jit step
+    def _pp_degree(self) -> int:
+        from .distributed import env
+        return env.get_mesh().shape.get("pp", 1) if env.has_mesh() else 1
+
     def _build_step(self):
         fn, opt, args = self._pure_fn, self.optimizer, self.args
         scaler = self.scaler
         accum = args.gradient_accumulation_steps
+
+        pp = self._pp_degree()
+        if pp > 1 and hasattr(self.model, "pipeline_functional"):
+            # 1F1B pipeline path: the schedule computes loss AND grads in
+            # one manual-SPMD program (microbatches = grad-accum steps).
+            if scaler is not None:
+                raise ValueError("fp16 GradScaler is not supported with "
+                                 "pipeline parallelism (use bf16)")
+            if not self._default_loss:
+                raise ValueError(
+                    "pipeline parallelism hardwires the causal-LM loss at "
+                    "the last stage; a custom loss_fn would be silently "
+                    "ignored — drop it or run without pp")
+            vag = self.model.pipeline_functional(pp)
+
+            def pp_step(params, state, sstate, stepno, batch):
+                if not hasattr(batch, "ndim"):
+                    raise TypeError(
+                        "pipeline path expects a token-id array batch "
+                        f"[n_micro, b, s] or [b, s], got {type(batch)}")
+                if batch.ndim == 2:  # [b, s] -> single microbatch
+                    batch = batch[None]
+                loss, grads = vag(params, batch)
+                params, state = opt.apply(params, grads, state, stepno)
+                return params, state, sstate, loss
+
+            donate = (0, 1) if args.donate_state else ()
+            return jax.jit(pp_step, donate_argnums=donate)
 
         def loss_of(p, batch):
             return self.loss_fn(fn, p, batch)
@@ -237,13 +270,17 @@ class Trainer:
                 from .amp import GradScaler
                 likes.append({**base, "scaler": GradScaler().init_state()})
             restored = None
-            for i, like in enumerate(likes):
+            first_err = None
+            for like in likes:
                 try:
                     restored = ckpt.restore(step, like=like)
                     break
-                except Exception:
-                    if i == len(likes) - 1:
-                        raise
+                except Exception as e:
+                    first_err = first_err or e
+            if restored is None:
+                # every tree shape failed: report the PRIMARY error (the
+                # fallback's mismatch error would mislead diagnosis)
+                raise first_err
             self._params = restored["params"]
             self._opt_state = restored["opt_state"]
             if self._scaler_state is not None and "scaler" in restored:

@@ -1,0 +1,92 @@
+"""1F1B pipeline correctness (VERDICT r1 item 3): pp=4 tiny-Llama train
+step must loss- and grad-match the non-pipelined step on the 8-CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import env
+from paddle_tpu.models import LlamaForCausalLM, causal_lm_loss, llama_tiny
+from paddle_tpu.parallel.pipeline import pipeline_value_and_grad, validate_pp_mesh
+
+
+def _tiny_model(n_layers=4):
+    pt.seed(0)
+    return LlamaForCausalLM(llama_tiny(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=n_layers, num_attention_heads=4,
+        num_key_value_heads=2))
+
+
+def _reference_loss_grads(model, tokens):
+    """Non-pipelined: mean over microbatches of the per-microbatch loss."""
+    fn, params = model.functional()
+
+    def loss_of(p):
+        losses = [causal_lm_loss(fn(p, tokens[m]), tokens[m])
+                  for m in range(tokens.shape[0])]
+        return jnp.mean(jnp.stack(losses))
+    return jax.value_and_grad(loss_of)(dict(params))
+
+
+@pytest.mark.parametrize("pp,dp", [(4, 2), (2, 1)])
+def test_1f1b_matches_sequential(pp, dp):
+    model = _tiny_model(n_layers=4)
+    env.init_parallel_env({"pp": pp, "dp": dp},
+                          devices=jax.devices()[:pp * dp])
+    M, b, s = 3, 2, 16
+    tokens = jnp.asarray(np.random.RandomState(1).randint(0, 128, (M, b, s)))
+
+    _, params = model.functional()
+    vag = jax.jit(model.pipeline_functional(pp))
+    loss_pp, grads_pp = vag(dict(params), tokens)
+
+    loss_ref, grads_ref = _reference_loss_grads(model, tokens)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+    assert set(grads_pp) == set(grads_ref)
+    for k in grads_ref:
+        np.testing.assert_allclose(
+            np.asarray(grads_pp[k]), np.asarray(grads_ref[k]),
+            rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_1f1b_single_microbatch():
+    model = _tiny_model(n_layers=2)
+    env.init_parallel_env({"pp": 2}, devices=jax.devices()[:2])
+    tokens = jnp.asarray(np.random.RandomState(2).randint(0, 128, (1, 2, 16)))
+    _, params = model.functional()
+    loss_pp, _ = jax.jit(model.pipeline_functional(2))(dict(params), tokens)
+    loss_ref, _ = _reference_loss_grads(model, tokens)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+
+
+def test_pp_mesh_validation_rejects_tp():
+    mesh = env.init_parallel_env({"pp": 2, "tp": 2},
+                                 devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="tp"):
+        validate_pp_mesh(mesh)
+
+
+def test_trainer_pp_path_runs_and_learns():
+    """Trainer auto-selects the pipeline step when the mesh has pp>1."""
+    from paddle_tpu.trainer import Trainer, TrainingArguments
+
+    model = _tiny_model(n_layers=4)
+    env.init_parallel_env({"pp": 4, "dp": 2})
+    data = np.random.RandomState(3).randint(0, 128, (64, 16))
+
+    class Loader:
+        def __iter__(self):
+            rs = np.random.RandomState(0)
+            while True:
+                idx = rs.randint(0, 64, 8)
+                yield jnp.asarray(data[idx])
+    tr = Trainer(model, pt.optimizer.AdamW(learning_rate=5e-3),
+                 TrainingArguments(output_dir="/tmp/pt_pp_trainer",
+                                   max_steps=12, logging_steps=4,
+                                   gradient_accumulation_steps=4),
+                 train_dataloader=Loader())
+    tr.train()
+    losses = tr.logger.history["loss"]
+    assert losses[-1][1] < losses[0][1]
