@@ -1,12 +1,27 @@
 #!/usr/bin/env python
 """Headline bench (SURVEY.md §6): Llama train-step tokens/sec/chip + MFU on
-the local chip. Prints ONE JSON line; vs_baseline = achieved MFU / 0.40
-(the reference's Llama-3 pretraining MFU target in BASELINE.json).
+the local chip. Prints EXACTLY ONE JSON line on stdout, ALWAYS — success or
+failure. vs_baseline = achieved MFU / 0.40 (the reference's Llama-3
+pretraining MFU target in BASELINE.json).
 
-Environment-proof (VERDICT r1 weak#2): TPU backend init over the axon
-tunnel can fail transiently with UNAVAILABLE; a failed init is sticky
-within a jax process, so the retry re-execs the bench in a fresh child
-process (3x, backoff) rather than retrying in-process."""
+Environment-proof redesign (VERDICT r2 item 1). The axon TPU tunnel has
+HUNG during backend init in both prior rounds, so:
+
+  (a) PROBE first: a subprocess that only calls ``jax.devices()`` under a
+      75s timeout, twice max. If the backend is down we stop *before*
+      building any model and emit a failure JSON with the probe evidence.
+  (b) HARD TOTAL BUDGET: everything (probe + all attempts + retries) fits
+      in PADDLE_TPU_BENCH_BUDGET seconds (default 450s < 8 min); each
+      subprocess timeout is clamped to the remaining budget.
+  (c) ALWAYS-EMIT JSON: every exit path prints one machine-readable line —
+      on failure ``{"error":..., "probe":..., "attempts":N, ...}`` so the
+      driver never records just a stderr tail again.
+  (d) CONFIG LADDER: a tiny model first (compiles in seconds → a real
+      tokens/s number is banked), then the ~470M headline config only if
+      budget remains. The best successful rung wins.
+
+Each rung runs in a fresh child process because a failed TPU init is
+sticky within a jax process."""
 import functools
 import json
 import os
@@ -14,13 +29,8 @@ import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-sys.path.insert(0, ".")
-import paddle_tpu as pt  # noqa: E402
-from paddle_tpu.models import LlamaForCausalLM, LlamaConfig, causal_lm_loss  # noqa: E402
+BATCH, SEQ = 8, 2048
+TINY_BATCH, TINY_SEQ = 8, 1024
 
 # peak bf16 FLOP/s per chip by device kind
 PEAK_FLOPS = {
@@ -31,12 +41,54 @@ PEAK_FLOPS = {
     "TPU v6 lite": 918e12,   # trillium
 }
 
-BATCH, SEQ = 8, 2048
+
+# ---------------------------------------------------------------- children
+
+def _force_platform():
+    """PADDLE_TPU_BENCH_PLATFORM=cpu forces a backend in the children. The
+    env var JAX_PLATFORMS alone is NOT enough in this image: the axon
+    sitecustomize re-selects its platform via jax.config after env
+    parsing, so only an in-process config.update wins."""
+    plat = os.environ.get("PADDLE_TPU_BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
 
 
-def bench_config() -> LlamaConfig:
-    """~470M-param Llama shaped to saturate a single v5e (16G HBM) with
-    remat; same code path as the 8B recipe."""
+def _child_probe():
+    """Backend-reachability probe: jax.devices() and nothing else."""
+    t0 = time.time()
+    _force_platform()
+    import jax
+    devs = jax.devices()
+    print(json.dumps({
+        "probe_ok": True,
+        "n_devices": len(devs),
+        "device_kind": devs[0].device_kind,
+        "platform": devs[0].platform,
+        "probe_s": round(time.time() - t0, 1),
+    }))
+
+
+def _bench_config(rung):
+    from paddle_tpu.models import LlamaConfig
+    import jax.numpy as jnp
+    if os.environ.get("PADDLE_TPU_BENCH_SMOKE"):
+        # machinery self-test (probe -> ladder -> JSON) on any backend; the
+        # numbers it yields are meaningless.
+        return LlamaConfig(
+            vocab_size=512, hidden_size=128, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, recompute=False, dtype=jnp.float32)
+    if rung == "tiny":
+        # ~67M params: compiles in seconds, still MXU-bound bf16 matmuls.
+        return LlamaConfig(
+            vocab_size=8192, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
+            max_position_embeddings=TINY_SEQ, rope_theta=500000.0,
+            recompute=False, dtype=jnp.bfloat16)
+    # headline: ~470M-param Llama shaped to saturate a single v5e (16G HBM)
+    # with remat; same code path as the 8B recipe.
     return LlamaConfig(
         vocab_size=32768, hidden_size=2048, intermediate_size=5632,
         num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=8,
@@ -44,18 +96,28 @@ def bench_config() -> LlamaConfig:
         recompute=True, dtype=jnp.bfloat16)
 
 
-def main():
-    # persistent compilation cache: the ~470M-model compile is the slow part
-    # over the axon tunnel; cache it across bench attempts/processes.
+def _child_bench(rung):
+    _force_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    # persistent compilation cache: shared across rungs/attempts/processes.
     try:
         jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import paddle_tpu as pt
+    from paddle_tpu.models import LlamaForCausalLM, causal_lm_loss
+
+    batch, seq = (TINY_BATCH, TINY_SEQ) if rung == "tiny" else (BATCH, SEQ)
+    if os.environ.get("PADDLE_TPU_BENCH_SMOKE"):
+        batch, seq = 2, 128
     dev = jax.devices()[0]
     peak = PEAK_FLOPS.get(dev.device_kind, 197e12)
     pt.seed(0)
-    cfg = bench_config()
+    cfg = _bench_config(rung)
     model = LlamaForCausalLM(cfg)
     fn, params = model.functional()
     n_params = sum(int(np.prod(v.shape)) for v in params.values())
@@ -63,7 +125,7 @@ def main():
     opt = pt.optimizer.AdamW(learning_rate=1e-4, multi_precision=True,
                              grad_clip=pt.optimizer.ClipGradByGlobalNorm(1.0))
     state = opt.init(params)
-    ids = jnp.asarray(np.random.randint(0, cfg.vocab_size, (BATCH, SEQ)))
+    ids = jnp.asarray(np.random.randint(0, cfg.vocab_size, (batch, seq)))
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, state, step, ids):
@@ -78,21 +140,21 @@ def main():
     params, state, loss = train_step(params, state, jnp.int32(0), ids)
     float(loss)
 
-    steps = 10
+    steps = 5 if rung == "tiny" else 10
     t0 = time.perf_counter()
     for i in range(1, steps + 1):
         params, state, loss = train_step(params, state, jnp.int32(i), ids)
     float(loss)
     dt = (time.perf_counter() - t0) / steps
 
-    tokens_per_sec = BATCH * SEQ / dt
+    tokens_per_sec = batch * seq / dt
     # Honest 6N (VERDICT r1 weak#3): the input-embedding forward is a
     # gather, not a matmul, so its params don't belong in 6N; lm_head does
     # (it IS a matmul). mfu_legacy keeps round 1's all-params formula once
     # for continuity.
     embed_params = cfg.vocab_size * cfg.hidden_size
     matmul_params = n_params - embed_params
-    attn_flops = 6 * cfg.num_hidden_layers * SEQ * cfg.hidden_size
+    attn_flops = 6 * cfg.num_hidden_layers * seq * cfg.hidden_size
     flops_per_token = 6 * matmul_params + attn_flops
     mfu = flops_per_token * tokens_per_sec / peak
     mfu_legacy = (6 * n_params + attn_flops) * tokens_per_sec / peak
@@ -103,6 +165,7 @@ def main():
         "vs_baseline": round(mfu / 0.40, 3),
         "mfu": round(mfu, 4),
         "mfu_legacy": round(mfu_legacy, 4),
+        "config": rung,
         "params": n_params,
         "step_ms": round(dt * 1e3, 2),
         "device": dev.device_kind,
@@ -110,42 +173,113 @@ def main():
     }))
 
 
-if __name__ == "__main__":
-    if os.environ.get("_PADDLE_TPU_BENCH_CHILD") == "1":
-        main()
-        sys.exit(0)
-    # parent: run the bench in a fresh process; retry transient backend
-    # failures with backoff (child inherits stdout so the JSON line flows).
-    # Each attempt is time-bounded: backend init over the axon tunnel can
-    # HANG (observed r1/r2), not just fail, and a hung attempt must not eat
-    # the driver's whole budget.
-    rc = 1
-    for attempt in range(3):
-        transient = False
+# ------------------------------------------------------------------ parent
+
+def _run_child(mode, timeout):
+    """Run one child rung; return (rc, parsed_json_or_None, stderr_tail)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env={**os.environ, "_PADDLE_TPU_BENCH_CHILD": mode},
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=timeout)
+        rc, out = proc.returncode, proc.stdout.decode(errors="replace")
+        err = proc.stderr.decode(errors="replace")
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode(errors="replace")
+        err = (e.stderr or b"").decode(errors="replace")
+        rc = 124
+    parsed = None
+    for line in reversed(out.strip().splitlines()):
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env={**os.environ, "_PADDLE_TPU_BENCH_CHILD": "1"},
-                stderr=subprocess.PIPE,
-                timeout=float(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT",
-                                             420)))
-            rc = proc.returncode
-            err = proc.stderr.decode(errors="replace")
-            sys.stderr.write(err)
-            transient = any(sig in err for sig in
-                            ("UNAVAILABLE", "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED",
-                             "failed to connect", "Socket closed"))
-        except subprocess.TimeoutExpired as e:
-            rc, transient = 124, True  # hung backend init
-            if e.stderr:
-                sys.stderr.write(e.stderr.decode(errors="replace"))
-        if rc == 0:
+            parsed = json.loads(line)
             break
-        print(f"bench attempt {attempt + 1} failed rc={rc}", file=sys.stderr)
-        if not transient:
-            break  # deterministic failure: retrying wastes driver budget
-        if attempt < 2:
-            wait = 15 * (attempt + 1)
-            print(f"retrying in {wait}s", file=sys.stderr)
-            time.sleep(wait)
-    sys.exit(rc)
+        except ValueError:
+            continue
+    return rc, parsed, err[-800:]
+
+
+def main():
+    budget = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET", 450))
+    t0 = time.monotonic()
+
+    def remaining():
+        return budget - (time.monotonic() - t0)
+
+    failures = []
+    attempts = 0
+
+    # (a) probe: is the backend even reachable?
+    probe = None
+    for _ in range(2):
+        if remaining() < 20:
+            break
+        attempts += 1
+        rc, parsed, err = _run_child(
+            "probe", min(75.0, max(remaining() - 10, 15)))
+        if rc == 0 and parsed and parsed.get("probe_ok"):
+            probe = parsed
+            break
+        failures.append({"stage": "probe", "rc": rc,
+                         "stderr_tail": err[-300:]})
+    if probe is None:
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": "backend unreachable: jax.devices() probe failed/hung",
+            "probe": failures, "attempts": attempts,
+            "budget_s": budget, "elapsed_s": round(time.monotonic() - t0, 1),
+        }))
+        return 3
+
+    # (b/d) ladder: bank a tiny number, then try the headline config.
+    result = None
+    for rung, max_t, min_t in (("tiny", 240.0, 45.0), ("headline", 420.0, 150.0)):
+        if remaining() < min_t:
+            break
+        attempts += 1
+        rc, parsed, err = _run_child(rung, min(max_t, remaining() - 15))
+        if rc == 0 and parsed and "value" in parsed:
+            result = parsed
+        else:
+            failures.append({"stage": rung, "rc": rc,
+                             "stderr_tail": err[-300:]})
+            # one retry per rung if the failure looks transient and the
+            # budget allows; a hang (rc=124) is NOT retried — it would
+            # just burn the rest of the budget the same way.
+            transient = rc != 124 and any(
+                s in err for s in ("UNAVAILABLE", "DEADLINE_EXCEEDED",
+                                   "failed to connect", "Socket closed"))
+            if transient and remaining() > min_t + 30:
+                attempts += 1
+                rc, parsed, err = _run_child(rung, min(max_t, remaining() - 15))
+                if rc == 0 and parsed and "value" in parsed:
+                    result = parsed
+                else:
+                    failures.append({"stage": rung + "_retry", "rc": rc,
+                                     "stderr_tail": err[-300:]})
+
+    # (c) always emit exactly one JSON line.
+    if result is not None:
+        result["probe"] = {k: probe[k] for k in
+                           ("device_kind", "probe_s", "n_devices")}
+        result["attempts"] = attempts
+        print(json.dumps(result))
+        return 0
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+        "error": "probe ok but all bench rungs failed",
+        "probe": probe, "failures": failures, "attempts": attempts,
+        "budget_s": budget, "elapsed_s": round(time.monotonic() - t0, 1),
+    }))
+    return 4
+
+
+if __name__ == "__main__":
+    mode = os.environ.get("_PADDLE_TPU_BENCH_CHILD")
+    if mode == "probe":
+        _child_probe()
+    elif mode in ("tiny", "headline"):
+        _child_bench(mode)
+    else:
+        sys.exit(main())

@@ -24,3 +24,6 @@ from .vae import (AutoencoderKL, DiagonalGaussian, VAEConfig, vae_loss,
                   vae_tiny)
 from .ppocr import (DBNet, DBNetConfig, SVTRConfig, SVTRNet, ctc_greedy_decode,
                     ctc_rec_loss, db_loss, dbnet_tiny, svtr_tiny)
+from .hf_interop import (config_from_hf, convert_hf_state_dict,
+                         from_pretrained, load_hf_checkpoint,
+                         to_hf_state_dict)

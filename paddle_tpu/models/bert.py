@@ -76,7 +76,8 @@ class BertEmbeddings(Layer):
                                        epsilon=config.layer_norm_eps)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
-    def forward(self, input_ids, token_type_ids=None, positions=None):
+    def forward(self, input_ids, token_type_ids=None, positions=None,
+                extra_embeds=None):
         b, s = input_ids.shape
         if positions is None:
             positions = jnp.arange(s)[None, :].repeat(b, axis=0)
@@ -85,6 +86,10 @@ class BertEmbeddings(Layer):
         x = (self.word_embeddings(input_ids)
              + self.position_embeddings[positions]
              + self.token_type_embeddings[token_type_ids])
+        if extra_embeds is not None:
+            # e.g. ERNIE's task-type stream: summed BEFORE LayerNorm
+            # (reference ErnieEmbeddings ordering)
+            x = x + extra_embeds
         return self.dropout(self.layer_norm(x))
 
 
@@ -157,9 +162,8 @@ class BertModel(Layer):
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 positions=None, extra_embeds=None):
-        x = self.embeddings(input_ids, token_type_ids, positions)
-        if extra_embeds is not None:  # e.g. ERNIE's task-type stream
-            x = x + extra_embeds
+        x = self.embeddings(input_ids, token_type_ids, positions,
+                            extra_embeds=extra_embeds)
         x = constraint(x, ("dp", "fsdp"), None, None)
         bias = (padding_bias(attention_mask, x.dtype)
                 if attention_mask is not None else None)

@@ -1,0 +1,337 @@
+"""Pretrained-weight interop: HF/safetensors checkpoints -> paddle_tpu.
+
+Reference: PaddleNLP ``paddlenlp/transformers/auto/modeling.py`` (AutoModel
+dispatch by config) and the per-model ``modeling.py`` converters
+(``convert_hf_state_dict`` name maps, e.g. llama/modeling.py).
+
+TPU-native design notes:
+- Our Linear weights are ``[in, out]`` (jax matmul convention; activations
+  are row-major [b, s, in] @ [in, out] feeds the MXU without a transpose).
+  HF torch stores ``[out, in]`` — every 2-D linear weight is transposed
+  once on load, on host, before device placement.
+- Weights are placed as a whole ``state_dict`` via ``Layer.set_state_dict``;
+  under a mesh, GSPMD resharding happens at first use — no per-rank
+  slicing code (the reference slices tensors per-mp-rank by hand in
+  ``convert_tensor_parallel``).
+- Index-sharded Llama-family checkpoints are converted and placed one
+  shard at a time (``iter_hf_checkpoint_shards``) so host peak memory is
+  one shard, not the whole model.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import warnings
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+__all__ = [
+    "load_hf_checkpoint", "iter_hf_checkpoint_shards",
+    "convert_hf_state_dict", "to_hf_state_dict",
+    "from_pretrained", "config_from_hf",
+]
+
+
+# ----------------------------------------------------------- tensor loading
+
+def _load_safetensors_file(path: str) -> Dict[str, np.ndarray]:
+    from safetensors.numpy import load_file
+    try:
+        return load_file(path)
+    except (TypeError, ValueError):
+        # bf16 safetensors can't land in numpy directly on some versions;
+        # go through torch (cpu) and cast to fp32.
+        from safetensors.torch import load_file as tload
+        return {k: v.float().numpy() for k, v in tload(path).items()}
+
+
+def iter_hf_checkpoint_shards(model_dir: str) -> Iterator[Dict[str, np.ndarray]]:
+    """Yield tensors shard-by-shard so the caller can convert + place each
+    shard and let it go before the next loads (host peak = one shard)."""
+    idx = os.path.join(model_dir, "model.safetensors.index.json")
+    if os.path.exists(idx):
+        with open(idx) as f:
+            weight_map = json.load(f)["weight_map"]
+        for shard in sorted(set(weight_map.values())):
+            yield _load_safetensors_file(os.path.join(model_dir, shard))
+        return
+    single = os.path.join(model_dir, "model.safetensors")
+    if os.path.exists(single):
+        yield _load_safetensors_file(single)
+        return
+    binp = os.path.join(model_dir, "pytorch_model.bin")
+    if os.path.exists(binp):
+        import torch
+        sd = torch.load(binp, map_location="cpu", weights_only=True)
+        yield {k: v.float().numpy() for k, v in sd.items()}
+        return
+    raise FileNotFoundError(f"no safetensors/bin checkpoint in {model_dir}")
+
+
+def load_hf_checkpoint(model_dir: str) -> Dict[str, np.ndarray]:
+    """Read ALL tensors into one dict (convenience; for big sharded
+    checkpoints prefer ``iter_hf_checkpoint_shards``)."""
+    out: Dict[str, np.ndarray] = {}
+    for shard in iter_hf_checkpoint_shards(model_dir):
+        out.update(shard)
+    return out
+
+
+# ------------------------------------------------------------- name mapping
+
+_LLAMA_LINEAR = re.compile(
+    r"(self_attn\.(q|k|v|o)_proj|mlp\.(gate|up|down)_proj)\.weight$")
+
+
+def _convert_llama(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """Llama/Qwen2/ERNIE-4.5 family: names already match
+    (model.layers.N.self_attn.q_proj...), only linear layout differs.
+    Per-key, so it works one shard at a time."""
+    out = {}
+    for k, v in hf.items():
+        if k.endswith("rotary_emb.inv_freq"):
+            continue  # we compute RoPE inline (llama.py:rotary_cos_sin)
+        if k == "lm_head.weight" or _LLAMA_LINEAR.search(k):
+            v = v.T  # [out, in] -> [in, out]
+        if k == "lm_head.weight" and getattr(cfg, "tie_word_embeddings", False):
+            continue
+        out[k] = v
+    return out
+
+
+def _revert_llama(sd: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in sd.items():
+        if k == "lm_head.weight" or _LLAMA_LINEAR.search(k):
+            v = v.T
+        out[k] = np.asarray(v)
+    return out
+
+
+def _src_prefix(hf: Dict[str, np.ndarray]) -> str:
+    for p in ("bert.", "ernie."):
+        if any(k.startswith(p) for k in hf):
+            return p
+    return ""
+
+
+def _convert_bert_encoder(hf: Dict[str, np.ndarray], cfg,
+                          dst_prefix: str) -> Dict[str, np.ndarray]:
+    """HF BERT-family encoder -> our fused-qkv layout (models/bert.py):
+    per layer, the three [h, h] q/k/v projections fuse into one [h, 3h]
+    qkv_proj so the MXU sees one big matmul instead of three."""
+    out: Dict[str, np.ndarray] = {}
+    g = lambda k: hf[k]  # noqa: E731
+    p = _src_prefix(hf)
+    emb = f"{p}embeddings."
+    dp = dst_prefix
+    out[dp + "embeddings.word_embeddings.weight"] = g(emb + "word_embeddings.weight")
+    out[dp + "embeddings.position_embeddings"] = g(emb + "position_embeddings.weight")
+    out[dp + "embeddings.token_type_embeddings"] = g(emb + "token_type_embeddings.weight")
+    out[dp + "embeddings.layer_norm.weight"] = g(emb + "LayerNorm.weight")
+    out[dp + "embeddings.layer_norm.bias"] = g(emb + "LayerNorm.bias")
+    for i in range(cfg.num_hidden_layers):
+        src = f"{p}encoder.layer.{i}."
+        dst = f"{dp}layers.{i}."
+        qw, kw, vw = (g(src + f"attention.self.{n}.weight") for n in
+                      ("query", "key", "value"))
+        qb, kb, vb = (g(src + f"attention.self.{n}.bias") for n in
+                      ("query", "key", "value"))
+        out[dst + "attention.qkv_proj.weight"] = np.concatenate(
+            [qw.T, kw.T, vw.T], axis=1)
+        out[dst + "attention.qkv_proj.bias"] = np.concatenate([qb, kb, vb])
+        out[dst + "attention.out_proj.weight"] = g(src + "attention.output.dense.weight").T
+        out[dst + "attention.out_proj.bias"] = g(src + "attention.output.dense.bias")
+        out[dst + "attn_norm.weight"] = g(src + "attention.output.LayerNorm.weight")
+        out[dst + "attn_norm.bias"] = g(src + "attention.output.LayerNorm.bias")
+        out[dst + "fc_in.weight"] = g(src + "intermediate.dense.weight").T
+        out[dst + "fc_in.bias"] = g(src + "intermediate.dense.bias")
+        out[dst + "fc_out.weight"] = g(src + "output.dense.weight").T
+        out[dst + "fc_out.bias"] = g(src + "output.dense.bias")
+        out[dst + "out_norm.weight"] = g(src + "output.LayerNorm.weight")
+        out[dst + "out_norm.bias"] = g(src + "output.LayerNorm.bias")
+    if p + "pooler.dense.weight" in hf:
+        out[dp + "pooler.dense.weight"] = g(p + "pooler.dense.weight").T
+        out[dp + "pooler.dense.bias"] = g(p + "pooler.dense.bias")
+    return out
+
+
+def _convert_mlm_head(hf: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """HF ``cls.predictions.*`` / ``cls.seq_relationship.*`` ->
+    our TiedMLMHead / nsp head (models/bert.py TiedMLMHead; the decoder
+    weight itself is tied to word embeddings on both sides, so only the
+    transform + biases transfer)."""
+    out: Dict[str, np.ndarray] = {}
+    cp = "cls.predictions."
+    if cp + "transform.dense.weight" in hf:
+        out["mlm_head.transform.weight"] = hf[cp + "transform.dense.weight"].T
+        out["mlm_head.transform.bias"] = hf[cp + "transform.dense.bias"]
+        out["mlm_head.transform_norm.weight"] = hf[cp + "transform.LayerNorm.weight"]
+        out["mlm_head.transform_norm.bias"] = hf[cp + "transform.LayerNorm.bias"]
+        out["mlm_head.mlm_bias"] = hf[cp + "bias"]
+    if "cls.seq_relationship.weight" in hf:
+        out["nsp_head.weight"] = hf["cls.seq_relationship.weight"].T
+        out["nsp_head.bias"] = hf["cls.seq_relationship.bias"]
+    return out
+
+
+def _convert_bert(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    out = _convert_bert_encoder(hf, cfg, "bert.")
+    out.update(_convert_mlm_head(hf))
+    return out
+
+
+def _convert_ernie(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """HF model_type 'ernie' (BERT-family encoder + task-type embeddings,
+    transformers ErnieModel) -> our ErnieModel (models/ernie.py)."""
+    out = _convert_bert_encoder(hf, cfg, "ernie.encoder.")
+    p = _src_prefix(hf)
+    tt = p + "embeddings.task_type_embeddings.weight"
+    if tt in hf:
+        out["ernie.task_type_embeddings"] = hf[tt]
+    head = _convert_mlm_head(hf)
+    head.pop("nsp_head.weight", None)  # ErnieForMaskedLM has no NSP head
+    head.pop("nsp_head.bias", None)
+    out.update(head)
+    return out
+
+
+_CONVERTERS: Dict[str, Callable] = {
+    "llama": _convert_llama,
+    "qwen2": _convert_llama,   # Llama backbone + qkv bias (qwen2.py)
+    "ernie4_5": _convert_llama,
+    "bert": _convert_bert,
+    "ernie": _convert_ernie,
+}
+
+# missing keys under these prefixes are heads a bare encoder checkpoint
+# legitimately lacks; they stay at init and we warn instead of raising.
+_OPTIONAL_HEAD_PREFIXES = ("mlm_head.", "nsp_head.", "bert.pooler.",
+                           "ernie.encoder.pooler.",
+                           "ernie.task_type_embeddings")
+
+
+def convert_hf_state_dict(hf_sd: Dict[str, np.ndarray], cfg,
+                          model_type: str) -> Dict[str, np.ndarray]:
+    if model_type not in _CONVERTERS:
+        raise ValueError(f"no converter for model_type={model_type!r}; "
+                         f"have {sorted(_CONVERTERS)}")
+    return _CONVERTERS[model_type](hf_sd, cfg)
+
+
+def to_hf_state_dict(model) -> Dict[str, np.ndarray]:
+    """Export back to HF layout (Llama-family only) — enables round-trip
+    tests and serving our checkpoints from HF-based stacks."""
+    sd = {k: np.asarray(v) for k, v in model.state_dict().items()}
+    return _revert_llama(sd, model.config)
+
+
+# ------------------------------------------------------------ construction
+
+def _jax_dtype(hf: Dict[str, Any]):
+    import jax.numpy as jnp
+    # transformers >= 4.56 writes "dtype"; older wrote "torch_dtype"
+    return (jnp.float32
+            if hf.get("dtype", hf.get("torch_dtype")) == "float32"
+            else jnp.bfloat16)
+
+
+def config_from_hf(model_dir: str):
+    """Map an HF ``config.json`` to our config dataclass + model class."""
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf = json.load(f)
+    mt = hf.get("model_type", "")
+    common = dict(
+        vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
+        num_hidden_layers=hf["num_hidden_layers"],
+        num_attention_heads=hf["num_attention_heads"],
+    )
+    if mt in ("llama", "qwen2", "ernie4_5"):
+        from .llama import LlamaConfig, LlamaForCausalLM
+        from .qwen2 import Qwen2Config, Qwen2ForCausalLM
+        cls, ccls = ((Qwen2ForCausalLM, Qwen2Config) if mt == "qwen2"
+                     else (LlamaForCausalLM, LlamaConfig))
+        cfg = ccls(
+            **common,
+            intermediate_size=hf["intermediate_size"],
+            num_key_value_heads=hf.get("num_key_value_heads",
+                                       hf["num_attention_heads"]),
+            max_position_embeddings=hf.get("max_position_embeddings", 8192),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            attention_bias=hf.get("attention_bias", mt == "qwen2"),
+            dtype=_jax_dtype(hf),
+        )
+        return cls, cfg, mt
+    if mt in ("bert", "ernie"):
+        from .bert import BertConfig, BertForPretraining
+        from .ernie import ErnieConfig, ErnieForMaskedLM
+        ccls, cls = ((ErnieConfig, ErnieForMaskedLM) if mt == "ernie"
+                     else (BertConfig, BertForPretraining))
+        kw = dict(
+            **common,
+            intermediate_size=hf["intermediate_size"],
+            max_position_embeddings=hf.get("max_position_embeddings", 512),
+            type_vocab_size=hf.get("type_vocab_size", 2),
+            layer_norm_eps=hf.get("layer_norm_eps", 1e-12),
+            hidden_dropout_prob=hf.get("hidden_dropout_prob", 0.1),
+            dtype=_jax_dtype(hf),
+        )
+        if mt == "ernie":
+            kw["task_type_vocab_size"] = hf.get("task_type_vocab_size", 3)
+            kw["use_task_id"] = hf.get("use_task_id", True)
+        return cls, ccls(**kw), mt
+    raise ValueError(f"unsupported model_type {mt!r} in {model_dir}")
+
+
+def _place(sd: Dict[str, np.ndarray], dtype):
+    """Host -> jax arrays, casting floats to the model's compute dtype.
+    jnp.issubdtype (not np.issubdtype): bf16 is an ml_dtypes extension
+    numpy doesn't recognize as floating."""
+    import jax.numpy as jnp
+    return {k: (jnp.asarray(v, dtype=dtype)
+                if jnp.issubdtype(np.asarray(v).dtype, jnp.floating)
+                else jnp.asarray(v))
+            for k, v in sd.items()}
+
+
+def from_pretrained(model_dir: str, dtype: Optional[Any] = None,
+                    model_cls=None, strict: bool = True):
+    """Build a model from an HF-format checkpoint directory.
+
+    - Unexpected converted keys always raise (converter drift).
+    - Missing head keys (``mlm_head.*`` etc. absent from a bare encoder
+      checkpoint) stay randomly initialized with a warning; any other
+      missing key raises when ``strict``.
+    """
+    cls, cfg, mt = config_from_hf(model_dir)
+    if dtype is not None:
+        cfg.dtype = dtype
+    if model_cls is not None:
+        cls = model_cls
+    model = cls(cfg)
+
+    if mt in ("llama", "qwen2", "ernie4_5"):
+        # per-key converter: stream shard-by-shard (host peak = one shard)
+        sd: Dict[str, Any] = {}
+        for shard in iter_hf_checkpoint_shards(model_dir):
+            sd.update(_place(convert_hf_state_dict(shard, cfg, mt), cfg.dtype))
+            del shard
+    else:
+        hf_sd = load_hf_checkpoint(model_dir)
+        sd = _place(convert_hf_state_dict(hf_sd, cfg, mt), cfg.dtype)
+
+    missing, unexpected = model.set_state_dict(sd, strict=False)
+    if unexpected:
+        raise KeyError(f"converted keys not in model: {unexpected[:8]}")
+    hard_missing = [k for k in missing
+                    if not k.startswith(_OPTIONAL_HEAD_PREFIXES)]
+    if hard_missing and strict:
+        raise KeyError(f"checkpoint missing model keys: {hard_missing[:8]}")
+    if missing:
+        warnings.warn(f"{len(missing)} keys left at random init "
+                      f"(e.g. {missing[:4]})", stacklevel=2)
+    return model

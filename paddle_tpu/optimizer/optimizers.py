@@ -51,7 +51,12 @@ class Optimizer:
     def init(self, params) -> Dict[str, Any]:
         slots = jax.tree.map(self._init_slot, params)
         if self.multi_precision:
-            master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+            # jnp.array(copy=True) (not astype): on already-fp32 params
+            # astype is a no-op alias, and a step jitted with
+            # donate_argnums=(params, state) would then donate the same
+            # buffer twice (XLA "f(donate(a), donate(a))" error).
+            master = jax.tree.map(
+                lambda p: jnp.array(p, dtype=jnp.float32, copy=True), params)
             return {"slots": slots, "master": master}
         return {"slots": slots}
 

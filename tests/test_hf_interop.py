@@ -1,0 +1,197 @@
+"""Pretrained-weight interop parity vs transformers/torch-cpu (VERDICT r2
+item 2; reference: PaddleNLP transformers/llama/modeling.py weight
+converters + auto/modeling.py). A tiny HF model is constructed locally
+(zero network), saved in HF format, loaded by ``from_pretrained``, and the
+logits must match the torch forward."""
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from paddle_tpu.models import from_pretrained, to_hf_state_dict  # noqa: E402
+
+
+def _save_hf(tmp_path, cls, cfg):
+    torch.manual_seed(0)
+    m = cls(cfg)
+    m.eval()
+    d = str(tmp_path)
+    m.save_pretrained(d, safe_serialization=True)
+    return m, d
+
+
+@pytest.fixture(scope="module")
+def tmp_module(tmp_path_factory):
+    return tmp_path_factory.mktemp("hf")
+
+
+def _llama_cfg(**kw):
+    base = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=128,
+                rope_theta=10000.0, tie_word_embeddings=False,
+                torch_dtype="float32", attn_implementation="eager")
+    base.update(kw)
+    return transformers.LlamaConfig(**base)
+
+
+def test_llama_logits_match(tmp_module):
+    hf_model, d = _save_hf(tmp_module / "llama", transformers.LlamaForCausalLM,
+                           _llama_cfg())
+    model = from_pretrained(d)
+    ids = np.random.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_llama_greedy_decode_matches(tmp_module):
+    d = str(tmp_module / "llama")
+    hf_model = transformers.LlamaForCausalLM.from_pretrained(d)
+    model = from_pretrained(d)
+    ids = np.random.randint(0, 128, (1, 8))
+    with torch.no_grad():
+        ref = hf_model.generate(torch.tensor(ids), max_new_tokens=8,
+                                do_sample=False).numpy()
+    out = model.generate(jnp.asarray(ids), max_new_tokens=8, temperature=0.0)
+    got = np.asarray(out)[:, :ref.shape[1]]
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_qwen2_logits_match(tmp_module):
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=False, torch_dtype="float32",
+        attn_implementation="eager")
+    hf_model, d = _save_hf(tmp_module / "qwen2",
+                           transformers.Qwen2ForCausalLM, cfg)
+    model = from_pretrained(d)
+    assert model.config.attention_bias  # the Qwen2 signature difference
+    ids = np.random.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_llama_tied_embeddings(tmp_module):
+    hf_model, d = _save_hf(tmp_module / "llama_tied",
+                           transformers.LlamaForCausalLM,
+                           _llama_cfg(tie_word_embeddings=True))
+    model = from_pretrained(d)
+    ids = np.random.randint(0, 128, (1, 12))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_bert_hidden_states_match(tmp_module):
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, torch_dtype="float32",
+        attn_implementation="eager")
+    hf_model, d = _save_hf(tmp_module / "bert", transformers.BertModel, cfg)
+    with pytest.warns(UserWarning, match="random init"):
+        model = from_pretrained(d)  # bare encoder ckpt: MLM/NSP heads warn
+    model.eval()
+    ids = np.random.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).last_hidden_state.numpy()
+    got = np.asarray(model.bert(jnp.asarray(ids))[0])
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_bert_pretraining_heads_load(tmp_module):
+    """Full BertForPreTraining checkpoint: cls.predictions/seq_relationship
+    map onto TiedMLMHead/nsp_head and MLM logits match torch."""
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, torch_dtype="float32",
+        attn_implementation="eager")
+    hf_model, d = _save_hf(tmp_module / "bert_pt",
+                           transformers.BertForPreTraining, cfg)
+    model = from_pretrained(d)
+    model.eval()
+    ids = np.random.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        out = hf_model(torch.tensor(ids))
+    mlm, nsp = model(jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(mlm),
+                               out.prediction_logits.numpy(),
+                               atol=3e-4, rtol=3e-4)
+    np.testing.assert_allclose(np.asarray(nsp),
+                               out.seq_relationship_logits.numpy(),
+                               atol=3e-4, rtol=3e-4)
+
+
+def test_ernie_mlm_logits_match(tmp_module):
+    cfg = transformers.ErnieConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, task_type_vocab_size=3, use_task_id=True,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        torch_dtype="float32", attn_implementation="eager")
+    hf_model, d = _save_hf(tmp_module / "ernie",
+                           transformers.ErnieForMaskedLM, cfg)
+    model = from_pretrained(d)
+    model.eval()
+    ids = np.random.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_round_trip_export(tmp_module):
+    _, d = _save_hf(tmp_module / "llama_rt", transformers.LlamaForCausalLM,
+                    _llama_cfg())
+    model = from_pretrained(d)
+    back = to_hf_state_dict(model)
+    from safetensors.numpy import load_file
+    orig = load_file(os.path.join(d, "model.safetensors"))
+    for k, v in orig.items():
+        if k.endswith("rotary_emb.inv_freq"):
+            continue
+        np.testing.assert_allclose(back[k], v, atol=0,
+                                   err_msg=f"round-trip mismatch at {k}")
+
+
+def test_sharded_index_checkpoint(tmp_module, tmp_path):
+    """model.safetensors.index.json multi-shard loading."""
+    from safetensors.numpy import load_file, save_file
+    _, d = _save_hf(tmp_module / "llama_shard", transformers.LlamaForCausalLM,
+                    _llama_cfg())
+    full = load_file(os.path.join(d, "model.safetensors"))
+    keys = sorted(full)
+    half = len(keys) // 2
+    shard_dir = tmp_path / "sharded"
+    shard_dir.mkdir()
+    save_file({k: full[k] for k in keys[:half]},
+              str(shard_dir / "model-00001-of-00002.safetensors"))
+    save_file({k: full[k] for k in keys[half:]},
+              str(shard_dir / "model-00002-of-00002.safetensors"))
+    wm = {k: ("model-00001-of-00002.safetensors" if i < half
+              else "model-00002-of-00002.safetensors")
+          for i, k in enumerate(keys)}
+    with open(shard_dir / "model.safetensors.index.json", "w") as f:
+        json.dump({"weight_map": wm}, f)
+    import shutil
+    shutil.copy(os.path.join(d, "config.json"), shard_dir / "config.json")
+    model = from_pretrained(str(shard_dir))
+    ids = np.random.randint(0, 128, (1, 8))
+    single = from_pretrained(d)
+    np.testing.assert_allclose(np.asarray(model(jnp.asarray(ids))),
+                               np.asarray(single(jnp.asarray(ids))), atol=0)
