@@ -42,13 +42,16 @@ class DataLoader:
     def __init__(self, dataset: Dataset, batch_size=1, shuffle=False,
                  sampler=None, batch_sampler=None, num_workers=0,
                  collate_fn: Optional[Callable] = None, drop_last=False,
-                 prefetch_factor=2, use_native=False, return_list=True,
-                 worker_init_fn=None, persistent_workers=False):  # noqa: ARG002
+                 prefetch_factor=2, use_native=False, return_list=True,  # noqa: ARG002
+                 worker_init_fn=None, persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
         self.use_native = use_native
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._pool = None
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_size = batch_size
@@ -138,7 +141,46 @@ class DataLoader:
         finally:
             stop.set()
 
+    def _iter_multiprocess(self):
+        """True multiprocess workers (io/worker.py): spawned processes,
+        ordered results, persistent across epochs when asked."""
+        from .worker import WorkerPool
+        pool = self._pool
+        if pool is None:
+            # fresh base seed per pool (drawn from the ambient numpy RNG,
+            # so pt.seed/np.random.seed still gives reproducible runs):
+            # respawned workers must NOT replay epoch 1's augmentations
+            pool = WorkerPool(self.dataset, self.collate_fn,
+                              self.num_workers, self.prefetch_factor,
+                              self.worker_init_fn,
+                              seed=int(np.random.randint(0, 2 ** 31 - 1)))
+            if self.persistent_workers:
+                self._pool = pool
+        try:
+            yield from pool.run_epoch(iter(self.batch_sampler))
+        finally:
+            if not self.persistent_workers:
+                pool.shutdown()
+
+    def shutdown(self):
+        """Tear down persistent workers (no-op otherwise)."""
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
     def __iter__(self):
         if self.num_workers > 0:
+            # map-style -> real worker processes; iterable/native keep the
+            # thread prefetcher (the native path's C++ ring buffer IS its
+            # worker pool; an IterableDataset shards via get_worker_info
+            # only when the user opts in, so default to single-stream)
+            if not self._iterable and not self.use_native:
+                return self._iter_multiprocess()
             return self._iter_prefetch()
         return self._iter_sync()

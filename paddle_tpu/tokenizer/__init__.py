@@ -1,0 +1,14 @@
+"""paddle_tpu.tokenizer — real tokenization (reference: PaddleNLP
+``paddlenlp/transformers/*/tokenizer.py``).
+
+- ``BPETokenizer``: merges-based byte-level BPE, loads HF tokenizer.json
+  or GPT-2 vocab.json+merges.txt — reproduces GPT-2/Llama-3/Qwen2
+  tokenizations exactly (parity-tested vs the ``tokenizers`` library).
+- ``TrieTokenizer``: C++ greedy longest-match trie (vocab-only models /
+  fast data prep), re-exported from ``paddle_tpu.native``.
+"""
+from ..native import Tokenizer as TrieTokenizer
+from .bpe import (GPT2_SPLIT, LLAMA3_SPLIT, BPETokenizer, bytes_to_unicode)
+
+__all__ = ["BPETokenizer", "TrieTokenizer", "bytes_to_unicode",
+           "GPT2_SPLIT", "LLAMA3_SPLIT"]

@@ -1,0 +1,269 @@
+"""Merges-based byte-level BPE tokenizer (reference: PaddleNLP
+``paddlenlp/transformers/gpt/tokenizer.py`` GPTTokenizer and
+``llama/tokenizer_fast.py`` — the rank-ordered merge loop over a
+byte-to-unicode alphabet that GPT-2/Llama-3/Qwen2 checkpoints require;
+the greedy-longest-match trie in ``native/src/runtime.cc`` cannot
+reproduce their tokenizations).
+
+Pure-host code (tokenization never runs on TPU); the C++ trie remains the
+fast path for vocab-only models. Loads either HF ``tokenizer.json`` or
+GPT-2 style ``vocab.json`` + ``merges.txt``.
+"""
+from __future__ import annotations
+
+import json
+import os
+from functools import lru_cache
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+try:
+    import regex as _re  # \p{L}/\p{N} classes (GPT2/Llama3 split patterns)
+except ImportError:  # pragma: no cover - regex ships with transformers
+    _re = None
+
+# GPT-2's pretokenizer split (tokenizers ByteLevel default)
+GPT2_SPLIT = (r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+"
+              r"| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
+# Llama-3 / GPT-4 (cl100k-style) split
+LLAMA3_SPLIT = (r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+"
+                r"|\p{N}{1,3}| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+"
+                r"|\s+(?!\S)|\s+")
+
+
+@lru_cache(maxsize=1)
+def bytes_to_unicode() -> Dict[int, str]:
+    """GPT-2's reversible byte <-> printable-unicode table: the 188
+    printable latin-1 bytes map to themselves, the rest shift past 255."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, (chr(c) for c in cs)))
+
+
+class BPETokenizer:
+    """Byte-level BPE with rank-ordered merges.
+
+    Parameters
+    ----------
+    vocab: token string -> id
+    merges: ordered (left, right) pairs; earlier = higher priority
+    special_tokens: content -> id, matched verbatim before pretokenization
+    split_pattern: pretokenizer regex (GPT2_SPLIT default)
+    add_prefix_space: prepend " " to the text (GPT-2 sentence-start quirk)
+    """
+
+    def __init__(self, vocab: Dict[str, int],
+                 merges: Sequence[Tuple[str, str]],
+                 special_tokens: Optional[Dict[str, int]] = None,
+                 split_pattern: str = GPT2_SPLIT,
+                 add_prefix_space: bool = False,
+                 unk_token: Optional[str] = None):
+        if _re is None:
+            raise ImportError("BPETokenizer needs the 'regex' package")
+        self.vocab = dict(vocab)
+        self.id_to_token = {i: t for t, i in self.vocab.items()}
+        self.ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.special_tokens = dict(special_tokens or {})
+        self.id_to_token.update({i: t for t, i in self.special_tokens.items()})
+        self._special_re = (_re.compile("|".join(
+            _re.escape(t) for t in sorted(self.special_tokens,
+                                          key=len, reverse=True)))
+            if self.special_tokens else None)
+        self._split_re = _re.compile(split_pattern)
+        self.add_prefix_space = add_prefix_space
+        self.unk_token = unk_token
+        self._byte_enc = bytes_to_unicode()
+        self._byte_dec = {c: b for b, c in self._byte_enc.items()}
+        self._cache: Dict[str, List[str]] = {}
+
+    # ------------------------------------------------------------- encoding
+    def _bpe(self, word: str) -> List[str]:
+        """Merge loop: repeatedly fuse the lowest-rank adjacent pair."""
+        cached = self._cache.get(word)
+        if cached is not None:
+            return cached
+        parts = list(word)
+        while len(parts) > 1:
+            best, best_rank = -1, None
+            for i in range(len(parts) - 1):
+                r = self.ranks.get((parts[i], parts[i + 1]))
+                if r is not None and (best_rank is None or r < best_rank):
+                    best, best_rank = i, r
+            if best_rank is None:
+                break
+            merged = parts[best] + parts[best + 1]
+            # fuse every occurrence of this exact pair in one pass
+            # (standard BPE: all instances of the chosen pair merge together)
+            out: List[str] = []
+            i = 0
+            while i < len(parts):
+                if (i < len(parts) - 1 and parts[i] + parts[i + 1] == merged
+                        and (parts[i], parts[i + 1]) in self.ranks
+                        and self.ranks[(parts[i], parts[i + 1])] == best_rank):
+                    out.append(merged)
+                    i += 2
+                else:
+                    out.append(parts[i])
+                    i += 1
+            parts = out
+        if len(self._cache) < 65536:
+            self._cache[word] = parts
+        return parts
+
+    def tokenize(self, text: str) -> List[str]:
+        """Text -> BPE token strings (no special-token handling)."""
+        if self.add_prefix_space and text and not text.startswith(" "):
+            text = " " + text
+        toks: List[str] = []
+        for piece in self._split_re.findall(text):
+            mapped = "".join(self._byte_enc[b] for b in piece.encode("utf-8"))
+            toks.extend(self._bpe(mapped))
+        return toks
+
+    def _convert(self, toks: Iterable[str]) -> List[int]:
+        unk = self.vocab.get(self.unk_token) if self.unk_token else None
+        out = []
+        for t in toks:
+            i = self.vocab.get(t, unk)
+            if i is None:
+                raise KeyError(f"token {t!r} not in vocab and no unk_token")
+            out.append(i)
+        return out
+
+    def encode(self, text: str) -> List[int]:
+        """Text -> ids; special tokens are matched verbatim first."""
+        if self._special_re is None:
+            return self._convert(self.tokenize(text))
+        ids: List[int] = []
+        pos = 0
+        for m in self._special_re.finditer(text):
+            if m.start() > pos:
+                ids.extend(self._convert(self.tokenize(text[pos:m.start()])))
+            ids.append(self.special_tokens[m.group()])
+            pos = m.end()
+        if pos < len(text):
+            ids.extend(self._convert(self.tokenize(text[pos:])))
+        return ids
+
+    __call__ = encode
+
+    # ------------------------------------------------------------- decoding
+    def decode(self, ids: Iterable[int],
+               skip_special_tokens: bool = False) -> str:
+        out: List[str] = []
+        buf: List[int] = []
+
+        def flush():
+            if buf:
+                out.append(bytes(buf).decode("utf-8", errors="replace"))
+                buf.clear()
+
+        special_ids = set(self.special_tokens.values())
+        for i in ids:
+            i = int(i)
+            if i in special_ids:
+                flush()
+                if not skip_special_tokens:
+                    out.append(self.id_to_token[i])
+                continue
+            for ch in self.id_to_token[i]:
+                buf.append(self._byte_dec[ch])
+        flush()
+        return "".join(out)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    # -------------------------------------------------------------- loading
+    @classmethod
+    def from_tokenizer_json(cls, path: str, **overrides) -> "BPETokenizer":
+        """Load an HF ``tokenizer.json`` (tokenizers-library format):
+        model.vocab/merges, added_tokens, and the pre_tokenizer's Split
+        regex (ByteLevel default = GPT-2's). ``overrides`` (e.g.
+        ``add_prefix_space``) take precedence over the parsed values."""
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        model = data["model"]
+        if model.get("type", "BPE") != "BPE":
+            raise ValueError(f"not a BPE tokenizer.json: {model.get('type')}")
+        if not cls._is_byte_level(data):
+            # e.g. Llama-2's sentencepiece-converted BPE: its vocab uses
+            # ▁ word boundaries, so running it through the GPT-2 byte
+            # alphabet would silently produce unk/garbage ids.
+            raise ValueError(
+                "only byte-level BPE tokenizer.json is supported (no "
+                "ByteLevel pre_tokenizer/decoder found — this looks like "
+                "a sentencepiece-style BPE)")
+        merges = [tuple(m) if isinstance(m, list) else tuple(m.split(" ", 1))
+                  for m in model["merges"]]
+        special = {t["content"]: t["id"]
+                   for t in data.get("added_tokens", [])}
+        split, prefix_space = cls._parse_pre_tokenizer(
+            data.get("pre_tokenizer"))
+        kw = dict(special_tokens=special, split_pattern=split,
+                  add_prefix_space=prefix_space,
+                  unk_token=model.get("unk_token"))
+        kw.update(overrides)
+        return cls(model["vocab"], merges, **kw)
+
+    @staticmethod
+    def _is_byte_level(data) -> bool:
+        pre = data.get("pre_tokenizer") or {}
+        entries = (pre.get("pretokenizers", [])
+                   if pre.get("type") == "Sequence" else [pre])
+        if any(e.get("type") == "ByteLevel" for e in entries):
+            return True
+        return (data.get("decoder") or {}).get("type") == "ByteLevel"
+
+    @staticmethod
+    def _parse_pre_tokenizer(pre) -> Tuple[str, bool]:
+        split, prefix_space = GPT2_SPLIT, False
+        entries = []
+        if pre:
+            entries = (pre.get("pretokenizers", [])
+                       if pre.get("type") == "Sequence" else [pre])
+        for e in entries:
+            if e.get("type") == "ByteLevel":
+                prefix_space = bool(e.get("add_prefix_space"))
+                if not e.get("use_regex", True):
+                    continue  # Split entry carries the pattern (Llama-3)
+            elif e.get("type") == "Split":
+                pat = e.get("pattern", {})
+                if "Regex" in pat:
+                    split = pat["Regex"]
+        return split, prefix_space
+
+    @classmethod
+    def from_vocab_merges(cls, vocab_path: str, merges_path: str,
+                          **kw) -> "BPETokenizer":
+        """GPT-2 style ``vocab.json`` + ``merges.txt``."""
+        with open(vocab_path, encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges: List[Tuple[str, str]] = []
+        with open(merges_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line or line.startswith("#version"):
+                    continue
+                a, b = line.split(" ", 1)
+                merges.append((a, b))
+        return cls(vocab, merges, **kw)
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str, **kw) -> "BPETokenizer":
+        tj = os.path.join(model_dir, "tokenizer.json")
+        if os.path.exists(tj):
+            return cls.from_tokenizer_json(tj, **kw)
+        vj = os.path.join(model_dir, "vocab.json")
+        mt = os.path.join(model_dir, "merges.txt")
+        if os.path.exists(vj) and os.path.exists(mt):
+            return cls.from_vocab_merges(vj, mt, **kw)
+        raise FileNotFoundError(
+            f"no tokenizer.json or vocab.json+merges.txt in {model_dir}")

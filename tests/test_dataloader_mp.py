@@ -1,0 +1,166 @@
+"""Multiprocess DataLoader workers (VERDICT r2 item 4; reference:
+python/paddle/io/dataloader/dataloader_iter.py worker pool). Spawned
+workers, ordered results, >=3x speedup on a 5ms-per-sample dataset,
+exception propagation, worker_init_fn/get_worker_info, persistence."""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import (DataLoader, TensorDataset, WorkerError,
+                           get_worker_info)
+
+
+class SlowDataset:
+    """5 ms of host work per sample (image decode stand-in)."""
+
+    def __init__(self, n=400, delay=0.005):
+        self.n = n
+        self.delay = delay
+
+    def __getitem__(self, i):
+        time.sleep(self.delay)
+        return np.full((4,), i, dtype=np.float32)
+
+    def __len__(self):
+        return self.n
+
+
+class WorkerIdDataset:
+    def __getitem__(self, i):
+        info = get_worker_info()
+        return np.array([i, -1 if info is None else info.id])
+
+    def __len__(self):
+        return 64
+
+
+class FailingDataset:
+    def __getitem__(self, i):
+        if i == 13:
+            raise ValueError("poison sample")
+        return np.zeros(2)
+
+    def __len__(self):
+        return 32
+
+
+def test_order_matches_serial():
+    X = np.random.randn(64, 8).astype(np.float32)
+    ds = TensorDataset([X])
+    serial = [b[0] for b in DataLoader(ds, batch_size=8, num_workers=0)]
+    par = [b[0] for b in DataLoader(ds, batch_size=8, num_workers=2)]
+    assert len(serial) == len(par)
+    for a, b in zip(serial, par):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_speedup_4_workers():
+    """>= 3x on epoch 2 (persistent workers: spawn cost amortizes across
+    epochs exactly as in real training)."""
+    ds = SlowDataset(n=400)
+    serial = DataLoader(ds, batch_size=4, num_workers=0)
+    t0 = time.perf_counter()
+    n_serial = sum(1 for _ in serial)
+    t_serial = time.perf_counter() - t0
+
+    par = DataLoader(ds, batch_size=4, num_workers=4,
+                     persistent_workers=True)
+    n_par = sum(1 for _ in par)          # epoch 1: includes spawn
+    t0 = time.perf_counter()
+    n_par2 = sum(1 for _ in par)         # epoch 2: steady state
+    t_par = time.perf_counter() - t0
+    par.shutdown()
+    assert n_serial == n_par == n_par2 == 100
+    assert t_serial / t_par >= 3.0, (t_serial, t_par)
+
+
+def test_worker_exception_propagates():
+    dl = DataLoader(FailingDataset(), batch_size=8, num_workers=2)
+    with pytest.raises(WorkerError, match="poison sample"):
+        list(dl)
+
+
+def test_get_worker_info_and_distribution():
+    dl = DataLoader(WorkerIdDataset(), batch_size=4, num_workers=4)
+    rows = np.concatenate([np.asarray(b) for b in dl])
+    ids = set(rows[:, 1].tolist())
+    assert ids == {0, 1, 2, 3}, ids               # all workers participated
+    np.testing.assert_array_equal(rows[:, 0], np.arange(64))  # order kept
+
+
+def _init_fn(worker_id):
+    import numpy as _np
+    _np.random.seed(1234 + worker_id)
+
+
+class RandDataset:
+    def __getitem__(self, i):
+        return np.random.randint(0, 1_000_000, (2,))
+
+    def __len__(self):
+        return 16
+
+
+def test_worker_init_fn_controls_rng():
+    a = [np.asarray(b) for b in DataLoader(
+        RandDataset(), batch_size=4, num_workers=2, worker_init_fn=_init_fn)]
+    b = [np.asarray(b) for b in DataLoader(
+        RandDataset(), batch_size=4, num_workers=2, worker_init_fn=_init_fn)]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_persistent_pool_reused():
+    ds = TensorDataset([np.arange(32, dtype=np.float32)])
+    dl = DataLoader(ds, batch_size=8, num_workers=2, persistent_workers=True)
+    e1 = [np.asarray(b[0]) for b in dl]
+    pool = dl._pool
+    assert pool is not None
+    e2 = [np.asarray(b[0]) for b in dl]
+    assert dl._pool is pool                      # same workers, epoch 2
+    for a, b in zip(e1, e2):
+        np.testing.assert_array_equal(a, b)
+    dl.shutdown()
+    assert dl._pool is None
+
+
+def test_consumer_early_break_then_reuse():
+    """Breaking out mid-epoch must not wedge or corrupt the next epoch."""
+    ds = TensorDataset([np.arange(64, dtype=np.float32)])
+    dl = DataLoader(ds, batch_size=4, num_workers=2, persistent_workers=True)
+    it = iter(dl)
+    next(it), next(it)
+    it.close()                                    # abandon epoch
+    full = [np.asarray(b[0]) for b in dl]         # fresh epoch: complete
+    np.testing.assert_array_equal(np.concatenate(full),
+                                  np.arange(64, dtype=np.float32))
+    dl.shutdown()
+
+
+class DyingDataset:
+    def __getitem__(self, i):
+        if i == 5:
+            import os
+            os._exit(3)  # simulate OOM-kill / hard crash
+        return np.zeros(2)
+
+    def __len__(self):
+        return 32
+
+
+def test_dead_worker_raises_not_hangs():
+    dl = DataLoader(DyingDataset(), batch_size=4, num_workers=2)
+    with pytest.raises(WorkerError, match="died"):
+        list(dl)
+
+
+def test_concurrent_iterators_rejected():
+    ds = TensorDataset([np.arange(32, dtype=np.float32)])
+    dl = DataLoader(ds, batch_size=4, num_workers=2, persistent_workers=True)
+    it1 = iter(dl)
+    next(it1)
+    with pytest.raises(RuntimeError, match="active iterator"):
+        next(iter(dl))
+    it1.close()
+    dl.shutdown()
