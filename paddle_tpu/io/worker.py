@@ -146,8 +146,8 @@ class WorkerPool:
                 self._seq += 1
                 in_flight += 1
 
-        dispatch()
         try:
+            dispatch()
             while in_flight > 0:
                 seq, batch, err = self._get_result()
                 if seq != -1 and seq < epoch_start:

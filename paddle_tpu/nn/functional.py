@@ -15,6 +15,13 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _use_onehot_nll() -> bool:
+    """Label-logit pick strategy: gather is fine single-device; under a
+    tp mesh the one-hot contraction partitions cleanly (see call site)."""
+    from ..distributed.env import get_mesh, has_mesh
+    return has_mesh() and get_mesh().shape.get("tp", 1) > 1
+
+
 # ------------------------------------------------------------- activations
 def relu(x):
     return jnp.maximum(x, 0)
@@ -465,6 +472,15 @@ def cross_entropy(logits, label, weight=None, ignore_index=-100,
             onehot = jax.nn.one_hot(label, n, axis=axis)
             target = onehot * (1 - label_smoothing) + label_smoothing / n
             loss = -jnp.sum(target * logp, axis=axis)
+        elif _use_onehot_nll():
+            # tp-sharded vocab: take_along_axis is a gather whose SPMD
+            # partition replicates the [.., V] logits (and crashes XLA's
+            # partitioner inside manual shard_map regions); the one-hot
+            # contraction partitions as a matmul with one psum instead
+            # (same trick as VocabParallelEmbedding's dispatch)
+            onehot = jax.nn.one_hot(jnp.clip(label, 0, n - 1), n, axis=axis,
+                                    dtype=logp.dtype)
+            loss = -jnp.sum(onehot * logp, axis=axis)
         else:
             loss = -jnp.take_along_axis(
                 logp, jnp.expand_dims(jnp.clip(label, 0, n - 1), axis), axis=axis

@@ -18,8 +18,6 @@ sharding-resolution time rather than at runtime.
 """
 from __future__ import annotations
 
-import contextlib
-import threading
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -32,26 +30,6 @@ from ..nn.layer import Layer
 
 class ShardingError(ValueError):
     """Invalid partition: unknown mesh axis or non-divisible dimension."""
-
-
-_manual_state = threading.local()
-
-
-@contextlib.contextmanager
-def manual_mode():
-    """Trace-time flag: inside a fully-manual `shard_map` region (e.g. the
-    1F1B pipeline body) GSPMD sharding hints are invalid — `constraint`
-    becomes a no-op while this context is active."""
-    prev = getattr(_manual_state, "on", False)
-    _manual_state.on = True
-    try:
-        yield
-    finally:
-        _manual_state.on = prev
-
-
-def in_manual_mode() -> bool:
-    return getattr(_manual_state, "on", False)
 
 
 def validate_partition(shape: Tuple[int, ...], partition, mesh: Mesh,
@@ -148,7 +126,7 @@ def constraint(x, *spec):
     mesh is installed or it is single-device (keeps layers usable eagerly).
     Axes that don't evenly divide their dim are dropped (a hint must never
     make a program invalid — e.g. a debug batch of 2 on an 8-way dp mesh)."""
-    if in_manual_mode() or not has_mesh():
+    if not has_mesh():
         return x
     mesh = get_mesh()
     if mesh.size == 1:
@@ -170,8 +148,33 @@ def constraint(x, *spec):
         fitted.pop()
     if not fitted:
         return x
+    abstract = jax.sharding.get_abstract_mesh()
+    if not abstract.empty:
+        # inside a mesh context — e.g. the partial-manual 1F1B body
+        # (shard_map axis_names={'pp'}): a NamedSharding built on the
+        # outer all-Auto mesh would clash with the context mesh's axis
+        # types, so hand over a bare PartitionSpec (manual axes in the
+        # hint would be invalid; drop them)
+        fitted = [None if _mentions_manual(a, abstract) else a
+                  for a in fitted]
+        while fitted and fitted[-1] is None:
+            fitted.pop()
+        if not any(a is not None for a in fitted):
+            return x
+        return jax.lax.with_sharding_constraint(x, P(*fitted))
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, P(*fitted)))
+
+
+def _mentions_manual(axes, abstract_mesh) -> bool:
+    if axes is None:
+        return False
+    tup = (axes,) if isinstance(axes, str) else tuple(axes)
+    manual_t = jax.sharding.AxisType.Manual
+    manual = {n for n, t in zip(abstract_mesh.axis_names,
+                                abstract_mesh.axis_types)
+              if t == manual_t}
+    return any(a in manual for a in tup)
 
 
 def tree_shardings(tree, like: Dict[str, NamedSharding], default=None):

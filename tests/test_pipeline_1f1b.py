@@ -61,11 +61,35 @@ def test_1f1b_single_microbatch():
     np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
 
 
-def test_pp_mesh_validation_rejects_tp():
-    mesh = env.init_parallel_env({"pp": 2, "tp": 2},
+def test_pp_mesh_validation_rejects_ep_only():
+    mesh = env.init_parallel_env({"pp": 2, "ep": 2},
                                  devices=jax.devices()[:4])
-    with pytest.raises(ValueError, match="tp"):
+    with pytest.raises(ValueError, match="ep"):
         validate_pp_mesh(mesh)
+    env.clear_mesh()
+
+
+def test_1f1b_composes_with_tp_dp():
+    """VERDICT r2 item 3: true hybrid — pp manual, tp+dp left to GSPMD
+    inside the stage fns — must still grad-match the dense step."""
+    model = _tiny_model(n_layers=4)
+    env.init_parallel_env({"pp": 2, "tp": 2, "dp": 2},
+                          devices=jax.devices()[:8])
+    from paddle_tpu.parallel.sharding import shard_layer
+    shard_layer(model, fsdp_min_size=1 << 30)  # tp rules only
+    M, b, s = 3, 2, 16
+    tokens = jnp.asarray(np.random.RandomState(5).randint(0, 128, (M, b, s)))
+
+    _, params = model.functional()
+    vag = jax.jit(model.pipeline_functional(2))
+    loss_pp, grads_pp = vag(dict(params), tokens)
+
+    loss_ref, grads_ref = _reference_loss_grads(model, tokens)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+    for k in grads_ref:
+        np.testing.assert_allclose(
+            np.asarray(grads_pp[k]), np.asarray(grads_ref[k]),
+            rtol=2e-4, atol=2e-5, err_msg=k)
 
 
 def test_trainer_pp_path_runs_and_learns():
