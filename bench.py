@@ -173,6 +173,69 @@ def _child_bench(rung):
     }))
 
 
+def _child_decode():
+    """Decode-path bench (VERDICT r2 item 5): per-step latency of the old
+    masked-dense attention over the full cache vs the new GQA-native
+    decode path (Pallas kernel on TPU), plus end-to-end generate()
+    tokens/s at bs=1 and bs=8."""
+    _force_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import paddle_tpu as pt
+    from paddle_tpu.ops.attention import decode_attention, dense_attention
+    from paddle_tpu.models import LlamaForCausalLM
+
+    smoke = bool(os.environ.get("PADDLE_TPU_BENCH_SMOKE"))
+    b, T, h, kv, d = (2, 256, 4, 2, 64) if smoke else (8, 2048, 16, 8, 128)
+    rs = np.random.RandomState(0)
+    dt = jnp.bfloat16
+    q = jnp.asarray(rs.randn(b, 1, h, d), dt)
+    ck = jnp.asarray(rs.randn(b, T, kv, d), dt)
+    cv = jnp.asarray(rs.randn(b, T, kv, d), dt)
+    idx = jnp.int32(T - 2)
+
+    def dense_ref(q, ck, cv, idx):
+        mask = (jnp.arange(T) <= idx)[None, None, None, :]
+        return dense_attention(q, ck, cv, attn_mask=mask)
+
+    def time_it(fn, *args, iters=50):
+        jfn = jax.jit(fn)  # one wrapper: iterations hit the trace cache
+        jax.block_until_ready(jfn(*args))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jfn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+    ms_dense = time_it(dense_ref, q, ck, cv, idx)
+    ms_decode = time_it(decode_attention, q, ck, cv, idx)
+
+    # end-to-end generate tokens/s (static cache, while_loop decode)
+    pt.seed(0)
+    model = LlamaForCausalLM(_bench_config("tiny"))
+    gen = {}
+    new_tok = 16 if smoke else 64
+    for bs in (1, 8):
+        ids = jnp.asarray(rs.randint(0, model.config.vocab_size, (bs, 32)))
+        out = model.generate(ids, max_new_tokens=new_tok, temperature=0.0)
+        jax.block_until_ready(out)  # compile
+        t0 = time.perf_counter()
+        out = model.generate(ids, max_new_tokens=new_tok, temperature=0.0)
+        jax.block_until_ready(out)
+        dt_s = time.perf_counter() - t0
+        gen[f"generate_tokens_per_sec_bs{bs}"] = round(bs * new_tok / dt_s, 1)
+
+    print(json.dumps({"decode": {
+        "attn_ms_dense": round(ms_dense, 3),
+        "attn_ms_decode_kernel": round(ms_decode, 3),
+        "attn_speedup": round(ms_dense / ms_decode, 2),
+        "shape": f"b{b} T{T} h{h} kv{kv} d{d}",
+        **gen,
+    }}))
+
+
 # ------------------------------------------------------------------ parent
 
 def _run_child(mode, timeout):
@@ -258,6 +321,17 @@ def main():
                     failures.append({"stage": rung + "_retry", "rc": rc,
                                      "stderr_tail": err[-300:]})
 
+    # decode-path bench rides along if a training number is banked and
+    # budget remains (its JSON merges into the result).
+    if result is not None and remaining() > 70:
+        attempts += 1
+        rc, parsed, err = _run_child("decode", min(200.0, remaining() - 15))
+        if rc == 0 and parsed and "decode" in parsed:
+            result["decode"] = parsed["decode"]
+        else:
+            failures.append({"stage": "decode", "rc": rc,
+                             "stderr_tail": err[-300:]})
+
     # (c) always emit exactly one JSON line.
     if result is not None:
         result["probe"] = {k: probe[k] for k in
@@ -279,6 +353,8 @@ if __name__ == "__main__":
     mode = os.environ.get("_PADDLE_TPU_BENCH_CHILD")
     if mode == "probe":
         _child_probe()
+    elif mode == "decode":
+        _child_decode()
     elif mode in ("tiny", "headline"):
         _child_bench(mode)
     else:

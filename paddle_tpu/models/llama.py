@@ -27,7 +27,8 @@ from .. import nn
 from ..nn import functional as F
 from ..nn.layer import Layer, Parameter
 from ..nn.recompute import POLICIES
-from ..ops.attention import dense_attention, flash_attention, use_flash
+from ..ops.attention import (decode_attention, dense_attention,
+                             flash_attention, use_flash)
 from ..parallel.layers import (ColumnParallelLinear, RowParallelLinear,
                                VocabParallelEmbedding, parallel_matmul)
 from ..parallel.sharding import constraint
@@ -147,12 +148,17 @@ class LlamaAttention(Layer):
             cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
                                               (0, cache_index, 0, 0))
             new_cache = (ck, cv)
-            # mask out positions beyond cache_index + s
-            total = ck.shape[1]
-            kpos = jnp.arange(total)[None, :]           # [1, T]
-            qpos = cache_index + jnp.arange(s)[:, None]  # [s, 1]
-            mask = (kpos <= qpos)[None, None]           # [1, 1, s, T]
-            out = dense_attention(q, ck, cv, attn_mask=mask)
+            if s == 1:
+                # single-token decode: Pallas masked-MHA kernel (GQA-
+                # native, no KV repeat) / grouped-einsum fallback
+                out = decode_attention(q, ck, cv, cache_index)
+            else:
+                # prefill-with-cache: mask positions beyond cache_index+s
+                total = ck.shape[1]
+                kpos = jnp.arange(total)[None, :]           # [1, T]
+                qpos = cache_index + jnp.arange(s)[:, None]  # [s, 1]
+                mask = (kpos <= qpos)[None, None]           # [1, 1, s, T]
+                out = dense_attention(q, ck, cv, attn_mask=mask)
         elif cfg.sequence_parallel and attn_mask is None and self._sp_degree() > 1:
             # ring attention: seq stays sp-sharded; KV blocks rotate on ICI
             import functools

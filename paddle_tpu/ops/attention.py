@@ -43,8 +43,8 @@ def flash_attention(query, key, value, causal=False, scale=None):
     """[b, s, h, d] flash attention; grouped-query aware. The Pallas kernel
     is TPU-only; on other backends (CPU mesh tests, dryruns) this routes to
     the numerically-identical dense XLA path."""
-    import jax
-    if jax.default_backend() not in ("tpu", "axon"):
+    from .pallas import tpu_backend
+    if not tpu_backend():
         return dense_attention(query, key, value, causal=causal, scale=scale)
     from .pallas.flash_attention import flash_attention_bshd
     return flash_attention_bshd(query, key, value, causal=causal, scale=scale)
@@ -85,3 +85,51 @@ def dense_attention(query, key, value, attn_mask=None, dropout_p=0.0,
 
 def naive_attention(query, key, value, causal=False, scale=None):
     return dense_attention(query, key, value, causal=causal, scale=scale)
+
+
+def use_decode_kernel(q, k_cache) -> bool:
+    """Pallas decode kernel wants a TPU backend (or interpret mode, so CI
+    exercises the same dispatch glue), MXU-friendly head_dim, a cache
+    length with a 128-multiple tile, and a whole number of query heads
+    per kv head."""
+    from .pallas import interpret_enabled, kernels_enabled
+    b, s, h, d = q.shape
+    T, kv = k_cache.shape[1], k_cache.shape[2]
+    if s != 1 or h % kv:
+        return False
+    if not (interpret_enabled()
+            or (_flash_enabled() and kernels_enabled())):
+        return False
+    return d in (64, 128, 256) and T % 128 == 0
+
+
+def decode_attention(q, k_cache, v_cache, cache_index, scale=None):
+    """Single-token decode over a static KV cache (reference: PHI
+    fusion/gpu/masked_multihead_attention). q [b, 1, h, d];
+    k/v_cache [b, T, kv, d]; positions <= cache_index attend.
+
+    Both paths are GQA-native — no `jnp.repeat` of K/V anywhere, so HBM
+    traffic is the cache read itself (the decode bottleneck), not
+    h/kv copies of it."""
+    b, s, h, d = q.shape
+    assert s == 1, f"decode_attention is for q_len=1, got {s}"
+    kv, T = k_cache.shape[2], k_cache.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    if use_decode_kernel(q, k_cache):
+        from .pallas.decode_attention import decode_attention_pallas
+        out = decode_attention_pallas(q[:, 0], k_cache, v_cache,
+                                      cache_index, scale)
+        return out[:, None]
+
+    # grouped einsum fallback (CPU mesh tests / odd shapes): same layout,
+    # XLA contracts per kv head without materializing the repeat
+    g = h // kv
+    qg = q[:, 0].reshape(b, kv, g, d)
+    scores = jnp.einsum("bkgd,btkd->bkgt", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(T)[None, None, None, :] <= cache_index
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgt,btkd->bkgd", probs, v_cache)
+    return out.reshape(b, 1, h, d)

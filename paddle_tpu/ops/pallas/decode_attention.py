@@ -1,0 +1,127 @@
+"""Pallas TPU decode attention (reference: PHI
+``fusion/gpu/masked_multihead_attention_kernel.cu`` — the single-token
+decode kernel; reimagined for TPU).
+
+Autoregressive decode is HBM-bandwidth-bound: each step streams the whole
+static KV cache once. The XLA dense path pays h/kv times that traffic for
+GQA models because it materializes `jnp.repeat`-ed K/V; this kernel reads
+each KV block exactly once per *kv head* and shares it across the whole
+query-head group:
+
+- grid (batch, kv_head, kv_blocks); KV innermost so the fp32 accumulator
+  scratch carries the online softmax across blocks.
+- q is pre-reshaped to [b, kv, group, d] (group = h // kv, padded to the
+  8-sublane minimum) — the group dim rides the matmul's M dimension.
+- `cache_index` arrives via scalar prefetch: blocks fully past the valid
+  length are predicated off with @pl.when (their compute never runs), the
+  boundary block masks with an iota compare.
+
+The non-TPU fallback (`ops.attention.decode_attention`) uses the same
+grouped einsum layout, so GQA never materializes a repeat on any backend.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+DEFAULT_BLOCK_T = 512
+
+
+from . import interpret_enabled as _interpret
+
+
+def pick_block_t(total: int, preferred: int = DEFAULT_BLOCK_T) -> int:
+    b = min(preferred, total)
+    while b > 128 and total % b:
+        b //= 2
+    return b if total % b == 0 else 0
+
+
+def _decode_kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,
+                   scale, block_t, nt, gp):
+    ti = pl.program_id(2)
+
+    @pl.when(ti == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    valid = idx_ref[0] + 1  # positions [0, cache_index] are attendable
+
+    @pl.when(ti * block_t < valid)
+    def _compute():
+        q = q_ref[0, 0, :, :]                       # [gp, d]
+        k = k_ref[0, :, 0, :]                       # [bt, d]
+        v = v_ref[0, :, 0, :]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        k_ids = lax.broadcasted_iota(jnp.int32, (gp, block_t), 1) \
+            + ti * block_t
+        s = jnp.where(k_ids < valid, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:, :1] = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1,
+                                                      keepdims=True)
+        acc[:] = acc[:] * alpha + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:, :1] = m_new
+
+    @pl.when(ti == nt - 1)
+    def _finalize():
+        safe_l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0, :, :] = (acc[:] / safe_l).astype(o_ref.dtype)
+
+
+def decode_attention_pallas(q, k_cache, v_cache, cache_index, scale,
+                            block_t: int = DEFAULT_BLOCK_T):
+    """q [b, h, d]; k/v_cache [b, T, kv, d]; cache_index: scalar int (the
+    write position of the current token; positions <= it are valid).
+    Returns [b, h, d]."""
+    b, h, d = q.shape
+    _, T, kv, _ = k_cache.shape
+    group = h // kv
+    gp = max(8, group)  # sublane-align the group dim
+    bt = pick_block_t(T, block_t)
+    assert bt, f"cache length {T} has no 128-multiple tile"
+    nt = T // bt
+
+    qg = q.reshape(b, kv, group, d)
+    if gp != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+
+    idx = jnp.asarray(cache_index, jnp.int32).reshape(1)
+    kernel = functools.partial(_decode_kernel, scale=scale, block_t=bt,
+                               nt=nt, gp=gp)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, kv, nt),
+            in_specs=[
+                pl.BlockSpec((1, 1, gp, d), lambda bi, ki, ti, idx: (bi, ki, 0, 0)),
+                pl.BlockSpec((1, bt, 1, d), lambda bi, ki, ti, idx: (bi, ti, ki, 0)),
+                pl.BlockSpec((1, bt, 1, d), lambda bi, ki, ti, idx: (bi, ti, ki, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, gp, d),
+                                   lambda bi, ki, ti, idx: (bi, ki, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((gp, d), jnp.float32),
+                pltpu.VMEM((gp, 128), jnp.float32),
+                pltpu.VMEM((gp, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kv, gp, d), q.dtype),
+        interpret=_interpret(),
+    )(idx, qg, k_cache, v_cache)
+    return out[:, :, :group, :].reshape(b, h, d)

@@ -64,12 +64,36 @@ def dequantize_weight(qweight, scales, bits: int = 8, block_size: int = 128,
 
 def weight_only_linear(x, qweight, scales, bias=None, bits: int = 8,
                        block_size: int = 128):
-    """y = x @ dequant(qweight) — the reference's weight_only_linear op."""
-    w = dequantize_weight(qweight, scales, bits, block_size, x.dtype)
-    out = x @ w
+    """y = x @ dequant(qweight) — the reference's weight_only_linear op.
+
+    Decode-sized calls on TPU route to the fused Pallas kernel
+    (ops/pallas/quant_matmul.py): int bytes DMA to VMEM, dequant
+    in-register, MXU matmul — the full-precision weight never touches
+    HBM. Larger (training/prefill) shapes go to XLA, whose fusion
+    handles the compute-bound regime fine."""
+    lead, din = x.shape[:-1], x.shape[-1]
+    x2d = x.reshape(-1, din)
+    out = None
+    if _quant_kernel_enabled():
+        from ..ops.pallas.quant_matmul import (quant_matmul_pallas,
+                                               use_quant_matmul)
+        if use_quant_matmul(x2d, qweight, block_size):
+            out = quant_matmul_pallas(x2d, qweight, scales, bits)
+    if out is None:
+        w = dequantize_weight(qweight, scales, bits, block_size, x.dtype)
+        out = x2d @ w
+    out = out.reshape(*lead, out.shape[-1])
     if bias is not None:
         out = out + bias
     return out
+
+
+def _quant_kernel_enabled() -> bool:
+    import os
+    if os.environ.get("PADDLE_TPU_DISABLE_QUANT_KERNEL"):
+        return False
+    from ..ops.pallas import kernels_enabled
+    return kernels_enabled()
 
 
 class QuantizedLinear(Layer):

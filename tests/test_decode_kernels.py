@@ -1,0 +1,132 @@
+"""Decode-path kernels (VERDICT r2 item 5; reference: PHI
+fusion/gpu/masked_multihead_attention + weight_only_linear_kernel.cu).
+Pallas kernels run in interpret mode on CPU; numerics must match the
+dense/XLA references exactly (same fp32 softmax/accumulate math)."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.attention import decode_attention, dense_attention
+
+pytestmark = pytest.mark.usefixtures("_interpret_pallas")
+
+
+@pytest.fixture
+def _interpret_pallas(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+
+
+def _dense_reference(q, ck, cv, cache_index):
+    """Masked dense attention over the full cache (the old decode path)."""
+    T = ck.shape[1]
+    kpos = jnp.arange(T)[None, :]
+    qpos = cache_index + jnp.arange(1)[:, None]
+    mask = (kpos <= qpos)[None, None]
+    return dense_attention(q, ck, cv, attn_mask=mask)
+
+
+@pytest.mark.parametrize("h,kv", [(8, 4), (4, 4), (16, 2)])
+@pytest.mark.parametrize("cache_index", [0, 5, 127, 200, 255])
+def test_decode_dispatch_matches_dense(h, kv, cache_index):
+    """Interpret mode routes through the Pallas kernel dispatch glue
+    (T=256 tiles); the T=192 case exercises the grouped-einsum fallback."""
+    rs = np.random.RandomState(0)
+    for T in (256, 192):
+        b, d = 2, 64
+        q = jnp.asarray(rs.randn(b, 1, h, d), jnp.float32)
+        ck = jnp.asarray(rs.randn(b, T, kv, d), jnp.float32)
+        cv = jnp.asarray(rs.randn(b, T, kv, d), jnp.float32)
+        ci = jnp.int32(min(cache_index, T - 1))
+        got = decode_attention(q, ck, cv, ci)
+        ref = _dense_reference(q, ck, cv, ci)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5, err_msg=f"T={T}")
+
+
+@pytest.mark.parametrize("h,kv,d", [(8, 4, 64), (16, 2, 128)])
+@pytest.mark.parametrize("cache_index", [0, 100, 255])
+def test_pallas_decode_kernel_matches_dense(h, kv, d, cache_index):
+    from paddle_tpu.ops.pallas.decode_attention import decode_attention_pallas
+    rs = np.random.RandomState(1)
+    b, T = 2, 256
+    q = jnp.asarray(rs.randn(b, h, d), jnp.float32)
+    ck = jnp.asarray(rs.randn(b, T, kv, d), jnp.float32)
+    cv = jnp.asarray(rs.randn(b, T, kv, d), jnp.float32)
+    got = decode_attention_pallas(q, ck, cv, jnp.int32(cache_index),
+                                  scale=1.0 / np.sqrt(d), block_t=128)
+    ref = _dense_reference(q[:, None], ck, cv, jnp.int32(cache_index))[:, 0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_pallas_decode_bf16():
+    from paddle_tpu.ops.pallas.decode_attention import decode_attention_pallas
+    rs = np.random.RandomState(2)
+    b, T, h, kv, d = 1, 128, 8, 4, 64
+    q = jnp.asarray(rs.randn(b, h, d), jnp.bfloat16)
+    ck = jnp.asarray(rs.randn(b, T, kv, d), jnp.bfloat16)
+    cv = jnp.asarray(rs.randn(b, T, kv, d), jnp.bfloat16)
+    got = decode_attention_pallas(q, ck, cv, jnp.int32(50),
+                                  scale=1.0 / np.sqrt(d), block_t=128)
+    ref = _dense_reference(q[:, None].astype(jnp.float32),
+                           ck.astype(jnp.float32), cv.astype(jnp.float32),
+                           jnp.int32(50))[:, 0]
+    np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(ref),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_generation_uses_decode_path():
+    """End-to-end: generate() with the new decode branch still produces
+    the same tokens as before (greedy, tiny llama)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    pt.seed(0)
+    model = LlamaForCausalLM(llama_tiny(max_position_embeddings=128))
+    ids = jnp.asarray(np.random.RandomState(3).randint(0, 256, (2, 8)))
+    out = model.generate(ids, max_new_tokens=8, temperature=0.0)
+    assert out.shape[1] == 16
+    # decode must be deterministic and stable across calls
+    out2 = model.generate(ids, max_new_tokens=8, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+# ------------------------------------------------------- fused dequant mm
+@pytest.mark.parametrize("bits", [8, 4])
+@pytest.mark.parametrize("m", [1, 8, 17])
+def test_quant_matmul_kernel_matches_dequant(bits, m):
+    from paddle_tpu.ops.pallas.quant_matmul import quant_matmul_pallas
+    from paddle_tpu.quant import dequantize_weight, quantize_blockwise
+    rs = np.random.RandomState(4)
+    din, dout = 256, 384
+    w = jnp.asarray(rs.randn(din, dout) * 0.1, jnp.float32)
+    qw, sc = quantize_blockwise(w, bits=bits)
+    x = jnp.asarray(rs.randn(m, din), jnp.float32)
+    got = quant_matmul_pallas(x, qw, sc, bits=bits)
+    ref = x @ dequantize_weight(qw, sc, bits=bits, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-3, rtol=1e-3)
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_weight_only_linear_routes_to_kernel(bits):
+    """With interpret mode on, decode-sized calls go through the Pallas
+    kernel and must agree with the XLA dequant path."""
+    from paddle_tpu.quant import weight_only_linear, quantize_blockwise
+    rs = np.random.RandomState(5)
+    w = jnp.asarray(rs.randn(256, 128) * 0.1, jnp.float32)
+    qw, sc = quantize_blockwise(w, bits=bits)
+    x = jnp.asarray(rs.randn(2, 4, 256), jnp.float32)  # [b, s, din]
+    bias = jnp.asarray(rs.randn(128), jnp.float32)
+    got = weight_only_linear(x, qw, sc, bias, bits=bits)
+    os.environ["PADDLE_TPU_DISABLE_QUANT_KERNEL"] = "1"
+    try:
+        del os.environ["PADDLE_TPU_PALLAS_INTERPRET"]
+        ref = weight_only_linear(x, qw, sc, bias, bits=bits)
+    finally:
+        os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+        del os.environ["PADDLE_TPU_DISABLE_QUANT_KERNEL"]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-3, rtol=1e-3)
