@@ -178,6 +178,13 @@ class GradScaler:
     # paddle flow: scaler.step(optimizer) + scaler.update()
     def step(self, optimizer, layer=None, grads=None):
         grads, found_inf = self.unscale(grads)
+        if isinstance(found_inf, jax.core.Tracer):
+            raise TypeError(
+                "GradScaler.step() is the eager/host-synced path and "
+                "cannot run under jit (bool(found_inf) would sync or "
+                "fail). Inside a jitted train step use the functional "
+                "API: init_state/update_state/select — see "
+                "Trainer._build_step for the pattern.")
         if not bool(found_inf):
             optimizer.step(grads=grads, layer=layer)
         self.update(found_inf)

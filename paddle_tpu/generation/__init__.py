@@ -10,6 +10,7 @@ where possible so changing temperature does not recompile.
 from __future__ import annotations
 
 import functools
+import weakref
 from dataclasses import dataclass
 from typing import Optional
 
@@ -19,6 +20,12 @@ import jax.numpy as jnp
 from .sampling import sample_token
 
 __all__ = ["GenerationConfig", "generate", "beam_search"]
+
+# model -> {static-shape/config key -> compiled run}. Without this every
+# generate() call would build a fresh closure and jax.jit would retrace +
+# recompile the whole prefill+decode program per request — the pipeline's
+# bucket ladder only pays off if the executable is actually reused.
+_GEN_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 @dataclass
@@ -35,27 +42,59 @@ class GenerationConfig:
 
 
 def generate(model, input_ids, config: Optional[GenerationConfig] = None,
-             key=None, params=None, **kwargs):
+             key=None, params=None, prompt_start=None, **kwargs):
     """Greedy/sampled decoding. `model` is a Layer with `init_kv_caches` and
     forward(ids, kv_caches=, cache_index=) (the CausalLM contract).
+
+    prompt_start: optional [b] index of each row's first REAL token for
+    left-padded serving batches (reference: PaddleNLP llm predictor's
+    padded batch layout) — pad prefixes are masked out of attention and
+    RoPE positions start at each row's real start.
 
     Returns [b, prompt_len + max_new_tokens] token ids (right-padded with
     pad_token_id after eos)."""
     cfg = config or GenerationConfig(**kwargs)
+    if config is not None and kwargs:
+        # per-call overrides on top of a base config (the pipeline path):
+        # silently dropping them would be wrong-output, not an error
+        import dataclasses
+        cfg = dataclasses.replace(cfg, **kwargs)
     if cfg.num_beams > 1:
         return beam_search(model, input_ids, cfg, params=params)
     key = key if key is not None else jax.random.key(0)
     fn, model_params = model.functional()
     params = params if params is not None else model_params
     b, prompt_len = input_ids.shape
+    has_start = prompt_start is not None
+
+    cache_key = (b, prompt_len, cfg.max_new_tokens, cfg.do_sample,
+                 cfg.top_k, cfg.top_p, cfg.eos_token_id, cfg.pad_token_id,
+                 has_start,
+                 # model surgery (e.g. quantize_model) changes the param
+                 # tree; a stale compiled fn must not be reused
+                 hash(tuple(model_params)))
+    per_model = _GEN_CACHE.setdefault(model, {})
+    run = per_model.get(cache_key)
+    if run is None:
+        run = _build_generate_fn(model, fn, cfg, b, prompt_len, has_start)
+        per_model[cache_key] = run
+    args = [params, input_ids, key, jnp.float32(cfg.temperature)]
+    if has_start:
+        args.append(jnp.asarray(prompt_start, jnp.int32))
+    return run(*args)
+
+
+def _build_generate_fn(model, fn, cfg, b, prompt_len, has_start):
     total = prompt_len + cfg.max_new_tokens
     eos = cfg.eos_token_id
 
-    @functools.partial(jax.jit, static_argnums=())
-    def run(params, input_ids, key, temperature):
+    @jax.jit
+    def run(params, input_ids, key, temperature, *start):
+        extra = {"attn_start": start[0]} if has_start else {}
         caches = model.init_kv_caches(b, total)
         # prefill
-        logits, caches = fn(params, input_ids, kv_caches=caches, cache_index=0)
+        logits, caches = fn(params, input_ids, kv_caches=caches,
+                            cache_index=0, **extra)
         tokens = jnp.concatenate(
             [input_ids,
              jnp.full((b, cfg.max_new_tokens), cfg.pad_token_id,
@@ -70,7 +109,7 @@ def generate(model, input_ids, config: Optional[GenerationConfig] = None,
             tokens, caches, key, done = state
             ids = jax.lax.dynamic_slice_in_dim(tokens, cur - 1, 1, axis=1)
             logits, caches = fn(params, ids, kv_caches=caches,
-                                cache_index=cur - 1)
+                                cache_index=cur - 1, **extra)
             key, sub = jax.random.split(key)
             nxt = sample_token(logits[:, 0], sub, temperature=temperature,
                                top_k=cfg.top_k, top_p=cfg.top_p,
@@ -100,7 +139,7 @@ def generate(model, input_ids, config: Optional[GenerationConfig] = None,
         tokens = state[0]
         return tokens
 
-    return run(params, input_ids, key, jnp.float32(cfg.temperature))
+    return run
 
 
 def beam_search(model, input_ids, config: GenerationConfig, params=None):
@@ -171,3 +210,8 @@ def beam_search(model, input_ids, config: GenerationConfig, params=None):
         return tokens.reshape(b, k, total)[jnp.arange(b), best]
 
     return run(params, input_ids)
+
+
+from .pipeline import TextGenerationPipeline  # noqa: E402
+
+__all__.append("TextGenerationPipeline")

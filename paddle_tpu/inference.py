@@ -4,26 +4,42 @@ PaddleNLP's llm/predict/predictor.py for the LLM path).
 
 TPU-native: the "optimized program" is a cached jax.jit of the model's
 functional form with donated weights left on device; optional weight-only
-quantization at load (C17). One Predictor == one compiled engine per input
-shape, the same mental model as the reference's shape-bucketed engines.
+quantization at load (C17). XLA compiles one engine per input shape, so
+serving discipline is SHAPE discipline:
+
+- batch-dim bucketing: requests pad up to a fixed bucket ladder, bounding
+  the number of compiled engines at len(buckets) per rank profile (the
+  reference's shape-bucketed engine cache); padding rows are cropped
+  before returning, so results are exact.
+- `BatchingPredictor` adds the server-side micro-batching policy: concurrent
+  `submit()` calls coalesce (up to max_batch, bounded by max_delay_ms)
+  into one engine call — the TPU sees few, large, fixed-shape batches.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
 
 
 class Config:
     """paddle.inference.Config parity surface (the knobs that matter on
-    TPU: dtype, quantization)."""
+    TPU: dtype, quantization, shape buckets)."""
 
     def __init__(self, model_path: Optional[str] = None):
         self.model_path = model_path
         self.dtype = None                         # None = keep model dtype
         self.quant_bits: Optional[int] = None     # 8 / 4 / None
         self.quant_skip = ["lm_head", "embed"]
+        self.batch_buckets: Optional[Tuple[int, ...]] = DEFAULT_BUCKETS
 
     def enable_weight_only_quant(self, bits: int = 8):
         self.quant_bits = bits
@@ -33,11 +49,15 @@ class Config:
         self.dtype = dtype
         return self
 
+    def set_batch_buckets(self, buckets: Optional[Sequence[int]]):
+        """None disables bucketing (one engine per exact batch size)."""
+        self.batch_buckets = tuple(sorted(buckets)) if buckets else None
+        return self
+
 
 class Predictor:
-    """Wraps a Layer for serving: one jitted engine (jax.jit's own cache
-    handles per-shape retraces), optional dtype cast + PTQ at load, state
-    kept on device."""
+    """Wraps a Layer for serving: jitted engines cached per shape bucket,
+    optional dtype cast + PTQ at load, state kept on device."""
 
     def __init__(self, model, config: Optional[Config] = None):
         self.config = config or Config()
@@ -54,11 +74,38 @@ class Predictor:
         self._params = jax.device_put(self._params)
         self._engine = jax.jit(self._fn)
 
+    def _bucket(self, b: int) -> int:
+        buckets = self.config.batch_buckets
+        if not buckets:
+            return b
+        for cap in buckets:
+            if b <= cap:
+                return cap
+        return b  # beyond the ladder: exact-shape engine
+
     def run(self, *inputs):
         """Eager-looking predict: inputs are host arrays; returns device
-        outputs (np.asarray them for host use)."""
+        outputs (np.asarray them for host use). The batch dim pads up to
+        the bucket (edge-replicated rows, cropped from every output), so
+        a b=3 request reuses the b=4 engine instead of compiling."""
         args = tuple(jnp.asarray(x) for x in inputs)
-        return self._engine(self._params, *args)
+        b = args[0].shape[0] if args[0].ndim else 1
+        cap = self._bucket(b)
+        if cap != b:
+            # pad only the inputs that actually carry the batch dim —
+            # scalars / shared side inputs pass through untouched
+            args = tuple(
+                jnp.concatenate(
+                    [a, jnp.broadcast_to(a[-1:], (cap - b,) + a.shape[1:])])
+                if a.ndim and a.shape[0] == b else a
+                for a in args)
+        out = self._engine(self._params, *args)
+        if cap != b:
+            out = jax.tree.map(
+                lambda o: o[:b]
+                if hasattr(o, "ndim") and o.ndim and o.shape[0] == cap
+                else o, out)
+        return out
 
     __call__ = run
 
@@ -74,6 +121,92 @@ class Predictor:
         model = model_factory()
         model.set_state_dict(load(path))
         return cls(model, config)
+
+
+class BatchingPredictor:
+    """Server-side micro-batching over a Predictor (reference: the
+    batching policy in PaddleNLP's serving predictor / fastdeploy).
+
+    Concurrent `submit()` calls enqueue single requests; a collector
+    thread coalesces up to ``max_batch`` of them (waiting at most
+    ``max_delay_ms`` once one is pending), stacks them into one bucketed
+    engine call, and resolves each request's Future with its own row.
+    """
+
+    def __init__(self, model, config: Optional[Config] = None,
+                 max_batch: int = 8, max_delay_ms: float = 2.0):
+        self.predictor = Predictor(model, config)
+        self.max_batch = max_batch
+        self.max_delay = max_delay_ms / 1e3
+        self._q: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def submit(self, *inputs) -> Future:
+        """One request (no batch dim on the inputs) -> Future of its
+        outputs (batch dim stripped)."""
+        if self._closed:
+            raise RuntimeError("BatchingPredictor is closed")
+        fut: Future = Future()
+        self._q.put((tuple(np.asarray(x) for x in inputs), fut))
+        return fut
+
+    def run(self, *inputs):
+        return self.submit(*inputs).result()
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            batch = [item]
+            deadline = time.monotonic() + self.max_delay
+            while len(batch) < self.max_batch:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=left)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._flush(batch)
+                    return
+                batch.append(nxt)
+            self._flush(batch)
+
+    def _flush(self, batch):
+        reqs = [r for r, _ in batch]
+        futs = [f for _, f in batch]
+        try:
+            stacked = tuple(np.stack([r[i] for r in reqs])
+                            for i in range(len(reqs[0])))
+            out = self.predictor.run(*stacked)
+            for i, fut in enumerate(futs):
+                fut.set_result(jax.tree.map(
+                    lambda o: o[i] if hasattr(o, "ndim") and o.ndim else o,
+                    out))
+        except BaseException as e:
+            for fut in futs:
+                if not fut.done():
+                    fut.set_exception(e)
+
+    def close(self):
+        self._closed = True
+        self._q.put(None)
+        self._worker.join(timeout=5)
+        # a submit() racing past the _closed check may have enqueued
+        # after the sentinel; its Future must fail, not hang forever
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None and not item[1].done():
+                item[1].set_exception(
+                    RuntimeError("BatchingPredictor closed before the "
+                                 "request was served"))
 
 
 def create_predictor(config: Config, model=None):

@@ -126,7 +126,7 @@ class LlamaAttention(Layer):
         return get_mesh().shape.get("sp", 1) if has_mesh() else 1
 
     def forward(self, x, positions, kv_cache: Optional[Tuple] = None,
-                cache_index=None, attn_mask=None):
+                cache_index=None, attn_mask=None, attn_start=None):
         cfg = self.config
         b, s, _ = x.shape
         q = self.q_proj(x).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
@@ -148,16 +148,26 @@ class LlamaAttention(Layer):
             cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
                                               (0, cache_index, 0, 0))
             new_cache = (ck, cv)
-            if s == 1:
+            if s == 1 and attn_start is None:
                 # single-token decode: Pallas masked-MHA kernel (GQA-
                 # native, no KV repeat) / grouped-einsum fallback
                 out = decode_attention(q, ck, cv, cache_index)
             else:
-                # prefill-with-cache: mask positions beyond cache_index+s
+                # prefill-with-cache (and left-padded serving batches):
+                # mask positions beyond cache_index+s; with attn_start,
+                # also mask each row's pad prefix out of the cache
                 total = ck.shape[1]
                 kpos = jnp.arange(total)[None, :]           # [1, T]
                 qpos = cache_index + jnp.arange(s)[:, None]  # [s, 1]
                 mask = (kpos <= qpos)[None, None]           # [1, 1, s, T]
+                if attn_start is not None:
+                    pad_ok = kpos[None] >= attn_start[:, None, None]
+                    # pad-prefix queries keep their own position: an
+                    # all-masked softmax row is NaN, and that NaN would
+                    # re-enter REAL rows in the next layer as 0 * NaN
+                    # through masked-out values
+                    self_ok = (kpos == qpos)[None]
+                    mask = mask & (pad_ok | self_ok)[:, None]  # [b,1,s,T]
                 out = dense_attention(q, ck, cv, attn_mask=mask)
         elif cfg.sequence_parallel and attn_mask is None and self._sp_degree() > 1:
             # ring attention: seq stays sp-sharded; KV blocks rotate on ICI
@@ -208,10 +218,10 @@ class LlamaDecoderLayer(Layer):
         self.mlp = LlamaMLP(config)
 
     def forward(self, x, positions, kv_cache=None, cache_index=None,
-                attn_mask=None):
+                attn_mask=None, attn_start=None):
         attn_out = self.self_attn(self.input_layernorm(x), positions,
                                   kv_cache=kv_cache, cache_index=cache_index,
-                                  attn_mask=attn_mask)
+                                  attn_mask=attn_mask, attn_start=attn_start)
         new_cache = None
         if kv_cache is not None:
             attn_out, new_cache = attn_out
@@ -237,11 +247,15 @@ class LlamaModel(Layer):
             self.to(dtype=config.dtype)
 
     def forward(self, input_ids, positions=None, kv_caches=None,
-                cache_index=None, attn_mask=None):
+                cache_index=None, attn_mask=None, attn_start=None):
         b, s = input_ids.shape
         if positions is None:
             start = cache_index if cache_index is not None else 0
             positions = start + jnp.arange(s)[None, :].repeat(b, axis=0)
+            if attn_start is not None:
+                # left-padded rows: RoPE position 0 sits at each row's
+                # first REAL token, not at the pad prefix
+                positions = jnp.maximum(positions - attn_start[:, None], 0)
         x = self.embed_tokens(input_ids)
         x = constraint(x, ("dp", "fsdp"), "sp", None)
         new_caches = [] if kv_caches is not None else None
@@ -254,7 +268,8 @@ class LlamaModel(Layer):
                     policy=POLICIES[self.config.recompute_policy])(x)
             else:
                 out = layer(x, positions, kv_cache=cache_i,
-                            cache_index=cache_index, attn_mask=attn_mask)
+                            cache_index=cache_index, attn_mask=attn_mask,
+                            attn_start=attn_start)
             if kv_caches is not None:
                 x, nc = out
                 new_caches.append(nc)
@@ -282,8 +297,9 @@ class LlamaForCausalLM(CausalLMBase):
         return llama_pipeline_functional(self, pp)
 
     def forward(self, input_ids, positions=None, kv_caches=None,
-                cache_index=None, attn_mask=None):
-        out = self.model(input_ids, positions, kv_caches, cache_index, attn_mask)
+                cache_index=None, attn_mask=None, attn_start=None):
+        out = self.model(input_ids, positions, kv_caches, cache_index,
+                         attn_mask, attn_start)
         caches = None
         if kv_caches is not None:
             out, caches = out

@@ -91,7 +91,7 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_index, scale,
     b, h, d = q.shape
     _, T, kv, _ = k_cache.shape
     group = h // kv
-    gp = max(8, group)  # sublane-align the group dim
+    gp = max(8, -(-group // 8) * 8)  # round UP to 8-sublane alignment
     bt = pick_block_t(T, block_t)
     assert bt, f"cache length {T} has no 128-multiple tile"
     nt = T // bt

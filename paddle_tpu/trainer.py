@@ -43,6 +43,18 @@ class TrainingArguments:
     seed: int = 42
     nan_patience: int = 3
     donate_state: bool = True
+    # elastic training (reference: paddle.distributed.elastic): a step
+    # that exceeds hang_timeout_s triggers best-effort checkpoint +
+    # process exit with hang_exit_code; a supervisor
+    # (distributed.elastic.supervise) relaunches and auto-resume picks
+    # up from the latest complete checkpoint.
+    hang_timeout_s: Optional[float] = None
+    hang_exit_code: int = 17
+    # on resume, fast-forward the data stream past the batches the
+    # checkpointed steps already consumed (reference: PaddleNLP Trainer's
+    # skip_first_batches / consumed_samples accounting) so the loss
+    # trajectory continues instead of re-seeing epoch-start data
+    skip_data_on_resume: bool = True
 
 
 class TrainerCallback:
@@ -76,7 +88,10 @@ class Trainer:
         self.eval_dataloader = eval_dataloader
         self.callbacks = callbacks or []
         self.logger = LogWriter(os.path.join(self.args.output_dir, "runs"))
-        self.watchdog = StepWatchdog(nan_patience=self.args.nan_patience)
+        self.watchdog = StepWatchdog(
+            nan_patience=self.args.nan_patience,
+            hang_timeout_s=self.args.hang_timeout_s,
+            on_hang=self._on_hang if self.args.hang_timeout_s else None)
         self._pure_fn, self._params = model.functional()
         self._opt_state = None
         self._step_fn = None
@@ -179,6 +194,8 @@ class Trainer:
 
         assert self.train_dataloader is not None, "pass train_dataloader"
         data = iter(self.train_dataloader)
+        if self.global_step and args.skip_data_on_resume:
+            data = self._skip_consumed(data, self.global_step)
         t_last = time.perf_counter()
         while self.global_step < max_steps:
             try:
@@ -192,6 +209,7 @@ class Trainer:
                               self._scaler_state, jnp.int32(self.global_step),
                               batch)
             self.global_step += 1
+            self.watchdog.beat()
             if self.global_step % args.logging_steps == 0 or \
                     self.global_step == max_steps:
                 loss_val = float(loss)
@@ -205,14 +223,37 @@ class Trainer:
                     cb.on_step_end(self.global_step, logs)
             if args.save_steps and self.global_step % args.save_steps == 0:
                 self.save_checkpoint()
+                self.watchdog.beat()  # a long save is not a hung step
             if args.eval_steps and self.eval_dataloader is not None and \
                     self.global_step % args.eval_steps == 0:
                 self.evaluate()
+                self.watchdog.beat()  # ditto a long eval
         for cb in self.callbacks:
             cb.on_train_end(self.global_step)
         # leave the module tree holding the trained weights
         self.model.bind(self._params)
         return self
+
+    def _skip_consumed(self, data, n: int):
+        """Advance the data iterator past ``n`` already-trained batches,
+        re-iterating at epoch boundaries."""
+        skip = n
+        while skip > 0:
+            got_any = False
+            try:
+                next(data)
+                got_any = True
+                skip -= 1
+            except StopIteration:
+                data = iter(self.train_dataloader)
+                try:
+                    next(data)
+                    skip -= 1
+                except StopIteration:
+                    if not got_any:
+                        raise RuntimeError("train_dataloader is empty; "
+                                           "cannot skip consumed batches")
+        return data
 
     def _prep_batch(self, batch):
         accum = self.args.gradient_accumulation_steps
@@ -250,6 +291,36 @@ class Trainer:
         ckpt.close()
         for cb in self.callbacks:
             cb.on_save(self.global_step)
+
+    def _on_hang(self):
+        """Monitor-thread path for a hung step (preempted chip, stuck
+        host callback): best-effort checkpoint, then hard-exit so the
+        elastic supervisor can relaunch. os._exit, not sys.exit — the
+        main thread is stuck and would never unwind."""
+        import sys
+        print(f"[watchdog] step hung > {self.args.hang_timeout_s}s at "
+              f"global_step={self.global_step}; checkpointing and exiting "
+              f"rc={self.args.hang_exit_code}", file=sys.stderr, flush=True)
+        # the save itself can wedge if the device is gone (device->host
+        # copies blocking, not raising) — give it a bounded side thread
+        # and exit regardless, or the detected hang becomes permanent
+        import threading
+
+        def _save():
+            try:
+                self.save_checkpoint(wait=True)
+            except Exception as e:
+                print(f"[watchdog] checkpoint during hang failed: {e}",
+                      file=sys.stderr, flush=True)
+
+        t = threading.Thread(target=_save, daemon=True)
+        t.start()
+        t.join(timeout=max(30.0, 2 * self.args.hang_timeout_s))
+        if t.is_alive():
+            print("[watchdog] checkpoint did not finish in time; exiting "
+                  "anyway (latest periodic checkpoint stands)",
+                  file=sys.stderr, flush=True)
+        os._exit(self.args.hang_exit_code)
 
     def _try_resume(self):
         from .checkpoint.distributed_ckpt import DistributedCheckpoint

@@ -32,6 +32,10 @@ class StepWatchdog:
         self._last_beat = time.monotonic()
         self._hang_timeout = hang_timeout_s
         self._on_hang = on_hang
+        # hang detection arms on the FIRST beat (= first completed step):
+        # the initial step includes jit compilation, which legitimately
+        # dwarfs any sane per-step timeout
+        self._armed = False
         self._monitor: Optional[threading.Thread] = None
         self._stop = threading.Event()
         if hang_timeout_s is not None:
@@ -53,6 +57,7 @@ class StepWatchdog:
 
     # ------------------------------------------------------------ heartbeat
     def beat(self):
+        self._armed = True
         self._last_beat = time.monotonic()
 
     def seconds_since_beat(self) -> float:
@@ -60,7 +65,7 @@ class StepWatchdog:
 
     def _watch(self):
         while not self._stop.wait(min(self._hang_timeout / 4, 30.0)):
-            if self.seconds_since_beat() > self._hang_timeout:
+            if self._armed and self.seconds_since_beat() > self._hang_timeout:
                 if self._on_hang is not None:
                     self._on_hang()
                 self._last_beat = time.monotonic()  # fire once per hang

@@ -130,3 +130,17 @@ def test_weight_only_linear_routes_to_kernel(bits):
         del os.environ["PADDLE_TPU_DISABLE_QUANT_KERNEL"]
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=1e-3, rtol=1e-3)
+
+def test_pallas_decode_group_not_multiple_of_8():
+    """GQA group 12 (h=24, kv=2): gp must round up to 16, not sit at 12."""
+    from paddle_tpu.ops.pallas.decode_attention import decode_attention_pallas
+    rs = np.random.RandomState(6)
+    b, T, h, kv, d = 1, 128, 24, 2, 64
+    q = jnp.asarray(rs.randn(b, h, d), jnp.float32)
+    ck = jnp.asarray(rs.randn(b, T, kv, d), jnp.float32)
+    cv = jnp.asarray(rs.randn(b, T, kv, d), jnp.float32)
+    got = decode_attention_pallas(q, ck, cv, jnp.int32(60),
+                                  scale=1.0 / np.sqrt(d), block_t=128)
+    ref = _dense_reference(q[:, None], ck, cv, jnp.int32(60))[:, 0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

@@ -1,0 +1,156 @@
+"""Elastic preemption end-to-end (VERDICT r2 item 7; reference:
+paddle.distributed.elastic). A real training subprocess is SIGKILLed
+mid-run, restarted, and the loss trajectory must continue from the
+latest complete checkpoint — plus the watchdog hang path: a stuck step
+checkpoints and exits with the elastic code, and the supervisor's
+relaunch finishes the run."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+TRAIN_SCRIPT = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", os.environ["PT_CACHE"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+import numpy as np
+import jax.numpy as jnp
+sys.path.insert(0, os.environ["PT_REPO"])
+import paddle_tpu as pt
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.trainer import Trainer, TrainingArguments
+
+pt.seed(0)
+model = LlamaForCausalLM(llama_tiny(
+    vocab_size=64, hidden_size=32, intermediate_size=64,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2))
+data = np.random.RandomState(7).randint(0, 64, (8, 4, 16))  # fixed batches
+
+class Loader:
+    def __iter__(self):
+        i = 0
+        while True:
+            if os.environ.get("PT_HANG_AT") and \
+                    i == int(os.environ["PT_HANG_AT"]) and \
+                    not os.path.exists(os.environ["PT_HANG_FLAG"]):
+                open(os.environ["PT_HANG_FLAG"], "w").write("x")
+                import time
+                time.sleep(3600)  # simulated stuck step (preempted chip)
+            yield jnp.asarray(data[i % 8])
+            i += 1
+
+args = TrainingArguments(
+    output_dir=os.environ["PT_OUT"], max_steps=30, logging_steps=1,
+    save_steps=5, donate_state=False,
+    hang_timeout_s=float(os.environ.get("PT_HANG_TIMEOUT", 0)) or None)
+tr = Trainer(model, pt.optimizer.AdamW(learning_rate=1e-3), args,
+             train_dataloader=Loader())
+tr.train()
+print("FINAL", tr.global_step, flush=True)
+"""
+
+
+def _losses(out_dir):
+    path = os.path.join(out_dir, "runs", "metrics.jsonl")
+    if not os.path.exists(path):
+        return {}
+    out = {}
+    with open(path) as f:
+        for line in f:
+            r = json.loads(line)
+            if r["tag"] == "loss":
+                out[r["step"]] = r["value"]
+    return out
+
+
+def _env(tmp_path, out, **extra):
+    env = dict(os.environ)
+    env.update(PT_REPO=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), PT_OUT=str(out),
+        PT_CACHE=str(tmp_path / "jaxcache"), JAX_PLATFORMS="cpu",
+        **{k: str(v) for k, v in extra.items()})
+    return env
+
+
+def test_kill_mid_run_then_resume_continues_trajectory(tmp_path):
+    out_killed = tmp_path / "killed"
+    # reference: uninterrupted run (also warms the compile cache)
+    out_ref = tmp_path / "ref"
+    subprocess.run([sys.executable, "-c", TRAIN_SCRIPT],
+                   env=_env(tmp_path, out_ref), check=True, timeout=90)
+    ref_losses = _losses(out_ref)
+    assert len(ref_losses) == 30
+
+    # run 1: SIGKILL once it logs step >= 12 (so ckpt@10 is complete)
+    proc = subprocess.Popen([sys.executable, "-c", TRAIN_SCRIPT],
+                            env=_env(tmp_path, out_killed))
+    deadline = time.time() + 80
+    try:
+        while time.time() < deadline:
+            if max(_losses(out_killed), default=0) >= 12:
+                break
+            time.sleep(0.3)
+        else:
+            pytest.fail("run never reached step 12")
+    finally:
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+    assert proc.returncode == -signal.SIGKILL
+
+    # run 2: restart; must RESUME (first logged step > 10), not restart
+    before = set(_losses(out_killed))
+    subprocess.run([sys.executable, "-c", TRAIN_SCRIPT],
+                   env=_env(tmp_path, out_killed), check=True, timeout=90)
+    after = _losses(out_killed)
+    resumed_steps = sorted(set(after) - before | {s for s in after if s > 12})
+    assert min(s for s in resumed_steps) > 10  # continued from ckpt@10
+    assert max(after) == 30
+
+    # trajectory continuity: deterministic data + same seed -> the
+    # resumed run's tail must match the uninterrupted reference closely
+    assert abs(after[30] - ref_losses[30]) < 1e-3, (after[30], ref_losses[30])
+
+
+def test_hang_checkpoints_exits_and_supervisor_finishes(tmp_path):
+    """Watchdog hang -> checkpoint + exit(hang_exit_code); elastic
+    supervisor relaunches; second attempt completes with continuity."""
+    from paddle_tpu.distributed.elastic import supervise
+    out = tmp_path / "hang"
+    flag = tmp_path / "hung_once"
+    env = _env(tmp_path, out, PT_HANG_AT=15, PT_HANG_FLAG=str(flag),
+               PT_HANG_TIMEOUT=3)
+
+    t0 = time.time()
+    import paddle_tpu.distributed.elastic as el
+    # drive subprocesses with the test env (supervise passes env through
+    # os.environ by default; use explicit Popen wrapper)
+    attempts = []
+    orig_run = el.subprocess.run
+
+    def run_with_env(argv, timeout=None):
+        attempts.append(1)
+        return orig_run(argv, env=env, timeout=timeout)
+    el.subprocess.run = run_with_env
+    try:
+        rc = supervise([sys.executable, "-c", TRAIN_SCRIPT],
+                       max_restarts=2, backoff_s=0.1, timeout_s=100)
+    finally:
+        el.subprocess.run = orig_run
+    assert rc == 0
+    assert len(attempts) == 2          # hung once, finished on relaunch
+    assert flag.exists()
+    losses = _losses(out)
+    assert max(losses) == 30
+    # the hang fired at data batch 15 (>= step 15): a checkpoint at or
+    # after step 15 must exist from the on-hang save
+    ckpts = os.listdir(os.path.join(out, "checkpoints"))
+    steps = [int(d) for d in ckpts if d.isdigit()]
+    assert steps and max(steps) >= 15, ckpts
+    assert time.time() - t0 < 110
