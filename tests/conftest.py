@@ -4,7 +4,13 @@ import os
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+    flags += " --xla_force_host_platform_device_count=8"
+if "xla_backend_optimization_level" not in flags:
+    # tests are compile-bound on this image's single CPU core; O0 cuts
+    # XLA:CPU compile ~2-3x and every numerics tolerance still holds
+    # (fast-math stays off). Production TPU compiles are untouched.
+    flags += " --xla_backend_optimization_level=0"
+os.environ["XLA_FLAGS"] = flags
 
 import jax
 
@@ -12,8 +18,96 @@ import jax
 # plain JAX_PLATFORMS env var is not enough here.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: many test files compile byte-identical
+# tiny-model programs in fresh closures; jit's in-process cache can't
+# dedupe those (different callables), the HLO-keyed persistent cache can —
+# both within one suite run and across runs/subprocess children.
+jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import numpy as np
 import pytest
+
+# ---------------------------------------------------------------- tiers
+# The heavy tier (see pytest.ini): exhaustive variants whose subsystem
+# keeps a fast representative in the default run. One central list, not
+# per-file markers, so the split stays reviewable.
+_HEAVY = (
+    # pipeline 1F1B: the tp+dp composition test subsumes these grad-match
+    # variants (same machinery, wider mesh)
+    "test_1f1b_matches_sequential[4-2]",
+    "test_1f1b_single_microbatch",
+    "test_trainer_pp_path_runs_and_learns",
+    # HF interop: llama logits parity + round-trip stay; the rest are
+    # per-family repeats of the same converter machinery
+    "test_hf_interop.py::test_llama_greedy_decode_matches",
+    "test_hf_interop.py::test_qwen2_logits_match",
+    "test_hf_interop.py::test_llama_tied_embeddings",
+    "test_hf_interop.py::test_bert_hidden_states_match",
+    "test_hf_interop.py::test_bert_pretraining_heads_load",
+    "test_hf_interop.py::test_ernie_mlm_logits_match",
+    "test_hf_interop.py::test_sharded_index_checkpoint",
+    # ring flash: forward parity (matches_full_attention) stays in the
+    # default tier; the interpret-mode backward is 2x the cost for the
+    # same kernel
+    "TestRingFlash::test_gradients_flow",
+    # elastic: kill/resume (the r2 deliverable) stays; the hang path is a
+    # second full subprocess cycle
+    "test_hang_checkpoints_exits_and_supervisor_finishes",
+    # dataloader: order/speedup/exception stay (each spawn pool costs
+    # seconds); these exercise secondary pool semantics
+    "test_get_worker_info_and_distribution",
+    "test_worker_init_fn_controls_rng",
+    "test_persistent_pool_reused",
+    "test_consumer_early_break_then_reuse",
+    "test_concurrent_iterators_rejected",
+    # model zoo: one overfit + one kv-decode parity per backbone family
+    # stays (gpt); qwen2/moe/bert/ernie reuse the identical Llama/Bert
+    # machinery verified elsewhere
+    "test_gpt_forward_and_overfit",
+    "test_qwen2_kv_cache_decode_parity",
+    "test_qwen2_moe_forward_aux_and_overfit",
+    "test_qwen2_moe_kv_cache_decode",
+    "test_bert_classifier_overfit",
+    # vision/diffusion/pipelines: shape/math smoke stays; grads + image
+    # pipelines are compile-heavy conv/attention repeats
+    "TestResNet::test_forward_and_grad",
+    "TestResNet::test_bottleneck_variant_d",
+    "TestCLIP::test_grad_through_both_towers",
+    "TestDiT::test_dit_grad",
+    "TestDiT::test_mmdit_joint_stream",
+    "TestVAE::test_roundtrip_shapes",
+    "TestPPOCR::test_svtr_ctc",
+    "TestPPOCR::test_dbnet_maps",
+    "TestLoopAndLoss::test_diffusion_loss_with_dit",
+    "TestDiTPipeline::test_vae_decode_stage",
+    "TestDiTPipeline::test_guidance_changes_output",
+    "TestSD3Pipeline::test_flow_sampling",
+    "TestPredictor::test_quantized_predictor",
+    # generation: beam internals stay via beam1==greedy; this reruns
+    # the whole beam program (sampling e2e stays default)
+    "test_beam_search_beats_greedy_logprob",
+    # second-tier variants added after the first timing pass: each line's
+    # subsystem keeps the named cheaper representative
+    "test_1f1b_matches_sequential[2-1]",   # <- compose_with_tp_dp
+    "test_dead_worker_raises_not_hangs",   # <- worker_exception_propagates
+    "TestVAE::test_kl_and_loss",           # <- vae sample_stochastic
+    "test_text_pipeline.py::test_pipeline_bucket_reuse",  # <- left_padded
+    "test_text_pipeline.py::test_pipeline_single_and_batch",
+    # decode kernels: keep a diagonal of the parametrized cross-product
+    "test_decode_dispatch_matches_dense[5-",
+    "test_decode_dispatch_matches_dense[127-",
+    "test_decode_dispatch_matches_dense[200-",
+    "test_pallas_decode_kernel_matches_dense[100-",
+    # trainer/llama: exhaustive repeats of the jitted-step machinery
+    "test_grad_accumulation_matches_big_batch",
+)
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        if any(key in item.nodeid for key in _HEAVY):
+            item.add_marker(pytest.mark.heavy)
 
 
 @pytest.fixture(autouse=True)

@@ -19,12 +19,20 @@ def tiny():
 
 
 def _greedy_reference(model, ids, n_new):
-    """Decode by rerunning the full forward each step (no cache)."""
-    for _ in range(n_new):
-        logits = model(ids)
-        nxt = jnp.argmax(logits[:, -1], axis=-1)
-        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
-    return ids
+    """Decode by rerunning the full forward each step (no cache). Runs on
+    a fixed-width buffer so ALL steps share one compiled forward — the
+    causal mask makes logits at filled positions independent of the
+    zero tail (growing shapes would recompile every step)."""
+    fn, params = model.functional()
+    fwd = jax.jit(fn)
+    b, s0 = ids.shape
+    buf = jnp.concatenate(
+        [ids, jnp.zeros((b, n_new), ids.dtype)], axis=1)
+    for i in range(n_new):
+        logits = fwd(params, buf)
+        nxt = jnp.argmax(logits[:, s0 + i - 1], axis=-1)
+        buf = buf.at[:, s0 + i].set(nxt)
+    return buf
 
 
 def test_greedy_matches_full_forward(tiny):

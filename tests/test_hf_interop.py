@@ -53,6 +53,11 @@ def test_llama_logits_match(tmp_module):
 
 def test_llama_greedy_decode_matches(tmp_module):
     d = str(tmp_module / "llama")
+    if not os.path.exists(os.path.join(d, "config.json")):
+        # self-sufficient when run alone (e.g. the heavy tier): the
+        # logits test normally creates this checkpoint first
+        _save_hf(tmp_module / "llama", transformers.LlamaForCausalLM,
+                 _llama_cfg())
     hf_model = transformers.LlamaForCausalLM.from_pretrained(d)
     model = from_pretrained(d)
     ids = np.random.randint(0, 128, (1, 8))

@@ -197,8 +197,10 @@ class TestRingFlash:
         from paddle_tpu.parallel.ring import ring_flash_attention
         from paddle_tpu.ops.attention import dense_attention
 
-        n = 8
-        B, S, H, D = 1, 8 * 128, 2, 32
+        # interpret-mode pallas is slow: 4 shards x 128 is the smallest
+        # shape that still tiles the kernel and rotates a real ring
+        n = 4
+        B, S, H, D = 1, 4 * 128, 1, 32
         q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D))
         k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D))
         v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, D))
@@ -222,8 +224,8 @@ class TestRingFlash:
         from paddle_tpu.parallel.ring import ring_flash_attention
         from paddle_tpu.ops.attention import dense_attention
 
-        n = 4
-        B, S, H, D = 1, 4 * 128, 2, 32
+        n = 2
+        B, S, H, D = 1, 2 * 128, 1, 32
         q = jax.random.normal(jax.random.PRNGKey(3), (B, S, H, D))
         k = jax.random.normal(jax.random.PRNGKey(4), (B, S, H, D))
         v = jax.random.normal(jax.random.PRNGKey(5), (B, S, H, D))
