@@ -38,6 +38,12 @@ def stop_gradient(x):
 
 
 from . import amp  # noqa: E402
+from . import autograd  # noqa: E402
+from . import distribution  # noqa: E402
+from . import fft  # noqa: E402
+from . import linalg  # noqa: E402
+from . import signal  # noqa: E402
+from . import tokenizer  # noqa: E402
 from . import distributed  # noqa: E402
 from . import io  # noqa: E402
 from . import jit  # noqa: E402

@@ -1,0 +1,159 @@
+"""paddle.autograd parity (reference: python/paddle/autograd/ — grad,
+functional jacobian/hessian/vjp/jvp, and PyLayer custom ops).
+
+TPU-native: autograd IS jax's functional transforms, so these are thin
+adapters with paddle's calling conventions. ``PyLayer`` (the custom
+forward/backward op API) maps onto ``jax.custom_vjp`` — the backward you
+write is the VJP rule XLA differentiates through.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["grad", "jacobian", "hessian", "vjp", "jvp", "PyLayer",
+           "no_grad"]
+
+
+def grad(outputs, inputs, grad_outputs=None, create_graph=False,
+         retain_graph=None, allow_unused=False):
+    """Differentiate ``outputs = fn(inputs)`` the paddle way is not
+    expressible without the graph; the functional form is
+    ``grad(fn)(inputs)``. This adapter accepts a CALLABLE as ``outputs``
+    (the idiomatic migration: pass the fn, not a traced tensor) and
+    returns gradients w.r.t. ``inputs``."""
+    if not callable(outputs):
+        raise TypeError(
+            "paddle_tpu.autograd.grad takes the loss FUNCTION, not a "
+            "tensor: autograd here is functional (jax). Migrate "
+            "`paddle.grad(loss, xs)` to `autograd.grad(loss_fn, xs)`.")
+    fn = outputs
+    single = not isinstance(inputs, (tuple, list))
+    xs = (inputs,) if single else tuple(inputs)
+    if grad_outputs is None:
+        g = jax.grad(lambda *a: jnp.sum(fn(*a)), argnums=tuple(range(len(xs))))(*xs)
+    else:
+        _, pull = jax.vjp(fn, *xs)
+        g = pull(grad_outputs)
+    return g[0] if single else list(g)
+
+
+def jacobian(func: Callable, xs, batch_axis: Optional[int] = None):
+    """paddle.autograd.jacobian: reverse-mode rows (jacrev)."""
+    if not isinstance(xs, (tuple, list)):
+        return jax.jacrev(func)(xs)
+    args = tuple(xs)
+    return list(jax.jacrev(func, argnums=tuple(range(len(args))))(*args))
+
+
+def hessian(func: Callable, xs, batch_axis: Optional[int] = None):
+    if not isinstance(xs, (tuple, list)):
+        return jax.hessian(func)(xs)
+    args = tuple(xs)
+    return list(jax.hessian(func, argnums=tuple(range(len(args))))(*args))
+
+
+def vjp(func: Callable, xs, v=None):
+    """(outputs, vjp_result) — paddle.incubate.autograd.vjp signature."""
+    single = not isinstance(xs, (tuple, list))
+    args = (xs,) if single else tuple(xs)
+    out, pull = jax.vjp(func, *args)
+    if v is None:
+        v = jax.tree.map(jnp.ones_like, out)
+    g = pull(v)
+    return out, (g[0] if single else list(g))
+
+
+def jvp(func: Callable, xs, v=None):
+    single = not isinstance(xs, (tuple, list))
+    args = (xs,) if single else tuple(xs)
+    if v is None:
+        tangents = jax.tree.map(jnp.ones_like, args)
+    else:
+        tangents = (v,) if single else tuple(v)
+    out, t = jax.jvp(func, args, tangents)
+    return out, t
+
+
+class _PyLayerContext:
+    """ctx object passed to forward/backward (save_for_backward parity)."""
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+        if name == "PyLayer" or "forward" not in ns:
+            return
+
+        @jax.custom_vjp
+        def op(*args):
+            ctx = _PyLayerContext()
+            return cls.forward(ctx, *args)
+
+        def fwd(*args):
+            ctx = _PyLayerContext()
+            out = cls.forward(ctx, *args)
+            return out, (ctx._saved, args)
+
+        def bwd(res, g):
+            import numpy as _np
+            ctx = _PyLayerContext()
+            ctx._saved = res[0]
+            grads = cls.backward(ctx, g)
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            # pad Nones (non-differentiable args): float args get zeros,
+            # integer args need float0 cotangents (custom_vjp contract)
+            args = res[1]
+            full = []
+            for i, a in enumerate(args):
+                gi = grads[i] if i < len(grads) else None
+                if gi is None:
+                    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) \
+                            or jnp.issubdtype(jnp.asarray(a).dtype,
+                                              jnp.complexfloating):
+                        gi = jnp.zeros_like(a)
+                    else:
+                        gi = _np.zeros(jnp.shape(a), jax.dtypes.float0)
+                full.append(gi)
+            return tuple(full)
+
+        op.defvjp(fwd, bwd)
+        cls._op = op
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom op with hand-written backward (reference:
+    paddle.autograd.PyLayer). Subclass with @staticmethod forward(ctx, *x)
+    and backward(ctx, grad); call via ``MyOp.apply(*x)``. Lowers to
+    ``jax.custom_vjp`` — fully jittable and composable with the rest of
+    the autograd stack."""
+
+    @classmethod
+    def apply(cls, *args):
+        return cls._op(*args)
+
+
+class no_grad:
+    """Context/decorator parity: gradients only flow through jax.grad
+    traces, so eager code is already grad-free; this is a no-op marker."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __call__(self, fn):
+        return fn

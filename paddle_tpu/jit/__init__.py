@@ -17,6 +17,7 @@ import functools
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 
 from ..nn.layer import Layer
 
@@ -105,3 +106,47 @@ def load(path: str):
 
 def ignore_module(modules):  # paddle API parity; nothing to ignore under jax
     return None
+
+
+def save_inference_model(path_prefix: str, layer, *example_inputs):
+    """Deployable bundle = serialized StableHLO program + weights
+    (reference: paddle.static.save_inference_model — program .pdmodel +
+    params .pdiparams). The exported artifact replays WITHOUT the model
+    class: ``load_inference_model`` returns a plain callable.
+
+    Layout: ``<prefix>.jaxir`` (jax.export serialization of
+    fn(params, *inputs)) + ``<prefix>.pdiparams`` (npz state_dict).
+    Buffers (e.g. BatchNorm running stats) are traced as constants —
+    frozen into the program, exactly the inference semantics.
+    """
+    import numpy as np
+
+    from jax import export as jax_export
+
+    fn, params = layer.functional()
+    # export records the exact pytree type of args[0]; serialize a plain
+    # dict so load-time invocation (which builds a dict from npz) matches
+    exported = jax_export.export(jax.jit(fn))(dict(params), *example_inputs)
+    with open(path_prefix + ".jaxir", "wb") as f:
+        f.write(exported.serialize())
+    host = {k: np.asarray(v) for k, v in params.items()}
+    np.savez(path_prefix + ".pdiparams", **host)
+    return path_prefix
+
+
+def load_inference_model(path_prefix: str):
+    """Load a save_inference_model bundle -> ``predict(*inputs)`` with the
+    weights baked in (params re-materialized on device at first call)."""
+    import numpy as np
+
+    from jax import export as jax_export
+
+    with open(path_prefix + ".jaxir", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with np.load(path_prefix + ".pdiparams.npz") as z:
+        params = {k: jnp.asarray(z[k]) for k in z.files}
+
+    def predict(*inputs):
+        return exported.call(params, *inputs)
+
+    return predict

@@ -1,0 +1,195 @@
+"""paddle.linalg parity (reference: python/paddle/tensor/linalg.py — the
+PHI linalg kernels: cholesky/svd/qr/eig/solve/lstsq/...).
+
+TPU-native: thin delegates to jnp.linalg/lax.linalg with paddle's
+signatures and semantics quirks (e.g. ``norm``'s fro default, ``cond``'s
+p conventions, matmul aliasing). Decompositions lower to XLA's custom
+calls — batched and differentiable where jax supports it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "matmul", "norm", "cond", "cov", "corrcoef", "cholesky",
+    "cholesky_solve", "svd", "svdvals", "qr", "eig", "eigh", "eigvals",
+    "eigvalsh", "inv", "pinv", "det", "slogdet", "solve",
+    "triangular_solve", "lstsq", "lu", "lu_unpack", "matrix_power",
+    "matrix_rank", "multi_dot", "matrix_transpose", "dot", "cross",
+    "bmm", "histogram",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return x @ y
+
+
+def matrix_transpose(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def cross(x, y, axis=None):
+    return jnp.cross(x, y, axis=-1 if axis is None else axis)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def norm(x, p=None, axis=None, keepdim=False):
+    """paddle.linalg.norm: p=None -> fro over all dims (matrix) / l2.
+    axis=None reduces ALL dims; keepdim then keeps every dim at 1
+    (paddle semantics — result broadcasts against x)."""
+    if p is None:
+        p = "fro" if axis is None and x.ndim >= 2 else 2
+    if axis is None:
+        out = (jnp.sqrt(jnp.sum(jnp.square(x))) if p == "fro"
+               else jnp.linalg.norm(x.reshape(-1), ord=p))
+        return out.reshape((1,) * x.ndim) if keepdim else out
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=int(bool(ddof)),
+                   fweights=fweights, aweights=aweights)
+
+
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+def cholesky_solve(x, y, upper=False):
+    """Solve A X = B given y = chol factor of A; paddle arg order (B, L)."""
+    import jax.scipy.linalg as jsl
+    return jsl.cho_solve((y, not upper), x)
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def svdvals(x):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def eig(x):
+    return jnp.linalg.eig(x)
+
+
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    # paddle returns stacked [sign, logabsdet]
+    return jnp.stack([sign, logabs])
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    import jax.scipy.linalg as jsl
+    return jsl.solve_triangular(x, y, lower=not upper,
+                                trans=1 if transpose else 0,
+                                unit_diagonal=unitriangular)
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def lu(x, pivot=True):
+    import jax.scipy.linalg as jsl
+    lu_mat, piv = jsl.lu_factor(x)
+    return lu_mat, piv + 1  # paddle pivots are 1-based (LAPACK style)
+
+
+def lu_unpack(lu_mat, pivots, unpack_ludata=True, unpack_pivots=True):
+    n = lu_mat.shape[-2]
+    L = jnp.tril(lu_mat, -1) + jnp.eye(n, lu_mat.shape[-1],
+                                       dtype=lu_mat.dtype)
+    U = jnp.triu(lu_mat)
+    # replay the LAPACK row swaps as a scan (jittable, no host loop)
+    def swap(pm, ip):
+        i, p = ip
+        a, b = pm[i], pm[p]
+        return pm.at[i].set(b).at[p].set(a), None
+    idx = jnp.arange(pivots.shape[-1])
+    perm, _ = jax.lax.scan(swap, jnp.arange(n), (idx, pivots - 1))
+    P = jnp.eye(n, dtype=lu_mat.dtype)[perm]
+    return P.T, L, U
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    """paddle semantics: ``tol`` is an ABSOLUTE threshold on singular
+    values (eigenvalue magnitudes when hermitian)."""
+    sv = (jnp.abs(jnp.linalg.eigvalsh(x)) if hermitian
+          else jnp.linalg.svd(x, compute_uv=False))
+    if tol is None:
+        eps = jnp.finfo(x.dtype).eps
+        tol = jnp.max(sv, axis=-1) * max(x.shape[-2:]) * eps
+    return jnp.sum(sv > tol, axis=-1)
+
+
+def multi_dot(mats):
+    return jnp.linalg.multi_dot(mats)
+
+
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi), weights=weight,
+                            density=density)
+    return hist

@@ -1,0 +1,101 @@
+"""paddle.signal parity (reference: python/paddle/signal.py — frame/
+overlap_add/stft/istft on the PHI fft kernels).
+
+TPU-native: frame extraction is a strided gather XLA vectorizes;
+stft = frame -> window -> rfft batched over frames (one fused program,
+no Python loop over hops).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length: int, hop_length: int, axis=-1):
+    """Split the last axis into overlapping frames
+    [..., n_frames, frame_length] (paddle puts frames on axis=-1 by
+    default with shape [..., frame_length, n_frames])."""
+    n = x.shape[-1]
+    if n < frame_length:
+        raise ValueError(
+            f"signal length {n} < frame_length {frame_length}")
+    n_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    frames = x[..., idx]                       # [..., n_frames, frame_len]
+    if axis == -1:
+        return jnp.swapaxes(frames, -1, -2)    # [..., frame_len, n_frames]
+    return frames
+
+
+def overlap_add(x, hop_length: int, axis=-1):
+    """Inverse of frame: x [..., frame_length, n_frames] -> [..., n]."""
+    if axis == -1:
+        x = jnp.swapaxes(x, -1, -2)            # [..., n_frames, frame_len]
+    *lead, n_frames, frame_length = x.shape
+    n = (n_frames - 1) * hop_length + frame_length
+    out = jnp.zeros((*lead, n), x.dtype)
+    idx = (jnp.arange(n_frames) * hop_length)[:, None] \
+        + jnp.arange(frame_length)[None, :]
+    return out.at[..., idx].add(x)
+
+
+def stft(x, n_fft: int, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True):
+    """x [..., n] -> complex [..., n_fft//2+1 (or n_fft), n_frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), x.dtype)
+    if win_length < n_fft:  # center-pad the window to n_fft (torch/paddle)
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    frames = frame(x, n_fft, hop_length, axis=-1)   # [..., n_fft, n_frames]
+    frames = jnp.swapaxes(frames, -1, -2) * window  # [..., n_frames, n_fft]
+    spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+            else jnp.fft.fft(frames, axis=-1))
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return jnp.swapaxes(spec, -1, -2)               # [..., freq, n_frames]
+
+
+def istft(x, n_fft: int, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False):
+    """Inverse stft with window-envelope normalization (COLA division)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    if return_complex and onesided:
+        raise ValueError("return_complex requires onesided=False (a "
+                         "onesided spectrum encodes a real signal)")
+    spec = jnp.swapaxes(x, -1, -2)                  # [..., n_frames, freq]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, axis=-1)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * window
+    sig = overlap_add(jnp.swapaxes(frames, -1, -2), hop_length, axis=-1)
+    env = overlap_add(
+        jnp.broadcast_to((window * window)[:, None],
+                         (n_fft, x.shape[-1])), hop_length, axis=-1)
+    sig = sig / jnp.maximum(env, 1e-11)
+    if center:
+        pad = n_fft // 2
+        sig = sig[..., pad:sig.shape[-1] - pad]
+    if length is not None:
+        sig = sig[..., :length]
+    return sig

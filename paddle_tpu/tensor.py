@@ -668,20 +668,8 @@ def bitwise_not(x):
     return jnp.bitwise_not(x)
 
 
-# ----------------------------------------------------------------- linalg
-class linalg:
-    norm = staticmethod(jnp.linalg.norm)
-    inv = staticmethod(jnp.linalg.inv)
-    det = staticmethod(jnp.linalg.det)
-    svd = staticmethod(jnp.linalg.svd)
-    qr = staticmethod(jnp.linalg.qr)
-    eigh = staticmethod(jnp.linalg.eigh)
-    cholesky = staticmethod(jnp.linalg.cholesky)
-    solve = staticmethod(jnp.linalg.solve)
-    matrix_rank = staticmethod(jnp.linalg.matrix_rank)
-    pinv = staticmethod(jnp.linalg.pinv)
-
-
+# linalg lives in paddle_tpu/linalg.py (the full paddle.linalg surface);
+# the flat-namespace norm below stays for paddle.norm parity.
 def norm(x, p=2, axis=None, keepdim=False):
     if p == "fro" or (p == 2 and axis is None):
         return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))

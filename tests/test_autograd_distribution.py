@@ -1,0 +1,179 @@
+"""paddle.autograd + paddle.distribution parity (reference:
+python/paddle/autograd/, python/paddle/distribution/) — PyLayer lowers
+to jax.custom_vjp; distributions check against scipy/torch moments."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import autograd, distribution as D
+
+
+class TestAutograd:
+    def test_grad_of_function(self):
+        g = autograd.grad(lambda x: jnp.sum(x ** 3), jnp.asarray([1.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(g), [3.0, 12.0], rtol=1e-6)
+
+    def test_grad_rejects_tensor(self):
+        with pytest.raises(TypeError, match="functional"):
+            autograd.grad(jnp.ones(3), jnp.ones(3))
+
+    def test_jacobian_hessian(self):
+        f = lambda x: jnp.asarray([x[0] ** 2, x[0] * x[1]])  # noqa: E731
+        x = jnp.asarray([2.0, 3.0])
+        J = np.asarray(autograd.jacobian(f, x))
+        np.testing.assert_allclose(J, [[4.0, 0.0], [3.0, 2.0]], rtol=1e-6)
+        H = np.asarray(autograd.hessian(lambda x: jnp.sum(x ** 3), x))
+        np.testing.assert_allclose(H, np.diag([12.0, 18.0]), rtol=1e-6)
+
+    def test_vjp_jvp(self):
+        f = lambda x: x ** 2  # noqa: E731
+        x = jnp.asarray([1.0, 2.0])
+        out, g = autograd.vjp(f, x, v=jnp.asarray([1.0, 1.0]))
+        np.testing.assert_allclose(np.asarray(g), [2.0, 4.0], rtol=1e-6)
+        out, t = autograd.jvp(f, x, v=jnp.asarray([1.0, 0.0]))
+        np.testing.assert_allclose(np.asarray(t), [2.0, 0.0], rtol=1e-6)
+
+    def test_pylayer_custom_backward(self):
+        class ScaledTanh(autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x, k):
+                y = jnp.tanh(k * x)
+                ctx.save_for_backward(y, k)
+                return y
+
+            @staticmethod
+            def backward(ctx, grad):
+                y, k = ctx.saved_tensor()
+                return grad * k * (1 - y ** 2), None  # no grad for k
+
+        x = jnp.asarray([0.3, -0.7])
+        k = jnp.asarray(2.0)
+        out = ScaledTanh.apply(x, k)
+        np.testing.assert_allclose(np.asarray(out), np.tanh(2 * np.asarray(x)),
+                                   rtol=1e-6)
+        g = jax.grad(lambda x: jnp.sum(ScaledTanh.apply(x, k)))(x)
+        ref = 2 * (1 - np.tanh(2 * np.asarray(x)) ** 2)
+        np.testing.assert_allclose(np.asarray(g), ref, rtol=1e-5)
+
+    def test_pylayer_wrong_backward_is_respected(self):
+        """The custom vjp REPLACES the real one (that's the point)."""
+        class DoubleButClaimTriple(autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return 2 * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                return 3 * grad
+
+        g = jax.grad(lambda x: jnp.sum(DoubleButClaimTriple.apply(x)))(
+            jnp.ones(2))
+        np.testing.assert_allclose(np.asarray(g), [3.0, 3.0])
+
+    def test_pylayer_jittable(self):
+        class Sq(autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return 2 * x * grad
+
+        f = jax.jit(jax.grad(lambda x: jnp.sum(Sq.apply(x))))
+        np.testing.assert_allclose(np.asarray(f(jnp.asarray([3.0]))), [6.0])
+
+
+class TestDistributions:
+    def test_normal_moments_logprob_kl(self):
+        p = D.Normal(1.0, 2.0)
+        q = D.Normal(0.0, 1.0)
+        x = jnp.asarray([0.5, 1.0, 3.0])
+        ref = -((np.asarray(x) - 1) ** 2) / 8 - math.log(2) \
+            - 0.5 * math.log(2 * math.pi)
+        np.testing.assert_allclose(np.asarray(p.log_prob(x)), ref, rtol=1e-5)
+        kl = float(D.kl_divergence(p, q))
+        ref_kl = 0.5 * (4 + 1 - 1 - math.log(4))
+        np.testing.assert_allclose(kl, ref_kl, rtol=1e-5)
+        s = p.sample((20000,), key=jax.random.key(0))
+        assert abs(float(jnp.mean(s)) - 1.0) < 0.05
+        assert abs(float(jnp.std(s)) - 2.0) < 0.05
+
+    def test_rsample_differentiable(self):
+        def f(mu):
+            return jnp.mean(D.Normal(mu, 1.0).rsample((1000,),
+                                                      key=jax.random.key(1)))
+        g = float(jax.grad(f)(jnp.float32(0.0)))
+        assert abs(g - 1.0) < 1e-4  # d mean / d mu == 1 exactly
+
+    def test_categorical_and_bernoulli(self):
+        c = D.Categorical(logits=jnp.log(jnp.asarray([0.2, 0.3, 0.5])))
+        np.testing.assert_allclose(np.asarray(c.probs), [0.2, 0.3, 0.5],
+                                   rtol=1e-5)
+        lp = float(c.log_prob(jnp.asarray(2)))
+        np.testing.assert_allclose(lp, math.log(0.5), rtol=1e-5)
+        ent = float(c.entropy())
+        ref = -(0.2 * math.log(0.2) + 0.3 * math.log(0.3) + 0.5 * math.log(0.5))
+        np.testing.assert_allclose(ent, ref, rtol=1e-5)
+        b = D.Bernoulli(0.3)
+        np.testing.assert_allclose(float(b.log_prob(jnp.asarray(1.0))),
+                                   math.log(0.3), rtol=1e-4)
+
+    def test_beta_gamma_dirichlet_exponential_laplace(self):
+        sp = pytest.importorskip("scipy.stats")
+        x = 0.4
+        np.testing.assert_allclose(
+            float(D.Beta(2.0, 3.0).log_prob(jnp.asarray(x))),
+            sp.beta.logpdf(x, 2, 3), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(D.Gamma(2.0, 3.0).log_prob(jnp.asarray(x))),
+            sp.gamma.logpdf(x, 2, scale=1 / 3), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(D.Exponential(1.5).log_prob(jnp.asarray(x))),
+            sp.expon.logpdf(x, scale=1 / 1.5), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(D.Laplace(0.0, 2.0).log_prob(jnp.asarray(x))),
+            sp.laplace.logpdf(x, scale=2), rtol=1e-4)
+        conc = jnp.asarray([1.0, 2.0, 3.0])
+        v = jnp.asarray([0.2, 0.3, 0.5])
+        np.testing.assert_allclose(
+            float(D.Dirichlet(conc).log_prob(v)),
+            sp.dirichlet.logpdf(np.asarray(v), np.asarray(conc)), rtol=1e-4)
+
+    def test_kl_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(0, 1), D.Laplace(0, 1))
+
+    def test_sampling_in_jit(self):
+        @jax.jit
+        def draw(key):
+            return D.Normal(0.0, 1.0).sample((4,), key=key)
+        out = draw(jax.random.key(2))
+        assert out.shape == (4,)
+
+def test_pylayer_integer_arg_nondiff():
+    """None grad for an int32 arg must produce a float0 cotangent, not an
+    int zeros array (custom_vjp contract)."""
+    from paddle_tpu import autograd
+
+    class Gather(autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x, idx):
+            ctx.save_for_backward(idx, x.shape[0])
+            return x[idx]
+
+        @staticmethod
+        def backward(ctx, grad):
+            idx, n = ctx.saved_tensor()
+            return jnp.zeros((n,) + grad.shape[1:], grad.dtype).at[idx].add(
+                grad), None
+
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    idx = jnp.asarray([2, 0], jnp.int32)
+    g = jax.grad(lambda x: jnp.sum(Gather.apply(x, idx)))(x)
+    np.testing.assert_allclose(np.asarray(g), [1.0, 0.0, 1.0])

@@ -37,6 +37,9 @@ class Loader:
     def __iter__(self):
         i = 0
         while True:
+            if os.environ.get("PT_STEP_DELAY"):
+                import time
+                time.sleep(float(os.environ["PT_STEP_DELAY"]))
             if os.environ.get("PT_HANG_AT") and \
                     i == int(os.environ["PT_HANG_AT"]) and \
                     not os.path.exists(os.environ["PT_HANG_FLAG"]):
@@ -90,9 +93,13 @@ def test_kill_mid_run_then_resume_continues_trajectory(tmp_path):
     ref_losses = _losses(out_ref)
     assert len(ref_losses) == 20
 
-    # run 1: SIGKILL once it logs step >= 12 (so ckpt@10 is complete)
+    # run 1: SIGKILL once it logs step >= 8 (so ckpt@5 is complete).
+    # PT_STEP_DELAY keeps the run slow enough that (with the compile
+    # cache warm from the reference run) it cannot race to step 20
+    # before the kill lands — the resume assertions must not pass
+    # vacuously against a completed run.
     proc = subprocess.Popen([sys.executable, "-c", TRAIN_SCRIPT],
-                            env=_env(out_killed))
+                            env=_env(out_killed, PT_STEP_DELAY="0.25"))
     deadline = time.time() + 80
     try:
         while time.time() < deadline:
@@ -105,6 +112,8 @@ def test_kill_mid_run_then_resume_continues_trajectory(tmp_path):
         os.kill(proc.pid, signal.SIGKILL)
         proc.wait()
     assert proc.returncode == -signal.SIGKILL
+    killed_at = max(_losses(out_killed), default=0)
+    assert killed_at < 20, "run finished before the kill; nothing resumed"
 
     # run 2: restart; must RESUME (first logged step > 10), not restart
     before = set(_losses(out_killed))

@@ -1,0 +1,181 @@
+"""paddle.linalg / paddle.fft / paddle.signal parity (reference:
+python/paddle/tensor/linalg.py, python/paddle/fft.py,
+python/paddle/signal.py) — numerics vs numpy/scipy/torch-cpu, plus the
+save_inference_model deployment bundle (paddle.static parity)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu import fft, linalg, signal
+
+
+class TestLinalg:
+    def setup_method(self):
+        self.rs = np.random.RandomState(0)
+
+    def _spd(self, n=6):
+        a = self.rs.randn(n, n)
+        return a @ a.T + n * np.eye(n)
+
+    def test_matmul_transpose_flags(self):
+        a, b = self.rs.randn(3, 4), self.rs.randn(3, 5)
+        np.testing.assert_allclose(
+            np.asarray(linalg.matmul(jnp.asarray(a), jnp.asarray(b),
+                                     transpose_x=True)),
+            a.T @ b, rtol=1e-5, atol=1e-5)
+
+    def test_norm_modes(self):
+        x = self.rs.randn(4, 5)
+        np.testing.assert_allclose(
+            float(linalg.norm(jnp.asarray(x))), np.linalg.norm(x), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(linalg.norm(jnp.asarray(x), p=2, axis=1)),
+            np.linalg.norm(x, ord=2, axis=1), rtol=1e-6)
+
+    def test_cholesky_and_solve(self):
+        a = self._spd()
+        b = self.rs.randn(6, 2)
+        L = np.asarray(linalg.cholesky(jnp.asarray(a)))
+        np.testing.assert_allclose(L @ L.T, a, rtol=1e-5, atol=1e-6)
+        x = np.asarray(linalg.solve(jnp.asarray(a), jnp.asarray(b)))
+        np.testing.assert_allclose(a @ x, b, rtol=1e-5, atol=1e-6)
+        x2 = np.asarray(linalg.cholesky_solve(jnp.asarray(b), jnp.asarray(L)))
+        np.testing.assert_allclose(a @ x2, b, rtol=1e-4, atol=1e-5)
+
+    def test_svd_qr_eigh(self):
+        a = self.rs.randn(5, 3)
+        u, s, vt = (np.asarray(t) for t in linalg.svd(jnp.asarray(a)))
+        np.testing.assert_allclose(u @ np.diag(s) @ vt, a, rtol=1e-5,
+                                   atol=1e-6)
+        q, r = (np.asarray(t) for t in linalg.qr(jnp.asarray(a)))
+        np.testing.assert_allclose(q @ r, a, rtol=1e-5, atol=1e-6)
+        spd = self._spd()
+        w, v = (np.asarray(t) for t in linalg.eigh(jnp.asarray(spd)))
+        np.testing.assert_allclose(v @ np.diag(w) @ v.T, spd, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_det_slogdet_inv_pinv(self):
+        a = self._spd(4)
+        np.testing.assert_allclose(float(linalg.det(jnp.asarray(a))),
+                                   np.linalg.det(a), rtol=1e-5)
+        sld = np.asarray(linalg.slogdet(jnp.asarray(a)))
+        np.testing.assert_allclose(sld[0] * np.exp(sld[1]),
+                                   np.linalg.det(a), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(linalg.inv(jnp.asarray(a))) @ a, np.eye(4),
+            atol=1e-5)
+        rect = self.rs.randn(5, 3)
+        np.testing.assert_allclose(
+            np.asarray(linalg.pinv(jnp.asarray(rect))),
+            np.linalg.pinv(rect), rtol=1e-4, atol=1e-5)
+
+    def test_lstsq_triangular_lu(self):
+        a, b = self.rs.randn(6, 3), self.rs.randn(6, 2)
+        sol = np.asarray(linalg.lstsq(jnp.asarray(a), jnp.asarray(b))[0])
+        np.testing.assert_allclose(sol, np.linalg.lstsq(a, b, rcond=None)[0],
+                                   rtol=1e-4, atol=1e-5)
+        spd = self._spd(5)
+        U = np.triu(spd)
+        y = self.rs.randn(5, 2)
+        x = np.asarray(linalg.triangular_solve(jnp.asarray(U),
+                                               jnp.asarray(y)))
+        np.testing.assert_allclose(U @ x, y, rtol=1e-5, atol=1e-6)
+        lu_mat, piv = linalg.lu(jnp.asarray(spd))
+        P, L, Umat = (np.asarray(t) for t in linalg.lu_unpack(lu_mat, piv))
+        np.testing.assert_allclose(P @ L @ Umat, spd, rtol=1e-5, atol=1e-5)
+
+    def test_matrix_power_rank_multidot(self):
+        a = self._spd(4)
+        np.testing.assert_allclose(
+            np.asarray(linalg.matrix_power(jnp.asarray(a), 3)),
+            np.linalg.matrix_power(a, 3), rtol=1e-5)
+        assert int(linalg.matrix_rank(jnp.asarray(a))) == 4
+        mats = [jnp.asarray(self.rs.randn(3, 4)),
+                jnp.asarray(self.rs.randn(4, 5)),
+                jnp.asarray(self.rs.randn(5, 2))]
+        np.testing.assert_allclose(
+            np.asarray(linalg.multi_dot(mats)),
+            np.asarray(mats[0]) @ np.asarray(mats[1]) @ np.asarray(mats[2]),
+            rtol=1e-5)
+
+
+class TestFFT:
+    def test_fft_roundtrip_and_numpy_parity(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(4, 32)
+        np.testing.assert_allclose(np.asarray(fft.fft(jnp.asarray(x))),
+                                   np.fft.fft(x), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(fft.ifft(fft.fft(jnp.asarray(x)))), x,
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(fft.rfft(jnp.asarray(x))),
+                                   np.fft.rfft(x), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(fft.irfft(fft.rfft(jnp.asarray(x)), n=32)), x,
+            rtol=1e-5, atol=1e-6)
+
+    def test_fft2_norm_and_shift(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(8, 8)
+        np.testing.assert_allclose(
+            np.asarray(fft.fft2(jnp.asarray(x), norm="ortho")),
+            np.fft.fft2(x, norm="ortho"), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(fft.fftshift(fft.fftfreq(8))),
+            np.fft.fftshift(np.fft.fftfreq(8)), rtol=1e-6)
+
+
+class TestSignal:
+    def test_frame_overlap_add_inverse(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(2, 64).astype(np.float32)
+        fr = signal.frame(jnp.asarray(x), frame_length=16, hop_length=16)
+        assert fr.shape == (2, 16, 4)
+        back = signal.overlap_add(fr, hop_length=16)
+        np.testing.assert_allclose(np.asarray(back), x, rtol=1e-6)
+
+    def test_stft_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(4)
+        x = rs.randn(2, 256).astype(np.float32)
+        win = np.hanning(64).astype(np.float32)
+        ours = np.asarray(signal.stft(jnp.asarray(x), n_fft=64,
+                                      hop_length=16,
+                                      window=jnp.asarray(win)))
+        ref = torch.stft(torch.tensor(x), n_fft=64, hop_length=16,
+                         window=torch.tensor(win), center=True,
+                         pad_mode="reflect", return_complex=True).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+    def test_istft_round_trip(self):
+        rs = np.random.RandomState(5)
+        x = rs.randn(1, 400).astype(np.float32)
+        win = jnp.asarray(np.hanning(128).astype(np.float32))
+        spec = signal.stft(jnp.asarray(x), n_fft=128, hop_length=32,
+                           window=win)
+        back = signal.istft(spec, n_fft=128, hop_length=32, window=win)
+        # edges lose energy to the window taper and the trailing partial
+        # frame is dropped by stft; compare the covered interior
+        n = back.shape[-1]
+        np.testing.assert_allclose(np.asarray(back)[:, 64:n - 64],
+                                   x[:, 64:n - 64], rtol=1e-3, atol=1e-4)
+
+
+def test_save_load_inference_model(tmp_path):
+    """paddle.static.save_inference_model parity: program + weights bundle
+    replays without the model class."""
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 3))
+    model.eval()
+    x = jnp.asarray(np.random.RandomState(6).randn(2, 8), jnp.float32)
+    want = np.asarray(model(x))
+
+    prefix = str(tmp_path / "deploy")
+    pt.jit.save_inference_model(prefix, model, x)
+    predict = pt.jit.load_inference_model(prefix)
+    got = np.asarray(predict(x))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
