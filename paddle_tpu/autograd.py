@@ -35,13 +35,24 @@ def grad(outputs, inputs, grad_outputs=None, create_graph=False,
     if grad_outputs is None:
         g = jax.grad(lambda *a: jnp.sum(fn(*a)), argnums=tuple(range(len(xs))))(*xs)
     else:
-        _, pull = jax.vjp(fn, *xs)
+        out, pull = jax.vjp(fn, *xs)
+        if (isinstance(grad_outputs, (list, tuple))
+                and len(grad_outputs) == 1
+                and not isinstance(out, (list, tuple))):
+            grad_outputs = grad_outputs[0]  # paddle's [g] for single output
         g = pull(grad_outputs)
     return g[0] if single else list(g)
 
 
 def jacobian(func: Callable, xs, batch_axis: Optional[int] = None):
-    """paddle.autograd.jacobian: reverse-mode rows (jacrev)."""
+    """paddle.autograd.jacobian: reverse-mode rows (jacrev). With
+    ``batch_axis``, per-sample jacobians via vmap (no cross-batch
+    zero blocks)."""
+    if batch_axis is not None:
+        if isinstance(xs, (tuple, list)):
+            raise NotImplementedError(
+                "batch_axis with multiple inputs is not supported")
+        return jax.vmap(jax.jacrev(func), in_axes=batch_axis)(xs)
     if not isinstance(xs, (tuple, list)):
         return jax.jacrev(func)(xs)
     args = tuple(xs)
@@ -49,6 +60,11 @@ def jacobian(func: Callable, xs, batch_axis: Optional[int] = None):
 
 
 def hessian(func: Callable, xs, batch_axis: Optional[int] = None):
+    if batch_axis is not None:
+        if isinstance(xs, (tuple, list)):
+            raise NotImplementedError(
+                "batch_axis with multiple inputs is not supported")
+        return jax.vmap(jax.hessian(func), in_axes=batch_axis)(xs)
     if not isinstance(xs, (tuple, list)):
         return jax.hessian(func)(xs)
     args = tuple(xs)
@@ -93,7 +109,12 @@ class _PyLayerContext:
 class PyLayerMeta(type):
     def __init__(cls, name, bases, ns):
         super().__init__(name, bases, ns)
-        if name == "PyLayer" or "forward" not in ns:
+        # rebuild the op whenever the class (re)defines forward OR
+        # backward: a subclass overriding only backward must not silently
+        # keep the parent's vjp rule
+        if name == "PyLayer" or not (
+                ("forward" in ns or "backward" in ns)
+                and hasattr(cls, "forward") and hasattr(cls, "backward")):
             return
 
         @jax.custom_vjp

@@ -17,7 +17,7 @@ __all__ = [
     "eigvalsh", "inv", "pinv", "det", "slogdet", "solve",
     "triangular_solve", "lstsq", "lu", "lu_unpack", "matrix_power",
     "matrix_rank", "multi_dot", "matrix_transpose", "dot", "cross",
-    "bmm", "histogram",
+    "bmm",
 ]
 
 
@@ -38,7 +38,10 @@ def dot(x, y):
 
 
 def cross(x, y, axis=None):
-    return jnp.cross(x, y, axis=-1 if axis is None else axis)
+    if axis is None:
+        # paddle: the first axis with length 3
+        axis = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+    return jnp.cross(x, y, axis=axis)
 
 
 def bmm(x, y):
@@ -151,6 +154,8 @@ def lu(x, pivot=True):
 
 
 def lu_unpack(lu_mat, pivots, unpack_ludata=True, unpack_pivots=True):
+    if lu_mat.ndim > 2:  # batched factors: vmap the 2-D unpack
+        return jax.vmap(lambda m, p: lu_unpack(m, p))(lu_mat, pivots)
     n = lu_mat.shape[-2]
     L = jnp.tril(lu_mat, -1) + jnp.eye(n, lu_mat.shape[-1],
                                        dtype=lu_mat.dtype)
@@ -172,24 +177,21 @@ def matrix_power(x, n):
 
 def matrix_rank(x, tol=None, hermitian=False):
     """paddle semantics: ``tol`` is an ABSOLUTE threshold on singular
-    values (eigenvalue magnitudes when hermitian)."""
+    values (eigenvalue magnitudes when hermitian); batched inputs get a
+    per-matrix threshold."""
     sv = (jnp.abs(jnp.linalg.eigvalsh(x)) if hermitian
           else jnp.linalg.svd(x, compute_uv=False))
     if tol is None:
         eps = jnp.finfo(x.dtype).eps
-        tol = jnp.max(sv, axis=-1) * max(x.shape[-2:]) * eps
-    return jnp.sum(sv > tol, axis=-1)
+        thresh = jnp.max(sv, axis=-1, keepdims=True) * max(x.shape[-2:]) * eps
+    else:
+        thresh = jnp.asarray(tol)
+        if thresh.ndim:
+            thresh = thresh[..., None]  # per-matrix tol for batched x
+    return jnp.sum(sv > thresh, axis=-1)
 
 
 def multi_dot(mats):
     return jnp.linalg.multi_dot(mats)
 
 
-def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
-    if min == 0 and max == 0:
-        lo, hi = jnp.min(x), jnp.max(x)
-    else:
-        lo, hi = min, max
-    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi), weights=weight,
-                            density=density)
-    return hist

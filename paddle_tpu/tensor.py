@@ -773,3 +773,120 @@ def multinomial(x, num_samples=1, replacement=False, key=None):
 
 def poisson(x, key=None):
     return jax.random.poisson(_rand_key(key), x).astype(jnp.float32)
+
+
+# ------------------------------------------------- manipulation/math (cont.)
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+def trapezoid(y, x=None, dx=1.0, axis=-1):
+    return jax.scipy.integrate.trapezoid(y, x=x, dx=dx, axis=axis)
+
+
+def index_add(x, index, axis, value):
+    """x with value rows added at `index` along `axis` (out-of-place)."""
+    idx = [builtins.slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+def index_fill(x, index, axis, value):
+    idx = [builtins.slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(value)
+
+
+def masked_scatter(x, mask, value):
+    """Fill True positions of mask (in row-major order) with consecutive
+    elements of `value` (paddle/torch masked_scatter semantics)."""
+    flat_m = mask.reshape(-1)
+    if not isinstance(flat_m, jax.core.Tracer):
+        n_true = int(jnp.sum(flat_m))
+        if value.size < n_true:
+            raise ValueError(
+                f"masked_scatter: value has {value.size} elements but "
+                f"mask selects {n_true}")
+    # position of each True among Trues; False lanes point at slot 0 but
+    # are never selected
+    slot = jnp.cumsum(flat_m) - 1
+    take = jnp.clip(slot, 0, value.size - 1)
+    filled = jnp.where(flat_m, value.reshape(-1)[take], x.reshape(-1))
+    return filled.reshape(x.shape)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1]
+    m = n + builtins.abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (m, m), x.dtype)
+    rows = jnp.arange(n) + builtins.max(-offset, 0)
+    cols = jnp.arange(n) + builtins.max(offset, 0)
+    out = out.at[..., rows, cols].set(x)
+    if (dim1, dim2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def as_strided(x, shape, stride, offset=0):
+    """Strided view emulation: gather with computed flat indices (XLA has
+    no aliasing views; this materializes, same numerics)."""
+    idx = jnp.asarray(offset)
+    for dim, (s, st) in enumerate(zip(shape, stride)):
+        r = jnp.arange(s) * st
+        idx = idx[..., None] + r.reshape((1,) * dim + (s,))
+    return x.reshape(-1)[idx]
+
+
+def view(x, shape_or_dtype):
+    """paddle.view: reshape (list/tuple) or bitcast (dtype)."""
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return x.reshape(shape_or_dtype)
+    from .dtypes import to_dtype
+    return jax.lax.bitcast_convert_type(x, to_dtype(shape_or_dtype))
+
+
+def unflatten(x, axis, shape):
+    axis = axis % x.ndim
+    new = x.shape[:axis] + tuple(shape) + x.shape[axis + 1:]
+    return x.reshape(new)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def renorm(x, p, axis, max_norm):
+    """Scale each sub-tensor along `axis` so its p-norm <= max_norm."""
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12),
+                       1.0)
+    return x * factor
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def cdist(x, y, p=2.0):
+    diff_ = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff_ * diff_, axis=-1))
+    return jnp.sum(jnp.abs(diff_) ** p, axis=-1) ** (1.0 / p)
+
+
+def block_diag(inputs):
+    import jax.scipy.linalg as _jsl
+    return _jsl.block_diag(*inputs)

@@ -177,3 +177,36 @@ def test_pylayer_integer_arg_nondiff():
     idx = jnp.asarray([2, 0], jnp.int32)
     g = jax.grad(lambda x: jnp.sum(Gather.apply(x, idx)))(x)
     np.testing.assert_allclose(np.asarray(g), [1.0, 0.0, 1.0])
+
+
+def test_pylayer_subclass_overrides_backward():
+    """A subclass overriding only backward must get its OWN vjp rule."""
+    from paddle_tpu import autograd
+
+    class Base(autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return 2 * x
+
+        @staticmethod
+        def backward(ctx, grad):
+            return 2 * grad
+
+    class Swapped(Base):
+        @staticmethod
+        def backward(ctx, grad):
+            return 5 * grad
+
+    gb = jax.grad(lambda x: jnp.sum(Base.apply(x)))(jnp.ones(2))
+    gs = jax.grad(lambda x: jnp.sum(Swapped.apply(x)))(jnp.ones(2))
+    np.testing.assert_allclose(np.asarray(gb), [2.0, 2.0])
+    np.testing.assert_allclose(np.asarray(gs), [5.0, 5.0])
+
+
+def test_jacobian_batch_axis():
+    from paddle_tpu import autograd
+    f = lambda x: x ** 2  # noqa: E731
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    J = autograd.jacobian(f, x, batch_axis=0)
+    assert J.shape == (2, 2, 2)  # per-sample jacobians, no cross blocks
+    np.testing.assert_allclose(np.asarray(J[1]), np.diag([6.0, 8.0]))

@@ -1,5 +1,6 @@
 """Tensor API numerics vs numpy (SURVEY.md §4: numerics vs reference
 semantics)."""
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -98,3 +99,40 @@ def test_einsum_norm():
     assert np.allclose(pt.numpy(pt.einsum("ij->ji", pt.to_tensor(a))), a.T)
     assert np.allclose(pt.numpy(pt.norm(pt.to_tensor(a))),
                        np.linalg.norm(a), atol=1e-5)
+
+
+def test_round3_flat_ops():
+    """diff/trapezoid/index_add/index_fill/masked_scatter/diag_embed/
+    as_strided/view/unflatten/moveaxis/renorm/cdist/block_diag/rot90/
+    nanmedian (reference: paddle/tensor/manipulation.py + math.py)."""
+    x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(np.asarray(pt.diff(x)), np.diff(np.asarray(x)))
+    assert float(pt.trapezoid(jnp.asarray([1., 2., 3.]))) == 4.0
+    out = pt.index_add(x, jnp.asarray([0, 2]), 0, jnp.ones((2, 4)))
+    assert float(out[0, 0]) == 1.0 and float(out[1, 0]) == 4.0
+    assert float(pt.index_fill(x, jnp.asarray([1]), 0, -1.0)[1, 0]) == -1.0
+    ms = pt.masked_scatter(x, x > 5, jnp.full((12,), 9.0))
+    assert float(ms[2, 3]) == 9.0 and float(ms[0, 0]) == 0.0
+    np.testing.assert_allclose(
+        np.asarray(pt.diag_embed(jnp.asarray([1., 2., 3.]))),
+        np.diag([1., 2., 3.]))
+    np.testing.assert_allclose(
+        np.asarray(pt.diag_embed(jnp.asarray([1., 2.]), offset=1)),
+        np.diag([1., 2.], k=1))
+    v = pt.as_strided(jnp.arange(10.), (3, 3), (3, 1))
+    np.testing.assert_allclose(
+        np.asarray(v),
+        np.lib.stride_tricks.as_strided(np.arange(10.), (3, 3), (24, 8)))
+    assert pt.view(jnp.asarray([1.0]), "int32").dtype == jnp.int32
+    assert pt.view(x, [4, 3]).shape == (4, 3)
+    r = pt.renorm(x, 2, 0, 1.0)
+    assert float(jnp.linalg.norm(r[2])) <= 1.0001
+    c = pt.cdist(x[:2], x)
+    ref = np.sqrt(((np.asarray(x[:2])[:, None] - np.asarray(x)[None]) ** 2
+                   ).sum(-1))
+    np.testing.assert_allclose(np.asarray(c), ref, rtol=1e-5)
+    assert pt.block_diag([jnp.eye(2), jnp.ones((1, 1))]).shape == (3, 3)
+    assert pt.unflatten(x, 1, (2, 2)).shape == (3, 2, 2)
+    assert pt.moveaxis(x, 0, 1).shape == (4, 3)
+    assert pt.rot90(x).shape == (4, 3)
+    assert float(pt.nanmedian(jnp.asarray([1.0, float("nan"), 3.0]))) == 2.0
