@@ -39,8 +39,12 @@ def dot(x, y):
 
 def cross(x, y, axis=None):
     if axis is None:
-        # paddle: the first axis with length 3
-        axis = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+        # paddle: the first axis with length 3; no such axis is an error,
+        # not a silent 2-D scalar cross on the wrong axis
+        axis = next((i for i, s in enumerate(x.shape) if s == 3), None)
+        if axis is None:
+            raise ValueError(
+                f"cross: no axis of length 3 in shape {x.shape}")
     return jnp.cross(x, y, axis=axis)
 
 

@@ -63,6 +63,15 @@ def _bind(lib):
         "pt_tok_encode_batch": ([vp, vp, c.c_char_p, c.POINTER(sz), sz,
                                  c.POINTER(c.c_int32), sz, c.c_int32,
                                  c.POINTER(sz)], None),
+        "pt_bpe_create": ([c.c_int32, c.c_char_p, c.POINTER(c.c_int32),
+                           c.POINTER(c.c_int32), c.c_int32, c.c_int32,
+                           c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+                           c.POINTER(c.c_int32)], vp),
+        "pt_bpe_destroy": ([vp], None),
+        "pt_bpe_encode_words": ([vp, c.c_char_p, c.POINTER(c.c_int32),
+                                 c.c_int32, c.POINTER(c.c_int32),
+                                 c.c_int64, c.POINTER(c.c_int32)],
+                                c.c_int64),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)
@@ -83,6 +92,22 @@ def lib():
             return None
         try:
             _lib = _bind(ctypes.CDLL(_SO))
+        except AttributeError:
+            # stale .so from before a symbol was added (ctypes raises
+            # AttributeError for missing symbols): rebuild once, then
+            # either bind cleanly or degrade to the Python paths
+            global _build_attempted
+            _build_attempted = False
+            try:
+                os.remove(_SO)
+            except OSError:
+                pass
+            if not _try_build():
+                return None
+            try:
+                _lib = _bind(ctypes.CDLL(_SO))
+            except (OSError, AttributeError):
+                return None
         except OSError:
             return None
     return _lib

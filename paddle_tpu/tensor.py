@@ -680,9 +680,10 @@ def norm(x, p=2, axis=None, keepdim=False):
     return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p)
 
 
-def histogram(x, bins=100, min=0, max=0):  # noqa: A002
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):  # noqa: A002
     rng = None if min == 0 and max == 0 else (min, max)
-    hist, _ = jnp.histogram(x, bins=bins, range=rng)
+    hist, _ = jnp.histogram(x, bins=bins, range=rng, weights=weight,
+                            density=density)
     return hist
 
 
@@ -850,11 +851,27 @@ def as_strided(x, shape, stride, offset=0):
 
 
 def view(x, shape_or_dtype):
-    """paddle.view: reshape (list/tuple) or bitcast (dtype)."""
+    """paddle.view: reshape (list/tuple) or dtype reinterpretation with
+    paddle's last-dim rescaling (a (2,4) float32 viewed as float16 is
+    (2,8); viewed as float64 it is (2,2), requiring divisibility)."""
     if isinstance(shape_or_dtype, (list, tuple)):
         return x.reshape(shape_or_dtype)
     from .dtypes import to_dtype
-    return jax.lax.bitcast_convert_type(x, to_dtype(shape_or_dtype))
+    target = jnp.dtype(to_dtype(shape_or_dtype))
+    inw, outw = x.dtype.itemsize, target.itemsize
+    if outw == inw:
+        return jax.lax.bitcast_convert_type(x, target)
+    if outw < inw:
+        r = inw // outw
+        y = jax.lax.bitcast_convert_type(x, target)   # [..., n, r]
+        return y.reshape(x.shape[:-1] + (x.shape[-1] * r,))
+    r = outw // inw
+    if x.shape[-1] % r:
+        raise ValueError(
+            f"view: last dim {x.shape[-1]} not divisible by width "
+            f"ratio {r} ({x.dtype} -> {target})")
+    y = x.reshape(x.shape[:-1] + (x.shape[-1] // r, r))
+    return jax.lax.bitcast_convert_type(y, target)
 
 
 def unflatten(x, axis, shape):
@@ -884,6 +901,12 @@ def cdist(x, y, p=2.0):
     diff_ = x[..., :, None, :] - y[..., None, :, :]
     if p == 2.0:
         return jnp.sqrt(jnp.sum(diff_ * diff_, axis=-1))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff_), axis=-1)       # Chebyshev
+    if p == 0:
+        return jnp.sum(diff_ != 0, axis=-1).astype(x.dtype)  # Hamming
+    if p < 0:
+        raise ValueError(f"cdist requires p >= 0, got {p}")
     return jnp.sum(jnp.abs(diff_) ** p, axis=-1) ** (1.0 / p)
 
 

@@ -46,6 +46,103 @@ def bytes_to_unicode() -> Dict[int, str]:
     return dict(zip(bs, (chr(c) for c in cs)))
 
 
+class _NativeBPE:
+    """ctypes handle over the C++ merge loop (native/src/bpe.cc). The
+    C++ side works on RAW BYTES; vocab/merge tokens are converted from
+    the printable byte-level alphabet once at build. Disabled (build
+    returns None) when the library is missing or any vocab/merge entry
+    falls outside the byte alphabet — the Python loop then guarantees
+    correctness."""
+
+    def __init__(self, handle, lib):
+        self._h = handle
+        self._lib = lib
+
+    @classmethod
+    def build(cls, vocab, merges, byte_dec):
+        import ctypes
+
+        import numpy as np
+
+        from ..native import lib as native_lib
+        lib = native_lib()
+        if lib is None or not vocab:
+            return None
+
+        def to_bytes(tok):
+            try:
+                return bytes(byte_dec[ch] for ch in tok)
+            except KeyError:
+                return None
+
+        blobs, offsets, ids = [], [0], []
+        tok_to_id = {}
+        for tok, i in vocab.items():
+            raw = to_bytes(tok)
+            if raw is None:
+                return None  # non-byte-level vocab entry: Python path
+            blobs.append(raw)
+            offsets.append(offsets[-1] + len(raw))
+            ids.append(i)
+            tok_to_id[tok] = i
+        ml, mr, mm = [], [], []
+        for left, right in merges:
+            lid = tok_to_id.get(left)
+            rid = tok_to_id.get(right)
+            mid = tok_to_id.get(left + right)
+            if lid is None or rid is None or mid is None:
+                return None  # merge outside vocab: semantics differ
+            ml.append(lid)
+            mr.append(rid)
+            mm.append(mid)
+
+        blob = b"".join(blobs)
+        off = np.asarray(offsets, np.int32)
+        idarr = np.asarray(ids, np.int32)
+        l_ = np.asarray(ml, np.int32)
+        r_ = np.asarray(mr, np.int32)
+        m_ = np.asarray(mm, np.int32)
+        p32 = ctypes.POINTER(ctypes.c_int32)
+        h = lib.pt_bpe_create(
+            len(ids), blob, off.ctypes.data_as(p32),
+            idarr.ctypes.data_as(p32), int(max(ids)), len(ml),
+            l_.ctypes.data_as(p32), r_.ctypes.data_as(p32),
+            m_.ctypes.data_as(p32))
+        if not h:
+            return None
+        # no keepalive needed: pt_bpe_create copies everything into its
+        # own std::string/map storage before returning
+        return cls(h, lib)
+
+    def encode_words(self, pieces):
+        """List of pretokenized strings -> flat ids, or None (fallback)."""
+        import ctypes
+
+        import numpy as np
+        if not pieces:
+            return []
+        raw = [p.encode("utf-8") for p in pieces]
+        blob = b"".join(raw)
+        offsets = np.zeros(len(raw) + 1, np.int32)
+        np.cumsum([len(r) for r in raw], out=offsets[1:])
+        cap = max(len(blob) * 2, 64)
+        out = np.empty(cap, np.int32)
+        ends = np.empty(len(raw), np.int32)
+        p32 = ctypes.POINTER(ctypes.c_int32)
+        n = self._lib.pt_bpe_encode_words(
+            self._h, blob, offsets.ctypes.data_as(p32), len(raw),
+            out.ctypes.data_as(p32), cap, ends.ctypes.data_as(p32))
+        if n < 0:
+            return None  # unknown byte or overflow: Python fallback
+        return out[:n].tolist()
+
+    def __del__(self):
+        try:
+            self._lib.pt_bpe_destroy(self._h)
+        except Exception:
+            pass
+
+
 class BPETokenizer:
     """Byte-level BPE with rank-ordered merges.
 
@@ -81,6 +178,9 @@ class BPETokenizer:
         self._byte_enc = bytes_to_unicode()
         self._byte_dec = {c: b for b, c in self._byte_enc.items()}
         self._cache: Dict[str, List[str]] = {}
+        # C++ merge loop (native/src/bpe.cc) — same ids, ~an order of
+        # magnitude faster on corpus encoding; None -> pure-Python path
+        self._native = _NativeBPE.build(self.vocab, merges, self._byte_dec)
 
     # ------------------------------------------------------------- encoding
     def _bpe(self, word: str) -> List[str]:
@@ -136,19 +236,30 @@ class BPETokenizer:
             out.append(i)
         return out
 
+    def _encode_plain(self, text: str) -> List[int]:
+        """Non-special text -> ids (native fast path when available)."""
+        if self._native is not None:
+            if self.add_prefix_space and text and not text.startswith(" "):
+                text = " " + text
+            pieces = self._split_re.findall(text)
+            ids = self._native.encode_words(pieces)
+            if ids is not None:
+                return ids
+        return self._convert(self.tokenize(text))
+
     def encode(self, text: str) -> List[int]:
         """Text -> ids; special tokens are matched verbatim first."""
         if self._special_re is None:
-            return self._convert(self.tokenize(text))
+            return self._encode_plain(text)
         ids: List[int] = []
         pos = 0
         for m in self._special_re.finditer(text):
             if m.start() > pos:
-                ids.extend(self._convert(self.tokenize(text[pos:m.start()])))
+                ids.extend(self._encode_plain(text[pos:m.start()]))
             ids.append(self.special_tokens[m.group()])
             pos = m.end()
         if pos < len(text):
-            ids.extend(self._convert(self.tokenize(text[pos:])))
+            ids.extend(self._encode_plain(text[pos:]))
         return ids
 
     __call__ = encode

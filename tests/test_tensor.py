@@ -136,3 +136,30 @@ def test_round3_flat_ops():
     assert pt.moveaxis(x, 0, 1).shape == (4, 3)
     assert pt.rot90(x).shape == (4, 3)
     assert float(pt.nanmedian(jnp.asarray([1.0, float("nan"), 3.0]))) == 2.0
+
+
+def test_view_dtype_rescales_last_dim():
+    """paddle.view(dtype): last dim scales by the width ratio."""
+    x = jnp.zeros((2, 4), jnp.float32)
+    assert pt.view(x, "float16").shape == (2, 8)
+    assert pt.view(x, "int32").shape == (2, 4)
+    # widening uses int16 -> int32 (x64 dtypes are disabled, TPU-first)
+    assert pt.view(jnp.zeros((2, 4), jnp.int16), "int32").shape == (2, 2)
+    with pytest.raises(ValueError, match="divisible"):
+        pt.view(jnp.zeros((2, 3), jnp.int16), "int32")
+
+
+def test_cdist_inf_and_zero_norms():
+    a = jnp.asarray([[0.0, 0.0], [1.0, 5.0]])
+    b = jnp.asarray([[3.0, 4.0]])
+    np.testing.assert_allclose(np.asarray(pt.cdist(a, b, p=float("inf"))),
+                               [[4.0], [2.0]])
+    np.testing.assert_allclose(np.asarray(pt.cdist(a, b, p=0)),
+                               [[2.0], [2.0]])
+
+
+def test_histogram_weight_density():
+    x = jnp.asarray([0.1, 0.2, 0.8])
+    h = pt.histogram(x, bins=2, min=0.0, max=1.0,
+                     weight=jnp.asarray([1.0, 2.0, 4.0]))
+    np.testing.assert_allclose(np.asarray(h), [3.0, 4.0])

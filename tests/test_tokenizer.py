@@ -139,3 +139,17 @@ def test_sentencepiece_style_rejected(tmp_path):
     p.write_text(json.dumps(data), encoding="utf-8")
     with pytest.raises(ValueError, match="byte-level"):
         BPETokenizer.from_tokenizer_json(str(p))
+
+
+def test_native_bpe_matches_python(trained):
+    """The C++ merge loop (native/src/bpe.cc) must produce exactly the
+    Python loop's ids on the same tokenizer."""
+    _, path = trained
+    ours = BPETokenizer.from_tokenizer_json(path)
+    if ours._native is None:
+        pytest.skip("native library unavailable")
+    for s in CORPUS + TRICKY:
+        native_ids = ours.encode(s)
+        ours_py = BPETokenizer.from_tokenizer_json(path)
+        ours_py._native = None
+        assert native_ids == ours_py.encode(s), f"mismatch on {s!r}"
