@@ -51,6 +51,7 @@ from . import nn  # noqa: E402
 from . import optimizer  # noqa: E402
 from . import inference  # noqa: E402
 from . import metric  # noqa: E402
+from . import peft  # noqa: E402
 from . import vision  # noqa: E402
 from . import quant  # noqa: E402
 from .checkpoint import load, save  # noqa: E402

@@ -59,8 +59,8 @@ def _decode_kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,
     @pl.when(ti * block_t < valid)
     def _compute():
         q = q_ref[0, 0, :, :]                       # [gp, d]
-        k = k_ref[0, :, 0, :]                       # [bt, d]
-        v = v_ref[0, :, 0, :]
+        k = k_ref[0, :, :]                          # [bt, d]
+        v = v_ref[0, :, :]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         k_ids = lax.broadcasted_iota(jnp.int32, (gp, block_t), 1) \
@@ -103,6 +103,12 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_index, scale,
     idx = jnp.asarray(cache_index, jnp.int32).reshape(1)
     kernel = functools.partial(_decode_kernel, scale=scale, block_t=bt,
                                nt=nt, gp=gp)
+    # Mosaic requires the last TWO block dims be (8,128)-tiled (or match the
+    # array), so a [b, T, kv, d] cache cannot take a kv-dim block of 1.
+    # View it as [b, T, kv*d] instead — contiguous, so the reshape is free —
+    # and let the column block (size d, 128-aligned) select the kv head.
+    kc = k_cache.reshape(b, T, kv * d)
+    vc = v_cache.reshape(b, T, kv * d)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -110,8 +116,8 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_index, scale,
             grid=(b, kv, nt),
             in_specs=[
                 pl.BlockSpec((1, 1, gp, d), lambda bi, ki, ti, idx: (bi, ki, 0, 0)),
-                pl.BlockSpec((1, bt, 1, d), lambda bi, ki, ti, idx: (bi, ti, ki, 0)),
-                pl.BlockSpec((1, bt, 1, d), lambda bi, ki, ti, idx: (bi, ti, ki, 0)),
+                pl.BlockSpec((1, bt, d), lambda bi, ki, ti, idx: (bi, ti, ki)),
+                pl.BlockSpec((1, bt, d), lambda bi, ki, ti, idx: (bi, ti, ki)),
             ],
             out_specs=pl.BlockSpec((1, 1, gp, d),
                                    lambda bi, ki, ti, idx: (bi, ki, 0, 0)),
@@ -123,5 +129,5 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_index, scale,
         ),
         out_shape=jax.ShapeDtypeStruct((b, kv, gp, d), q.dtype),
         interpret=_interpret(),
-    )(idx, qg, k_cache, v_cache)
+    )(idx, qg, kc, vc)
     return out[:, :, :group, :].reshape(b, h, d)

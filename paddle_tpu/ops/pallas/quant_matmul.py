@@ -52,8 +52,9 @@ def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc, *, bits, bk, bn, nin):
         lo = (w << 28) >> 28                       # sign-extend low nibble
         hi = w >> 4                                # arithmetic: signed high
         w = jnp.stack([lo, hi], axis=1).reshape(bk, bn)
+    scales = s_ref[0, :bk // QUANT_BLOCK, :]       # drop the 8-sublane pad
     wf = w.astype(jnp.float32).reshape(bk // QUANT_BLOCK, QUANT_BLOCK, bn)
-    wf = (wf * s_ref[...].astype(jnp.float32)[:, None, :]).reshape(bk, bn)
+    wf = (wf * scales.astype(jnp.float32)[:, None, :]).reshape(bk, bn)
     acc[:] += lax.dot_general(
         x_ref[...].astype(jnp.float32), wf, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -85,6 +86,16 @@ def quant_matmul_pallas(x, qweight, scales, bits: int = 8,
     else:
         w_spec = pl.BlockSpec((bk, bn), lambda no, ni: (ni, no))
 
+    # Mosaic tiling: a scales block of (bk/128, bn) rows-per-block (often 4)
+    # violates the 8-sublane minimum. Regroup to [nin, rows_pad, dout] with
+    # the per-block rows padded up to a multiple of 8; the kernel slices the
+    # real rows back off. The pad touches only the tiny scales array.
+    rows = bk // QUANT_BLOCK
+    rows_pad = max(8, rows + (-rows) % 8)
+    s3 = scales.reshape(nin, rows, dout)
+    if rows_pad != rows:
+        s3 = jnp.pad(s3, ((0, 0), (0, rows_pad - rows), (0, 0)))
+
     kernel = functools.partial(_qmm_kernel, bits=bits, bk=bk, bn=bn, nin=nin)
     out = pl.pallas_call(
         kernel,
@@ -92,13 +103,13 @@ def quant_matmul_pallas(x, qweight, scales, bits: int = 8,
         in_specs=[
             pl.BlockSpec((mp, bk), lambda no, ni: (0, ni)),
             w_spec,
-            pl.BlockSpec((bk // QUANT_BLOCK, bn), lambda no, ni: (ni, no)),
+            pl.BlockSpec((1, rows_pad, bn), lambda no, ni: (ni, 0, no)),
         ],
         out_specs=pl.BlockSpec((mp, bn), lambda no, ni: (0, no)),
         scratch_shapes=[pltpu.VMEM((mp, bn), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct((mp, dout), x.dtype),
         interpret=_interpret(),
-    )(x, qweight, scales)
+    )(x, qweight, s3)
     return out[:m]
 
 

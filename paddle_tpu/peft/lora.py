@@ -1,0 +1,243 @@
+"""LoRA low-rank adaptation (reference: PaddleNLP ``paddlenlp/peft/lora/
+lora_model.py`` + ``lora_layers.py`` — LoRAConfig, LoRAModel, LoRALinear,
+ColumnParallelLoRALinear, RowParallelLoRALinear).
+
+TPU-native design: instead of swapping layer classes (the reference
+subclasses every Linear variant), the adapter is *injected into the
+existing layer instance* — two new Parameters (``lora_A``, ``lora_B``)
+plus a forward-post-hook that adds the low-rank delta. This keeps the
+parameter tree names stable (``...q_proj.weight`` stays, ``...q_proj.
+lora_A`` appears), so pretrained checkpoints, HF interop name maps, TP
+partition metadata, and the optimizer/checkpoint layout all keep working
+unchanged. Tensor parallelism composes by giving the adapter factors the
+partition specs induced by the base weight's spec:
+
+    base W (None,"tp")  (column-parallel) -> A replicated, B (None,"tp")
+    base W ("tp",None)  (row-parallel)    -> A ("tp",None), B replicated
+
+so the delta ``x @ A @ B`` carries exactly the base layer's output
+sharding and GSPMD inserts the same collectives it does for the base
+matmul. Training only the adapters goes through ``Layer.param_meta``
+trainable flags — the Trainer differentiates w.r.t. the trainable subset
+only, and the optimizer holds state only for it (frozen base weights
+never get Adam moments; that is the LoRA memory win).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, Parameter
+from ..utils.rng import next_key
+
+_LINEAR_KINDS = ("Linear", "ColumnParallelLinear", "RowParallelLinear")
+
+
+@dataclass
+class LoRAConfig:
+    """Reference: paddlenlp.peft.LoRAConfig (the subset that matters)."""
+    r: int = 8
+    lora_alpha: int = 16
+    lora_dropout: float = 0.0
+    # regexes matched against full sublayer paths (PaddleNLP semantics:
+    # ".*q_proj" targets every attention query projection)
+    target_modules: Sequence[str] = field(
+        default_factory=lambda: [".*q_proj", ".*v_proj"])
+    trainable_bias: bool = False
+    rslora: bool = False  # scale by alpha/sqrt(r) instead of alpha/r
+
+    @property
+    def scaling(self) -> float:
+        return self.lora_alpha / (self.r ** 0.5 if self.rslora else self.r)
+
+    def save_pretrained(self, path: str):
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "lora_config.json"), "w") as f:
+            json.dump(dataclasses.asdict(self), f, indent=2)
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "LoRAConfig":
+        with open(os.path.join(path, "lora_config.json")) as f:
+            return cls(**json.load(f))
+
+
+def _adapter_partitions(layer: Layer):
+    """Derive (A, B) partition specs from the base weight's spec."""
+    meta = layer._param_meta.get("weight")
+    part = meta.partition if meta is not None else None
+    if part == (None, "tp"):        # column-parallel: out dim sharded
+        return None, (None, "tp")
+    if part == ("tp", None):        # row-parallel: in dim sharded
+        return ("tp", None), None
+    return None, None
+
+
+def _lora_hook(layer, args, result):
+    """Forward-post-hook: result += dropout(x) @ A @ B * scaling."""
+    if getattr(layer, "_lora_merged", False):
+        return result
+    x = args[0]
+    p = layer._lora_dropout_p
+    if p > 0.0 and layer.training:
+        x = F.dropout(x, p, training=True, key=next_key())
+    a = layer.lora_A
+    delta = (x.astype(a.dtype) @ a @ layer.lora_B) * layer._lora_scaling
+    return result + delta.astype(result.dtype)
+
+
+def inject_lora(layer: Layer, config: LoRAConfig) -> None:
+    """Attach a LoRA adapter to one Linear-family layer in place."""
+    if "lora_A" in layer._parameters:
+        raise ValueError(f"{layer.full_name()}: LoRA already injected")
+    din, dout = layer.in_features, layer.out_features
+    part_a, part_b = _adapter_partitions(layer)
+    dt = layer.weight.dtype
+    a0 = I.KaimingUniform()(next_key(), (din, config.r)).astype(dt)
+    layer.lora_A = Parameter(a0, partition=part_a)
+    # B starts at zero: the adapted model is EXACTLY the base model at
+    # step 0 (the LoRA identity-init property)
+    layer.lora_B = Parameter(jnp.zeros((config.r, dout), dt),
+                             partition=part_b)
+    object.__setattr__(layer, "_lora_scaling", config.scaling)
+    object.__setattr__(layer, "_lora_dropout_p", config.lora_dropout)
+    object.__setattr__(layer, "_lora_merged", False)
+    layer.register_forward_post_hook(_lora_hook)
+
+
+def apply_lora(model: Layer, config: LoRAConfig) -> List[str]:
+    """Inject adapters into every sublayer matching ``target_modules``,
+    then freeze everything except the adapters. Returns injected paths."""
+    pats = [re.compile(p + r"\Z") for p in config.target_modules]
+    hit = []
+    for path, sub in model.named_sublayers():
+        if type(sub).__name__ not in _LINEAR_KINDS:
+            continue
+        if not hasattr(sub, "in_features"):
+            continue
+        if any(p.match(path) for p in pats):
+            inject_lora(sub, config)
+            hit.append(path)
+    if not hit:
+        raise ValueError(
+            f"target_modules {list(config.target_modules)} matched nothing")
+    mark_only_lora_as_trainable(model, bias="lora_only"
+                                if config.trainable_bias else "none")
+    return hit
+
+
+def mark_only_lora_as_trainable(model: Layer, bias: str = "none") -> None:
+    """bias: "none" | "lora_only" | "all" (PaddleNLP semantics)."""
+    meta = model.param_meta()
+    lora_layers = {k.rsplit(".", 1)[0] for k in meta if _is_lora_name(k)}
+    for name, m in meta.items():
+        if _is_lora_name(name):
+            m.trainable = True
+        elif name.endswith(".bias") and (
+                bias == "all" or
+                (bias == "lora_only" and name.rsplit(".", 1)[0] in lora_layers)):
+            m.trainable = True
+        else:
+            m.trainable = False
+
+
+def _is_lora_name(name: str) -> bool:
+    leaf = name.rsplit(".", 1)[-1]
+    return leaf in ("lora_A", "lora_B")
+
+
+def lora_state_dict(model: Layer) -> Dict[str, jax.Array]:
+    return {k: v for k, v in model.named_parameters() if _is_lora_name(k)}
+
+
+def _lora_layers(model: Layer):
+    for path, sub in model.named_sublayers(include_self=True):
+        if "lora_A" in sub._parameters:
+            yield path, sub
+
+
+def merge_lora(model: Layer) -> None:
+    """Fold every adapter into its base weight (W += A @ B * scaling) so
+    inference pays zero adapter overhead. Idempotent."""
+    for _, sub in _lora_layers(model):
+        if sub._lora_merged:
+            continue
+        delta = (sub.lora_A.astype(jnp.float32) @
+                 sub.lora_B.astype(jnp.float32)) * sub._lora_scaling
+        sub.weight = (sub.weight.astype(jnp.float32) +
+                      delta).astype(sub.weight.dtype)
+        object.__setattr__(sub, "_lora_merged", True)
+
+
+def unmerge_lora(model: Layer) -> None:
+    for _, sub in _lora_layers(model):
+        if not sub._lora_merged:
+            continue
+        delta = (sub.lora_A.astype(jnp.float32) @
+                 sub.lora_B.astype(jnp.float32)) * sub._lora_scaling
+        sub.weight = (sub.weight.astype(jnp.float32) -
+                      delta).astype(sub.weight.dtype)
+        object.__setattr__(sub, "_lora_merged", False)
+
+
+class LoRAModel:
+    """Thin facade mirroring paddlenlp.peft.LoRAModel: wraps a base model,
+    injects adapters, saves/loads ONLY the adapter weights. Attribute
+    access transparently delegates to the wrapped model, and the wrapped
+    model's parameter names are unchanged (see module docstring)."""
+
+    def __init__(self, model: Layer, config: LoRAConfig):
+        object.__setattr__(self, "model", model)
+        object.__setattr__(self, "lora_config", config)
+        object.__setattr__(self, "injected", apply_lora(model, config))
+
+    def __getattr__(self, name):
+        # fetch via __dict__: during deepcopy/unpickle the instance dict is
+        # empty and a plain self.model would recurse into __getattr__
+        model = self.__dict__.get("model")
+        if model is None:
+            raise AttributeError(name)
+        return getattr(model, name)
+
+    def __call__(self, *args, **kwargs):
+        return self.model(*args, **kwargs)
+
+    def save_pretrained(self, path: str):
+        from ..checkpoint import save
+        os.makedirs(path, exist_ok=True)
+        self.lora_config.save_pretrained(path)
+        save(lora_state_dict(self.model),
+             os.path.join(path, "lora_weights.pdparams"))
+
+    @classmethod
+    def from_pretrained(cls, model: Layer, path: str) -> "LoRAModel":
+        from ..checkpoint import load
+        config = LoRAConfig.from_pretrained(path)
+        obj = cls(model, config)
+        weights = load(os.path.join(path, "lora_weights.pdparams"))
+        want = set(lora_state_dict(model))
+        got = set(weights)
+        if got != want:
+            # strict=False below is only for the legitimately-absent base
+            # params; a key mismatch on the ADAPTER set means the file
+            # doesn't fit this model and must not be silently dropped
+            raise KeyError(
+                f"adapter weights do not match the injected adapters: "
+                f"missing={sorted(want - got)[:4]} "
+                f"unexpected={sorted(got - want)[:4]}")
+        model.set_state_dict(weights, strict=False)
+        return obj
+
+    def merge(self):
+        merge_lora(self.model)
+
+    def unmerge(self):
+        unmerge_lora(self.model)

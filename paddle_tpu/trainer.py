@@ -93,6 +93,14 @@ class Trainer:
             hang_timeout_s=self.args.hang_timeout_s,
             on_hang=self._on_hang if self.args.hang_timeout_s else None)
         self._pure_fn, self._params = model.functional()
+        # PEFT/LoRA: parameters whose ParamMeta says trainable=False are
+        # frozen — grads are taken only w.r.t. the trainable subset and
+        # the optimizer holds state only for it (frozen weights never get
+        # Adam moments). Empty tuple = everything trains (the usual case).
+        meta = model.param_meta()
+        self._trainable_keys = tuple(
+            k for k in self._params if meta[k].trainable)
+        self._has_frozen = len(self._trainable_keys) < len(self._params)
         self._opt_state = None
         self._step_fn = None
         self._eval_fn = None
@@ -118,6 +126,12 @@ class Trainer:
         if pp > 1 and hasattr(self.model, "pipeline_functional"):
             # 1F1B pipeline path: the schedule computes loss AND grads in
             # one manual-SPMD program (microbatches = grad-accum steps).
+            if self._has_frozen:
+                raise ValueError(
+                    "frozen parameters (PEFT/LoRA) are not supported on "
+                    "the pipeline-parallel path: the 1F1B schedule "
+                    "differentiates the full stage stack; run LoRA under "
+                    "tp/fsdp/dp instead")
             if scaler is not None:
                 raise ValueError("fp16 GradScaler is not supported with "
                                  "pipeline parallelism (use bf16)")
@@ -142,40 +156,57 @@ class Trainer:
             donate = (0, 1) if args.donate_state else ()
             return jax.jit(pp_step, donate_argnums=donate)
 
-        def loss_of(p, batch):
-            return self.loss_fn(fn, p, batch)
+        # One unified step: differentiate w.r.t. the TRAINABLE subset only
+        # (PEFT/LoRA freezes the rest; the all-trainable case is simply
+        # frozen = {}). Frozen params ride along as (donated) jit inputs,
+        # not constants, and the optimizer sees only the trainable subset.
+        tkeys = frozenset(self._trainable_keys)
 
-        def scaled_loss(p, mb, sstate):
-            loss = loss_of(p, mb)
+        def loss_of(p, batch, stepno):
+            # route next_key() through a per-step traced key so dropout
+            # masks change every step (a bare next_key() during tracing
+            # would bake ONE host key in as a constant)
+            from .utils.rng import key_context
+            key = jax.random.fold_in(jax.random.PRNGKey(args.seed), stepno)
+            with key_context(key):
+                return self.loss_fn(fn, p, batch)
+
+        def scaled_loss(p, mb, sstate, stepno):
+            loss = loss_of(p, mb, stepno)
             scaled = scaler.scale(loss, sstate) if scaler else loss
             return scaled, loss
 
         def step(params, state, sstate, stepno, batch):
+            frozen = {k: v for k, v in params.items() if k not in tkeys}
+            tp = {k: v for k, v in params.items() if k in tkeys}
+            vg = jax.value_and_grad(
+                lambda t, b, ss: scaled_loss({**frozen, **t}, b, ss, stepno),
+                has_aux=True)
             if accum == 1:
-                (_, loss), grads = jax.value_and_grad(
-                    scaled_loss, has_aux=True)(params, batch, sstate)
+                (_, loss), grads = vg(tp, batch, sstate)
             else:
                 # batch leading dim = accum: scan microbatches, mean grads
+                # (dropout masks vary per step via stepno; within one
+                # step's scan the microbatches share a mask)
                 def micro(carry, mb):
                     gsum, lsum = carry
-                    (_, loss), g = jax.value_and_grad(
-                        scaled_loss, has_aux=True)(params, mb, sstate)
-                    gsum = jax.tree.map(jnp.add, gsum, g)
-                    return (gsum, lsum + loss), None
-                zeros = jax.tree.map(jnp.zeros_like, params)
+                    (_, l), g = vg(tp, mb, sstate)
+                    return (jax.tree.map(jnp.add, gsum, g), lsum + l), None
+                zeros = jax.tree.map(jnp.zeros_like, tp)
                 (gsum, lsum), _ = jax.lax.scan(micro, (zeros, 0.0), batch)
                 grads = jax.tree.map(lambda g: g / accum, gsum)
                 loss = lsum / accum
             if scaler is None:
-                params, state = opt.apply(params, grads, state, stepno)
-                return params, state, sstate, loss
-            # fp16: unscale, branchlessly skip the update on inf/nan grads,
-            # and advance the dynamic loss scale — all inside this one jit.
-            grads, found_inf = scaler.unscale(grads, sstate)
-            new_params, new_state = opt.apply(params, grads, state, stepno)
-            params = scaler.select(found_inf, params, new_params)
-            state = scaler.select(found_inf, state, new_state)
-            sstate = scaler.update_state(sstate, found_inf)
+                new_tp, state = opt.apply(tp, grads, state, stepno)
+            else:
+                # fp16: unscale, branchlessly skip the update on inf/nan
+                # grads, and advance the dynamic loss scale — in this jit.
+                grads, found_inf = scaler.unscale(grads, sstate)
+                cand_tp, cand_state = opt.apply(tp, grads, state, stepno)
+                new_tp = scaler.select(found_inf, tp, cand_tp)
+                state = scaler.select(found_inf, state, cand_state)
+                sstate = scaler.update_state(sstate, found_inf)
+            params = {**params, **new_tp}
             return params, state, sstate, loss
 
         donate = (0, 1) if args.donate_state else ()
@@ -186,7 +217,9 @@ class Trainer:
         args = self.args
         max_steps = max_steps or args.max_steps
         if self._opt_state is None:
-            self._opt_state = self.optimizer.init(self._params)
+            self._opt_state = self.optimizer.init(
+                {k: self._params[k] for k in self._trainable_keys}
+                if self._has_frozen else self._params)
         if args.resume_from_checkpoint and args.save_steps:
             self._try_resume()
         if self._step_fn is None:
