@@ -292,9 +292,14 @@ class LlamaForCausalLM(CausalLMBase):
             if config.dtype != jnp.float32:
                 self.lm_head.to(dtype=config.dtype)
 
-    def pipeline_functional(self, pp: int):
-        """1F1B pipeline train step over ``pp`` stages (Trainer pp path)."""
-        return llama_pipeline_functional(self, pp)
+    def pipeline_functional(self, pp: int, logits_loss=None, vpp: int = 1):
+        """1F1B pipeline train step over ``pp`` stages (Trainer pp path).
+        ``logits_loss(logits, labels) -> scalar mean`` swaps the last-stage
+        loss head (default: shifted causal-LM cross-entropy). ``vpp`` > 1
+        interleaves that many virtual chunks per device (Megatron-style),
+        shrinking the pipeline bubble vpp-fold."""
+        return llama_pipeline_functional(self, pp, logits_loss=logits_loss,
+                                         vpp=vpp)
 
     def forward(self, input_ids, positions=None, kv_caches=None,
                 cache_index=None, attn_mask=None, attn_start=None):
@@ -321,7 +326,8 @@ def causal_lm_loss(logits, labels, ignore_index: int = -100):
 
 
 # ------------------------------------------------------- pipeline parallel
-def llama_pipeline_functional(model: "LlamaForCausalLM", pp: int):
+def llama_pipeline_functional(model: "LlamaForCausalLM", pp: int,
+                              logits_loss=None, vpp: int = 1):
     """Wire a LlamaForCausalLM into the 1F1B pipeline (reference:
     fleet.meta_parallel.PipelineLayer's LayerDesc segmentation — embedding
     at stage 0, ``num_hidden_layers/pp`` LlamaDecoderLayers per stage,
@@ -338,23 +344,37 @@ def llama_pipeline_functional(model: "LlamaForCausalLM", pp: int):
 
     cfg = model.config
     L = cfg.num_hidden_layers
-    if L % pp != 0:
-        raise ValueError(f"num_hidden_layers {L} % pp {pp} != 0")
+    S = pp * vpp  # global stages (vpp chunks per device when interleaved)
+    if L % S != 0:
+        raise ValueError(f"num_hidden_layers {L} % (pp*vpp) {S} != 0")
     if cfg.tie_word_embeddings:
         raise ValueError("pipeline requires untied embeddings (the tied "
                          "table would live on two stages)")
-    n_per = L // pp
+    n_per = L // S
     layer_fn, layer_p0 = model.model.layers[0].functional()
     embed_fn, _ = model.model.embed_tokens.functional()
     norm_fn, _ = model.model.norm.functional()
     lm_fn, _ = model.lm_head.functional()
     rel_keys = list(layer_p0)
 
+    def _stage_stack(flat, k, g):
+        """One global stage's [n_per, ...] stack for param k."""
+        return jnp.stack([flat[f"model.layers.{g * n_per + i}.{k}"]
+                          for i in range(n_per)])
+
     def split(flat):
-        stages = {k: jnp.stack([
-            jnp.stack([flat[f"model.layers.{g * n_per + i}.{k}"]
-                       for i in range(n_per)]) for g in range(pp)])
-            for k in rel_keys}
+        if vpp == 1:
+            stages = {k: jnp.stack([_stage_stack(flat, k, g)
+                                    for g in range(pp)])
+                      for k in rel_keys}
+        else:
+            # [v, pp, n_per, ...]: chunk c on device d is global stage
+            # g = c*pp + d (round-robin layout — consecutive stages on
+            # consecutive devices so the interleaved ring handoff works)
+            stages = {k: jnp.stack([
+                jnp.stack([_stage_stack(flat, k, c * pp + d)
+                           for d in range(pp)]) for c in range(vpp)])
+                for k in rel_keys}
         embed = {k[len("model.embed_tokens."):]: v for k, v in flat.items()
                  if k.startswith("model.embed_tokens.")}
         head = {"norm": {k[len("model.norm."):]: v for k, v in flat.items()
@@ -366,9 +386,13 @@ def llama_pipeline_functional(model: "LlamaForCausalLM", pp: int):
     def merge(pp_grads):
         flat = {}
         for k, v in pp_grads["stages"].items():
-            for g in range(pp):
+            for g in range(S):
                 for i in range(n_per):
-                    flat[f"model.layers.{g * n_per + i}.{k}"] = v[g, i]
+                    layer = f"model.layers.{g * n_per + i}.{k}"
+                    if vpp == 1:
+                        flat[layer] = v[g, i]
+                    else:
+                        flat[layer] = v[g // pp, g % pp, i]
         flat.update({f"model.embed_tokens.{k}": v
                      for k, v in pp_grads["embed"].items()})
         flat.update({f"model.norm.{k}": v
@@ -386,12 +410,20 @@ def llama_pipeline_functional(model: "LlamaForCausalLM", pp: int):
         y, _ = _lax.scan(one, x, sp)
         return y
 
+    loss_head = logits_loss or causal_lm_loss
+
     def head_loss_fn(hp, y, labels):
         h = norm_fn(hp["norm"], y)
         logits = lm_fn(hp["lm"], h).astype(jnp.float32)
-        return causal_lm_loss(logits, labels)
+        return loss_head(logits, labels)
 
-    run = pipeline_value_and_grad(embed_fn, stage_fn, head_loss_fn, pp)
+    if vpp == 1:
+        run = pipeline_value_and_grad(embed_fn, stage_fn, head_loss_fn, pp)
+    else:
+        from ..parallel.pipeline_interleaved import \
+            interleaved_pipeline_value_and_grad
+        run = interleaved_pipeline_value_and_grad(
+            embed_fn, stage_fn, head_loss_fn, pp, vpp)
 
     def vag(flat_params, tokens):
         loss, grads = run(split(flat_params), tokens, tokens)

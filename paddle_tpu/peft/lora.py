@@ -39,7 +39,10 @@ from ..nn import initializer as I
 from ..nn.layer import Layer, Parameter
 from ..utils.rng import next_key
 
-_LINEAR_KINDS = ("Linear", "ColumnParallelLinear", "RowParallelLinear")
+def _linear_kinds():
+    from ..nn.common import Linear
+    from ..parallel.layers import ColumnParallelLinear, RowParallelLinear
+    return (Linear, ColumnParallelLinear, RowParallelLinear)
 
 
 @dataclass
@@ -117,15 +120,22 @@ def apply_lora(model: Layer, config: LoRAConfig) -> List[str]:
     """Inject adapters into every sublayer matching ``target_modules``,
     then freeze everything except the adapters. Returns injected paths."""
     pats = [re.compile(p + r"\Z") for p in config.target_modules]
-    hit = []
+    kinds = _linear_kinds()
+    hit, skipped = [], []
     for path, sub in model.named_sublayers():
-        if type(sub).__name__ not in _LINEAR_KINDS:
+        if not any(p.match(path) for p in pats):
             continue
-        if not hasattr(sub, "in_features"):
-            continue
-        if any(p.match(path) for p in pats):
+        # isinstance, not class-name: Linear subclasses adapt fine (the
+        # hook only needs forward(x)->y and in/out_features)
+        if isinstance(sub, kinds) and hasattr(sub, "in_features"):
             inject_lora(sub, config)
             hit.append(path)
+        else:
+            skipped.append(path)
+    if skipped:
+        import warnings
+        warnings.warn(f"apply_lora: target_modules matched non-Linear "
+                      f"sublayers, skipped: {skipped[:5]}", stacklevel=2)
     if not hit:
         raise ValueError(
             f"target_modules {list(config.target_modules)} matched nothing")

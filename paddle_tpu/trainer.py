@@ -55,6 +55,9 @@ class TrainingArguments:
     # skip_first_batches / consumed_samples accounting) so the loss
     # trajectory continues instead of re-seeing epoch-start data
     skip_data_on_resume: bool = True
+    # interleaved pipeline: virtual chunks per pp device (Megatron-style
+    # virtual_pp_degree); >1 shrinks the pipeline bubble that many times
+    virtual_pp_degree: int = 1
 
 
 class TrainerCallback:
@@ -75,15 +78,28 @@ class Trainer:
                  train_dataloader: Optional[Iterable] = None,
                  eval_dataloader: Optional[Iterable] = None,
                  callbacks: Optional[List[TrainerCallback]] = None,
-                 scaler=None):
+                 scaler=None, logits_loss: Optional[Callable] = None):
         self.model = model
         self.optimizer = optimizer
         self.args = args or TrainingArguments()
         # loss_fn(pure_fn, params, batch) -> scalar; default: causal LM on
-        # a batch of token ids (the flagship recipe)
+        # a batch of token ids (the flagship recipe). logits_loss(logits,
+        # labels) -> scalar swaps just the loss head while keeping the
+        # token-ids recipe — unlike loss_fn it also works under pipeline
+        # parallelism, where the loss must live at the LAST stage and a
+        # whole-model loss_fn cannot be decomposed.
+        if loss_fn is not None and logits_loss is not None:
+            raise ValueError("pass loss_fn OR logits_loss, not both")
         self._default_loss = loss_fn is None
-        self.loss_fn = loss_fn or (
-            lambda fn, p, batch: causal_lm_loss(fn(p, batch), batch))
+        self._logits_loss = logits_loss
+        if loss_fn is not None:
+            self.loss_fn = loss_fn
+        elif logits_loss is not None:
+            self.loss_fn = (
+                lambda fn, p, batch: logits_loss(fn(p, batch), batch))
+        else:
+            self.loss_fn = (
+                lambda fn, p, batch: causal_lm_loss(fn(p, batch), batch))
         self.train_dataloader = train_dataloader
         self.eval_dataloader = eval_dataloader
         self.callbacks = callbacks or []
@@ -137,10 +153,12 @@ class Trainer:
                                  "pipeline parallelism (use bf16)")
             if not self._default_loss:
                 raise ValueError(
-                    "pipeline parallelism hardwires the causal-LM loss at "
-                    "the last stage; a custom loss_fn would be silently "
-                    "ignored — drop it or run without pp")
-            vag = self.model.pipeline_functional(pp)
+                    "a whole-model loss_fn cannot be decomposed onto "
+                    "pipeline stages; pass logits_loss=(logits, labels) -> "
+                    "scalar instead — it runs at the last stage")
+            vag = self.model.pipeline_functional(
+                pp, logits_loss=self._logits_loss,
+                vpp=args.virtual_pp_degree)
 
             def pp_step(params, state, sstate, stepno, batch):
                 if not hasattr(batch, "ndim"):
@@ -301,10 +319,20 @@ class Trainer:
         assert self.eval_dataloader is not None
         fn = self._pure_fn
         losses = []
-        if self._eval_fn is None:  # build once; jit caches per batch shape
-            self._eval_fn = jax.jit(lambda p, b: self.loss_fn(fn, p, b))
-        for batch in self.eval_dataloader:
-            losses.append(float(self._eval_fn(self._params, batch)))
+        # trace the eval program with the module tree in eval mode so
+        # dropout (incl. LoRA adapter dropout) is OFF — training flags are
+        # trace-time constants, so flipping them here bakes eval semantics
+        # into this executable without touching the jitted train step
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            if self._eval_fn is None:  # build once; jit caches per shape
+                self._eval_fn = jax.jit(lambda p, b: self.loss_fn(fn, p, b))
+            for batch in self.eval_dataloader:
+                losses.append(float(self._eval_fn(self._params, batch)))
+        finally:
+            if was_training:
+                self.model.train()
         mean = float(np.mean(losses)) if losses else float("nan")
         self.logger.add_scalar("eval_loss", mean, self.global_step)
         return mean

@@ -257,3 +257,28 @@ class TestLoRADropout:
         p, s, _, l0b = step(p, s, None, jnp.int32(0), ids)
         assert float(l0) != float(l1)       # mask varies across steps
         assert float(l0) == float(l0b)      # ...but is step-deterministic
+
+    def test_evaluate_runs_without_dropout(self, tmp_path):
+        """evaluate() traces in eval mode: adapter dropout must be OFF, so
+        the eval loss equals the deterministic no-dropout loss."""
+        from paddle_tpu.models.llama import causal_lm_loss
+        from paddle_tpu.trainer import Trainer, TrainingArguments
+
+        pt.seed(0)
+        model = LlamaForCausalLM(llama_tiny())
+        apply_lora(model, LoRAConfig(r=4, lora_alpha=8, lora_dropout=0.5))
+        for k, v in lora_state_dict(model).items():
+            if k.endswith("lora_B"):
+                model._set_by_path(k, jnp.full_like(v, 0.05))
+        ids = _ids(2, 16)
+        tr = Trainer(model, pt.optimizer.SGD(learning_rate=0.0),
+                     TrainingArguments(output_dir=str(tmp_path),
+                                       resume_from_checkpoint=False),
+                     eval_dataloader=[ids])
+        got = tr.evaluate()
+        model.eval()
+        fn, p = model.functional()
+        want = float(causal_lm_loss(fn(p, ids), ids))
+        model.train()
+        assert abs(got - want) < 1e-5
+        assert model.training  # restored
