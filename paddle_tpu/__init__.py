@@ -52,6 +52,8 @@ from . import optimizer  # noqa: E402
 from . import inference  # noqa: E402
 from . import metric  # noqa: E402
 from . import peft  # noqa: E402
+from . import sparse  # noqa: E402
+from . import static  # noqa: E402
 from . import vision  # noqa: E402
 from . import quant  # noqa: E402
 from .checkpoint import load, save  # noqa: E402

@@ -5,8 +5,9 @@ from .gpt import GPTConfig, GPTForCausalLM, GPTModel, gpt_tiny
 from .bert import (BertConfig, BertForPretraining,
                    BertForSequenceClassification, BertModel, bert_tiny,
                    pretraining_loss)
-from .ernie import (ErnieConfig, ErnieForMaskedLM,
-                    ErnieForSequenceClassification, ErnieModel, ernie_tiny)
+from .ernie import (Ernie45MoeConfig, Ernie45MoeForCausalLM, ErnieConfig,
+                    ErnieForMaskedLM, ErnieForSequenceClassification,
+                    ErnieModel, ernie45_moe_tiny, ernie_tiny)
 from .qwen2 import (Qwen2Config, Qwen2ForCausalLM, Qwen2Model, qwen2_7b,
                     qwen2_tiny)
 from .qwen2_moe import (DeepseekMoeConfig, DeepseekMoeForCausalLM,

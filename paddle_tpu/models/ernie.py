@@ -88,3 +88,57 @@ class ErnieForSequenceClassification(Layer):
         _, pooled = self.ernie(input_ids, token_type_ids, attention_mask,
                                task_type_ids)
         return self.classifier(self.dropout(pooled)).astype(jnp.float32)
+
+
+# ----------------------------------------------------------- ERNIE 4.5 MoE
+# (reference: PaddleNLP paddlenlp/transformers/ernie4_5[_moe]/modeling.py —
+# Baidu's flagship decoder LM: GQA attention + fine-grained MoE FFN with
+# always-on shared experts, first k layers dense. Architecturally it is the
+# Qwen2MoE/DeepSeekMoE decoder shape, so the TPU build reuses that backbone
+# (parallel.moe.MoEMLP capacity dispatch over the ep axis); what ERNIE-4.5
+# changes is the config point below.)
+from .qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM  # noqa: E402
+
+
+@dataclass
+class Ernie45MoeConfig(Qwen2MoeConfig):
+    """ERNIE-4.5 text-MoE defaults at the 21B-A3B scale (the open release;
+    the 300B-A47B recipe is the same architecture scaled up). Exact tensor
+    shapes come from the checkpoint via hf_interop at load time; these
+    defaults define the architecture family."""
+    vocab_size: int = 103424
+    hidden_size: int = 2560
+    intermediate_size: int = 12288         # dense layers' FFN width
+    moe_intermediate_size: int = 1536      # per fine-grained expert
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 20
+    num_key_value_heads: int = 4
+    num_experts: int = 64
+    num_experts_per_tok: int = 6
+    num_shared_experts: int = 2
+    shared_expert_intermediate_size: Optional[int] = 1536
+    first_k_dense_replace: int = 1         # layer 0 stays dense
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    attention_bias: bool = False
+    tie_word_embeddings: bool = False
+
+
+def ernie45_moe_tiny(**overrides) -> Ernie45MoeConfig:
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                moe_intermediate_size=64, num_experts=4,
+                num_experts_per_tok=2, num_shared_experts=1,
+                shared_expert_intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=128,
+                first_k_dense_replace=1, rope_theta=10000.0,
+                dtype=jnp.float32)
+    base.update(overrides)
+    return Ernie45MoeConfig(**base)
+
+
+class Ernie45MoeForCausalLM(Qwen2MoeForCausalLM):
+    """ERNIE-4.5 CLM = the shared MoE decoder with ERNIE's config point."""
+
+    def __init__(self, config: Optional[Ernie45MoeConfig] = None):
+        super().__init__(config or Ernie45MoeConfig())

@@ -1,0 +1,250 @@
+"""paddle.sparse parity (reference: python/paddle/sparse — SparseCooTensor
+/ SparseCsrTensor creation, conversion, elementwise/matmul/activation ops).
+
+TPU-native design: sparse tensors wrap `jax.experimental.sparse` BCOO/BCSR,
+JAX's batched-sparse formats whose ops lower to XLA gather/scatter/segment
+ops — so sparse matmuls run through jit/grad/vmap like everything else
+instead of through hand-written CUDA kernels. On TPU, truly sparse compute
+rarely beats a dense MXU matmul unless sparsity is extreme; these types
+are for memory-bound workloads (huge embedding-style matrices, graph
+adjacency) and API parity, and `.to_dense()` is always one call away.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_same_shape", "add", "subtract", "multiply",
+    "divide", "matmul", "masked_matmul", "relu", "tanh", "sqrt", "sin",
+    "abs", "pow", "neg", "cast", "transpose", "coalesce",
+]
+
+
+class _SparseBase:
+    """Shared wrapper surface over a jax.experimental.sparse array."""
+
+    def __init__(self, mat):
+        self._mat = mat
+
+    @property
+    def shape(self):
+        return tuple(self._mat.shape)
+
+    @property
+    def dtype(self):
+        return self._mat.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._mat.nse)
+
+    def to_dense(self):
+        return self._mat.todense()
+
+    # paddle parity aliases
+    dense = property(to_dense)
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self.to_dense())
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(shape={self.shape}, "
+                f"nnz={self.nnz}, dtype={self.dtype})")
+
+
+class SparseCooTensor(_SparseBase):
+    """COO sparse tensor (reference: paddle.sparse.sparse_coo_tensor)."""
+
+    @property
+    def indices(self):
+        return self._mat.indices.T  # paddle layout: [ndim, nnz]
+
+    @property
+    def values(self):
+        return self._mat.data
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._mat))
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(jsparse.bcoo_sum_duplicates(self._mat))
+
+
+class SparseCsrTensor(_SparseBase):
+    """CSR sparse tensor (reference: paddle.sparse.sparse_csr_tensor)."""
+
+    @property
+    def crows(self):
+        return self._mat.indptr
+
+    @property
+    def cols(self):
+        return self._mat.indices
+
+    @property
+    def values(self):
+        return self._mat.data
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> "SparseCooTensor":
+        return SparseCooTensor(self._mat.to_bcoo())
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None, stop_gradient=True):
+    """indices [ndim, nnz] + values [nnz] -> SparseCooTensor."""
+    indices = jnp.asarray(indices)
+    values = jnp.asarray(values, dtype=dtype)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in indices.max(axis=1))
+    mat = jsparse.BCOO((values, indices.T.astype(jnp.int32)),
+                       shape=tuple(shape))
+    return SparseCooTensor(mat)
+
+
+def sparse_csr_tensor(crows, cols, values, shape: Sequence[int],
+                      dtype=None, place=None, stop_gradient=True):
+    crows = jnp.asarray(crows, jnp.int32)
+    cols = jnp.asarray(cols, jnp.int32)
+    values = jnp.asarray(values, dtype=dtype)
+    mat = jsparse.BCSR((values, cols, crows), shape=tuple(shape))
+    return SparseCsrTensor(mat)
+
+
+def _unwrap(x):
+    return x._mat if isinstance(x, _SparseBase) else jnp.asarray(x)
+
+
+def _rewrap(mat, like):
+    """Wrap a result, preserving the INPUT's sparse format (paddle
+    semantics: ops on CSR return CSR)."""
+    if isinstance(mat, jsparse.BCOO) and isinstance(like, SparseCsrTensor):
+        mat = jsparse.BCSR.from_bcoo(jsparse.bcoo_sum_duplicates(mat))
+    if isinstance(mat, jsparse.BCSR):
+        return SparseCsrTensor(mat)
+    if isinstance(mat, jsparse.BCOO):
+        return SparseCooTensor(mat)
+    return mat  # dense jax.Array
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def _coo(x):
+    """Elementwise ops run on BCOO (BCSR converts through)."""
+    m = _unwrap(x)
+    return m.to_bcoo() if isinstance(m, jsparse.BCSR) else m
+
+
+def add(x, y):
+    if isinstance(x, _SparseBase) and isinstance(y, _SparseBase):
+        if not is_same_shape(x, y):
+            raise ValueError(f"shape mismatch: {x.shape} vs {y.shape} "
+                             "(out-of-range indices would be silently "
+                             "dropped at densification)")
+        a, b = _coo(x), _coo(y)
+        merged = jsparse.BCOO(
+            (jnp.concatenate([a.data, b.data]),
+             jnp.concatenate([a.indices, b.indices])), shape=a.shape)
+        return _rewrap(jsparse.bcoo_sum_duplicates(merged), x)
+    return _unwrap(x).todense() + _unwrap(y)
+
+
+def subtract(x, y):
+    if isinstance(y, _SparseBase):
+        return add(x, multiply_scalar(y, -1.0))
+    # dense / scalar right operand: densify, mirroring add's behavior
+    return _unwrap(x).todense() - (jnp.asarray(y) if not
+                                   isinstance(y, (int, float)) else y)
+
+
+def multiply_scalar(x, s: float):
+    m = _coo(x)
+    return _rewrap(jsparse.BCOO((m.data * s, m.indices), shape=m.shape), x)
+
+
+def multiply(x, y):
+    if isinstance(y, (int, float)):
+        return multiply_scalar(x, float(y))
+    # elementwise sparse*sparse / sparse*dense via dense values at indices
+    m = _coo(x)
+    yv = _unwrap(y)
+    ydense = yv.todense() if isinstance(yv, (jsparse.BCOO, jsparse.BCSR)) \
+        else yv
+    picked = ydense[tuple(m.indices.T)]
+    return _rewrap(jsparse.BCOO((m.data * picked, m.indices),
+                                shape=m.shape), x)
+
+
+def divide(x, y):
+    if isinstance(y, (int, float)):
+        return multiply_scalar(x, 1.0 / float(y))
+    yv = _unwrap(y)
+    ydense = yv.todense() if isinstance(yv, (jsparse.BCOO, jsparse.BCSR)) \
+        else yv
+    m = _coo(x)
+    picked = ydense[tuple(m.indices.T)]
+    return _rewrap(jsparse.BCOO((m.data / picked, m.indices),
+                                shape=m.shape), x)
+
+
+def matmul(x, y):
+    """sparse @ dense -> dense (reference: paddle.sparse.matmul). The
+    gather/segment-sum lowering is XLA-native; grads flow to both sides."""
+    out = _unwrap(x) @ _unwrap(y)
+    return out if not isinstance(out, (jsparse.BCOO, jsparse.BCSR)) \
+        else out.todense()
+
+
+def masked_matmul(x, y, mask: SparseCooTensor):
+    """(x @ y) evaluated ONLY at mask's nonzero positions (reference:
+    paddle.sparse.masked_matmul) — the SDDMM primitive; avoids forming the
+    dense product."""
+    m = _coo(mask)
+    xd, yd = jnp.asarray(x), jnp.asarray(y)
+    rows, cols = m.indices[:, 0], m.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xd[rows, :], yd[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals.astype(xd.dtype), m.indices),
+                                        shape=m.shape))
+
+
+def _value_op(fn):
+    def op(x):
+        m = _coo(x)
+        return _rewrap(jsparse.BCOO((fn(m.data), m.indices),
+                                    shape=m.shape), x)
+    return op
+
+
+relu = _value_op(lambda v: jnp.maximum(v, 0))
+tanh = _value_op(jnp.tanh)
+sqrt = _value_op(jnp.sqrt)
+sin = _value_op(jnp.sin)
+abs = _value_op(jnp.abs)  # noqa: A001 (paddle name)
+neg = _value_op(jnp.negative)
+
+
+def pow(x, factor):  # noqa: A001 (paddle name)
+    return _value_op(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    m = _coo(x)
+    data = m.data.astype(value_dtype) if value_dtype else m.data
+    idx = m.indices.astype(index_dtype) if index_dtype else m.indices
+    return _rewrap(jsparse.BCOO((data, idx), shape=m.shape), x)
+
+
+def transpose(x, perm: Sequence[int]):
+    m = _coo(x)
+    return _rewrap(jsparse.bcoo_transpose(m, permutation=tuple(perm)), x)
+
+
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    return x.coalesce()

@@ -1,0 +1,284 @@
+"""paddle.static parity facade (reference: python/paddle/static — Program,
+Executor, data, program_guard, save/load_inference_model).
+
+TPU-native stance: the reference's static graph is a mutable op-by-op
+Program built under ``program_guard`` and run by a C++ Executor. Here the
+"static graph" IS a traced jaxpr and the "Executor" IS the XLA runtime, so
+this module maps the feed/fetch workflow onto the functional core:
+
+- ``static.data(name, shape, dtype)`` declares a named input spec
+  (shape/dtype placeholder; a leading -1 means a runtime-variable batch,
+  realised per concrete feed — each distinct shape compiles once).
+- A ``Program`` owns a python callable over those inputs. Imperative
+  op-by-op graph building is deliberately NOT emulated — Paddle itself
+  moved dynamic-first (dy2static); the supported way to get a graph is
+  ``Program.from_callable`` / ``build_program(fn)``, which captures the
+  jaxpr exactly like ``paddle.jit.to_static``.
+- ``Executor.run(program, feed={...}, fetch_list=[...])`` jit-compiles the
+  program for the feed's shapes (cached) and returns numpy outputs —
+  the reference's feed/fetch contract.
+- ``save/load_inference_model`` reuse the AOT jax.export path in
+  ``paddle_tpu.jit``.
+"""
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dtypes import to_dtype
+
+__all__ = [
+    "InputSpec", "data", "Program", "program_guard", "default_main_program",
+    "default_startup_program", "build_program", "Executor", "cpu_places",
+    "cuda_places", "xpu_places", "device_places", "global_scope", "Scope",
+    "save_inference_model", "load_inference_model", "name_scope",
+]
+
+
+@dataclass(frozen=True)
+class InputSpec:
+    """Named input placeholder (reference: paddle.static.InputSpec)."""
+    name: str
+    shape: Tuple[int, ...]
+    dtype: Any
+
+    def concrete_shape(self, feed_value) -> Tuple[int, ...]:
+        got = tuple(np.shape(feed_value))
+        want = self.shape
+        if len(got) != len(want) or any(
+                w != -1 and w != g for w, g in zip(want, got)):
+            raise ValueError(
+                f"feed '{self.name}': shape {got} does not match "
+                f"declared {want}")
+        return got
+
+
+class Program:
+    """A runnable graph: named input specs + a callable over them.
+
+    ``fn(**inputs) -> output or tuple`` is traced per concrete feed shape
+    (the jaxpr is the reference's ProgramDesc analogue, inspectable via
+    ``concrete_program``)."""
+
+    def __init__(self):
+        self.specs: Dict[str, InputSpec] = {}
+        self.fn: Optional[Callable] = None
+        self._jitted = None
+        self.random_seed: Optional[int] = None
+
+    # ---- construction
+    def add_spec(self, spec: InputSpec):
+        if spec.name in self.specs:
+            raise ValueError(f"duplicate static.data name {spec.name!r}")
+        self.specs[spec.name] = spec
+        return spec
+
+    def set_callable(self, fn: Callable) -> "Program":
+        self.fn = fn
+        self._jitted = jax.jit(fn)
+        return self
+
+    @classmethod
+    def from_callable(cls, fn: Callable,
+                      specs: Sequence[InputSpec]) -> "Program":
+        p = cls()
+        for s in specs:
+            p.add_spec(s)
+        return p.set_callable(fn)
+
+    # ---- inspection (ProgramDesc parity)
+    def concrete_program(self, feed: Dict[str, Any]):
+        args = self._ordered_feed(feed)
+        return jax.make_jaxpr(lambda *a: self.fn(**dict(zip(self.specs, a))))(
+            *args)
+
+    def _ordered_feed(self, feed: Dict[str, Any]) -> List[jax.Array]:
+        missing = [n for n in self.specs if n not in feed]
+        if missing:
+            raise KeyError(f"feed missing inputs {missing}")
+        out = []
+        for name, spec in self.specs.items():
+            v = jnp.asarray(feed[name], dtype=to_dtype(spec.dtype))
+            spec.concrete_shape(v)
+            out.append(v)
+        return out
+
+    def run(self, feed: Dict[str, Any]):
+        if self.fn is None:
+            raise RuntimeError(
+                "Program has no callable. Imperative op-by-op building is "
+                "not emulated on the jax core — attach the computation with "
+                "Program.from_callable(fn, specs) / build_program(fn) "
+                "(the dy2static path, like the reference's to_static)")
+        args = self._ordered_feed(feed)
+        return self._jitted(**dict(zip(self.specs, args)))
+
+    def global_block(self):  # minimal ProgramDesc surface
+        return self
+
+    def all_parameters(self):
+        return []
+
+
+_default_main = Program()
+_default_startup = Program()
+_program_stack: List[Program] = []
+
+
+def default_main_program() -> Program:
+    return _program_stack[-1] if _program_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program,
+                  startup_program: Optional[Program] = None):
+    _program_stack.append(main_program)
+    try:
+        yield main_program
+    finally:
+        _program_stack.pop()
+
+
+def data(name: str, shape: Sequence[int], dtype="float32",
+         lod_level: int = 0) -> InputSpec:  # noqa: ARG001 (paddle sig)
+    """Declare a named input on the current program (paddle.static.data)."""
+    spec = InputSpec(name, tuple(int(s) for s in shape), dtype)
+    default_main_program().add_spec(spec)
+    return spec
+
+
+def build_program(fn: Callable, program: Optional[Program] = None) -> Program:
+    """Attach `fn(**declared_inputs)` to the program (dy2static path)."""
+    p = program or default_main_program()
+    return p.set_callable(fn)
+
+
+# ------------------------------------------------------------------ places
+class _Place:
+    def __init__(self, kind: str, idx: int = 0):
+        self.kind, self.idx = kind, idx
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.idx})"
+
+
+def device_places(device_count: Optional[int] = None):
+    devs = jax.devices()
+    n = device_count or len(devs)
+    return [_Place(d.platform, d.id) for d in devs[:n]]
+
+
+def cpu_places(device_count: Optional[int] = None):
+    return [_Place("cpu", i) for i in range(device_count or 1)]
+
+
+def cuda_places(device_ids=None):  # reference API; maps to the TPU devices
+    return device_places()
+
+
+xpu_places = cuda_places
+
+
+# ------------------------------------------------------------------- scope
+class Scope:
+    """Name -> value store (reference: paddle.static.global_scope)."""
+
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def var(self, name: str):
+        return self._vars.setdefault(name, None)
+
+    def set_var(self, name: str, value):
+        self._vars[name] = value
+
+    def find_var(self, name: str):
+        return self._vars.get(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):  # cosmetic parity; jaxpr names are automatic
+    yield
+
+
+# --------------------------------------------------------------- executor
+class Executor:
+    """Feed/fetch runner (reference: paddle.static.Executor). ``place`` is
+    accepted for parity; execution always targets the active jax backend."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None,
+            return_numpy: bool = True):
+        program = program or default_main_program()
+        out = program.run(feed or {})
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        if fetch_list is not None:
+            if all(isinstance(f, int) for f in fetch_list):
+                # select by output position (the fetch_list contract)
+                try:
+                    outs = [outs[f] for f in fetch_list]
+                except IndexError:
+                    raise ValueError(
+                        f"fetch_list {list(fetch_list)} out of range for "
+                        f"{len(outs)} program outputs") from None
+            elif len(fetch_list) != len(outs):
+                raise ValueError(
+                    f"program returned {len(outs)} outputs, fetch_list "
+                    f"asks for {len(fetch_list)}; use integer positions "
+                    "to fetch a subset")
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs
+
+    def close(self):
+        pass
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
+                         program: Optional[Program] = None):
+    """AOT-export the program for serving (jax.export under the hood).
+    Declared -1 dims export as SYMBOLIC dims, so the loaded model accepts
+    any size there (each -1 gets its own dimension variable)."""
+    from . import jit as _jit
+    program = program or default_main_program()
+    var_names = [f"{s.name}_d{i}".replace("-", "_")
+                 for s in program.specs.values()
+                 for i, d in enumerate(s.shape) if d == -1]
+    sym = {}
+    if var_names:
+        from jax import export as jax_export
+        dims = jax_export.symbolic_shape(", ".join(var_names))
+        sym = dict(zip(var_names, dims))
+    example = []
+    for s in program.specs.values():
+        shape = tuple(sym[f"{s.name}_d{i}".replace("-", "_")] if d == -1
+                      else d for i, d in enumerate(s.shape))
+        example.append(jax.ShapeDtypeStruct(shape, to_dtype(s.dtype)))
+    return _jit.save(_jit.StaticFunction(
+        lambda *a: program.fn(**dict(zip(program.specs, a)))),
+        path_prefix, *example)
+
+
+def load_inference_model(path_prefix: str, executor=None):
+    from . import jit as _jit
+    return _jit.load(path_prefix)

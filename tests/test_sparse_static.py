@@ -1,0 +1,211 @@
+"""paddle.sparse (BCOO/BCSR core ops) + paddle.static (Program/Executor
+feed-fetch) + ERNIE-4.5 MoE config-point tests (SURVEY C31/C32)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import sparse, static
+
+
+def _coo_example():
+    dense = np.array([[0., 2., 0.], [3., 0., 4.]], np.float32)
+    idx = np.array([[0, 1, 1], [1, 0, 2]])
+    vals = np.array([2., 3., 4.], np.float32)
+    return dense, idx, vals
+
+
+class TestSparse:
+    def test_coo_create_to_dense(self):
+        dense, idx, vals = _coo_example()
+        s = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+        assert s.nnz == 3 and s.shape == (2, 3)
+        np.testing.assert_array_equal(np.asarray(s.to_dense()), dense)
+        np.testing.assert_array_equal(np.asarray(s.indices), idx)
+
+    def test_csr_create_and_convert(self):
+        dense, _, _ = _coo_example()
+        c = sparse.sparse_csr_tensor([0, 1, 3], [1, 0, 2], [2., 3., 4.],
+                                     (2, 3))
+        np.testing.assert_array_equal(np.asarray(c.to_dense()), dense)
+        coo = c.to_sparse_coo()
+        np.testing.assert_array_equal(np.asarray(coo.to_dense()), dense)
+        back = coo.to_sparse_csr()
+        np.testing.assert_array_equal(np.asarray(back.crows), [0, 1, 3])
+
+    def test_elementwise_and_activations(self):
+        dense, idx, vals = _coo_example()
+        s = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+        np.testing.assert_allclose(
+            np.asarray(sparse.add(s, s).to_dense()), dense * 2)
+        np.testing.assert_allclose(
+            np.asarray(sparse.multiply(s, 3.0).to_dense()), dense * 3)
+        neg = sparse.neg(s)
+        np.testing.assert_allclose(
+            np.asarray(sparse.relu(neg).to_dense()), np.zeros_like(dense))
+        np.testing.assert_allclose(
+            np.asarray(sparse.tanh(s).to_dense()), np.tanh(dense), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sparse.pow(s, 2).to_dense()), dense ** 2)
+
+    def test_matmul_and_grad(self):
+        dense, idx, vals = _coo_example()
+        s = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+        y = jnp.asarray(np.random.RandomState(0).randn(3, 4), jnp.float32)
+        out = sparse.matmul(s, y)
+        np.testing.assert_allclose(np.asarray(out), dense @ np.asarray(y),
+                                   rtol=1e-6)
+        # grads flow through the sparse matmul to the dense operand
+        g = jax.grad(lambda yy: sparse.matmul(s, yy).sum())(y)
+        np.testing.assert_allclose(np.asarray(g),
+                                   dense.T @ np.ones((2, 4), np.float32),
+                                   rtol=1e-6)
+
+    def test_masked_matmul(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(4, 8).astype(np.float32)
+        y = rs.randn(8, 4).astype(np.float32)
+        mask_idx = np.array([[0, 1, 3], [2, 0, 3]])
+        mask = sparse.sparse_coo_tensor(mask_idx, np.ones(3, np.float32),
+                                        (4, 4))
+        out = sparse.masked_matmul(x, y, mask)
+        full = x @ y
+        want = np.zeros((4, 4), np.float32)
+        for r, c in zip(*mask_idx):
+            want[r, c] = full[r, c]
+        np.testing.assert_allclose(np.asarray(out.to_dense()), want,
+                                   rtol=1e-5)
+
+    def test_csr_format_preserved(self):
+        c = sparse.sparse_csr_tensor([0, 1, 3], [1, 0, 2], [2., -3., 4.],
+                                     (2, 3))
+        r = sparse.relu(c)
+        assert isinstance(r, sparse.SparseCsrTensor)
+        assert hasattr(r, "crows")
+        np.testing.assert_allclose(
+            np.asarray(r.to_dense()),
+            np.array([[0., 2., 0.], [0., 0., 4.]], np.float32))
+
+    def test_subtract_dense_and_mismatch(self):
+        dense, idx, vals = _coo_example()
+        s = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+        out = sparse.subtract(s, jnp.ones((2, 3), jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), dense - 1.0)
+        np.testing.assert_allclose(
+            np.asarray(sparse.subtract(s, s).to_dense()),
+            np.zeros_like(dense))
+        bigger = sparse.sparse_coo_tensor([[0], [0]], [1.0], (4, 4))
+        with pytest.raises(ValueError, match="shape mismatch"):
+            sparse.add(s, bigger)
+
+    def test_transpose_cast(self):
+        dense, idx, vals = _coo_example()
+        s = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+        t = sparse.transpose(s, [1, 0])
+        np.testing.assert_array_equal(np.asarray(t.to_dense()), dense.T)
+        c = sparse.cast(s, value_dtype=jnp.float16)
+        assert c.dtype == jnp.float16
+
+
+class TestStatic:
+    def test_program_executor_feed_fetch(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [-1, 4], "float32")
+            w = static.data("w", [4, 2], "float32")
+            static.build_program(lambda x, w: (x @ w, (x @ w).sum()))
+        exe = static.Executor(static.device_places()[0])
+        xv = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        wv = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+        out, total = exe.run(prog, feed={"x": xv, "w": wv},
+                             fetch_list=[0, 1])
+        np.testing.assert_allclose(out, xv @ wv, rtol=1e-5)
+        np.testing.assert_allclose(total, (xv @ wv).sum(), rtol=1e-5)
+        # variable batch: leading -1 admits a different batch size
+        out2, _ = exe.run(prog, feed={"x": xv[:2], "w": wv},
+                          fetch_list=[0, 1])
+        assert out2.shape == (2, 2)
+
+    def test_fetch_list_selects_subset(self):
+        prog = static.Program.from_callable(
+            lambda x: (x * 2, x.sum()),
+            [static.InputSpec("x", (3,), "float32")])
+        exe = static.Executor()
+        xv = np.arange(3, dtype=np.float32)
+        (total,) = exe.run(prog, feed={"x": xv}, fetch_list=[1])
+        np.testing.assert_allclose(total, 3.0)
+        with pytest.raises(ValueError, match="out of range"):
+            exe.run(prog, feed={"x": xv}, fetch_list=[2])
+
+    def test_save_load_inference_model_dynamic_batch(self, tmp_path):
+        import os
+        prog = static.Program()
+        with static.program_guard(prog):
+            static.data("x", [-1, 4], "float32")
+            static.build_program(lambda x: x @ jnp.ones((4, 2)))
+        path = os.path.join(str(tmp_path), "served")
+        static.save_inference_model(path, None, None, None, program=prog)
+        fn = static.load_inference_model(path)
+        # the -1 dim exported symbolically: both batch sizes work
+        assert np.asarray(fn(np.zeros((2, 4), np.float32))).shape == (2, 2)
+        assert np.asarray(fn(np.zeros((5, 4), np.float32))).shape == (5, 2)
+
+    def test_shape_mismatch_rejected(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            static.data("x", [2, 3], "float32")
+            static.build_program(lambda x: x * 2)
+        with pytest.raises(ValueError, match="shape"):
+            static.Executor().run(prog, feed={"x": np.zeros((2, 4),
+                                                            np.float32)})
+
+    def test_program_without_callable_errors(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            static.data("x", [1], "float32")
+        with pytest.raises(RuntimeError, match="from_callable"):
+            static.Executor().run(prog, feed={"x": np.zeros(1, np.float32)})
+
+    def test_concrete_program_jaxpr(self):
+        prog = static.Program.from_callable(
+            lambda a: a + 1, [static.InputSpec("a", (2,), "float32")])
+        jaxpr = prog.concrete_program({"a": np.zeros(2, np.float32)})
+        assert "add" in str(jaxpr)
+
+    def test_default_program_and_scope(self):
+        assert static.default_main_program() is not None
+        sc = static.global_scope()
+        sc.set_var("k", 7)
+        assert sc.find_var("k") == 7
+
+
+class TestErnie45Moe:
+    def test_forward_loss_and_grad(self):
+        from paddle_tpu.models import (Ernie45MoeForCausalLM, ernie45_moe_tiny,
+                                       moe_lm_loss)
+        pt.seed(0)
+        model = Ernie45MoeForCausalLM(ernie45_moe_tiny())
+        # layer 0 dense (first_k_dense_replace=1), layer 1 MoE
+        from paddle_tpu.parallel.moe import MoEMLP
+        kinds = [type(l.mlp).__name__ for l in model.model.layers]
+        assert kinds[0] != "MoEMLP" and kinds[1] == "MoEMLP"
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 16)))
+        fn, params = model.functional()
+
+        def loss(p):
+            logits, aux = fn(p, ids, return_aux=True)
+            return moe_lm_loss(logits, aux, ids)
+
+        l, g = jax.value_and_grad(loss)(dict(params))
+        assert np.isfinite(float(l))
+        gsum = sum(float(jnp.abs(v).sum()) for v in g.values())
+        assert np.isfinite(gsum) and gsum > 0
+
+    def test_generate(self):
+        from paddle_tpu.models import Ernie45MoeForCausalLM, ernie45_moe_tiny
+        pt.seed(0)
+        model = Ernie45MoeForCausalLM(ernie45_moe_tiny())
+        ids = jnp.asarray(np.random.RandomState(1).randint(0, 256, (2, 8)))
+        out = model.generate(ids, max_new_tokens=4, temperature=0.0)
+        assert out.shape == (2, 12)
