@@ -9,6 +9,9 @@
 """
 from ..native import Tokenizer as TrieTokenizer
 from .bpe import (GPT2_SPLIT, LLAMA3_SPLIT, BPETokenizer, bytes_to_unicode)
+from .chat import (CHAT_TEMPLATES, apply_chat_template,
+                   render_chat_template)
 
 __all__ = ["BPETokenizer", "TrieTokenizer", "bytes_to_unicode",
-           "GPT2_SPLIT", "LLAMA3_SPLIT"]
+           "GPT2_SPLIT", "LLAMA3_SPLIT", "CHAT_TEMPLATES",
+           "apply_chat_template", "render_chat_template"]

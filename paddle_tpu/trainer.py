@@ -308,10 +308,15 @@ class Trainer:
 
     def _prep_batch(self, batch):
         accum = self.args.gradient_accumulation_steps
-        if accum > 1 and hasattr(batch, "shape"):
-            b = batch.shape[0]
-            assert b % accum == 0, f"batch {b} % accum {accum} != 0"
-            batch = batch.reshape((accum, b // accum) + batch.shape[1:])
+        if accum > 1:
+            def fold(x):
+                b = x.shape[0]
+                assert b % accum == 0, f"batch {b} % accum {accum} != 0"
+                return x.reshape((accum, b // accum) + x.shape[1:])
+            if hasattr(batch, "shape"):
+                batch = fold(batch)
+            elif isinstance(batch, dict):  # SFT/DPO dict batches
+                batch = {k: fold(v) for k, v in batch.items()}
         return batch
 
     # ------------------------------------------------------------- eval

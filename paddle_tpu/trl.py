@@ -1,0 +1,159 @@
+"""LLM fine-tuning losses and trainers (reference: PaddleNLP
+paddlenlp/trl — SFTTrainer/DPOTrainer and llm/ alignment recipes).
+
+TPU-native stance: both recipes are ordinary jitted train steps over the
+existing Trainer; what this module adds is the loss algebra and the batch
+conventions:
+
+- SFT: causal LM cross-entropy masked to the RESPONSE tokens only
+  (prompt tokens contribute no gradient). Batches are dicts of static-
+  shape arrays (``input_ids`` [b, s], ``loss_mask`` [b, s]) — right-
+  padded, so one compiled step serves every batch.
+- DPO: the Bradley-Terry preference loss on (chosen, rejected) pairs.
+  Reference log-probs are PRECOMPUTED (``compute_sequence_logps`` with
+  the frozen reference params) and carried in the batch — the jitted
+  policy step then needs no second model in the program, which on TPU
+  means no duplicated weights in HBM and no constant-folding a whole
+  reference model into the executable.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .trainer import Trainer, TrainingArguments
+
+__all__ = [
+    "sft_loss", "sequence_logps", "compute_sequence_logps", "dpo_loss",
+    "DataCollatorForSFT", "SFTTrainer", "make_dpo_loss_fn", "DPOTrainer",
+]
+
+
+def _token_logps(logits, input_ids, loss_mask):
+    """Shifted next-token log-probs at the masked positions: [b, s-1]."""
+    lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    tgt = jnp.take_along_axis(lp, input_ids[:, 1:, None], axis=-1)[..., 0]
+    return tgt * loss_mask[:, 1:].astype(jnp.float32)
+
+
+def sft_loss(logits, input_ids, loss_mask):
+    """Next-token CE on positions where loss_mask[t+1] == 1 (the response;
+    reference: PaddleNLP SFT recipes' masked cross-entropy)."""
+    tok = _token_logps(logits, input_ids, loss_mask)
+    n = jnp.maximum(loss_mask[:, 1:].sum().astype(jnp.float32), 1.0)
+    return -tok.sum() / n
+
+
+def sequence_logps(logits, input_ids, loss_mask):
+    """Per-sequence sum log-prob of the masked (response) tokens."""
+    return _token_logps(logits, input_ids, loss_mask).sum(axis=-1)
+
+
+def compute_sequence_logps(model, input_ids, loss_mask, batch_size: int = 8):
+    """Run a (frozen reference) model over sequences and return summed
+    response log-probs — the precompute step of the DPO recipe. The model
+    is traced in EVAL mode (dropout off): a reference model in train mode
+    would either crash on an un-keyed next_key() under tracing or bias
+    the reference logps with dropout noise."""
+    was_training = model.training
+    model.eval()
+    try:
+        fn, params = model.functional()
+        jf = jax.jit(lambda p, ids, m: sequence_logps(fn(p, ids), ids, m))
+        outs = []
+        for i in range(0, input_ids.shape[0], batch_size):
+            outs.append(jf(params, input_ids[i:i + batch_size],
+                           loss_mask[i:i + batch_size]))
+    finally:
+        if was_training:
+            model.train()
+    return jnp.concatenate(outs)
+
+
+def dpo_loss(policy_chosen_logps, policy_rejected_logps,
+             reference_chosen_logps, reference_rejected_logps,
+             beta: float = 0.1, label_smoothing: float = 0.0):
+    """Direct Preference Optimization (reference: PaddleNLP DPOTrainer;
+    Rafailov et al. 2023). Returns (loss, chosen_rewards, rejected_rewards)
+    — the rewards are the implicit ones, for logging margin/accuracy."""
+    chosen_rel = policy_chosen_logps - reference_chosen_logps
+    rejected_rel = policy_rejected_logps - reference_rejected_logps
+    logits = beta * (chosen_rel - rejected_rel)
+    loss = (-jax.nn.log_sigmoid(logits) * (1 - label_smoothing)
+            - jax.nn.log_sigmoid(-logits) * label_smoothing).mean()
+    return loss, beta * chosen_rel, beta * rejected_rel
+
+
+class DataCollatorForSFT:
+    """prompt/response token lists -> right-padded static-shape batches
+    {"input_ids": [b, max_len], "loss_mask": [b, max_len]} (reference:
+    PaddleNLP llm/ SFT data pipeline). Static shapes = one compile."""
+
+    def __init__(self, max_length: int, pad_token_id: int = 0,
+                 mask_prompt: bool = True):
+        self.max_length = max_length
+        self.pad_token_id = pad_token_id
+        self.mask_prompt = mask_prompt
+
+    def __call__(self, examples) -> Dict[str, jnp.ndarray]:
+        L = self.max_length
+        ids = np.full((len(examples), L), self.pad_token_id, np.int32)
+        mask = np.zeros((len(examples), L), np.int32)
+        for i, ex in enumerate(examples):
+            prompt = list(ex["prompt_ids"])
+            resp = list(ex["response_ids"])
+            seq = (prompt + resp)[:L]
+            ids[i, :len(seq)] = seq
+            start = min(len(prompt), L) if self.mask_prompt else 0
+            mask[i, start:len(seq)] = 1
+        return {"input_ids": jnp.asarray(ids), "loss_mask": jnp.asarray(mask)}
+
+
+class SFTTrainer(Trainer):
+    """Trainer preconfigured with the masked SFT loss over dict batches
+    (reference: paddlenlp.trl.SFTTrainer)."""
+
+    def __init__(self, model, optimizer, args: Optional[TrainingArguments]
+                 = None, **kw):
+        kw.setdefault("loss_fn", lambda fn, p, batch: sft_loss(
+            fn(p, batch["input_ids"]), batch["input_ids"],
+            batch["loss_mask"]))
+        super().__init__(model, optimizer, args, **kw)
+
+
+def make_dpo_loss_fn(beta: float = 0.1, label_smoothing: float = 0.0
+                     ) -> Callable:
+    """Trainer loss_fn for DPO batches: {"chosen_ids", "chosen_mask",
+    "rejected_ids", "rejected_mask", "ref_chosen_logps",
+    "ref_rejected_logps"} (reference logps precomputed)."""
+
+    def loss_fn(fn, p, batch):
+        # concatenated forward (the standard DPO trick): one [2b, s] pass
+        # instead of two [b, s] passes — same math, better TPU utilization
+        b = batch["chosen_ids"].shape[0]
+        ids = jnp.concatenate([batch["chosen_ids"], batch["rejected_ids"]])
+        mask = jnp.concatenate([batch["chosen_mask"],
+                                batch["rejected_mask"]])
+        logps = sequence_logps(fn(p, ids), ids, mask)
+        loss, _, _ = dpo_loss(logps[:b], logps[b:],
+                              batch["ref_chosen_logps"],
+                              batch["ref_rejected_logps"], beta,
+                              label_smoothing)
+        return loss
+
+    return loss_fn
+
+
+class DPOTrainer(Trainer):
+    """Trainer preconfigured with the DPO preference loss (reference:
+    paddlenlp.trl.DPOTrainer). Precompute the reference logps with
+    ``compute_sequence_logps(ref_model, ...)`` into the batches."""
+
+    def __init__(self, model, optimizer, args: Optional[TrainingArguments]
+                 = None, beta: float = 0.1, label_smoothing: float = 0.0,
+                 **kw):
+        kw.setdefault("loss_fn", make_dpo_loss_fn(beta, label_smoothing))
+        super().__init__(model, optimizer, args, **kw)

@@ -1,0 +1,150 @@
+"""SFT/DPO fine-tuning (C33: paddlenlp.trl parity) + chat templates."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.tokenizer import render_chat_template
+from paddle_tpu.trainer import TrainingArguments
+from paddle_tpu.trl import (DataCollatorForSFT, DPOTrainer, SFTTrainer,
+                            compute_sequence_logps, dpo_loss, sequence_logps,
+                            sft_loss)
+
+
+def _model():
+    pt.seed(0)
+    return LlamaForCausalLM(llama_tiny())
+
+
+class TestSFT:
+    def test_loss_masks_prompt(self):
+        rs = np.random.RandomState(0)
+        logits = jnp.asarray(rs.randn(2, 8, 16), jnp.float32)
+        ids = jnp.asarray(rs.randint(0, 16, (2, 8)))
+        full = sft_loss(logits, ids, jnp.ones((2, 8), jnp.int32))
+        # manual shifted CE mean
+        lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        want = -np.take_along_axis(np.asarray(lp),
+                                   np.asarray(ids)[:, 1:, None],
+                                   axis=-1).mean()
+        np.testing.assert_allclose(float(full), want, rtol=1e-6)
+        # masking out everything but one position isolates that token
+        mask = np.zeros((2, 8), np.int32)
+        mask[0, 5] = 1
+        one = sft_loss(logits, ids, jnp.asarray(mask))
+        want_one = -float(np.asarray(lp)[0, 4, int(ids[0, 5])])
+        np.testing.assert_allclose(float(one), want_one, rtol=1e-6)
+
+    def test_collator(self):
+        coll = DataCollatorForSFT(max_length=10, pad_token_id=9)
+        batch = coll([
+            {"prompt_ids": [1, 2, 3], "response_ids": [4, 5]},
+            {"prompt_ids": [6], "response_ids": list(range(20))},  # trunc
+        ])
+        ids, mask = np.asarray(batch["input_ids"]), np.asarray(batch["loss_mask"])
+        assert ids.shape == (2, 10)
+        np.testing.assert_array_equal(ids[0, :5], [1, 2, 3, 4, 5])
+        assert (ids[0, 5:] == 9).all()
+        np.testing.assert_array_equal(mask[0], [0, 0, 0, 1, 1, 0, 0, 0, 0, 0])
+        assert mask[1, 0] == 0 and mask[1, 1:].all()  # prompt len 1
+
+    def test_sft_trainer_learns_response_only(self, tmp_path):
+        model = _model()
+        coll = DataCollatorForSFT(max_length=16, pad_token_id=0)
+        rs = np.random.RandomState(0)
+        examples = [{"prompt_ids": rs.randint(1, 256, 6).tolist(),
+                     "response_ids": rs.randint(1, 256, 8).tolist()}
+                    for _ in range(4)]
+        batch = coll(examples)
+        tr = SFTTrainer(model, pt.optimizer.AdamW(learning_rate=1e-2),
+                        TrainingArguments(output_dir=str(tmp_path),
+                                          max_steps=15, logging_steps=5,
+                                          resume_from_checkpoint=False),
+                        train_dataloader=[batch])
+        tr.train()
+        hist = tr.logger.history["loss"]
+        assert hist[-1][1] < hist[0][1]
+
+
+class TestDPO:
+    def test_dpo_loss_neutral_point(self):
+        z = jnp.zeros((4,))
+        loss, cr, rr = dpo_loss(z, z, z, z, beta=0.1)
+        np.testing.assert_allclose(float(loss), np.log(2.0), rtol=1e-6)
+        # improving chosen relative to reference lowers the loss
+        better, _, _ = dpo_loss(z + 1.0, z, z, z, beta=0.1)
+        assert float(better) < float(loss)
+
+    def test_sequence_logps_and_precompute(self):
+        model = _model()
+        rs = np.random.RandomState(1)
+        ids = jnp.asarray(rs.randint(0, 256, (3, 12)))
+        mask = jnp.asarray((rs.rand(3, 12) > 0.3).astype(np.int32))
+        fn, params = model.functional()
+        direct = sequence_logps(fn(dict(params), ids), ids, mask)
+        pre = compute_sequence_logps(model, ids, mask, batch_size=2)
+        np.testing.assert_allclose(np.asarray(direct), np.asarray(pre),
+                                   rtol=1e-5)
+        assert (np.asarray(direct) <= 0).all()
+
+    def test_dpo_trainer_improves_preference(self, tmp_path):
+        model = _model()
+        rs = np.random.RandomState(2)
+        chosen = jnp.asarray(rs.randint(1, 256, (4, 12)))
+        rejected = jnp.asarray(rs.randint(1, 256, (4, 12)))
+        mask = jnp.ones((4, 12), jnp.int32)
+        ref_c = compute_sequence_logps(model, chosen, mask)
+        ref_r = compute_sequence_logps(model, rejected, mask)
+        batch = {"chosen_ids": chosen, "chosen_mask": mask,
+                 "rejected_ids": rejected, "rejected_mask": mask,
+                 "ref_chosen_logps": ref_c, "ref_rejected_logps": ref_r}
+        tr = DPOTrainer(model, pt.optimizer.AdamW(learning_rate=5e-3),
+                        TrainingArguments(output_dir=str(tmp_path),
+                                          max_steps=10, logging_steps=5,
+                                          resume_from_checkpoint=False),
+                        beta=0.1, train_dataloader=[batch])
+        tr.train()
+        hist = tr.logger.history["loss"]
+        assert hist[0][1] <= np.log(2.0) + 0.2
+        assert hist[-1][1] < hist[0][1]
+        # post-training: the policy now prefers chosen over rejected
+        fn, params = model.functional()
+        pc = sequence_logps(fn(dict(params), chosen), chosen, mask)
+        pr = sequence_logps(fn(dict(params), rejected), rejected, mask)
+        margin = float((pc - ref_c).mean() - (pr - ref_r).mean())
+        assert margin > 0, margin
+
+
+class TestChatTemplates:
+    MSGS = [{"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"}]
+
+    def test_llama3(self):
+        s = render_chat_template(self.MSGS, "llama3")
+        assert s.startswith("<|begin_of_text|>")
+        assert "<|start_header_id|>system<|end_header_id|>\n\nbe brief" in s
+        assert s.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+
+    def test_chatml_qwen(self):
+        s = render_chat_template(self.MSGS, "qwen2",
+                                 add_generation_prompt=False)
+        assert s == ("<|im_start|>system\nbe brief<|im_end|>\n"
+                     "<|im_start|>user\nhi<|im_end|>\n")
+
+    def test_unknown_template_and_bad_message(self):
+        with pytest.raises(KeyError, match="unknown chat template"):
+            render_chat_template(self.MSGS, "nope")
+        with pytest.raises(ValueError, match="role"):
+            render_chat_template([{"content": "x"}], "llama3")
+
+    def test_apply_with_tokenizer(self):
+        from paddle_tpu.tokenizer import apply_chat_template
+
+        class Tok:
+            def encode(self, text):
+                return [ord(c) % 97 for c in text[:5]]
+
+        out = apply_chat_template(Tok(), self.MSGS, "chatml")
+        assert len(out) == 5
