@@ -1,0 +1,217 @@
+"""paddle.audio parity (reference: python/paddle/audio — functional
+window/mel utilities and the Spectrogram/MelSpectrogram/LogMelSpectrogram/
+MFCC feature layers).
+
+TPU-native: everything is a pure jnp program over the existing
+``paddle_tpu.signal.stft`` — one fused XLA program per feature (frame →
+window → rfft → |.|^p → mel matmul → log/DCT), batched over channels, so
+feature extraction can live INSIDE a jitted train step (e.g. an audio
+classifier consuming raw waveforms) instead of a host-side preprocessing
+pass.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from . import signal as _signal
+from .nn.layer import Layer
+
+__all__ = [
+    "get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+    "compute_fbank_matrix", "create_dct", "power_to_db",
+    "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
+]
+
+
+# -------------------------------------------------------------- functional
+def get_window(window: str, win_length: int, fftbins: bool = True,
+               dtype=jnp.float32):
+    """hann/hamming/blackman/ones (reference: paddle.audio.functional
+    .get_window). ``fftbins=True`` gives the periodic variant."""
+    n = jnp.arange(win_length, dtype=jnp.float32)
+    denom = win_length if fftbins else win_length - 1
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * n / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * n / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * n / denom)
+             + 0.08 * jnp.cos(4 * math.pi * n / denom))
+    elif window in ("ones", "boxcar", "rectangular"):
+        w = jnp.ones((win_length,), jnp.float32)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return w.astype(dtype)
+
+
+def hz_to_mel(freq, htk: bool = False):
+    freq = jnp.asarray(freq, jnp.float32)
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + freq / 700.0)
+    # Slaney: linear below 1 kHz, log above
+    f_min, f_sp = 0.0, 200.0 / 3
+    mel = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(freq >= min_log_hz,
+                     min_log_mel + jnp.log(freq / min_log_hz) / logstep, mel)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    mel = jnp.asarray(mel, jnp.float32)
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freq = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(mel >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (mel - min_log_mel)),
+                     freq)
+
+
+def mel_frequencies(n_mels: int, f_min: float, f_max: float,
+                    htk: bool = False):
+    mels = jnp.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney",
+                         dtype=jnp.float32):
+    """[n_mels, n_fft//2 + 1] triangular mel filterbank (reference:
+    paddle.audio.functional.compute_fbank_matrix; librosa-compatible)."""
+    f_max = f_max or sr / 2.0
+    fft_freqs = jnp.linspace(0.0, sr / 2.0, n_fft // 2 + 1)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_freqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":  # area-normalize each triangle
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return weights.astype(dtype)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype=jnp.float32):
+    """[n_mels, n_mfcc] DCT-II basis (reference: paddle.audio.functional
+    .create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :]) * 2.0
+    if norm == "ortho":
+        dct = dct.at[:, 0].multiply(1.0 / math.sqrt(2.0))
+        dct = dct * math.sqrt(1.0 / (2.0 * n_mels))
+    return dct.astype(dtype)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, spect))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return log_spec
+
+
+# ------------------------------------------------------------------ layers
+class Spectrogram(Layer):
+    """|STFT|^power over frames (reference: paddle.audio.features
+    .Spectrogram). Input [..., time] -> [..., freq, frame]."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype=jnp.float32):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer(
+            "window", get_window(window, self.win_length, dtype=dtype),
+            persistable=False)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                            self.window, center=self.center,
+                            pad_mode=self.pad_mode)
+        return jnp.abs(spec) ** self.power
+
+
+class MelSpectrogram(Layer):
+    """Spectrogram -> mel filterbank (reference: paddle.audio.features
+    .MelSpectrogram)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney", dtype=jnp.float32):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode,
+                                       dtype=dtype)
+        self.register_buffer(
+            "fbank", compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                          htk, norm, dtype),
+            persistable=False)
+
+    def forward(self, x):
+        return self.fbank @ self.spectrogram(x)
+
+
+class LogMelSpectrogram(Layer):
+    """Mel spectrogram in dB (reference: paddle.audio.features
+    .LogMelSpectrogram — positional order matches the reference, so
+    paddle code calling (sr, n_fft, hop_length, ...) binds correctly)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype=jnp.float32):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm, dtype)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        return power_to_db(self.mel(x), self.ref_value, self.amin,
+                           self.top_db)
+
+
+class MFCC(Layer):
+    """Mel-frequency cepstral coefficients (reference: paddle.audio
+    .features.MFCC): log-mel -> DCT-II."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_mels: int = 64,
+                 **kw):
+        super().__init__()
+        # kw passes through LogMelSpectrogram's full (reference-ordered)
+        # keyword surface: n_fft, hop_length, center, pad_mode, top_db, ...
+        self.log_mel = LogMelSpectrogram(sr=sr, n_mels=n_mels, **kw)
+        self.register_buffer("dct", create_dct(n_mfcc, n_mels),
+                             persistable=False)
+
+    def forward(self, x):
+        # [..., n_mels, frames] -> [..., n_mfcc, frames]
+        return self.dct.T @ self.log_mel(x)

@@ -1,5 +1,6 @@
-"""paddle_tpu.vision — transforms + model re-exports (reference:
-python/paddle/vision: transforms, models)."""
+"""paddle_tpu.vision — transforms + datasets + model re-exports
+(reference: python/paddle/vision: transforms, datasets, models)."""
+from . import datasets
 from . import transforms
 from ..models.resnet import ResNet, resnet18, resnet34, resnet50, resnet50_vd
 from ..models.vit import ViTForImageClassification

@@ -1,0 +1,146 @@
+"""paddle.audio features (C34) + paddle.vision.datasets (C35): numerics
+vs numpy formulas, file-format loaders on synthesized files."""
+import gzip
+import os
+import pickle
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import audio
+from paddle_tpu.vision import datasets
+
+
+class TestAudioFunctional:
+    def test_hann_matches_numpy_periodic(self):
+        w = np.asarray(audio.get_window("hann", 16))
+        np.testing.assert_allclose(w, np.hanning(17)[:-1], atol=1e-6)
+
+    def test_mel_hz_roundtrip(self):
+        f = np.array([0.0, 440.0, 1000.0, 4000.0, 8000.0])
+        back = np.asarray(audio.mel_to_hz(audio.hz_to_mel(f)))
+        np.testing.assert_allclose(back, f, rtol=1e-4, atol=1e-2)
+        back_htk = np.asarray(audio.mel_to_hz(audio.hz_to_mel(f, htk=True),
+                                              htk=True))
+        np.testing.assert_allclose(back_htk, f, rtol=1e-4, atol=1e-2)
+
+    def test_fbank_shape_and_coverage(self):
+        fb = np.asarray(audio.compute_fbank_matrix(16000, 512, n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        # every filter has support, and peaks move up in frequency
+        peaks = fb.argmax(axis=1)
+        assert (np.diff(peaks) >= 0).all() and fb.sum() > 0
+
+    def test_dct_orthonormal(self):
+        d = np.asarray(audio.create_dct(13, 40, norm="ortho"))
+        gram = d.T @ d
+        np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+    def test_power_to_db_clamp(self):
+        s = jnp.asarray([1e-12, 1.0, 100.0])
+        db = np.asarray(audio.power_to_db(s, top_db=30.0))
+        assert db.max() == pytest.approx(20.0)
+        assert db.min() >= db.max() - 30.0
+
+
+class TestAudioFeatures:
+    def test_spectrogram_peak_bin(self):
+        sr, n_fft = 8000, 256
+        t = np.arange(sr, dtype=np.float32) / sr
+        freq = 1000.0
+        x = jnp.asarray(np.sin(2 * np.pi * freq * t))[None]  # [1, time]
+        spec = audio.Spectrogram(n_fft=n_fft)(x)
+        assert spec.shape[1] == n_fft // 2 + 1
+        peak = int(np.asarray(spec.mean(axis=-1)).argmax())
+        want = round(freq * n_fft / sr)
+        assert abs(peak - want) <= 1
+
+    def test_mel_logmel_mfcc_shapes(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 4000), jnp.float32)
+        mel = audio.MelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+        assert mel.shape[:2] == (2, 32)
+        logmel = audio.LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+        assert logmel.shape == mel.shape
+        np.testing.assert_allclose(
+            np.asarray(logmel), np.asarray(audio.power_to_db(mel)),
+            atol=1e-4)
+        mfcc = audio.MFCC(sr=8000, n_mfcc=13, n_mels=32, n_fft=256)(x)
+        assert mfcc.shape[:2] == (2, 13)
+        assert np.isfinite(np.asarray(mfcc)).all()
+
+    def test_jittable(self):
+        import jax
+        feat = audio.MelSpectrogram(sr=8000, n_fft=128, n_mels=16)
+        fn, params = feat.functional()
+        x = jnp.asarray(np.random.RandomState(1).randn(1, 1024), jnp.float32)
+        out = jax.jit(lambda p, x: fn(p, x))(params, x)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestFakeData:
+    def test_deterministic_and_transform(self):
+        ds = datasets.FakeData(num_samples=5, image_shape=(3, 8, 8),
+                               num_classes=4, seed=7)
+        assert len(ds) == 5
+        img1, lab1 = ds[2]
+        img2, lab2 = ds[2]
+        np.testing.assert_array_equal(img1, img2)
+        assert img1.shape == (3, 8, 8) and 0 <= lab1 < 4 and lab1 == lab2
+        ds_t = datasets.FakeData(num_samples=5, image_shape=(3, 8, 8),
+                                 transform=lambda im: im * 0)
+        assert np.asarray(ds_t[0][0]).sum() == 0
+        with pytest.raises(IndexError):
+            ds[5]
+
+
+class TestFileDatasets:
+    def _write_idx(self, path, arr):
+        ndim = arr.ndim
+        with gzip.open(path, "wb") as f:
+            f.write(struct.pack(">I", (0x08 << 8) | ndim))
+            f.write(struct.pack(f">{ndim}I", *arr.shape))
+            f.write(arr.astype(np.uint8).tobytes())
+
+    def test_mnist_idx(self, tmp_path):
+        rs = np.random.RandomState(0)
+        imgs = rs.randint(0, 255, (6, 28, 28), np.uint8)
+        labs = rs.randint(0, 10, (6,), np.uint8)
+        self._write_idx(tmp_path / "train-images-idx3-ubyte.gz", imgs)
+        self._write_idx(tmp_path / "train-labels-idx1-ubyte.gz", labs)
+        ds = datasets.MNIST(str(tmp_path), mode="train")
+        assert len(ds) == 6
+        img, lab = ds[3]
+        np.testing.assert_allclose(img, imgs[3] / 255.0, atol=1e-6)
+        assert lab == labs[3]
+        with pytest.raises(RuntimeError, match="egress"):
+            datasets.MNIST(str(tmp_path), download=True)
+
+    def test_cifar10_pickle(self, tmp_path):
+        rs = np.random.RandomState(1)
+        base = tmp_path / "cifar-10-batches-py"
+        os.makedirs(base)
+        for n in [f"data_batch_{i}" for i in range(1, 6)]:
+            batch = {b"data": rs.randint(0, 255, (4, 3072), np.uint8),
+                     b"labels": rs.randint(0, 10, 4).tolist()}
+            with open(base / n, "wb") as f:
+                pickle.dump(batch, f)
+        ds = datasets.Cifar10(str(tmp_path), mode="train")
+        assert len(ds) == 20
+        img, lab = ds[0]
+        assert img.shape == (3, 32, 32) and 0 <= lab < 10
+
+    def test_dataset_folder_npy(self, tmp_path):
+        for cls in ("cat", "dog"):
+            os.makedirs(tmp_path / cls)
+            for i in range(3):
+                np.save(tmp_path / cls / f"{i}.npy",
+                        np.full((2, 2), ord(cls[0]), np.float32))
+        ds = datasets.DatasetFolder(str(tmp_path))
+        assert ds.classes == ["cat", "dog"] and len(ds) == 6
+        img, lab = ds[0]
+        assert lab == 0 and img[0, 0] == ord("c")
+        flat = datasets.ImageFolder(str(tmp_path / "cat"))
+        assert len(flat) == 3 and flat[1][0].shape == (2, 2)
