@@ -56,6 +56,7 @@ from . import sparse  # noqa: E402
 from . import static  # noqa: E402
 from . import trl  # noqa: E402
 from . import audio  # noqa: E402
+from . import incubate  # noqa: E402
 from . import vision  # noqa: E402
 from . import quant  # noqa: E402
 from .checkpoint import load, save  # noqa: E402

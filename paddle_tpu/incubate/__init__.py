@@ -1,0 +1,5 @@
+"""paddle.incubate parity (reference: python/paddle/incubate — the
+experimental namespace PaddleNLP imports fused ops from)."""
+from . import nn
+
+__all__ = ["nn"]

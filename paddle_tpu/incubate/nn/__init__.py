@@ -1,0 +1,4 @@
+"""paddle.incubate.nn parity."""
+from . import functional
+
+__all__ = ["functional"]

@@ -1,0 +1,159 @@
+"""paddle.incubate.nn.functional parity (reference:
+python/paddle/incubate/nn/functional — the fused_* ops PaddleNLP model
+code imports directly).
+
+TPU-native stance: the reference fuses these by hand in CUDA because its
+eager executor cannot; under XLA every one of these compositions fuses
+automatically inside jit, so the "fused" entry points are the plain
+compositions with the reference's signatures — they exist so reference
+model code ports without edits, and the Pallas-backed ones (attention)
+route to the real kernels.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...ops.attention import dense_attention, flash_attention, use_flash
+from ...utils.rng import next_key
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "fused_linear",
+    "fused_linear_activation", "swiglu", "fused_dropout_add",
+    "fused_rotary_position_embedding", "fused_dot_product_attention",
+    "fused_feedforward",
+]
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=None):
+    if begin_norm_axis is not None and begin_norm_axis != x.ndim - 1:
+        # reference semantics: normalize over ALL trailing axes
+        shape = x.shape
+        flat = x.reshape(shape[:begin_norm_axis] + (-1,))
+        w = None if norm_weight is None else norm_weight.reshape(-1)
+        y = F.rms_norm(flat, weight=w, epsilon=epsilon).reshape(shape)
+    else:
+        y = F.rms_norm(x, weight=norm_weight, epsilon=epsilon)
+    return y if norm_bias is None else y + norm_bias
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=None):
+    if begin_norm_axis is not None and begin_norm_axis != x.ndim - 1:
+        shape = x.shape
+        flat = x.reshape(shape[:begin_norm_axis] + (-1,))
+        w = None if norm_weight is None else norm_weight.reshape(-1)
+        b = None if norm_bias is None else norm_bias.reshape(-1)
+        return F.layer_norm(flat, flat.shape[-1:], weight=w, bias=b,
+                            epsilon=epsilon).reshape(shape)
+    return F.layer_norm(x, x.shape[-1:], weight=norm_weight,
+                        bias=norm_bias, epsilon=epsilon)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    return F.linear(x, weight.T if transpose_weight else weight, bias)
+
+
+_ACTS = {"": lambda x: x, None: lambda x: x, "relu": F.relu,
+         "gelu": F.gelu, "silu": F.silu, "swish": F.silu}
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation=""):
+    if trans_x:
+        x = jnp.swapaxes(x, -1, -2)
+    out = F.linear(x, jnp.swapaxes(y, -1, -2) if trans_y else y, bias)
+    return _ACTS[activation](out)
+
+
+def swiglu(x, y=None):
+    """silu(x) * y; with y=None, x splits in half on the last dim
+    (reference: paddle.incubate.nn.functional.swiglu — the Llama MLP)."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return F.silu(x) * y
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
+    key = next_key() if (training and p > 0.0) else None
+    return F.dropout(x, p, training=training, key=key, mode=mode) + y
+
+
+def _apply_rotary_interleaved(x, cos, sin):
+    """Non-neox ("interleaved") RoPE: pairs are (x[2i], x[2i+1]) rather
+    than (x[i], x[i + d/2])."""
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """RoPE on [b, s, h, d] tensors (reference:
+    fused_rotary_position_embedding). With sin/cos None they are computed
+    from position_ids (or arange) at theta=10000.
+    ``use_neox_rotary_style=False`` selects the interleaved pairing."""
+    from ...models.llama import apply_rotary, rotary_cos_sin
+    b, s = q.shape[0], q.shape[1]
+    if cos is None or sin is None:
+        pos = position_ids if position_ids is not None else \
+            jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+        cos, sin = rotary_cos_sin(pos, q.shape[-1], 10000.0, q.dtype)
+    else:
+        # reference passes [s, d] or [1, s, 1, d] half-tables
+        cos = jnp.asarray(cos).reshape(1, s, 1, -1).astype(q.dtype)
+        sin = jnp.asarray(sin).reshape(1, s, 1, -1).astype(q.dtype)
+        if cos.shape[-1] == q.shape[-1]:  # full-dim tables: halve
+            cos, sin = cos[..., ::2], sin[..., ::2]
+    rot = apply_rotary if use_neox_rotary_style else \
+        _apply_rotary_interleaved
+    outs = tuple(rot(t, cos, sin) if t is not None else None
+                 for t in (q, k, v))
+    return outs if (k is not None or v is not None) else outs[0]
+
+
+def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                is_causal: bool = False, scale=None):
+    """[b, s, h, d] attention; routes to the Pallas flash kernel when the
+    shape qualifies (reference: fused_dot_product_attention / the PHI
+    flash_attn kernel). ``is_causal`` and ``attn_mask`` COMPOSE, as in
+    the reference (causal structure + padding/bias mask)."""
+    if attn_mask is None and is_causal and dropout_p == 0.0 and \
+            use_flash(q, k, None, 0.0):
+        return flash_attention(q, k, v, causal=True, scale=scale)
+    return dense_attention(q, k, v, causal=is_causal,
+                           attn_mask=attn_mask, scale=scale)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode=None,
+                      name=None):
+    """LN -> linear -> act -> linear (+ residual) with the REFERENCE's
+    parameter order, ln1/ln2 weights, and dropout defaults (reference:
+    paddle.incubate.nn.functional.fused_feedforward; dropout keys ride
+    the ambient rng stream). pre_layer_norm uses ln1 before linear1;
+    the post-LN variant normalizes the residual sum with ln2."""
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = _ACTS[activation](F.linear(x, linear1_weight, linear1_bias))
+    if dropout1_rate and training:
+        h = F.dropout(h, dropout1_rate, training=True, key=next_key())
+    out = F.linear(h, linear2_weight, linear2_bias)
+    if dropout2_rate and training:
+        out = F.dropout(out, dropout2_rate, training=True, key=next_key())
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln2_scale,
+                           bias=ln2_bias, epsilon=ln2_epsilon)
+    return out

@@ -28,7 +28,8 @@ from .trainer import Trainer, TrainingArguments
 
 __all__ = [
     "sft_loss", "sequence_logps", "compute_sequence_logps", "dpo_loss",
-    "DataCollatorForSFT", "SFTTrainer", "make_dpo_loss_fn", "DPOTrainer",
+    "DataCollatorForSFT", "packed_sft_inputs", "SFTTrainer",
+    "make_dpo_loss_fn", "DPOTrainer",
 ]
 
 
@@ -39,11 +40,20 @@ def _token_logps(logits, input_ids, loss_mask):
     return tgt * loss_mask[:, 1:].astype(jnp.float32)
 
 
-def sft_loss(logits, input_ids, loss_mask):
+def sft_loss(logits, input_ids, loss_mask, segment_ids=None):
     """Next-token CE on positions where loss_mask[t+1] == 1 (the response;
-    reference: PaddleNLP SFT recipes' masked cross-entropy)."""
-    tok = _token_logps(logits, input_ids, loss_mask)
-    n = jnp.maximum(loss_mask[:, 1:].sum().astype(jnp.float32), 1.0)
+    reference: PaddleNLP SFT recipes' masked cross-entropy). With packed
+    ``segment_ids``, targets whose CONTEXT token lies in a different
+    segment are dropped — the shifted loss must never train segment k's
+    last token to predict segment k+1's unrelated first token."""
+    mask = loss_mask
+    if segment_ids is not None:
+        same = jnp.concatenate(
+            [jnp.ones_like(segment_ids[:, :1], dtype=bool),
+             segment_ids[:, 1:] == segment_ids[:, :-1]], axis=1)
+        mask = mask * same
+    tok = _token_logps(logits, input_ids, mask)
+    n = jnp.maximum(mask[:, 1:].sum().astype(jnp.float32), 1.0)
     return -tok.sum() / n
 
 
@@ -90,37 +100,125 @@ def dpo_loss(policy_chosen_logps, policy_rejected_logps,
 class DataCollatorForSFT:
     """prompt/response token lists -> right-padded static-shape batches
     {"input_ids": [b, max_len], "loss_mask": [b, max_len]} (reference:
-    PaddleNLP llm/ SFT data pipeline). Static shapes = one compile."""
+    PaddleNLP llm/ SFT data pipeline). Static shapes = one compile.
+
+    ``packing=True`` (reference: PaddleNLP's "intokens"/ZeroPadding
+    packing) greedily packs several examples into each row and adds
+    ``segment_ids`` [b, max_len] (0 = pad, 1..k = example): attention is
+    then block-causal per segment and positions restart per example (see
+    ``packed_sft_inputs``). Packing removes pad waste, the difference
+    between ~50% and ~95% useful FLOPs on short-example SFT corpora.
+    Pass ``pack_rows`` to FIX the packed row count (padding with empty
+    rows, erroring on overflow) so every batch keeps one static shape —
+    without it the row count follows the content and each new count
+    retraces the jitted step."""
 
     def __init__(self, max_length: int, pad_token_id: int = 0,
-                 mask_prompt: bool = True):
+                 mask_prompt: bool = True, packing: bool = False,
+                 pack_rows: Optional[int] = None):
         self.max_length = max_length
         self.pad_token_id = pad_token_id
         self.mask_prompt = mask_prompt
+        self.packing = packing
+        self.pack_rows = pack_rows
+
+    def _fit(self, ex):
+        prompt = list(ex["prompt_ids"])
+        resp = list(ex["response_ids"])
+        seq = (prompt + resp)[:self.max_length]
+        lstart = min(len(prompt), self.max_length) if self.mask_prompt else 0
+        return seq, lstart
 
     def __call__(self, examples) -> Dict[str, jnp.ndarray]:
         L = self.max_length
-        ids = np.full((len(examples), L), self.pad_token_id, np.int32)
-        mask = np.zeros((len(examples), L), np.int32)
-        for i, ex in enumerate(examples):
-            prompt = list(ex["prompt_ids"])
-            resp = list(ex["response_ids"])
-            seq = (prompt + resp)[:L]
-            ids[i, :len(seq)] = seq
-            start = min(len(prompt), L) if self.mask_prompt else 0
-            mask[i, start:len(seq)] = 1
-        return {"input_ids": jnp.asarray(ids), "loss_mask": jnp.asarray(mask)}
+        if not self.packing:
+            ids = np.full((len(examples), L), self.pad_token_id, np.int32)
+            mask = np.zeros((len(examples), L), np.int32)
+            for i, ex in enumerate(examples):
+                seq, lstart = self._fit(ex)
+                ids[i, :len(seq)] = seq
+                mask[i, lstart:len(seq)] = 1
+            return {"input_ids": jnp.asarray(ids),
+                    "loss_mask": jnp.asarray(mask)}
+
+        # greedy first-fit packing into rows of max_length
+        rows = []  # each: {"ids": [...], "mask": [...], "seg": [...], "n": k}
+        for ex in examples:
+            seq, lstart = self._fit(ex)
+            for row in rows:
+                if len(row["ids"]) + len(seq) <= L:
+                    break
+            else:
+                row = {"ids": [], "mask": [], "seg": [], "n": 0}
+                rows.append(row)
+            row["n"] += 1
+            row["ids"].extend(seq)
+            row["mask"].extend([0] * lstart + [1] * (len(seq) - lstart))
+            row["seg"].extend([row["n"]] * len(seq))
+
+        n_rows = len(rows)
+        if self.pack_rows is not None:
+            if n_rows > self.pack_rows:
+                raise ValueError(
+                    f"packing needed {n_rows} rows > pack_rows="
+                    f"{self.pack_rows}; raise pack_rows or max_length")
+            n_rows = self.pack_rows
+        ids = np.full((n_rows, L), self.pad_token_id, np.int32)
+        mask = np.zeros((n_rows, L), np.int32)
+        segs = np.zeros((n_rows, L), np.int32)
+        for i, row in enumerate(rows):
+            ids[i, :len(row["ids"])] = row["ids"]
+            mask[i, :len(row["mask"])] = row["mask"]
+            segs[i, :len(row["seg"])] = row["seg"]
+        return {"input_ids": jnp.asarray(ids), "loss_mask": jnp.asarray(mask),
+                "segment_ids": jnp.asarray(segs)}
+
+
+def packed_sft_inputs(segment_ids):
+    """segment_ids [b, s] -> (positions [b, s], attn_mask [b, 1, s, s]).
+
+    Attention is causal AND segment-diagonal (tokens never attend across
+    packed examples — the correctness requirement of packing), and RoPE
+    positions restart at each example's first token. Pure jnp: runs
+    inside the jitted step, so the collator ships only one extra [b, s]
+    int array."""
+    seg = segment_ids
+    s = seg.shape[-1]
+    idx = jnp.arange(s)
+    # position = index - index_of_segment_start, computed via the running
+    # max index where the segment id changes
+    change = jnp.concatenate(
+        [jnp.ones_like(seg[:, :1]), (seg[:, 1:] != seg[:, :-1])], axis=1)
+    start_idx = jax.lax.cummax(jnp.where(change, idx[None, :], 0), axis=1)
+    positions = idx[None, :] - start_idx
+    causal = (idx[None, :, None] >= idx[None, None, :])
+    same_seg = (seg[:, :, None] == seg[:, None, :]) & (seg[:, :, None] > 0)
+    # pad rows (seg 0) attend only themselves: an all-masked softmax row
+    # would be NaN and pollute real rows downstream (cf. serving path)
+    self_only = idx[:, None] == idx[None, :]
+    attn = jnp.where(seg[:, :, None] > 0, causal & same_seg,
+                     self_only[None])
+    return positions, attn[:, None]
+
+
+def _sft_batch_loss(fn, p, batch):
+    ids = batch["input_ids"]
+    if "segment_ids" in batch:  # packed rows: block-causal + reset RoPE
+        seg = batch["segment_ids"]
+        positions, attn = packed_sft_inputs(seg)
+        logits = fn(p, ids, positions=positions, attn_mask=attn)
+        return sft_loss(logits, ids, batch["loss_mask"], segment_ids=seg)
+    return sft_loss(fn(p, ids), ids, batch["loss_mask"])
 
 
 class SFTTrainer(Trainer):
     """Trainer preconfigured with the masked SFT loss over dict batches
-    (reference: paddlenlp.trl.SFTTrainer)."""
+    (reference: paddlenlp.trl.SFTTrainer); handles both padded and
+    packed (segment_ids) collator outputs."""
 
     def __init__(self, model, optimizer, args: Optional[TrainingArguments]
                  = None, **kw):
-        kw.setdefault("loss_fn", lambda fn, p, batch: sft_loss(
-            fn(p, batch["input_ids"]), batch["input_ids"],
-            batch["loss_mask"]))
+        kw.setdefault("loss_fn", _sft_batch_loss)
         super().__init__(model, optimizer, args, **kw)
 
 

@@ -1,0 +1,119 @@
+"""paddle.incubate.nn.functional fused-op facade (C36): each fused entry
+point must match its unfused composition exactly."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.incubate.nn import functional as IF
+from paddle_tpu.nn import functional as F
+
+rs = np.random.RandomState(0)
+
+
+def _x(*shape):
+    return jnp.asarray(rs.randn(*shape), jnp.float32)
+
+
+class TestFusedOps:
+    def test_rms_and_layer_norm(self):
+        x, w, b = _x(2, 8, 16), _x(16), _x(16)
+        np.testing.assert_allclose(
+            np.asarray(IF.fused_rms_norm(x, w)),
+            np.asarray(F.rms_norm(x, weight=w)), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(IF.fused_layer_norm(x, w, b)),
+            np.asarray(F.layer_norm(x, (16,), weight=w, bias=b)), rtol=1e-6)
+
+    def test_linear_variants(self):
+        x, w, b = _x(4, 8), _x(8, 12), _x(12)
+        np.testing.assert_allclose(np.asarray(IF.fused_linear(x, w, b)),
+                                   np.asarray(x @ w + b), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(IF.fused_linear(x, w.T, b, transpose_weight=True)),
+            np.asarray(x @ w + b), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(IF.fused_linear_activation(x, w, b,
+                                                  activation="gelu")),
+            np.asarray(F.gelu(x @ w + b)), rtol=1e-5)
+
+    def test_swiglu(self):
+        x, y = _x(3, 8), _x(3, 8)
+        np.testing.assert_allclose(np.asarray(IF.swiglu(x, y)),
+                                   np.asarray(F.silu(x) * y), rtol=1e-6)
+        xy = jnp.concatenate([x, y], axis=-1)
+        np.testing.assert_allclose(np.asarray(IF.swiglu(xy)),
+                                   np.asarray(F.silu(x) * y), rtol=1e-6)
+
+    def test_rope_matches_model_rope(self):
+        from paddle_tpu.models.llama import apply_rotary, rotary_cos_sin
+        q, k = _x(2, 6, 4, 8), _x(2, 6, 2, 8)
+        qr, kr, _ = IF.fused_rotary_position_embedding(q, k)
+        pos = jnp.broadcast_to(jnp.arange(6)[None], (2, 6))
+        cos, sin = rotary_cos_sin(pos, 8, 10000.0, q.dtype)
+        np.testing.assert_allclose(np.asarray(qr),
+                                   np.asarray(apply_rotary(q, cos, sin)),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(kr),
+                                   np.asarray(apply_rotary(k, cos, sin)),
+                                   rtol=1e-5)
+
+    def test_fused_attention_matches_dense(self):
+        from paddle_tpu.ops.attention import dense_attention
+        q, k, v = _x(2, 16, 4, 8), _x(2, 16, 4, 8), _x(2, 16, 4, 8)
+        out = IF.fused_dot_product_attention(q, k, v, is_causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(dense_attention(q, k, v, causal=True)),
+            atol=2e-5)
+
+    def test_fused_feedforward(self):
+        x = _x(2, 4, 8)
+        w1, w2 = _x(8, 16), _x(16, 8)
+        g, b = _x(8), _x(8)
+        out = IF.fused_feedforward(x, w1, w2, activation="gelu",
+                                   ln1_scale=g, ln1_bias=b,
+                                   pre_layer_norm=True, training=False)
+        ln = F.layer_norm(x, (8,), weight=g, bias=b)
+        want = x + F.gelu(ln @ w1) @ w2
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-5)
+
+    def test_interleaved_rope_differs_and_pairs(self):
+        q = _x(1, 4, 2, 8)
+        neox = IF.fused_rotary_position_embedding(q)
+        inter = IF.fused_rotary_position_embedding(
+            q, use_neox_rotary_style=False)
+        assert not np.allclose(np.asarray(neox), np.asarray(inter))
+        # position 0 rotates by angle 0 in both styles -> identity
+        np.testing.assert_allclose(np.asarray(inter[:, 0]),
+                                   np.asarray(q[:, 0]), rtol=1e-6)
+
+    def test_causal_composes_with_mask(self):
+        from paddle_tpu.ops.attention import dense_attention
+        q, k, v = _x(1, 8, 2, 8), _x(1, 8, 2, 8), _x(1, 8, 2, 8)
+        # padding mask blocking the last two keys, PLUS causality
+        pad = (jnp.arange(8) < 6)[None, None, None, :]
+        out = IF.fused_dot_product_attention(q, k, v, attn_mask=pad,
+                                             is_causal=True)
+        want = dense_attention(q, k, v, causal=True, attn_mask=pad)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=1e-6)
+        # rows attending a future-masked-out region must differ from the
+        # bidirectional result
+        bidir = dense_attention(q, k, v, causal=False, attn_mask=pad)
+        assert not np.allclose(np.asarray(out), np.asarray(bidir))
+
+    def test_begin_norm_axis(self):
+        x = _x(2, 3, 4)
+        w = _x(12)
+        out = IF.fused_layer_norm(x, w, None, begin_norm_axis=1)
+        want = F.layer_norm(x.reshape(2, 12), (12,), weight=w).reshape(
+            2, 3, 4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-5)
+
+    def test_dropout_add_eval_is_identity_add(self):
+        x, y = _x(3, 5), _x(3, 5)
+        np.testing.assert_allclose(
+            np.asarray(IF.fused_dropout_add(x, y, p=0.5, training=False)),
+            np.asarray(x + y), rtol=1e-6)

@@ -68,6 +68,106 @@ class TestSFT:
         assert hist[-1][1] < hist[0][1]
 
 
+class TestPacking:
+    def test_collator_packs_and_segments(self):
+        from paddle_tpu.trl import DataCollatorForSFT
+        coll = DataCollatorForSFT(max_length=12, pad_token_id=0,
+                                  packing=True)
+        batch = coll([
+            {"prompt_ids": [1, 2], "response_ids": [3, 4]},      # len 4
+            {"prompt_ids": [5], "response_ids": [6, 7, 8]},      # len 4
+            {"prompt_ids": [9], "response_ids": [10, 11]},       # len 3
+            {"prompt_ids": [12] * 8, "response_ids": [13] * 3},  # len 11
+        ])
+        ids = np.asarray(batch["input_ids"])
+        segs = np.asarray(batch["segment_ids"])
+        mask = np.asarray(batch["loss_mask"])
+        assert ids.shape[0] == 2  # 4+4+3 packed into row 0, 11 into row 1
+        np.testing.assert_array_equal(
+            segs[0], [1, 1, 1, 1, 2, 2, 2, 2, 3, 3, 3, 0])
+        np.testing.assert_array_equal(
+            mask[0], [0, 0, 1, 1, 0, 1, 1, 1, 0, 1, 1, 0])
+        assert segs[1, 10] == 1 and segs[1, 11] == 0
+
+    def test_packed_inputs_positions_and_mask(self):
+        from paddle_tpu.trl import packed_sft_inputs
+        seg = jnp.asarray([[1, 1, 1, 2, 2, 0]])
+        pos, attn = packed_sft_inputs(seg)
+        np.testing.assert_array_equal(np.asarray(pos[0]), [0, 1, 2, 0, 1, 0])
+        a = np.asarray(attn[0, 0])
+        assert a[1, 0] and not a[0, 1]          # causal within segment 1
+        assert a[4, 3] and not a[3, 1]          # no cross-segment attention
+        assert not a[5, 4] and a[5, 5]          # pad: self-only
+
+    def test_packed_logits_match_individual_forward(self):
+        """The packing correctness property: each packed example's logits
+        equal its standalone forward (same positions, no leakage)."""
+        from paddle_tpu.trl import packed_sft_inputs
+        model = _model()
+        fn, params = model.functional()
+        rs = np.random.RandomState(3)
+        a = rs.randint(1, 256, 5)
+        b = rs.randint(1, 256, 4)
+        packed = np.zeros((1, 12), np.int64)
+        packed[0, :5], packed[0, 5:9] = a, b
+        seg = np.zeros((1, 12), np.int64)
+        seg[0, :5], seg[0, 5:9] = 1, 2
+        pos, attn = packed_sft_inputs(jnp.asarray(seg))
+        lp = fn(dict(params), jnp.asarray(packed), positions=pos,
+                attn_mask=attn)
+        la = fn(dict(params), jnp.asarray(a)[None])
+        lb = fn(dict(params), jnp.asarray(b)[None])
+        np.testing.assert_allclose(np.asarray(lp[0, :5]),
+                                   np.asarray(la[0]), atol=2e-4)
+        np.testing.assert_allclose(np.asarray(lp[0, 5:9]),
+                                   np.asarray(lb[0]), atol=2e-4)
+
+    def test_boundary_targets_dropped(self):
+        """Segment k's last token must not be trained to predict segment
+        k+1's first token, even when that first token's loss_mask is 1
+        (mask_prompt=False)."""
+        from paddle_tpu.trl import sft_loss
+        rs = np.random.RandomState(5)
+        logits = jnp.asarray(rs.randn(1, 6, 16), jnp.float32)
+        ids = jnp.asarray(rs.randint(0, 16, (1, 6)))
+        seg = jnp.asarray([[1, 1, 1, 2, 2, 0]])
+        mask_all = jnp.asarray([[1, 1, 1, 1, 1, 0]])
+        loss = sft_loss(logits, ids, mask_all, segment_ids=seg)
+        # manual: targets at positions 1,2 (seg1) and 4 (seg2); position 3
+        # (first of seg2) and 5 (pad) are dropped
+        lp = jax.nn.log_softmax(np.asarray(logits[0]), axis=-1)
+        want = -(lp[0, int(ids[0, 1])] + lp[1, int(ids[0, 2])]
+                 + lp[3, int(ids[0, 4])]) / 3
+        np.testing.assert_allclose(float(loss), want, rtol=1e-6)
+
+    def test_pack_rows_static_shape(self):
+        from paddle_tpu.trl import DataCollatorForSFT
+        coll = DataCollatorForSFT(max_length=8, packing=True, pack_rows=3)
+        small = [{"prompt_ids": [1], "response_ids": [2, 3]}]
+        big = small * 5
+        assert coll(small)["input_ids"].shape == (3, 8)
+        assert coll(big)["input_ids"].shape == (3, 8)
+        with pytest.raises(ValueError, match="pack_rows"):
+            coll(small * 12)
+
+    def test_sft_trainer_packed_learns(self, tmp_path):
+        from paddle_tpu.trl import DataCollatorForSFT
+        model = _model()
+        rs = np.random.RandomState(4)
+        coll = DataCollatorForSFT(max_length=24, packing=True)
+        batch = coll([{"prompt_ids": rs.randint(1, 256, 4).tolist(),
+                       "response_ids": rs.randint(1, 256, 6).tolist()}
+                      for _ in range(6)])
+        tr = SFTTrainer(model, pt.optimizer.AdamW(learning_rate=1e-2),
+                        TrainingArguments(output_dir=str(tmp_path),
+                                          max_steps=12, logging_steps=4,
+                                          resume_from_checkpoint=False),
+                        train_dataloader=[batch])
+        tr.train()
+        hist = tr.logger.history["loss"]
+        assert hist[-1][1] < hist[0][1]
+
+
 class TestDPO:
     def test_dpo_loss_neutral_point(self):
         z = jnp.zeros((4,))
