@@ -1,0 +1,39 @@
+"""Direct Preference Optimization: precompute reference log-probs, then
+train the policy with DPOTrainer.
+
+  python examples/dpo.py
+"""
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.trainer import TrainingArguments
+from paddle_tpu.trl import DPOTrainer, compute_sequence_logps
+
+
+def main():
+    pt.seed(0)
+    policy = LlamaForCausalLM(llama_tiny())
+
+    rs = np.random.RandomState(0)
+    chosen = jnp.asarray(rs.randint(1, 256, (8, 32)))
+    rejected = jnp.asarray(rs.randint(1, 256, (8, 32)))
+    mask = jnp.ones_like(chosen)
+
+    # reference = frozen snapshot of the starting policy (eval mode)
+    ref_c = compute_sequence_logps(policy, chosen, mask)
+    ref_r = compute_sequence_logps(policy, rejected, mask)
+
+    batch = {"chosen_ids": chosen, "chosen_mask": mask,
+             "rejected_ids": rejected, "rejected_mask": mask,
+             "ref_chosen_logps": ref_c, "ref_rejected_logps": ref_r}
+    tr = DPOTrainer(policy, pt.optimizer.AdamW(learning_rate=5e-4),
+                    TrainingArguments(output_dir="output/dpo", max_steps=20,
+                                      logging_steps=5),
+                    beta=0.1, train_dataloader=[batch])
+    tr.train()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""The beginner path: paddle.Model high-level API on synthetic image data.
+
+  python examples/mnist_model_api.py
+"""
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.vision.datasets import FakeData
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.flatten = nn.Flatten()
+        self.fc1 = nn.Linear(3 * 16 * 16, 128)
+        self.fc2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(self.flatten(x))))
+
+
+def main():
+    pt.seed(0)
+    net = Net()
+    pt.summary(net)
+    model = pt.Model(net)
+    model.prepare(pt.optimizer.AdamW(learning_rate=1e-3),
+                  loss=nn.functional.cross_entropy,
+                  metrics=pt.metric.Accuracy())
+    data = FakeData(num_samples=256, image_shape=(3, 16, 16), num_classes=10)
+    model.fit(data, batch_size=32, epochs=2, log_freq=4)
+    print(model.evaluate(data, batch_size=32))
+    model.save("output/mnist/model")
+
+
+if __name__ == "__main__":
+    main()

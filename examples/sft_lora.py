@@ -1,0 +1,48 @@
+"""Supervised fine-tuning with LoRA adapters + packed batches:
+chat-template rendering -> packing collator -> SFTTrainer training only
+the adapters -> merged export.
+
+  python examples/sft_lora.py
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.peft import LoRAConfig, LoRAModel
+from paddle_tpu.tokenizer import render_chat_template
+from paddle_tpu.trainer import TrainingArguments
+from paddle_tpu.trl import DataCollatorForSFT, SFTTrainer
+
+
+def main():
+    pt.seed(0)
+    base = LlamaForCausalLM(llama_tiny())
+    lora = LoRAModel(base, LoRAConfig(
+        r=8, lora_alpha=16, target_modules=[".*q_proj", ".*v_proj"]))
+
+    # toy "tokenizer": bytes of the rendered chat template
+    def encode(text):
+        return [b % 255 + 1 for b in text.encode()][:48]
+
+    rs = np.random.RandomState(0)
+    examples = []
+    for i in range(16):
+        prompt = render_chat_template(
+            [{"role": "user", "content": f"question {i}"}], "llama3")
+        examples.append({"prompt_ids": encode(prompt),
+                         "response_ids": encode(f"answer {i}")})
+
+    coll = DataCollatorForSFT(max_length=128, packing=True, pack_rows=8)
+    tr = SFTTrainer(base, pt.optimizer.AdamW(learning_rate=1e-3),
+                    TrainingArguments(output_dir="output/sft_lora",
+                                      max_steps=30, logging_steps=10),
+                    train_dataloader=[coll(examples)])
+    tr.train()
+
+    lora.save_pretrained("output/sft_lora/adapter")  # adapter-only ckpt
+    lora.merge()  # fold adapters into the base weights for serving
+    print("saved adapter + merged model")
+
+
+if __name__ == "__main__":
+    main()

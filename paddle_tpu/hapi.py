@@ -1,0 +1,240 @@
+"""paddle.Model — the high-level Keras-style API (reference:
+python/paddle/hapi/model.py: Model.prepare/fit/evaluate/predict/save/load,
+paddle.summary).
+
+TPU-native: ``prepare`` builds ONE jitted train step (loss -> grads ->
+optimizer update, params/opt-state donated) and one jitted eval step;
+``fit`` is then a plain host loop feeding static-shape batches. Metrics
+update from device outputs only at log points. The same Model runs
+un-sharded on one chip or SPMD over an ambient mesh — exactly the
+Trainer's execution model, packaged behind paddle's beginner surface.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .metric import Metric
+from .nn.layer import Layer
+
+__all__ = ["Model", "summary"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _update_metric(m: Metric, preds, labels):
+    """paddle's metric protocol: compute() (if defined) pre-reduces the
+    device outputs and update() takes its result; metrics without
+    compute() (Precision/Recall/Auc) take update(preds, labels)."""
+    if hasattr(m, "compute"):
+        m.update(m.compute(preds, labels))
+    else:
+        m.update(preds, labels)
+
+
+class Model:
+    """Reference: paddle.Model(network). input/label specs are accepted
+    for signature parity; shapes are taken from the actual batches (each
+    distinct shape compiles once)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._pure_fn, self._params = network.functional()
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._opt_state = None
+        self._train_step = None
+        self._eval_step = None
+        self._predict_fn = None
+
+    # ---------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        """optimizer: paddle_tpu.optimizer.*; loss: callable
+        (logits, label) -> scalar or an nn loss layer; metrics: Metric(s)."""
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        fn = self._pure_fn
+
+        if optimizer is not None and loss is not None:
+            opt = optimizer
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def train_step(params, state, stepno, x, y):
+                def loss_fn(p):
+                    return jnp.asarray(self._loss(fn(p, x), y),
+                                       jnp.float32)
+                l, g = jax.value_and_grad(loss_fn)(params)
+                params, state = opt.apply(params, g, state, stepno)
+                return params, state, l
+            self._train_step = train_step
+
+        if loss is not None:
+            @jax.jit
+            def eval_step(params, x, y):
+                out = fn(params, x)
+                return jnp.asarray(self._loss(out, y), jnp.float32), out
+            self._eval_step = eval_step
+
+        self._predict_fn = jax.jit(fn)
+        return self
+
+    def _require(self, what, attr):
+        if getattr(self, attr) is None:
+            raise RuntimeError(f"call prepare() with {what} first")
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (tuple, list)):
+            if len(batch) == 2:
+                return batch[0], batch[1]
+            return batch[0], batch[-1]
+        raise TypeError("fit/evaluate expect (input, label) batches; got "
+                        f"{type(batch)}")
+
+    # -------------------------------------------------------------- fit
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, log_freq: int = 10, verbose: int = 1,
+            shuffle: bool = True, callbacks=None):
+        """train_data: DataLoader-like iterable of (x, y) batches, or a
+        Dataset (wrapped in a DataLoader with ``batch_size``/``shuffle``)."""
+        self._require("an optimizer and a loss", "_train_step")
+        loader = self._as_loader(train_data, batch_size, shuffle)
+        if self._opt_state is None:
+            self._opt_state = self._optimizer.init(self._params)
+        stepno = 0
+        history = {"loss": []}
+        loss = None
+        try:
+            for epoch in range(epochs):
+                for m in self._metrics:
+                    m.reset()
+                for batch in loader:
+                    x, y = self._split_batch(batch)
+                    x, y = jnp.asarray(x), jnp.asarray(y)
+                    self._params, self._opt_state, loss = self._train_step(
+                        self._params, self._opt_state, jnp.int32(stepno),
+                        x, y)
+                    stepno += 1
+                    if stepno % log_freq == 0:
+                        lv = float(loss)
+                        history["loss"].append(lv)
+                        if verbose:
+                            print(f"epoch {epoch + 1}/{epochs} step "
+                                  f"{stepno}: loss {lv:.4f}", flush=True)
+                if loss is not None:  # epoch-end loss, even under log_freq
+                    history["loss"].append(float(loss))
+                if eval_data is not None:
+                    eres = self.evaluate(eval_data, batch_size=batch_size,
+                                         verbose=verbose)
+                    history.setdefault("eval_loss", []).append(eres["loss"])
+        finally:
+            # the step DONATES params; on an abort between steps, write the
+            # live arrays back so the network never holds deleted buffers
+            try:
+                self.network.bind(self._params)
+            except Exception:
+                pass
+        return history
+
+    def _as_loader(self, data, batch_size, shuffle):
+        from .io.dataset import Dataset
+        if isinstance(data, Dataset):
+            from .io import DataLoader
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        return data
+
+    # --------------------------------------------------------- evaluate
+    def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
+                 verbose: int = 1):
+        self._require("a loss", "_eval_step")
+        loader = self._as_loader(eval_data, batch_size, shuffle=False)
+        was_training = self.network.training
+        self.network.eval()
+        try:
+            losses = []
+            for m in self._metrics:
+                m.reset()
+            for batch in loader:
+                x, y = self._split_batch(batch)
+                loss, out = self._eval_step(self._params, jnp.asarray(x),
+                                            jnp.asarray(y))
+                losses.append(float(loss))
+                for m in self._metrics:
+                    _update_metric(m, out, jnp.asarray(y))
+        finally:
+            if was_training:
+                self.network.train()
+        result = {"loss": float(np.mean(losses)) if losses else float("nan")}
+        for m in self._metrics:
+            result[m.name() if callable(m.name) else m.name] = m.accumulate()
+        if verbose:
+            print(f"eval: {result}", flush=True)
+        return result
+
+    # ---------------------------------------------------------- predict
+    def predict(self, test_data, batch_size: int = 1):
+        self._require("prepare()", "_predict_fn")
+        loader = self._as_loader(test_data, batch_size, shuffle=False)
+        was_training = self.network.training
+        self.network.eval()
+        try:
+            outs = []
+            for batch in loader:
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                outs.append(np.asarray(self._predict_fn(self._params,
+                                                        jnp.asarray(x))))
+        finally:
+            if was_training:
+                self.network.train()
+        return outs
+
+    # ------------------------------------------------------- save/load
+    def save(self, path: str, training: bool = True):
+        from .checkpoint import save as _save
+        self.network.bind(self._params)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._opt_state is not None:
+            _save({"opt_state": self._opt_state}, path + ".pdopt")
+
+    def load(self, path: str, reset_optimizer: bool = False):
+        import os
+        from .checkpoint import load as _load
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        self._pure_fn, self._params = self.network.functional()
+        opt_path = path + ".pdopt"
+        # checkpoint.save appends .npz to array archives
+        if not reset_optimizer and (os.path.exists(opt_path) or
+                                    os.path.exists(opt_path + ".npz")):
+            self._opt_state = _load(opt_path)["opt_state"]
+        return self
+
+    def parameters(self):
+        return self.network.parameters()
+
+
+def summary(net: Layer, input_size=None, dtypes=None):
+    """paddle.summary parity: layer tree with parameter counts."""
+    rows = []
+    total = 0
+    for name, sub in net.named_sublayers(include_self=True):
+        own = sum(int(np.prod(v.shape)) for v in sub._parameters.values())
+        total += own
+        if own or not name:
+            rows.append((name or type(net).__name__,
+                         type(sub).__name__, own))
+    lines = [f"{'Layer':40s} {'Type':24s} {'Params':>12s}"]
+    lines += [f"{n:40s} {t:24s} {p:>12,d}" for n, t, p in rows]
+    lines.append(f"{'Total params':>66s}: {total:,d}")
+    text = "\n".join(lines)
+    print(text, flush=True)
+    return {"total_params": total, "text": text}

@@ -30,13 +30,17 @@ __all__ = [
 def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
                    begin_norm_axis=None):
     if begin_norm_axis is not None and begin_norm_axis != x.ndim - 1:
-        # reference semantics: normalize over ALL trailing axes
+        # reference semantics: normalize over ALL trailing axes; the bias
+        # aligns with the FLATTENED normalized axis, so add before the
+        # reshape back
         shape = x.shape
         flat = x.reshape(shape[:begin_norm_axis] + (-1,))
         w = None if norm_weight is None else norm_weight.reshape(-1)
-        y = F.rms_norm(flat, weight=w, epsilon=epsilon).reshape(shape)
-    else:
-        y = F.rms_norm(x, weight=norm_weight, epsilon=epsilon)
+        y = F.rms_norm(flat, weight=w, epsilon=epsilon)
+        if norm_bias is not None:
+            y = y + norm_bias.reshape(-1)
+        return y.reshape(shape)
+    y = F.rms_norm(x, weight=norm_weight, epsilon=epsilon)
     return y if norm_bias is None else y + norm_bias
 
 
@@ -100,16 +104,21 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     ``use_neox_rotary_style=False`` selects the interleaved pairing."""
     from ...models.llama import apply_rotary, rotary_cos_sin
     b, s = q.shape[0], q.shape[1]
+    pos = position_ids if position_ids is not None else \
+        jnp.broadcast_to(jnp.arange(s)[None], (b, s))
     if cos is None or sin is None:
-        pos = position_ids if position_ids is not None else \
-            jnp.broadcast_to(jnp.arange(s)[None], (b, s))
         cos, sin = rotary_cos_sin(pos, q.shape[-1], 10000.0, q.dtype)
     else:
-        # reference passes [s, d] or [1, s, 1, d] half-tables
-        cos = jnp.asarray(cos).reshape(1, s, 1, -1).astype(q.dtype)
-        sin = jnp.asarray(sin).reshape(1, s, 1, -1).astype(q.dtype)
-        if cos.shape[-1] == q.shape[-1]:  # full-dim tables: halve
-            cos, sin = cos[..., ::2], sin[..., ::2]
+        # reference passes [max_pos, d] (or [1, max_pos, 1, d]) tables
+        # and GATHERS rows at position_ids — left-padded batches rotate
+        # by their logical position, not the physical index
+        def table(t):
+            t = jnp.asarray(t).astype(q.dtype)
+            t = t.reshape(-1, t.shape[-1])          # [max_pos, d or d/2]
+            if t.shape[-1] == q.shape[-1]:          # full-dim: halve
+                t = t[..., ::2]
+            return t[pos][:, :, None, :]            # [b, s, 1, d/2]
+        cos, sin = table(cos), table(sin)
     rot = apply_rotary if use_neox_rotary_style else \
         _apply_rotary_interleaved
     outs = tuple(rot(t, cos, sin) if t is not None else None
@@ -127,7 +136,10 @@ def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             use_flash(q, k, None, 0.0):
         return flash_attention(q, k, v, causal=True, scale=scale)
     return dense_attention(q, k, v, causal=is_causal,
-                           attn_mask=attn_mask, scale=scale)
+                           attn_mask=attn_mask, scale=scale,
+                           dropout_p=dropout_p,
+                           dropout_key=next_key() if dropout_p > 0.0
+                           else None)
 
 
 def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
@@ -142,16 +154,24 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     paddle.incubate.nn.functional.fused_feedforward; dropout keys ride
     the ambient rng stream). pre_layer_norm uses ln1 before linear1;
     the post-LN variant normalizes the residual sum with ln2."""
+    dmode = mode or "upscale_in_train"
+
+    def _drop(t, rate):
+        # F.dropout handles the eval side itself (downscale_in_infer
+        # rescales by (1-p) at inference), so route through it whenever a
+        # rate is set — not only when training
+        if not rate:
+            return t
+        return F.dropout(t, rate, training=training,
+                         key=next_key() if training else None, mode=dmode)
+
     residual = x
     if pre_layer_norm:
         x = F.layer_norm(x, x.shape[-1:], weight=ln1_scale, bias=ln1_bias,
                          epsilon=ln1_epsilon)
-    h = _ACTS[activation](F.linear(x, linear1_weight, linear1_bias))
-    if dropout1_rate and training:
-        h = F.dropout(h, dropout1_rate, training=True, key=next_key())
-    out = F.linear(h, linear2_weight, linear2_bias)
-    if dropout2_rate and training:
-        out = F.dropout(out, dropout2_rate, training=True, key=next_key())
+    h = _drop(_ACTS[activation](F.linear(x, linear1_weight, linear1_bias)),
+              dropout1_rate)
+    out = _drop(F.linear(h, linear2_weight, linear2_bias), dropout2_rate)
     out = residual + out
     if not pre_layer_norm:
         out = F.layer_norm(out, out.shape[-1:], weight=ln2_scale,

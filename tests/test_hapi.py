@@ -1,0 +1,103 @@
+"""paddle.Model high-level API (C37): prepare/fit/evaluate/predict,
+save/load, summary."""
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+def _cls_data(n=64, d=8, classes=4, seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d, classes)
+    x = rs.randn(n, d).astype(np.float32)
+    y = (x @ w).argmax(-1).astype(np.int64)
+    return x, y
+
+
+def _batches(x, y, bs):
+    return [(x[i:i + bs], y[i:i + bs]) for i in range(0, len(x), bs)]
+
+
+def _net(d=8, classes=4):
+    pt.seed(0)
+    return nn.Sequential(nn.Linear(d, 32), nn.GELU(), nn.Linear(32, classes))
+
+
+class TestModel:
+    def test_fit_learns_and_evaluate_metrics(self):
+        x, y = _cls_data()
+        model = pt.Model(_net())
+        model.prepare(pt.optimizer.AdamW(learning_rate=5e-2),
+                      loss=lambda logits, lab: nn.functional.cross_entropy(
+                          logits, lab),
+                      metrics=pt.metric.Accuracy())
+        hist = model.fit(_batches(x, y, 16), epochs=8, log_freq=4, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+        res = model.evaluate(_batches(x, y, 16), verbose=0)
+        assert res["acc"] > 0.9 and res["loss"] < 0.5
+
+    def test_predict_matches_direct_forward(self):
+        x, y = _cls_data(n=8)
+        net = _net()
+        model = pt.Model(net).prepare()
+        outs = model.predict([(x,)], batch_size=8)
+        np.testing.assert_allclose(outs[0], np.asarray(net(jnp.asarray(x))),
+                                   atol=1e-6)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        import os
+        x, y = _cls_data()
+        model = pt.Model(_net())
+        model.prepare(pt.optimizer.AdamW(learning_rate=5e-2),
+                      loss=nn.functional.cross_entropy)
+        model.fit(_batches(x, y, 16), epochs=2, verbose=0)
+        path = os.path.join(str(tmp_path), "m")
+        model.save(path)
+        fresh = pt.Model(_net())
+        fresh.prepare(pt.optimizer.AdamW(learning_rate=5e-2),
+                      loss=nn.functional.cross_entropy)
+        fresh.load(path)
+        np.testing.assert_allclose(
+            np.asarray(fresh.predict([(x[:4],)])[0]),
+            np.asarray(model.predict([(x[:4],)])[0]), atol=1e-6)
+        # optimizer state came back too
+        assert fresh._opt_state is not None
+
+    def test_computeless_metric_protocol(self):
+        """Precision/Recall/Auc define update(preds, labels) with no
+        compute(); evaluate must drive both protocols."""
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 8).astype(np.float32)
+        y = (rs.rand(32) > 0.5).astype(np.int64)
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(8, 1), nn.Sigmoid())
+        model = pt.Model(net)
+        model.prepare(loss=lambda p, lab: ((p[:, 0] - lab) ** 2).mean(),
+                      metrics=[pt.metric.Precision(), pt.metric.Recall()])
+        res = model.evaluate([(x, y)], verbose=0)
+        assert 0.0 <= res["precision"] <= 1.0
+        assert 0.0 <= res["recall"] <= 1.0
+
+    def test_fit_requires_prepare(self):
+        import pytest
+        model = pt.Model(_net())
+        with pytest.raises(RuntimeError, match="prepare"):
+            model.fit([])
+
+    def test_summary_counts(self):
+        net = _net(d=8, classes=4)
+        info = pt.summary(net)
+        want = 8 * 32 + 32 + 32 * 4 + 4
+        assert info["total_params"] == want
+
+    def test_dataset_input(self):
+        from paddle_tpu.io import TensorDataset
+        x, y = _cls_data(n=32)
+        ds = TensorDataset([x, y])
+        model = pt.Model(_net())
+        model.prepare(pt.optimizer.AdamW(learning_rate=5e-2),
+                      loss=nn.functional.cross_entropy,
+                      metrics=pt.metric.Accuracy())
+        hist = model.fit(ds, batch_size=16, epochs=4, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]

@@ -88,6 +88,36 @@ class TestFusedOps:
         np.testing.assert_allclose(np.asarray(inter[:, 0]),
                                    np.asarray(q[:, 0]), rtol=1e-6)
 
+    def test_rope_tables_gather_position_ids(self):
+        """Provided cos/sin tables must be gathered at position_ids, so a
+        left-padded row rotates by logical position."""
+        from paddle_tpu.models.llama import rotary_cos_sin
+        q = _x(1, 4, 2, 8)
+        # full-dim tables at theta=10000, max_pos=16
+        pos_all = jnp.arange(16)[None]
+        cos_h, sin_h = rotary_cos_sin(pos_all, 8, 10000.0, jnp.float32)
+        cos_t = jnp.repeat(cos_h[0, :, 0], 2, axis=-1)  # [16, 8] full-dim
+        sin_t = jnp.repeat(sin_h[0, :, 0], 2, axis=-1)
+        pos = jnp.asarray([[0, 0, 1, 2]])  # left-padded style
+        got = IF.fused_rotary_position_embedding(
+            q, sin=sin_t, cos=cos_t, position_ids=pos)
+        want = IF.fused_rotary_position_embedding(q, position_ids=pos)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_ffn_downscale_in_infer(self):
+        x = _x(2, 4, 8)
+        w1, w2 = _x(8, 16), _x(16, 8)
+        g, b = _x(8), _x(8)
+        out = IF.fused_feedforward(x, w1, w2, ln1_scale=g, ln1_bias=b,
+                                   dropout1_rate=0.5, dropout2_rate=0.0,
+                                   pre_layer_norm=True, training=False,
+                                   mode="downscale_in_infer")
+        ln = F.layer_norm(x, (8,), weight=g, bias=b)
+        want = x + (F.relu(ln @ w1) * 0.5) @ w2  # (1-p) inference scaling
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-5)
+
     def test_causal_composes_with_mask(self):
         from paddle_tpu.ops.attention import dense_attention
         q, k, v = _x(1, 8, 2, 8), _x(1, 8, 2, 8), _x(1, 8, 2, 8)
