@@ -97,7 +97,10 @@ class Model:
         if isinstance(batch, (tuple, list)):
             if len(batch) == 2:
                 return batch[0], batch[1]
-            return batch[0], batch[-1]
+            raise TypeError(
+                f"fit/evaluate expect (input, label) 2-tuples, got "
+                f"{len(batch)} elements — multi-input networks should "
+                "pack their inputs into one structure")
         raise TypeError("fit/evaluate expect (input, label) batches; got "
                         f"{type(batch)}")
 
@@ -106,9 +109,12 @@ class Model:
             epochs: int = 1, log_freq: int = 10, verbose: int = 1,
             shuffle: bool = True, callbacks=None):
         """train_data: DataLoader-like iterable of (x, y) batches, or a
-        Dataset (wrapped in a DataLoader with ``batch_size``/``shuffle``)."""
+        Dataset (wrapped in a DataLoader with ``batch_size``/``shuffle``).
+        callbacks: objects with (any of) ``on_train_batch_end(step, logs)``
+        / ``on_epoch_end(epoch, logs)`` — invoked at log points."""
         self._require("an optimizer and a loss", "_train_step")
         loader = self._as_loader(train_data, batch_size, shuffle)
+        callbacks = _to_list(callbacks)
         if self._opt_state is None:
             self._opt_state = self._optimizer.init(self._params)
         stepno = 0
@@ -116,8 +122,7 @@ class Model:
         loss = None
         try:
             for epoch in range(epochs):
-                for m in self._metrics:
-                    m.reset()
+                logged = False
                 for batch in loader:
                     x, y = self._split_batch(batch)
                     x, y = jnp.asarray(x), jnp.asarray(y)
@@ -125,18 +130,27 @@ class Model:
                         self._params, self._opt_state, jnp.int32(stepno),
                         x, y)
                     stepno += 1
-                    if stepno % log_freq == 0:
+                    logged = stepno % log_freq == 0
+                    if logged:
                         lv = float(loss)
                         history["loss"].append(lv)
                         if verbose:
                             print(f"epoch {epoch + 1}/{epochs} step "
                                   f"{stepno}: loss {lv:.4f}", flush=True)
-                if loss is not None:  # epoch-end loss, even under log_freq
+                        for cb in callbacks:  # duck-typed callback hook
+                            if hasattr(cb, "on_train_batch_end"):
+                                cb.on_train_batch_end(stepno, {"loss": lv})
+                if loss is not None and not logged:
+                    # epoch-end loss, unless the last step just logged it
                     history["loss"].append(float(loss))
                 if eval_data is not None:
                     eres = self.evaluate(eval_data, batch_size=batch_size,
                                          verbose=verbose)
                     history.setdefault("eval_loss", []).append(eres["loss"])
+                for cb in callbacks:
+                    if hasattr(cb, "on_epoch_end"):
+                        cb.on_epoch_end(epoch, {k: v[-1] for k, v in
+                                                history.items() if v})
         finally:
             # the step DONATES params; on an abort between steps, write the
             # live arrays back so the network never holds deleted buffers

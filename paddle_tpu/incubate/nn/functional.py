@@ -112,11 +112,20 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         # reference passes [max_pos, d] (or [1, max_pos, 1, d]) tables
         # and GATHERS rows at position_ids — left-padded batches rotate
         # by their logical position, not the physical index
+        import jax as _jax
+
         def table(t):
             t = jnp.asarray(t).astype(q.dtype)
             t = t.reshape(-1, t.shape[-1])          # [max_pos, d or d/2]
             if t.shape[-1] == q.shape[-1]:          # full-dim: halve
                 t = t[..., ::2]
+            # gather clamps silently under jit; when positions are
+            # concrete (the eager/serving path), fail loudly instead
+            if not isinstance(pos, _jax.core.Tracer):
+                mx = int(jnp.max(pos))
+                if mx >= t.shape[0]:
+                    raise ValueError(
+                        f"position {mx} >= rotary table rows {t.shape[0]}")
             return t[pos][:, :, None, :]            # [b, s, 1, d/2]
         cos, sin = table(cos), table(sin)
     rot = apply_rotary if use_neox_rotary_style else \
@@ -127,19 +136,21 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 
 
 def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
-                                is_causal: bool = False, scale=None):
+                                is_causal: bool = False, scale=None,
+                                training: bool = True):
     """[b, s, h, d] attention; routes to the Pallas flash kernel when the
     shape qualifies (reference: fused_dot_product_attention / the PHI
     flash_attn kernel). ``is_causal`` and ``attn_mask`` COMPOSE, as in
-    the reference (causal structure + padding/bias mask)."""
-    if attn_mask is None and is_causal and dropout_p == 0.0 and \
+    the reference (causal structure + padding/bias mask); attention
+    dropout applies only when ``training``."""
+    p = dropout_p if training else 0.0
+    if attn_mask is None and is_causal and p == 0.0 and \
             use_flash(q, k, None, 0.0):
         return flash_attention(q, k, v, causal=True, scale=scale)
     return dense_attention(q, k, v, causal=is_causal,
                            attn_mask=attn_mask, scale=scale,
-                           dropout_p=dropout_p,
-                           dropout_key=next_key() if dropout_p > 0.0
-                           else None)
+                           dropout_p=p,
+                           dropout_key=next_key() if p > 0.0 else None)
 
 
 def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,

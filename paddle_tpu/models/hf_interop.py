@@ -110,6 +110,54 @@ def _revert_llama(sd: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
     return out
 
 
+_MOE_EXPERT = re.compile(
+    r"^(model\.layers\.\d+\.mlp)\.experts\.(\d+)\."
+    r"(gate_proj|up_proj|down_proj)\.weight$")
+_MOE_SHARED = re.compile(
+    r"^(model\.layers\.\d+\.mlp)\.shared_experts?\."
+    r"(gate_proj|up_proj|down_proj)\.weight$")
+
+
+def _convert_qwen2_moe(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """Qwen2-MoE / ERNIE-4.5-MoE family: Llama rules for attention/norms,
+    plus per-layer stacking of the HF per-expert weights into our batched
+    [E, ...] expert tensors (reference: PaddleNLP qwen2_moe/modeling.py).
+    Needs the WHOLE checkpoint (experts may span shards), so from_pretrained
+    routes these model types through the full-merge loader."""
+    out = {}
+    experts: Dict[str, Dict[int, np.ndarray]] = {}
+    for k, v in hf.items():
+        m = _MOE_EXPERT.match(k)
+        if m:
+            layer, eid, proj = m.group(1), int(m.group(2)), m.group(3)
+            name = {"gate_proj": "w_gate", "up_proj": "w_up",
+                    "down_proj": "w_down"}[proj]
+            experts.setdefault(f"{layer}.{name}", {})[eid] = v.T
+            continue
+        m = _MOE_SHARED.match(k)
+        if m:
+            out[f"{m.group(1)}.shared_{m.group(2)}"] = v.T
+            continue
+        if k.endswith(".mlp.gate.weight"):            # router [E, h] -> [h, E]
+            out[k[:-len(".weight")]] = v.T
+            continue
+        if k.endswith(".mlp.shared_expert_gate.weight"):  # [1, h] -> [h, 1]
+            out[k[:-len(".weight")]] = v.T
+            continue
+        if k.endswith(".mlp.moe_statics.e_score_correction_bias"):
+            # ERNIE-4.5's aux-free routing correction == our loss-free
+            # balancing buffer (DeepSeek-V3 style)
+            out[k.replace(".moe_statics.e_score_correction_bias",
+                          ".expert_bias")] = v.reshape(-1)
+            continue
+        out.update(_convert_llama({k: v}, cfg))
+    for name, by_id in experts.items():
+        E = len(by_id)
+        assert sorted(by_id) == list(range(E)), f"missing experts in {name}"
+        out[name] = np.stack([by_id[e] for e in range(E)])
+    return out
+
+
 def _src_prefix(hf: Dict[str, np.ndarray]) -> str:
     for p in ("bert.", "ernie."):
         if any(k.startswith(p) for k in hf):
@@ -202,6 +250,8 @@ _CONVERTERS: Dict[str, Callable] = {
     "llama": _convert_llama,
     "qwen2": _convert_llama,   # Llama backbone + qkv bias (qwen2.py)
     "ernie4_5": _convert_llama,
+    "qwen2_moe": _convert_qwen2_moe,
+    "ernie4_5_moe": _convert_qwen2_moe,
     "bert": _convert_bert,
     "ernie": _convert_ernie,
 }
@@ -263,6 +313,43 @@ def config_from_hf(model_dir: str):
             rope_theta=hf.get("rope_theta", 10000.0),
             tie_word_embeddings=hf.get("tie_word_embeddings", False),
             attention_bias=hf.get("attention_bias", mt == "qwen2"),
+            dtype=_jax_dtype(hf),
+        )
+        return cls, cfg, mt
+    if mt in ("qwen2_moe", "ernie4_5_moe"):
+        from .ernie import Ernie45MoeConfig, Ernie45MoeForCausalLM
+        from .qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM
+        qwen = mt == "qwen2_moe"
+        ccls, cls = ((Qwen2MoeConfig, Qwen2MoeForCausalLM) if qwen
+                     else (Ernie45MoeConfig, Ernie45MoeForCausalLM))
+        if hf.get("decoder_sparse_step", 1) not in (0, 1) or \
+                hf.get("mlp_only_layers"):
+            raise ValueError(
+                "decoder_sparse_step > 1 / mlp_only_layers are not "
+                "supported (this build places MoE on every layer past "
+                "first_k_dense_replace)")
+        n_shared = hf.get("shared_expert_intermediate_size") or 0
+        cfg = ccls(
+            **common,
+            intermediate_size=hf["intermediate_size"],
+            num_key_value_heads=hf.get("num_key_value_heads",
+                                       hf["num_attention_heads"]),
+            max_position_embeddings=hf.get("max_position_embeddings", 8192),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+            rope_theta=hf.get("rope_theta", 1000000.0),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            attention_bias=hf.get("attention_bias", qwen),
+            num_experts=hf.get("num_experts") or hf.get("moe_num_experts"),
+            num_experts_per_tok=hf.get("num_experts_per_tok")
+            or hf.get("moe_k", 2),
+            moe_intermediate_size=hf.get("moe_intermediate_size", 1408),
+            num_shared_experts=(1 if n_shared else
+                                hf.get("moe_num_shared_experts", 0)),
+            shared_expert_intermediate_size=n_shared or None,
+            first_k_dense_replace=hf.get("first_k_dense_replace",
+                                         hf.get("moe_layer_start_index", 0)),
+            shared_expert_gate=qwen,
+            norm_topk_prob=hf.get("norm_topk_prob", False),
             dtype=_jax_dtype(hf),
         )
         return cls, cfg, mt
@@ -328,7 +415,9 @@ def from_pretrained(model_dir: str, dtype: Optional[Any] = None,
     if unexpected:
         raise KeyError(f"converted keys not in model: {unexpected[:8]}")
     hard_missing = [k for k in missing
-                    if not k.startswith(_OPTIONAL_HEAD_PREFIXES)]
+                    if not k.startswith(_OPTIONAL_HEAD_PREFIXES)
+                    and not k.endswith(".expert_bias")]  # loss-free-balance
+                    # buffer: ours, never in an HF checkpoint
     if hard_missing and strict:
         raise KeyError(f"checkpoint missing model keys: {hard_missing[:8]}")
     if missing:

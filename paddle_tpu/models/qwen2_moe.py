@@ -40,6 +40,10 @@ class Qwen2MoeConfig(LlamaConfig):
     first_k_dense_replace: int = 0         # DeepSeekMoE: first k layers dense
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.001
+    # Qwen2-MoE: sigmoid token gate scaling the shared expert's output
+    shared_expert_gate: bool = False
+    # renormalize the selected top-k gates to sum to 1 (Qwen2-57B-A14B)
+    norm_topk_prob: bool = False
     attention_bias: bool = True
     rms_norm_eps: float = 1e-6
     rope_theta: float = 1000000.0
@@ -86,7 +90,10 @@ class Qwen2MoeDecoderLayer(Layer):
                 capacity_factor=config.capacity_factor,
                 num_shared_experts=config.num_shared_experts,
                 shared_intermediate_size=config.shared_expert_intermediate_size,
-                aux_loss_weight=config.aux_loss_weight)
+                aux_loss_weight=config.aux_loss_weight,
+                use_shared_expert_gate=getattr(config, "shared_expert_gate",
+                                               False),
+                norm_topk_prob=getattr(config, "norm_topk_prob", False))
 
     def forward(self, x, positions, kv_cache=None, cache_index=None,
                 attn_mask=None):

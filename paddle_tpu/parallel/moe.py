@@ -29,9 +29,12 @@ from .sharding import constraint
 
 
 def top_k_routing(router_logits, k: int, capacity: int,
-                  bias: Optional[jax.Array] = None):
+                  bias: Optional[jax.Array] = None,
+                  norm_topk_prob: bool = False):
     """router_logits [T, E] -> (dispatch [T, E, C] bool, combine [T, E, C],
-    aux_loss scalar). GShard top-k with per-expert capacity C."""
+    aux_loss scalar). GShard top-k with per-expert capacity C.
+    ``norm_topk_prob`` renormalizes the selected gates to sum to 1
+    (Qwen2-57B-A14B-style); False keeps raw softmax-over-all probs."""
     T, E = router_logits.shape
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
     select_scores = probs if bias is None else probs + bias[None, :]
@@ -39,6 +42,9 @@ def top_k_routing(router_logits, k: int, capacity: int,
     _, expert_ids = jax.lax.top_k(select_scores, k)          # [T, k]
     onehot = jax.nn.one_hot(expert_ids, E, dtype=jnp.float32)  # [T, k, E]
     gates = probs[:, None, :] * onehot                        # gate per choice
+    if norm_topk_prob:
+        total = jnp.sum(gates, axis=(1, 2), keepdims=True)
+        gates = gates / jnp.maximum(total, 1e-9)
     # position of each token within its expert's bucket (over T*k choices,
     # priority by choice rank then token order — GShard's policy)
     flat = onehot.transpose(1, 0, 2).reshape(k * T, E)        # choice-major
@@ -65,7 +71,9 @@ class MoEMLP(Layer):
                  capacity_factor: float = 1.25,
                  num_shared_experts: int = 0,
                  shared_intermediate_size: Optional[int] = None,
-                 aux_loss_weight: float = 0.01, name=None):
+                 aux_loss_weight: float = 0.01,
+                 use_shared_expert_gate: bool = False,
+                 norm_topk_prob: bool = False, name=None):
         super().__init__(name)
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -73,6 +81,7 @@ class MoEMLP(Layer):
         self.top_k = top_k
         self.capacity_factor = capacity_factor
         self.aux_loss_weight = aux_loss_weight
+        self.norm_topk_prob = norm_topk_prob
         E, h, m = num_experts, hidden_size, intermediate_size
         init = I.XavierNormal()
         self.gate = Parameter(init(next_key(), (h, E)))  # router, replicated
@@ -85,12 +94,19 @@ class MoEMLP(Layer):
         # loss-free balancing bias (buffer: updated outside the grad path)
         self.register_buffer("expert_bias", jnp.zeros((E,)), persistable=True)
         self.shared = None
+        self.has_shared_gate = False
         if num_shared_experts:
             sm = shared_intermediate_size or m * num_shared_experts
             self.shared_gate_proj = Parameter(init(next_key(), (h, sm)))
             self.shared_up_proj = Parameter(init(next_key(), (h, sm)))
             self.shared_down_proj = Parameter(init(next_key(), (sm, h)))
             self.shared = True
+            if use_shared_expert_gate:
+                # Qwen2-MoE: the shared expert's output is scaled by a
+                # learned sigmoid gate on the token
+                self.shared_expert_gate = Parameter(
+                    init(next_key(), (h, 1)))
+                self.has_shared_gate = True
 
     def capacity(self, tokens: int) -> int:
         c = int(math.ceil(self.capacity_factor * tokens * self.top_k
@@ -104,8 +120,9 @@ class MoEMLP(Layer):
         T = xt.shape[0]
         C = self.capacity(T)
         logits = xt.astype(jnp.float32) @ self.gate.astype(jnp.float32)
-        dispatch, combine, aux = top_k_routing(logits, self.top_k, C,
-                                               bias=self.expert_bias)
+        dispatch, combine, aux = top_k_routing(
+            logits, self.top_k, C, bias=self.expert_bias,
+            norm_topk_prob=self.norm_topk_prob)
         # dispatch to expert buckets: [E, C, h], sharded over ep
         xe = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
         xe = constraint(xe, "ep", None, None)
@@ -117,7 +134,13 @@ class MoEMLP(Layer):
         y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), ye)
         if self.shared:
             sg = F.silu(xt @ self.shared_gate_proj) * (xt @ self.shared_up_proj)
-            y = y + sg @ self.shared_down_proj
+            so = sg @ self.shared_down_proj
+            if self.has_shared_gate:
+                so = jax.nn.sigmoid(
+                    xt.astype(jnp.float32) @
+                    self.shared_expert_gate.astype(jnp.float32)
+                ).astype(so.dtype) * so
+            y = y + so
         y = y.reshape(orig_shape)
         if return_aux:
             return y, self.aux_loss_weight * aux

@@ -85,6 +85,37 @@ class TestModel:
         with pytest.raises(RuntimeError, match="prepare"):
             model.fit([])
 
+    def test_multi_element_batch_rejected(self):
+        import pytest
+        x, y = _cls_data(n=4)
+        model = pt.Model(_net())
+        model.prepare(pt.optimizer.AdamW(learning_rate=1e-3),
+                      loss=nn.functional.cross_entropy)
+        with pytest.raises(TypeError, match="2-tuples"):
+            model.fit([(x, x, y)])
+
+    def test_callbacks_invoked(self):
+        x, y = _cls_data(n=32)
+        events = []
+
+        class CB:
+            def on_train_batch_end(self, step, logs):
+                events.append(("batch", step, logs["loss"]))
+
+            def on_epoch_end(self, epoch, logs):
+                events.append(("epoch", epoch))
+
+        model = pt.Model(_net())
+        model.prepare(pt.optimizer.AdamW(learning_rate=1e-3),
+                      loss=nn.functional.cross_entropy)
+        hist = model.fit(_batches(x, y, 16), epochs=2, log_freq=2,
+                         verbose=0, callbacks=CB())
+        assert ("epoch", 0) in events and ("epoch", 1) in events
+        assert sum(1 for e in events if e[0] == "batch") == 2
+        # log_freq=2 over 2 steps/epoch: exactly one entry per epoch,
+        # no epoch-end duplicate
+        assert len(hist["loss"]) == 2
+
     def test_summary_counts(self):
         net = _net(d=8, classes=4)
         info = pt.summary(net)

@@ -51,6 +51,33 @@ def test_llama_logits_match(tmp_module):
     np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
 
 
+def test_qwen2_moe_logits_match(tmp_module):
+    """MoE-family interop: per-expert HF weights stack into our batched
+    [E, ...] tensors; shared expert + its sigmoid gate and the router all
+    line up. Capacity is raised to E/k so GShard dispatch drops nothing —
+    then our capacity-based MoE must equal HF's dropless top-k exactly."""
+    cfg = transformers.Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=32, shared_expert_intermediate_size=64,
+        num_experts=4, num_experts_per_tok=2, decoder_sparse_step=1,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        norm_topk_prob=False, tie_word_embeddings=False,
+        torch_dtype="float32", attn_implementation="eager")
+    hf_model, d = _save_hf(tmp_module / "qwen2moe",
+                           transformers.Qwen2MoeForCausalLM, cfg)
+    model = from_pretrained(d)
+    for layer in model.model.layers:
+        if hasattr(layer.mlp, "capacity_factor"):
+            layer.mlp.capacity_factor = (cfg.num_experts
+                                         / cfg.num_experts_per_tok)
+    ids = np.random.RandomState(0).randint(0, 128, (2, 16))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
 def test_llama_greedy_decode_matches(tmp_module):
     d = str(tmp_module / "llama")
     if not os.path.exists(os.path.join(d, "config.json")):

@@ -244,3 +244,20 @@ class TestRingFlash:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4, rtol=1e-3)
+
+
+def test_norm_topk_prob_routing():
+    """norm_topk_prob renormalizes the selected gates to sum to 1 per
+    token (Qwen2-57B-A14B semantics); combine weights prove it."""
+    import numpy as np
+    from paddle_tpu.parallel.moe import top_k_routing
+
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(16, 8), jnp.float32)
+    _, combine_raw, _ = top_k_routing(logits, 2, capacity=16)
+    _, combine_norm, _ = top_k_routing(logits, 2, capacity=16,
+                                       norm_topk_prob=True)
+    raw_sums = np.asarray(combine_raw.sum(axis=(1, 2)))
+    norm_sums = np.asarray(combine_norm.sum(axis=(1, 2)))
+    assert (raw_sums < 0.999).any()       # raw softmax mass < 1 over top-k
+    np.testing.assert_allclose(norm_sums, 1.0, atol=1e-5)
