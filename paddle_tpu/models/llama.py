@@ -126,7 +126,8 @@ class LlamaAttention(Layer):
         return get_mesh().shape.get("sp", 1) if has_mesh() else 1
 
     def forward(self, x, positions, kv_cache: Optional[Tuple] = None,
-                cache_index=None, attn_mask=None, attn_start=None):
+                cache_index=None, attn_mask=None, attn_start=None,
+                segment_ids=None):
         cfg = self.config
         b, s, _ = x.shape
         q = self.q_proj(x).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
@@ -169,7 +170,10 @@ class LlamaAttention(Layer):
                     self_ok = (kpos == qpos)[None]
                     mask = mask & (pad_ok | self_ok)[:, None]  # [b,1,s,T]
                 out = dense_attention(q, ck, cv, attn_mask=mask)
-        elif cfg.sequence_parallel and attn_mask is None and self._sp_degree() > 1:
+        elif cfg.sequence_parallel and attn_mask is None and \
+                segment_ids is None and self._sp_degree() > 1:
+            # (segment_ids falls through to the segment-aware paths below:
+            # the ring KV rotation has no segment masking)
             # ring attention: seq stays sp-sharded; KV blocks rotate on ICI
             import functools
             from jax.sharding import PartitionSpec as P
@@ -181,7 +185,14 @@ class LlamaAttention(Layer):
                 mesh=get_mesh(), in_specs=(spec,) * 3, out_specs=spec,
                 check_vma=False)(q, k, v)
         elif cfg.use_flash_attention and attn_mask is None and use_flash(q, k, None, 0.0):
-            out = flash_attention(q, k, v, causal=True)
+            # segment_ids ride the flash kernel (packed sequences): the
+            # same-segment mask applies inside the online softmax
+            out = flash_attention(q, k, v, causal=True,
+                                  segment_ids=segment_ids)
+        elif segment_ids is not None and attn_mask is None:
+            from ..ops.attention import segment_mask
+            out = dense_attention(q, k, v, causal=True,
+                                  attn_mask=segment_mask(segment_ids))
         else:
             out = dense_attention(q, k, v, causal=attn_mask is None,
                                   attn_mask=attn_mask)
@@ -218,10 +229,11 @@ class LlamaDecoderLayer(Layer):
         self.mlp = LlamaMLP(config)
 
     def forward(self, x, positions, kv_cache=None, cache_index=None,
-                attn_mask=None, attn_start=None):
+                attn_mask=None, attn_start=None, segment_ids=None):
         attn_out = self.self_attn(self.input_layernorm(x), positions,
                                   kv_cache=kv_cache, cache_index=cache_index,
-                                  attn_mask=attn_mask, attn_start=attn_start)
+                                  attn_mask=attn_mask, attn_start=attn_start,
+                                  segment_ids=segment_ids)
         new_cache = None
         if kv_cache is not None:
             attn_out, new_cache = attn_out
@@ -247,7 +259,8 @@ class LlamaModel(Layer):
             self.to(dtype=config.dtype)
 
     def forward(self, input_ids, positions=None, kv_caches=None,
-                cache_index=None, attn_mask=None, attn_start=None):
+                cache_index=None, attn_mask=None, attn_start=None,
+                segment_ids=None):
         b, s = input_ids.shape
         if positions is None:
             start = cache_index if cache_index is not None else 0
@@ -263,13 +276,14 @@ class LlamaModel(Layer):
             cache_i = kv_caches[i] if kv_caches is not None else None
             if self.config.recompute and kv_caches is None:
                 out = jax.checkpoint(
-                    lambda h, lyr=layer: lyr(h, positions, attn_mask=attn_mask),
+                    lambda h, lyr=layer: lyr(h, positions, attn_mask=attn_mask,
+                                             segment_ids=segment_ids),
                     prevent_cse=False,
                     policy=POLICIES[self.config.recompute_policy])(x)
             else:
                 out = layer(x, positions, kv_cache=cache_i,
                             cache_index=cache_index, attn_mask=attn_mask,
-                            attn_start=attn_start)
+                            attn_start=attn_start, segment_ids=segment_ids)
             if kv_caches is not None:
                 x, nc = out
                 new_caches.append(nc)
@@ -302,9 +316,10 @@ class LlamaForCausalLM(CausalLMBase):
                                          vpp=vpp)
 
     def forward(self, input_ids, positions=None, kv_caches=None,
-                cache_index=None, attn_mask=None, attn_start=None):
+                cache_index=None, attn_mask=None, attn_start=None,
+                segment_ids=None):
         out = self.model(input_ids, positions, kv_caches, cache_index,
-                         attn_mask, attn_start)
+                         attn_mask, attn_start, segment_ids=segment_ids)
         caches = None
         if kv_caches is not None:
             out, caches = out

@@ -96,10 +96,11 @@ class Qwen2MoeDecoderLayer(Layer):
                 norm_topk_prob=getattr(config, "norm_topk_prob", False))
 
     def forward(self, x, positions, kv_cache=None, cache_index=None,
-                attn_mask=None):
+                attn_mask=None, segment_ids=None):
         attn_out = self.self_attn(self.input_layernorm(x), positions,
                                   kv_cache=kv_cache, cache_index=cache_index,
-                                  attn_mask=attn_mask)
+                                  attn_mask=attn_mask,
+                                  segment_ids=segment_ids)
         new_cache = None
         if kv_cache is not None:
             attn_out, new_cache = attn_out

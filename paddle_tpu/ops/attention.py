@@ -39,15 +39,29 @@ def use_flash(query, key, attn_mask, dropout_p) -> bool:
     return sq % 128 == 0 and sk % 128 == 0 and d in (64, 128, 256)
 
 
-def flash_attention(query, key, value, causal=False, scale=None):
+def flash_attention(query, key, value, causal=False, scale=None,
+                    segment_ids=None):
     """[b, s, h, d] flash attention; grouped-query aware. The Pallas kernel
     is TPU-only; on other backends (CPU mesh tests, dryruns) this routes to
-    the numerically-identical dense XLA path."""
+    the numerically-identical dense XLA path. ``segment_ids`` [b, s]
+    (0 = pad) restricts attention to same-segment pairs (packed
+    sequences)."""
     from .pallas import tpu_backend
     if not tpu_backend():
-        return dense_attention(query, key, value, causal=causal, scale=scale)
+        return dense_attention(query, key, value, causal=causal, scale=scale,
+                               attn_mask=segment_mask(segment_ids)
+                               if segment_ids is not None else None)
     from .pallas.flash_attention import flash_attention_bshd
-    return flash_attention_bshd(query, key, value, causal=causal, scale=scale)
+    return flash_attention_bshd(query, key, value, causal=causal,
+                                scale=scale, segment_ids=segment_ids)
+
+
+def segment_mask(segment_ids):
+    """[b, s] segment ids -> [b, 1, s, s] same-segment boolean mask with
+    pads (seg 0) attending only pads (flash-kernel semantics; combined
+    with `causal=` by dense_attention)."""
+    seg = jnp.asarray(segment_ids)
+    return (seg[:, :, None] == seg[:, None, :])[:, None]
 
 
 def dense_attention(query, key, value, attn_mask=None, dropout_p=0.0,

@@ -56,9 +56,12 @@ def pick_block(seq: int, preferred: int) -> int:
 
 
 def _scores(q, k, qi, ki, *, scale, causal, block_q, block_k,
-            causal_offset):
+            causal_offset, qs=None, ks=None):
     """q@k^T with the shared bottom-right causal mask — the ONE definition
-    of the masking convention, inlined into fwd and both bwd kernels."""
+    of the masking convention, inlined into fwd and both bwd kernels.
+    qs [block_q, 128] / ks [1, block_k] (lane/sublane-broadcast segment-id
+    tiles, the jax TPU flash layout) additionally mask cross-segment
+    pairs — the packed-sequence case."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     if causal:
@@ -67,14 +70,23 @@ def _scores(q, k, qi, ki, *, scale, causal, block_q, block_k,
         k_ids = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) \
             + ki * block_k
         s = jnp.where(q_ids + causal_offset >= k_ids, s, NEG_INF)
+    if qs is not None:
+        qs_full = jnp.tile(qs, (1, block_k // 128))   # [block_q, block_k]
+        s = jnp.where(qs_full == ks, s, NEG_INF)
     return s
 
 
 # ----------------------------------------------------------------- forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
-                scale, causal, block_q, block_k, kv_blocks, causal_offset):
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, kv_blocks,
+                causal_offset, has_seg):
     """causal_offset = sk - sq: bottom-right-aligned causal mask (matches
     the naive path and the backward), so query i attends keys <= i+offset."""
+    if has_seg:
+        (q_ref, k_ref, v_ref, qs_ref, ks_ref,
+         o_ref, lse_ref, acc, m_scr, l_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr = refs
+        qs_ref = ks_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -93,7 +105,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
     def _compute():
         s = _scores(q_ref[0, :, :], k_ref[0, :, :], qi, ki, scale=scale,
                     causal=causal, block_q=block_q, block_k=block_k,
-                    causal_offset=causal_offset)
+                    causal_offset=causal_offset,
+                    qs=qs_ref[0] if has_seg else None,
+                    ks=ks_ref[0, :1, :] if has_seg else None)
         m_prev = m_scr[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -114,29 +128,56 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
             m_scr[:, :1] + jnp.log(safe_l), (acc.shape[0], 128))
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+def _seg_operands(segment_ids, heads):
+    """[b, s] int32 -> the jax-TPU-flash layout: q ids broadcast into the
+    128-lane dim, kv ids into an 8-sublane dim, so every block is
+    (8,128)-tiled. ``heads`` lets the bh-flattened grids index batch as
+    bh // heads."""
+    seg = jnp.asarray(segment_ids, jnp.int32)
+    b, s = seg.shape
+    qs = jnp.broadcast_to(seg[:, :, None], (b, s, 128))
+    ks = jnp.broadcast_to(seg[:, None, :], (b, 8, s))
+    return qs, ks
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+               segment_ids=None, heads=1):
     """q: [bh, sq, d]; k/v: [bh_kv, sk, d] with bh % bh_kv == 0."""
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
     group = bh // bh_kv
     q_blocks = sq // block_q
     kv_blocks = sk // block_k
+    has_seg = segment_ids is not None
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, kv_blocks=kv_blocks, causal_offset=sk - sq)
+        block_k=block_k, kv_blocks=kv_blocks, causal_offset=sk - sq,
+        has_seg=has_seg)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [q, k, v]
+    if has_seg:
+        h = heads
+        in_specs += [
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b // h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // h, 0, j),
+                         memory_space=pltpu.VMEM),
+        ]
+        operands += list(_seg_operands(segment_ids, heads))
 
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, q_blocks, kv_blocks),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
@@ -153,14 +194,20 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*operands)
     return out, lse[:, :, :1]   # [bh, sq, 1]
 
 
 # ---------------------------------------------------------------- backward
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
-                   acc, *, scale, causal, block_q, block_k, kv_blocks,
-                   causal_offset):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, kv_blocks,
+                   causal_offset, has_seg):
+    if has_seg:
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dq_ref, acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+         dq_ref, acc) = refs
+        qs_ref = ks_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -177,7 +224,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, :, :]
         s = _scores(q_ref[0, :, :], k, qi, ki, scale=scale, causal=causal,
                     block_q=block_q, block_k=block_k,
-                    causal_offset=causal_offset)
+                    causal_offset=causal_offset,
+                    qs=qs_ref[0] if has_seg else None,
+                    ks=ks_ref[0, :1, :] if has_seg else None)
         p = jnp.exp(s - lse_ref[0, :, :1])            # exact probs via lse
         dp = jax.lax.dot_general(
             g_ref[0, :, :], v_ref[0, :, :], (((1,), (1,)), ((), ())),
@@ -192,9 +241,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, :, :] = acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k, group, q_blocks, causal_offset):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, group,
+                    q_blocks, causal_offset, has_seg):
+    if has_seg:
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        qs_ref = ks_ref = None
     kj = pl.program_id(1)
     gi = pl.program_id(2)
     qi = pl.program_id(3)
@@ -213,7 +268,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         q = q_ref[0, :, :]
         s = _scores(q, k_ref[0, :, :], qi, kj, scale=scale, causal=causal,
                     block_q=block_q, block_k=block_k,
-                    causal_offset=causal_offset)
+                    causal_offset=causal_offset,
+                    qs=qs_ref[0] if has_seg else None,
+                    ks=ks_ref[0, :1, :] if has_seg else None)
         p = jnp.exp(s - lse_ref[0, :, :1])
         g = g_ref[0, :, :]
         # dv += p^T g
@@ -235,67 +292,95 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dv_ref[0, :, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k):
+def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
+               segment_ids=None, heads=1):
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
     group = bh // bh_kv
     q_blocks = sq // block_q
     kv_blocks = sk // block_k
     offset = sk - sq
+    has_seg = segment_ids is not None
 
     # delta_i = rowsum(dout * out): cheap XLA reduction, fp32
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)                  # [bh, sq, 1]
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    dq_operands = [q, k, v, g, lse, delta]
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d),
+                     lambda b, j, gidx, i: (b * group + gidx, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, j, gidx, i: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, j, gidx, i: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, d),
+                     lambda b, j, gidx, i: (b * group + gidx, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 1),
+                     lambda b, j, gidx, i: (b * group + gidx, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 1),
+                     lambda b, j, gidx, i: (b * group + gidx, i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    dkv_operands = [q, k, v, g, lse, delta]
+    if has_seg:
+        h, hk = heads, heads // group
+        qs3, ks3 = _seg_operands(segment_ids, heads)
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b // h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // h, 0, j),
+                         memory_space=pltpu.VMEM),
+        ]
+        dq_operands += [qs3, ks3]
+        dkv_in_specs += [
+            pl.BlockSpec((1, block_q, 128),
+                         lambda b, j, gidx, i: (b // hk, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_k),
+                         lambda b, j, gidx, i: (b // hk, 0, j),
+                         memory_space=pltpu.VMEM),
+        ]
+        dkv_operands += [qs3, ks3]
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          kv_blocks=kv_blocks, causal_offset=offset),
+                          kv_blocks=kv_blocks, causal_offset=offset,
+                          has_seg=has_seg),
         grid=(bh, q_blocks, kv_blocks),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, g, lse, delta)
+    )(*dq_operands)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, group=group,
-                          q_blocks=q_blocks, causal_offset=offset),
+                          q_blocks=q_blocks, causal_offset=offset,
+                          has_seg=has_seg),
         grid=(bh_kv, kv_blocks, group, q_blocks),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d),
-                         lambda b, j, gidx, i: (b * group + gidx, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, j, gidx, i: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, j, gidx, i: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d),
-                         lambda b, j, gidx, i: (b * group + gidx, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1),
-                         lambda b, j, gidx, i: (b * group + gidx, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1),
-                         lambda b, j, gidx, i: (b * group + gidx, i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, gidx, i: (b, j, 0),
                          memory_space=pltpu.VMEM),
@@ -311,7 +396,7 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, g, lse, delta)
+    )(*dkv_operands)
     return dq, dk, dv
 
 
@@ -334,9 +419,37 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+# -------------------------------------------------- flash with segment ids
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_seg(q, k, v, seg, scale, causal, block_q, block_k, heads):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        segment_ids=seg, heads=heads)
+    return out
+
+
+def _flash_seg_vjp_fwd(q, k, v, seg, scale, causal, block_q, block_k,
+                       heads):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          segment_ids=seg, heads=heads)
+    return out, (q, k, v, seg, out, lse)
+
+
+def _flash_seg_vjp_bwd(scale, causal, block_q, block_k, heads, res, g):
+    q, k, v, seg, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, scale, causal,
+                            block_q, block_k, segment_ids=seg, heads=heads)
+    return dq, dk, dv, None  # int segment ids carry no cotangent
+
+
+_flash_seg.defvjp(_flash_seg_vjp_fwd, _flash_seg_vjp_bwd)
+
+
 def flash_attention_bshd(query, key, value, causal=False, scale=None,
-                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """Flash attention on [batch, seq, heads, head_dim] (paddle layout)."""
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                         segment_ids=None):
+    """Flash attention on [batch, seq, heads, head_dim] (paddle layout).
+    ``segment_ids`` [b, s] (0 = pad) restricts attention to same-segment
+    pairs — packed-sequence training on the flash path."""
     b, sq, h, d = query.shape
     _, sk, hk, _ = key.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -345,7 +458,11 @@ def flash_attention_bshd(query, key, value, causal=False, scale=None,
     q = jnp.swapaxes(query, 1, 2).reshape(b * h, sq, d)
     k = jnp.swapaxes(key, 1, 2).reshape(b * hk, sk, d)
     v = jnp.swapaxes(value, 1, 2).reshape(b * hk, sk, d)
-    out = _flash(q, k, v, scale, causal, block_q, block_k)
+    if segment_ids is not None:
+        out = _flash_seg(q, k, v, jnp.asarray(segment_ids, jnp.int32),
+                         scale, causal, block_q, block_k, h)
+    else:
+        out = _flash(q, k, v, scale, causal, block_q, block_k)
     return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
 
 

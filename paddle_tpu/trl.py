@@ -174,14 +174,16 @@ class DataCollatorForSFT:
                 "segment_ids": jnp.asarray(segs)}
 
 
-def packed_sft_inputs(segment_ids):
+def packed_sft_inputs(segment_ids, with_mask: bool = True):
     """segment_ids [b, s] -> (positions [b, s], attn_mask [b, 1, s, s]).
 
     Attention is causal AND segment-diagonal (tokens never attend across
     packed examples — the correctness requirement of packing), and RoPE
     positions restart at each example's first token. Pure jnp: runs
     inside the jitted step, so the collator ships only one extra [b, s]
-    int array."""
+    int array. ``with_mask=False`` skips the O(s^2) mask and returns
+    (positions, None) — the path used when the model takes segment_ids
+    directly (segment-aware flash kernel)."""
     seg = segment_ids
     s = seg.shape[-1]
     idx = jnp.arange(s)
@@ -191,6 +193,8 @@ def packed_sft_inputs(segment_ids):
         [jnp.ones_like(seg[:, :1]), (seg[:, 1:] != seg[:, :-1])], axis=1)
     start_idx = jax.lax.cummax(jnp.where(change, idx[None, :], 0), axis=1)
     positions = idx[None, :] - start_idx
+    if not with_mask:
+        return positions, None
     causal = (idx[None, :, None] >= idx[None, None, :])
     same_seg = (seg[:, :, None] == seg[:, None, :]) & (seg[:, :, None] > 0)
     # pad rows (seg 0) attend only themselves: an all-masked softmax row
@@ -205,8 +209,17 @@ def _sft_batch_loss(fn, p, batch):
     ids = batch["input_ids"]
     if "segment_ids" in batch:  # packed rows: block-causal + reset RoPE
         seg = batch["segment_ids"]
-        positions, attn = packed_sft_inputs(seg)
-        logits = fn(p, ids, positions=positions, attn_mask=attn)
+        try:
+            # segment_ids (not a dense [s, s] mask) so attention takes the
+            # segment-aware FLASH path on TPU when shapes qualify; the
+            # dense fallback builds the same mask internally
+            positions, _ = packed_sft_inputs(seg, with_mask=False)
+            logits = fn(p, ids, positions=positions, segment_ids=seg)
+        except TypeError:
+            # model forward without a segment_ids parameter (e.g. GPT):
+            # fall back to the explicit block-causal mask
+            positions, attn = packed_sft_inputs(seg)
+            logits = fn(p, ids, positions=positions, attn_mask=attn)
         return sft_loss(logits, ids, batch["loss_mask"], segment_ids=seg)
     return sft_loss(fn(p, ids), ids, batch["loss_mask"])
 

@@ -150,6 +150,27 @@ class TestPacking:
         with pytest.raises(ValueError, match="pack_rows"):
             coll(small * 12)
 
+    def test_packed_fallback_for_models_without_segment_ids(self, tmp_path):
+        """Models whose forward lacks segment_ids (GPT) take the explicit
+        block-causal-mask fallback; packing still trains."""
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        from paddle_tpu.trl import DataCollatorForSFT
+        pt.seed(0)
+        model = GPTForCausalLM(gpt_tiny())
+        rs = np.random.RandomState(6)
+        coll = DataCollatorForSFT(max_length=24, packing=True)
+        batch = coll([{"prompt_ids": rs.randint(1, 256, 4).tolist(),
+                       "response_ids": rs.randint(1, 256, 6).tolist()}
+                      for _ in range(4)])
+        tr = SFTTrainer(model, pt.optimizer.AdamW(learning_rate=1e-2),
+                        TrainingArguments(output_dir=str(tmp_path),
+                                          max_steps=8, logging_steps=4,
+                                          resume_from_checkpoint=False),
+                        train_dataloader=[batch])
+        tr.train()
+        hist = tr.logger.history["loss"]
+        assert hist[-1][1] < hist[0][1]
+
     def test_sft_trainer_packed_learns(self, tmp_path):
         from paddle_tpu.trl import DataCollatorForSFT
         model = _model()
