@@ -213,5 +213,6 @@ def beam_search(model, input_ids, config: GenerationConfig, params=None):
 
 
 from .pipeline import TextGenerationPipeline  # noqa: E402
+from .speculative import speculative_generate  # noqa: E402
 
-__all__.append("TextGenerationPipeline")
+__all__ += ["TextGenerationPipeline", "speculative_generate"]

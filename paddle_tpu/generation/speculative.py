@@ -1,0 +1,142 @@
+"""Speculative decoding (reference: PaddleNLP llm/ speculative decoding /
+draft-model inference acceleration; Leviathan et al. 2023).
+
+A small DRAFT model proposes ``k`` tokens autoregressively; the TARGET
+model scores all of them in ONE forward over its static KV cache and the
+longest matching prefix is accepted, plus the target's own next token as
+a bonus. Greedy speculative decoding is EXACT: whatever the draft does,
+the emitted sequence equals the target's own greedy decode — the draft
+only changes how many target forwards it takes.
+
+TPU-native: one `lax.while_loop` whose body is (draft scan of k single-
+token steps) + (one k+1-token target verify) — all static shapes. Cache
+rewind is free: stale speculative K/V entries sit beyond the accepted
+cursor, decode attention never reads past its cache_index, and the next
+iteration overwrites them before they become readable.
+
+Batch size 1 (the latency case speculative decoding exists for): rows
+accepting different counts would force per-row cache cursors, which the
+shared-scalar cache_index design deliberately avoids.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["speculative_generate"]
+
+
+def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
+                         num_draft_tokens: int = 4,
+                         eos_token_id: Optional[int] = None,
+                         pad_token_id: int = 0,
+                         target_params=None, draft_params=None,
+                         return_stats: bool = False):
+    """Greedy decode of ``target`` accelerated by ``draft``.
+
+    Both models follow the CausalLM contract (init_kv_caches + forward
+    with kv_caches/cache_index). Returns [1, prompt + max_new_tokens]
+    ids (pad after eos / past the end), exactly equal to
+    ``target.generate(..., temperature=0.0)``. With ``return_stats``,
+    also a dict with ``target_forwards`` — the speedup measure: plain
+    greedy needs max_new_tokens of them."""
+    if input_ids.shape[0] != 1:
+        raise ValueError("speculative_generate is batch-size-1 (per-row "
+                         "accept counts would need per-row cache cursors)")
+    k = int(num_draft_tokens)
+    if k < 1:
+        raise ValueError("num_draft_tokens must be >= 1")
+    t_fn, t_p = target.functional()
+    d_fn, d_p = draft.functional()
+    t_params = target_params if target_params is not None else t_p
+    d_params = draft_params if draft_params is not None else d_p
+    prompt_len = input_ids.shape[1]
+    total = prompt_len + max_new_tokens
+    eos = eos_token_id
+
+    @jax.jit
+    def run(t_params, d_params, input_ids):
+        t_caches = target.init_kv_caches(1, total + k + 1)
+        d_caches = draft.init_kv_caches(1, total + k + 1)
+        t_logits, t_caches = t_fn(t_params, input_ids, kv_caches=t_caches,
+                                  cache_index=0)
+        _, d_caches = d_fn(d_params, input_ids, kv_caches=d_caches,
+                           cache_index=0)
+        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(input_ids.dtype)
+        tokens = jnp.concatenate(
+            [input_ids, jnp.full((1, max_new_tokens + k + 1), pad_token_id,
+                                 input_ids.dtype)], axis=1)
+        tokens = tokens.at[:, prompt_len].set(first)
+        n0 = jnp.int32(prompt_len + 1)
+        done0 = jnp.bool_(False) if eos is None else (first[0] == eos)
+
+        def draft_step(carry, _):
+            d_caches, cur, tokens = carry
+            ids = jax.lax.dynamic_slice(tokens, (0, cur - 1), (1, 1))
+            dl, d_caches = d_fn(d_params, ids, kv_caches=d_caches,
+                                cache_index=cur - 1)
+            nxt = jnp.argmax(dl[:, -1], axis=-1).astype(tokens.dtype)
+            tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None],
+                                                  (0, cur))
+            return (d_caches, cur + 1, tokens), None
+
+        def body(state):
+            tokens, t_caches, d_caches, n, done, nfwd = state
+            # 1) draft k tokens at positions n .. n+k-1 (written into the
+            #    speculative tail of `tokens`). k+1 steps, not k: each step
+            #    caches K/V for its INPUT token only, so the extra step
+            #    commits d_{k-1}'s cache entry — without it, a full accept
+            #    would leave the next iteration reading a zero cache slot
+            #    at position n+k-1. The extra step's own proposal (written
+            #    at n+k) is discarded by the verify-write below.
+            (d_caches, _, tokens), _ = jax.lax.scan(
+                draft_step, (d_caches, n, tokens), None, length=k + 1)
+            # 2) ONE target forward over [t_{n-1}, d_0 .. d_{k-1}]:
+            #    logits[j] is the target's prediction for position n+j
+            chunk = jax.lax.dynamic_slice(tokens, (0, n - 1), (1, k + 1))
+            t_logits, t_caches = t_fn(t_params, chunk, kv_caches=t_caches,
+                                      cache_index=n - 1)
+            g = jnp.argmax(t_logits[0].astype(jnp.float32), axis=-1) \
+                .astype(tokens.dtype)                      # [k+1]
+            d = jax.lax.dynamic_slice(tokens, (0, n), (1, k))[0]  # drafts
+            # 3) accept the longest prefix where draft == target, then the
+            #    target's own token — the correction (or the bonus if all
+            #    k matched)
+            match = jnp.cumprod((d == g[:k]).astype(jnp.int32))
+            m = jnp.sum(match)                             # accepted drafts
+            # accepted drafts ARE g[:m] by definition of matching, and
+            # g[m] is the correction/bonus — so the whole commit is g[:m+1]
+            write = jnp.where(jnp.arange(k + 1) <= m, g,
+                              pad_token_id).astype(tokens.dtype)
+            tokens = jax.lax.dynamic_update_slice(tokens, write[None],
+                                                  (0, n))
+            if eos is not None:
+                hit = (write[:k + 1] == eos) & \
+                    (jnp.arange(k + 1) <= m)
+                done = done | jnp.any(hit)
+                # stop at the first eos: cap the advance there
+                first_eos = jnp.argmax(hit)
+                adv = jnp.where(jnp.any(hit), first_eos + 1, m + 1)
+            else:
+                adv = m + 1
+            return (tokens, t_caches, d_caches, n + adv, done, nfwd + 1)
+
+        def cond(state):
+            _, _, _, n, done, _ = state
+            return (n < total) & ~done
+
+        state = (tokens, t_caches, d_caches, n0, done0, jnp.int32(1))
+        tokens, _, _, n_end, _, nfwd = jax.lax.while_loop(cond, body, state)
+        # blank the speculative tail and anything past the final cursor
+        pos = jnp.arange(tokens.shape[1])[None, :]
+        tokens = jnp.where(pos < jnp.minimum(n_end, total), tokens,
+                           pad_token_id)
+        return tokens[:, :total], nfwd
+
+    out, nfwd = run(t_params, d_params, input_ids)
+    if return_stats:
+        return out, {"target_forwards": int(nfwd),
+                     "tokens_per_forward": max_new_tokens / max(int(nfwd), 1)}
+    return out

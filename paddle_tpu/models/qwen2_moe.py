@@ -131,7 +131,7 @@ class Qwen2MoeModel(Layer):
             self.to(dtype=config.dtype)
 
     def forward(self, input_ids, positions=None, kv_caches=None,
-                cache_index=None, attn_mask=None):
+                cache_index=None, attn_mask=None, segment_ids=None):
         b, s = input_ids.shape
         if positions is None:
             start = cache_index if cache_index is not None else 0
@@ -149,10 +149,12 @@ class Qwen2MoeModel(Layer):
             elif self.config.recompute:
                 x, aux = jax.checkpoint(
                     lambda h, lyr=layer: lyr(h, positions,
-                                             attn_mask=attn_mask),
+                                             attn_mask=attn_mask,
+                                             segment_ids=segment_ids),
                     prevent_cse=False)(x)
             else:
-                x, aux = layer(x, positions, attn_mask=attn_mask)
+                x, aux = layer(x, positions, attn_mask=attn_mask,
+                               segment_ids=segment_ids)
             aux_total = aux_total + aux
         x = self.norm(x)
         if kv_caches is not None:
@@ -174,9 +176,10 @@ class Qwen2MoeForCausalLM(CausalLMBase):
                 self.lm_head.to(dtype=config.dtype)
 
     def forward(self, input_ids, positions=None, kv_caches=None,
-                cache_index=None, attn_mask=None, return_aux: bool = False):
+                cache_index=None, attn_mask=None, return_aux: bool = False,
+                segment_ids=None):
         out = self.model(input_ids, positions, kv_caches, cache_index,
-                         attn_mask)
+                         attn_mask, segment_ids=segment_ids)
         caches = None
         if kv_caches is not None:
             h, aux, caches = out

@@ -205,23 +205,35 @@ def packed_sft_inputs(segment_ids, with_mask: bool = True):
     return positions, attn[:, None]
 
 
-def _sft_batch_loss(fn, p, batch):
-    ids = batch["input_ids"]
-    if "segment_ids" in batch:  # packed rows: block-causal + reset RoPE
-        seg = batch["segment_ids"]
-        try:
-            # segment_ids (not a dense [s, s] mask) so attention takes the
-            # segment-aware FLASH path on TPU when shapes qualify; the
-            # dense fallback builds the same mask internally
-            positions, _ = packed_sft_inputs(seg, with_mask=False)
-            logits = fn(p, ids, positions=positions, segment_ids=seg)
-        except TypeError:
-            # model forward without a segment_ids parameter (e.g. GPT):
-            # fall back to the explicit block-causal mask
-            positions, attn = packed_sft_inputs(seg)
-            logits = fn(p, ids, positions=positions, attn_mask=attn)
-        return sft_loss(logits, ids, batch["loss_mask"], segment_ids=seg)
-    return sft_loss(fn(p, ids), ids, batch["loss_mask"])
+def _model_takes_segment_ids(model) -> bool:
+    import inspect
+    try:
+        return "segment_ids" in inspect.signature(
+            type(model).forward).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def _make_sft_loss(supports_seg: bool):
+    def loss_fn(fn, p, batch):
+        ids = batch["input_ids"]
+        if "segment_ids" in batch:  # packed rows: block-causal + RoPE reset
+            seg = batch["segment_ids"]
+            if supports_seg:
+                # segment_ids (not a dense [s, s] mask) so attention takes
+                # the segment-aware FLASH path on TPU when shapes qualify;
+                # the dense fallback builds the same mask internally
+                positions, _ = packed_sft_inputs(seg, with_mask=False)
+                logits = fn(p, ids, positions=positions, segment_ids=seg)
+            else:
+                # model forward without a segment_ids parameter (e.g.
+                # GPT): the explicit block-causal mask
+                positions, attn = packed_sft_inputs(seg)
+                logits = fn(p, ids, positions=positions, attn_mask=attn)
+            return sft_loss(logits, ids, batch["loss_mask"],
+                            segment_ids=seg)
+        return sft_loss(fn(p, ids), ids, batch["loss_mask"])
+    return loss_fn
 
 
 class SFTTrainer(Trainer):
@@ -231,7 +243,11 @@ class SFTTrainer(Trainer):
 
     def __init__(self, model, optimizer, args: Optional[TrainingArguments]
                  = None, **kw):
-        kw.setdefault("loss_fn", _sft_batch_loss)
+        # capability dispatch by signature, not try/except around the
+        # whole trace — a genuine TypeError inside a segment-aware model
+        # must surface, not silently reroute to the dense path
+        kw.setdefault("loss_fn", _make_sft_loss(
+            _model_takes_segment_ids(model)))
         super().__init__(model, optimizer, args, **kw)
 
 

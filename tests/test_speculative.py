@@ -1,0 +1,77 @@
+"""Speculative decoding (C38): greedy exactness regardless of draft
+quality, fewer target forwards with a good draft, eos handling."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.generation import speculative_generate
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+
+def _models():
+    pt.seed(0)
+    target = LlamaForCausalLM(llama_tiny())
+    # decisive logits: random-init outputs are near-uniform, and the
+    # decode (q_len=1) vs verify (q_len=k+1) paths differ by float
+    # epsilon — enough to flip coin-toss argmaxes and make exactness
+    # seed-lottery. Scaling the head widens every gap 10x.
+    target.lm_head.weight = target.lm_head.weight * 10.0
+    pt.seed(99)  # a DIFFERENT (bad) draft: random init, half the size
+    draft = LlamaForCausalLM(llama_tiny(hidden_size=32, intermediate_size=64,
+                                        num_hidden_layers=1))
+    return target, draft
+
+
+def _prompt(seed=0, n=8):
+    return jnp.asarray(np.random.RandomState(seed).randint(1, 256, (1, n)))
+
+
+class TestSpeculative:
+    def test_exactness_with_bad_draft(self):
+        """The defining property: a random draft changes SPEED only —
+        the output equals the target's own greedy decode token-for-token."""
+        target, draft = _models()
+        ids = _prompt()
+        want = target.generate(ids, max_new_tokens=24, temperature=0.0)
+        got = speculative_generate(target, draft, ids, max_new_tokens=24,
+                                   num_draft_tokens=4)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_perfect_draft_cuts_target_forwards(self):
+        """Draft == target: every proposal accepted, so ~(k+1) tokens per
+        target forward instead of 1."""
+        target, _ = _models()
+        ids = _prompt(seed=1)
+        got, stats = speculative_generate(
+            target, target, ids, max_new_tokens=24, num_draft_tokens=4,
+            return_stats=True)
+        want = target.generate(ids, max_new_tokens=24, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # 1 prefill + ceil(23 / 5) = 6 verify calls; plain greedy uses 24
+        assert stats["target_forwards"] <= 8, stats
+        assert stats["tokens_per_forward"] > 2.5
+
+    def test_eos_stops_and_pads(self):
+        target, draft = _models()
+        ids = _prompt(seed=2)
+        want = target.generate(ids, max_new_tokens=24, temperature=0.0,
+                               eos_token_id=7)
+        got = speculative_generate(target, draft, ids, max_new_tokens=24,
+                                   num_draft_tokens=3, eos_token_id=7)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_batch_gt_one_rejected(self):
+        target, draft = _models()
+        with pytest.raises(ValueError, match="batch-size-1"):
+            speculative_generate(target, draft,
+                                 jnp.zeros((2, 8), jnp.int32))
+
+    @pytest.mark.parametrize("k", [1, 2, 6])
+    def test_various_draft_lengths(self, k):
+        target, draft = _models()
+        ids = _prompt(seed=3)
+        want = target.generate(ids, max_new_tokens=16, temperature=0.0)
+        got = speculative_generate(target, draft, ids, max_new_tokens=16,
+                                   num_draft_tokens=k)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
