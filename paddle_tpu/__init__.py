@@ -61,6 +61,7 @@ from . import vision  # noqa: E402
 from . import quant  # noqa: E402
 from .checkpoint import load, save  # noqa: E402
 from .hapi import Model, summary  # noqa: E402
+from . import callbacks  # noqa: E402
 
 __version__ = "0.1.0"
 

@@ -21,11 +21,17 @@ shared-scalar cache_index design deliberately avoids.
 from __future__ import annotations
 
 from typing import Optional
+import weakref
 
 import jax
 import jax.numpy as jnp
 
 __all__ = ["speculative_generate"]
+
+# target -> draft -> {static key -> compiled run}: without this every call
+# would retrace the draft-scan + verify while_loop (cf. generation's
+# _GEN_CACHE) — fatal for the serving latency this feature exists for.
+_SPEC_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
@@ -55,6 +61,20 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
     prompt_len = input_ids.shape[1]
     total = prompt_len + max_new_tokens
     eos = eos_token_id
+
+    cache_key = (prompt_len, max_new_tokens, k, eos, pad_token_id,
+                 hash(tuple(t_p)), hash(tuple(d_p)))
+    per_draft = _SPEC_CACHE.setdefault(
+        target, weakref.WeakKeyDictionary())
+    per_key = per_draft.setdefault(draft, {})
+    cached = per_key.get(cache_key)
+    if cached is not None:
+        out, nfwd = cached(t_params, d_params, input_ids)
+        if return_stats:
+            return out, {"target_forwards": int(nfwd),
+                         "tokens_per_forward":
+                         max_new_tokens / max(int(nfwd), 1)}
+        return out
 
     @jax.jit
     def run(t_params, d_params, input_ids):
@@ -135,6 +155,7 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
                            pad_token_id)
         return tokens[:, :total], nfwd
 
+    per_key[cache_key] = run
     out, nfwd = run(t_params, d_params, input_ids)
     if return_stats:
         return out, {"target_forwards": int(nfwd),

@@ -115,6 +115,9 @@ class Model:
         self._require("an optimizer and a loss", "_train_step")
         loader = self._as_loader(train_data, batch_size, shuffle)
         callbacks = _to_list(callbacks)
+        for cb in callbacks:
+            if hasattr(cb, "set_model"):
+                cb.set_model(self)
         if self._opt_state is None:
             self._opt_state = self._optimizer.init(self._params)
         stepno = 0
@@ -147,10 +150,13 @@ class Model:
                     eres = self.evaluate(eval_data, batch_size=batch_size,
                                          verbose=verbose)
                     history.setdefault("eval_loss", []).append(eres["loss"])
-                for cb in callbacks:
-                    if hasattr(cb, "on_epoch_end"):
-                        cb.on_epoch_end(epoch, {k: v[-1] for k, v in
-                                                history.items() if v})
+                try:
+                    for cb in callbacks:
+                        if hasattr(cb, "on_epoch_end"):
+                            cb.on_epoch_end(epoch, {k: v[-1] for k, v in
+                                                    history.items() if v})
+                except StopIteration:
+                    break  # a callback (EarlyStopping) ended training
         finally:
             # the step DONATES params; on an abort between steps, write the
             # live arrays back so the network never holds deleted buffers

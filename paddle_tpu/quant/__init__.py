@@ -4,3 +4,4 @@ from .weight_only import (QuantizedLinear, dequantize_weight,
                           quantize_blockwise, quantize_model,
                           weight_only_linear)
 from .qat import FakeQuantLinear, fake_quant
+from .ptq import PTQ, AbsMaxObserver, W8A8Linear

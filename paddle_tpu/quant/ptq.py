@@ -1,0 +1,120 @@
+"""Post-training quantization with activation calibration (reference:
+python/paddle/quantization — PTQ with AbsmaxObserver/HistObserver +
+paddlenlp llm PTQ recipes: A8W8 smooth/static quantization).
+
+TPU-native: calibration is a host-side pass (forward hooks record
+activation statistics over calibration batches — nothing enters the
+jitted graph), then ``convert`` swaps each observed Linear for a
+``W8A8Linear`` whose forward fake-quantizes activations with the FROZEN
+calibrated scale and runs the int8-weight matmul. The resulting model is
+still a pure jnp program: XLA folds the static scales into the
+surrounding ops, and bf16/int8 tensors stream at half/quarter HBM cost.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from .weight_only import QuantizedLinear
+
+__all__ = ["AbsMaxObserver", "PTQ", "W8A8Linear"]
+
+
+class AbsMaxObserver:
+    """Running abs-max over calibration batches (reference:
+    paddle.quantization.observers.AbsmaxObserver). ``ema`` smooths
+    outliers the way the reference's EMA observer does."""
+
+    def __init__(self, ema: float = 0.0):
+        self.ema = ema
+        self.stat: Optional[float] = None
+
+    def update(self, x):
+        cur = float(jnp.max(jnp.abs(x.astype(jnp.float32))))
+        if self.stat is None or self.ema == 0.0:
+            self.stat = cur if self.stat is None else max(self.stat, cur)
+        else:
+            self.stat = self.ema * self.stat + (1 - self.ema) * cur
+
+    def scale(self) -> float:
+        return max(self.stat or 0.0, 1e-8) / 127.0
+
+
+class W8A8Linear(QuantizedLinear):
+    """int8 weights + int8-fake-quantized activations with a frozen
+    calibrated scale (reference: paddlenlp llm A8W8). Subclasses
+    QuantizedLinear, so the TP contracts (qweight/scales partitions,
+    Column/Row activation constraints) and frozen-bias semantics carry
+    over unchanged."""
+
+    def __init__(self, *args, act_scale: float = 1.0, **kw):
+        super().__init__(*args, **kw)
+        self.act_scale = float(act_scale)
+
+    def forward(self, x):
+        # activation fake-quant with the FROZEN calibration scale: the
+        # rounding happens at trace time as pure ops, so serving keeps
+        # one static program
+        s = jnp.asarray(self.act_scale, jnp.float32)
+        xq = (jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127)
+              * s).astype(x.dtype)
+        return super().forward(xq)
+
+    def extra_repr(self):
+        return (f"{super().extra_repr()}, "
+                f"A8 act_scale={self.act_scale:.3g}")
+
+
+class PTQ:
+    """Calibrate-then-convert driver (reference: paddle.quantization.PTQ).
+
+    ptq = PTQ(model)                      # hooks every Linear-family layer
+    for batch in calib_data: model(batch) # observers record abs-max
+    ptq.convert()                         # swap in W8A8Linear, drop hooks
+    """
+
+    def __init__(self, model: Layer, ema: float = 0.0,
+                 skip: Optional[List[str]] = None):
+        from ..nn.common import Linear
+        from ..parallel.layers import ColumnParallelLinear, RowParallelLinear
+        self.model = model
+        self.observers: Dict[str, AbsMaxObserver] = {}
+        self._hooked = []
+        skip = tuple(skip or ())
+        for path, sub in model.named_sublayers():
+            if not isinstance(sub, (Linear, ColumnParallelLinear,
+                                    RowParallelLinear)):
+                continue
+            if path.startswith(skip) or any(s in path for s in skip):
+                continue
+            obs = AbsMaxObserver(ema=ema)
+            self.observers[path] = obs
+            hid = sub.register_forward_pre_hook(
+                lambda layer, args, _obs=obs: _obs.update(args[0]) or None)
+            self._hooked.append((path, sub, hid))
+        if not self.observers:
+            raise ValueError("no Linear-family layers to calibrate")
+
+    def convert(self, bits: int = 8, block_size: int = 128) -> Layer:
+        """Swap calibrated layers for W8A8Linear in place; remove hooks."""
+        uncalibrated = [p for p, o in self.observers.items()
+                        if o.stat is None]
+        if uncalibrated:
+            raise RuntimeError(
+                f"run calibration batches first; no activations seen for "
+                f"{uncalibrated[:4]}")
+        for path, sub, hid in self._hooked:
+            del sub._forward_pre_hooks[hid]
+            parent = self.model
+            parts = path.split(".")
+            for p in parts[:-1]:
+                parent = parent._sub_layers[p]
+            din = sub.weight.shape[0]
+            bs = block_size if din % block_size == 0 else din
+            lay = W8A8Linear.from_linear(sub, bits=bits, block_size=bs)
+            lay.act_scale = self.observers[path].scale()
+            parent._sub_layers[parts[-1]] = lay
+        self._hooked = []
+        return self.model

@@ -1,0 +1,115 @@
+"""paddle.callbacks (EarlyStopping / ModelCheckpoint / LRScheduler) on
+both high-level loops."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.callbacks import EarlyStopping, LRScheduler, ModelCheckpoint
+
+
+def _data(n=64, d=8, classes=4, seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d, classes)
+    x = rs.randn(n, d).astype(np.float32)
+    y = (x @ w).argmax(-1).astype(np.int64)
+    return [(x[i:i + 16], y[i:i + 16]) for i in range(0, n, 16)]
+
+
+def _model():
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 4))
+    m = pt.Model(net)
+    m.prepare(pt.optimizer.AdamW(learning_rate=5e-2),
+              loss=nn.functional.cross_entropy)
+    return m
+
+
+class TestEarlyStopping:
+    def test_stops_when_plateaued(self):
+        es = EarlyStopping(monitor="loss", patience=2, min_delta=1e9)
+        # min_delta huge -> nothing ever counts as improvement
+        model = _model()
+        hist = model.fit(_data(), epochs=20, log_freq=2, verbose=0,
+                         callbacks=es)
+        assert es.stop_training and es.stopped_epoch is not None
+        assert es.stopped_epoch < 19  # did not run all epochs
+        # history only covers the epochs actually run
+        assert len(hist["loss"]) <= (es.stopped_epoch + 1) * 2 + 1
+
+    def test_improvement_resets_patience(self):
+        es = EarlyStopping(monitor="loss", patience=3)
+        model = _model()
+        model.fit(_data(), epochs=6, log_freq=2, verbose=0, callbacks=es)
+        assert not es.stop_training  # loss keeps improving on this problem
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="min|max"):
+            EarlyStopping(mode="best")
+
+
+class TestModelCheckpoint:
+    def test_save_freq(self, tmp_path):
+        mc = ModelCheckpoint(str(tmp_path), save_freq=2)
+        model = _model()
+        model.fit(_data(), epochs=4, verbose=0, callbacks=mc)
+        assert len(mc.saved) == 2
+        assert os.path.exists(mc.saved[0] + ".pdparams.npz")
+
+    def test_monitor_best_only(self, tmp_path):
+        mc = ModelCheckpoint(str(tmp_path), monitor="loss", mode="min")
+        model = _model()
+        model.fit(_data(), epochs=3, verbose=0, callbacks=mc)
+        assert mc.saved and all(p.endswith("best") for p in mc.saved)
+        assert mc.best < float("inf")
+
+
+class TestLRSchedulerCallback:
+    def test_epoch_stepping(self):
+        sched = pt.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                          gamma=0.5)
+        model = _model()
+        model._optimizer = pt.optimizer.AdamW(learning_rate=sched)
+        model.prepare(model._optimizer, loss=nn.functional.cross_entropy)
+        model.fit(_data(), epochs=3, verbose=0,
+                  callbacks=LRScheduler(sched))
+        assert sched.get_lr() == pytest.approx(0.1 * 0.5 ** 3)
+
+
+class TestTrainerIntegration:
+    def test_callbacks_in_trainer(self, tmp_path):
+        """The same callback objects ride the low-level Trainer list."""
+        import jax.numpy as jnp
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+        from paddle_tpu.trainer import Trainer, TrainingArguments
+
+        seen = []
+
+        class Probe(pt.callbacks.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen.append(step)
+
+        pt.seed(0)
+        model = LlamaForCausalLM(llama_tiny())
+        batch = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 16)))
+        tr = Trainer(model, pt.optimizer.AdamW(learning_rate=1e-3),
+                     TrainingArguments(output_dir=str(tmp_path), max_steps=4,
+                                       logging_steps=2,
+                                       resume_from_checkpoint=False),
+                     train_dataloader=[batch], callbacks=[Probe()])
+        tr.train()
+        assert seen == [2, 4]
+
+
+class TestLRSchedulerStepDelta:
+    def test_by_step_counts_every_step(self):
+        """log_freq-sparse hook invocations still step the scheduler once
+        per TRAINING step (the callback steps by the observed delta)."""
+        sched = pt.optimizer.lr.StepDecay(learning_rate=1.0, step_size=1,
+                                          gamma=0.5)
+        cb = LRScheduler(sched, by_epoch=False)
+        cb.on_train_batch_end(4)   # steps 1..4 happened since last call
+        cb.on_train_batch_end(8)
+        assert sched.get_lr() == pytest.approx(1.0 * 0.5 ** 8)

@@ -163,3 +163,56 @@ class TestParallelQuant:
         finally:
             env.init_parallel_env({})
         np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+class TestPTQ:
+    """Activation-calibrated post-training quantization (C17 PTQ half:
+    observers -> convert -> W8A8 forward)."""
+
+    def _mlp(self):
+        import paddle_tpu as pt
+        pt.seed(0)
+        return nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 8))
+
+    def test_calibrate_convert_accuracy(self):
+        from paddle_tpu.quant import PTQ, W8A8Linear
+        model = self._mlp()
+        rs = np.random.RandomState(0)
+        calib = [jnp.asarray(rs.randn(8, 16), jnp.float32) for _ in range(4)]
+        ref = np.asarray(model(calib[0]))
+        ptq = PTQ(model)
+        for b in calib:
+            model(b)
+        assert all(o.stat is not None for o in ptq.observers.values())
+        ptq.convert()
+        kinds = [type(l).__name__ for l in model.sublayers()]
+        assert kinds.count("W8A8Linear") == 2 and "Linear" not in kinds
+        got = np.asarray(model(calib[0]))
+        # int8 weights + int8 activations: a few percent, not garbage
+        rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.08, rel
+
+    def test_convert_without_calibration_raises(self):
+        import pytest
+        from paddle_tpu.quant import PTQ
+        ptq = PTQ(self._mlp())
+        with pytest.raises(RuntimeError, match="calibration"):
+            ptq.convert()
+
+    def test_observer_semantics(self):
+        import pytest
+        from paddle_tpu.quant import AbsMaxObserver
+        o = AbsMaxObserver()
+        o.update(jnp.asarray([1.0, -3.0]))
+        o.update(jnp.asarray([2.0]))
+        assert o.stat == 3.0 and o.scale() == pytest.approx(3.0 / 127)
+        e = AbsMaxObserver(ema=0.9)
+        e.update(jnp.asarray([10.0]))
+        e.update(jnp.asarray([0.0]))
+        assert e.stat == pytest.approx(9.0)
+
+    def test_skip_patterns(self):
+        from paddle_tpu.quant import PTQ
+        model = self._mlp()
+        ptq = PTQ(model, skip=["2"])  # skip the second Linear ("2")
+        assert len(ptq.observers) == 1

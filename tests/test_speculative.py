@@ -75,3 +75,18 @@ class TestSpeculative:
         got = speculative_generate(target, draft, ids, max_new_tokens=16,
                                    num_draft_tokens=k)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_executable_cached_across_calls():
+    """Same (target, draft, shapes): the second call reuses the compiled
+    run instead of retracing (serving latency)."""
+    from paddle_tpu.generation.speculative import _SPEC_CACHE
+    target, draft = _models()
+    ids = _prompt(seed=5)
+    out1 = speculative_generate(target, draft, ids, max_new_tokens=8,
+                                num_draft_tokens=2)
+    assert len(_SPEC_CACHE[target][draft]) == 1
+    out2 = speculative_generate(target, draft, ids, max_new_tokens=8,
+                                num_draft_tokens=2)
+    assert len(_SPEC_CACHE[target][draft]) == 1  # no new entry
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
