@@ -313,6 +313,10 @@ def config_from_hf(model_dir: str):
             rope_theta=hf.get("rope_theta", 10000.0),
             tie_word_embeddings=hf.get("tie_word_embeddings", False),
             attention_bias=hf.get("attention_bias", mt == "qwen2"),
+            sliding_window=(hf.get("sliding_window")
+                            if hf.get("use_sliding_window") else None),
+            max_window_layers=(hf.get("max_window_layers")
+                               if hf.get("use_sliding_window") else None),
             dtype=_jax_dtype(hf),
         )
         return cls, cfg, mt

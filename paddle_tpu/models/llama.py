@@ -57,6 +57,12 @@ class LlamaConfig:
     # the usual MFU winner when memory allows.
     recompute_policy: str = "full"
     use_flash_attention: bool = True
+    # sliding-window attention (Qwen2/Mistral): each query attends only
+    # the trailing `sliding_window` keys; None = full causal. HF-Qwen2
+    # gating: only layers with index >= max_window_layers slide (None =
+    # every layer slides)
+    sliding_window: "Optional[int]" = None
+    max_window_layers: "Optional[int]" = None
     sequence_parallel: bool = False  # ring attention over the sp axis
     dtype: Any = jnp.bfloat16
 
@@ -105,9 +111,14 @@ def apply_rotary(x, cos, sin):
 
 # -------------------------------------------------------------- components
 class LlamaAttention(Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, layer_idx: int = 0):
         super().__init__()
         self.config = config
+        mwl = getattr(config, "max_window_layers", None)
+        # HF-Qwen2 semantics: the window applies from max_window_layers on
+        self.window = (config.sliding_window
+                       if getattr(config, "sliding_window", None) is not None
+                       and (mwl is None or layer_idx >= mwl) else None)
         h, kv = config.num_attention_heads, config.num_key_value_heads
         d = config.head_dim
         qkv_bias = config.attention_bias
@@ -152,7 +163,8 @@ class LlamaAttention(Layer):
             if s == 1 and attn_start is None:
                 # single-token decode: Pallas masked-MHA kernel (GQA-
                 # native, no KV repeat) / grouped-einsum fallback
-                out = decode_attention(q, ck, cv, cache_index)
+                out = decode_attention(q, ck, cv, cache_index,
+                                       window=self.window)
             else:
                 # prefill-with-cache (and left-padded serving batches):
                 # mask positions beyond cache_index+s; with attn_start,
@@ -161,6 +173,9 @@ class LlamaAttention(Layer):
                 kpos = jnp.arange(total)[None, :]           # [1, T]
                 qpos = cache_index + jnp.arange(s)[:, None]  # [s, 1]
                 mask = (kpos <= qpos)[None, None]           # [1, 1, s, T]
+                if self.window is not None:
+                    mask = mask & \
+                        (qpos - kpos < self.window)[None, None]
                 if attn_start is not None:
                     pad_ok = kpos[None] >= attn_start[:, None, None]
                     # pad-prefix queries keep their own position: an
@@ -171,9 +186,11 @@ class LlamaAttention(Layer):
                     mask = mask & (pad_ok | self_ok)[:, None]  # [b,1,s,T]
                 out = dense_attention(q, ck, cv, attn_mask=mask)
         elif cfg.sequence_parallel and attn_mask is None and \
-                segment_ids is None and self._sp_degree() > 1:
-            # (segment_ids falls through to the segment-aware paths below:
-            # the ring KV rotation has no segment masking)
+                segment_ids is None and self.window is None and \
+                self._sp_degree() > 1:
+            # (segment_ids and sliding windows fall through to the
+            # segment/window-aware paths below: the ring KV rotation has
+            # neither masking)
             # ring attention: seq stays sp-sharded; KV blocks rotate on ICI
             import functools
             from jax.sharding import PartitionSpec as P
@@ -186,13 +203,22 @@ class LlamaAttention(Layer):
                 check_vma=False)(q, k, v)
         elif cfg.use_flash_attention and attn_mask is None and use_flash(q, k, None, 0.0):
             # segment_ids ride the flash kernel (packed sequences): the
-            # same-segment mask applies inside the online softmax
+            # same-segment mask applies inside the online softmax; a
+            # sliding window narrows the causal band in-kernel
             out = flash_attention(q, k, v, causal=True,
-                                  segment_ids=segment_ids)
+                                  segment_ids=segment_ids,
+                                  window=self.window)
         elif segment_ids is not None and attn_mask is None:
             from ..ops.attention import segment_mask
             out = dense_attention(q, k, v, causal=True,
-                                  attn_mask=segment_mask(segment_ids))
+                                  attn_mask=segment_mask(segment_ids),
+                                  window=self.window)
+        elif self.window is not None:
+            # an explicit mask COMBINES with the window band (HF
+            # intersects them); causal-decoder masks are within causal
+            # context, so forcing causal=True only narrows
+            out = dense_attention(q, k, v, causal=True,
+                                  attn_mask=attn_mask, window=self.window)
         else:
             out = dense_attention(q, k, v, causal=attn_mask is None,
                                   attn_mask=attn_mask)
@@ -219,11 +245,11 @@ class LlamaMLP(Layer):
 
 
 class LlamaDecoderLayer(Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, layer_idx: int = 0):
         super().__init__()
         self.config = config
         self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
-        self.self_attn = LlamaAttention(config)
+        self.self_attn = LlamaAttention(config, layer_idx=layer_idx)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
                                                    config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
@@ -252,7 +278,8 @@ class LlamaModel(Layer):
         self.embed_tokens.weight = self.embed_tokens.weight.astype(config.dtype) \
             * jnp.asarray(config.initializer_range / 0.02, config.dtype)
         self.layers = nn.LayerList(
-            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+            [LlamaDecoderLayer(config, layer_idx=i)
+             for i in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         if config.dtype != jnp.float32:
             # compute-weight dtype (fp32 masters live in the optimizer)

@@ -76,7 +76,7 @@ class Qwen2MoeDecoderLayer(Layer):
         self.config = config
         self.input_layernorm = nn.RMSNorm(config.hidden_size,
                                           config.rms_norm_eps)
-        self.self_attn = LlamaAttention(config)
+        self.self_attn = LlamaAttention(config, layer_idx=layer_idx)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
                                                    config.rms_norm_eps)
         self.is_dense = layer_idx < config.first_k_dense_replace

@@ -40,7 +40,7 @@ def use_flash(query, key, attn_mask, dropout_p) -> bool:
 
 
 def flash_attention(query, key, value, causal=False, scale=None,
-                    segment_ids=None):
+                    segment_ids=None, window=None):
     """[b, s, h, d] flash attention; grouped-query aware. The Pallas kernel
     is TPU-only; on other backends (CPU mesh tests, dryruns) this routes to
     the numerically-identical dense XLA path. ``segment_ids`` [b, s]
@@ -49,11 +49,13 @@ def flash_attention(query, key, value, causal=False, scale=None,
     from .pallas import tpu_backend
     if not tpu_backend():
         return dense_attention(query, key, value, causal=causal, scale=scale,
+                               window=window,
                                attn_mask=segment_mask(segment_ids)
                                if segment_ids is not None else None)
     from .pallas.flash_attention import flash_attention_bshd
     return flash_attention_bshd(query, key, value, causal=causal,
-                                scale=scale, segment_ids=segment_ids)
+                                scale=scale, segment_ids=segment_ids,
+                                window=window)
 
 
 def segment_mask(segment_ids):
@@ -65,10 +67,13 @@ def segment_mask(segment_ids):
 
 
 def dense_attention(query, key, value, attn_mask=None, dropout_p=0.0,
-                    causal=False, scale=None, dropout_key=None):
+                    causal=False, scale=None, dropout_key=None,
+                    window=None):
     """XLA-fused dense path, [b, s, h, d]; fp32 softmax; GQA-aware.
     Single source of truth for the non-flash math (nn.functional's
-    scaled_dot_product_attention fallback routes here)."""
+    scaled_dot_product_attention fallback routes here). ``window``
+    (with causal) keeps only the trailing ``window`` keys per query —
+    sliding-window attention (Qwen2/Mistral)."""
     b, sq, h, d = query.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     q = jnp.swapaxes(query, 1, 2)
@@ -82,7 +87,14 @@ def dense_attention(query, key, value, attn_mask=None, dropout_p=0.0,
     if causal:
         sk = k.shape[2]
         mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        if window is not None:
+            # bottom-right aligned: query i sits at absolute sk - sq + i
+            qpos = jnp.arange(sq)[:, None] + (sk - sq)
+            mask = mask & (qpos - jnp.arange(sk)[None, :] < window)
         scores = jnp.where(mask, scores, -jnp.inf)
+    elif window is not None:
+        raise ValueError("window requires causal=True (sliding-window "
+                         "attention is causal)")
     if attn_mask is not None:
         if attn_mask.dtype == jnp.bool_:
             scores = jnp.where(attn_mask, scores, -jnp.inf)
@@ -121,7 +133,8 @@ def use_decode_kernel(q, k_cache) -> bool:
         d % 128 == 0 or kv == 1 or interpret_enabled())
 
 
-def decode_attention(q, k_cache, v_cache, cache_index, scale=None):
+def decode_attention(q, k_cache, v_cache, cache_index, scale=None,
+                     window=None):
     """Single-token decode over a static KV cache (reference: PHI
     fusion/gpu/masked_multihead_attention). q [b, 1, h, d];
     k/v_cache [b, T, kv, d]; positions <= cache_index attend.
@@ -137,7 +150,7 @@ def decode_attention(q, k_cache, v_cache, cache_index, scale=None):
     if use_decode_kernel(q, k_cache):
         from .pallas.decode_attention import decode_attention_pallas
         out = decode_attention_pallas(q[:, 0], k_cache, v_cache,
-                                      cache_index, scale)
+                                      cache_index, scale, window=window)
         return out[:, None]
 
     # grouped einsum fallback (CPU mesh tests / odd shapes): same layout,
@@ -146,7 +159,10 @@ def decode_attention(q, k_cache, v_cache, cache_index, scale=None):
     qg = q[:, 0].reshape(b, kv, g, d)
     scores = jnp.einsum("bkgd,btkd->bkgt", qg, k_cache,
                         preferred_element_type=jnp.float32) * scale
-    mask = jnp.arange(T)[None, None, None, :] <= cache_index
+    kpos = jnp.arange(T)[None, None, None, :]
+    mask = kpos <= cache_index
+    if window is not None:  # sliding window: only the trailing keys
+        mask = mask & (kpos > cache_index - window)
     scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bkgt,btkd->bkgd", probs, v_cache)

@@ -45,7 +45,7 @@ def pick_block_t(total: int, preferred: int = DEFAULT_BLOCK_T) -> int:
 
 
 def _decode_kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,
-                   scale, block_t, nt, gp):
+                   scale, block_t, nt, gp, window=None):
     ti = pl.program_id(2)
 
     @pl.when(ti == 0)
@@ -55,8 +55,11 @@ def _decode_kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
 
     valid = idx_ref[0] + 1  # positions [0, cache_index] are attendable
+    run = ti * block_t < valid
+    if window is not None:  # skip blocks fully before the window band
+        run &= (ti + 1) * block_t > valid - window
 
-    @pl.when(ti * block_t < valid)
+    @pl.when(run)
     def _compute():
         q = q_ref[0, 0, :, :]                       # [gp, d]
         k = k_ref[0, :, :]                          # [bt, d]
@@ -65,7 +68,10 @@ def _decode_kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,
                             preferred_element_type=jnp.float32) * scale
         k_ids = lax.broadcasted_iota(jnp.int32, (gp, block_t), 1) \
             + ti * block_t
-        s = jnp.where(k_ids < valid, s, NEG_INF)
+        keep = k_ids < valid
+        if window is not None:  # only the trailing `window` cache slots
+            keep &= k_ids >= valid - window
+        s = jnp.where(keep, s, NEG_INF)
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -84,10 +90,11 @@ def _decode_kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,
 
 
 def decode_attention_pallas(q, k_cache, v_cache, cache_index, scale,
-                            block_t: int = DEFAULT_BLOCK_T):
+                            block_t: int = DEFAULT_BLOCK_T, window=None):
     """q [b, h, d]; k/v_cache [b, T, kv, d]; cache_index: scalar int (the
     write position of the current token; positions <= it are valid).
-    Returns [b, h, d]."""
+    ``window`` keeps only the trailing window cache slots (sliding-window
+    decode). Returns [b, h, d]."""
     b, h, d = q.shape
     _, T, kv, _ = k_cache.shape
     group = h // kv
@@ -102,7 +109,7 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_index, scale,
 
     idx = jnp.asarray(cache_index, jnp.int32).reshape(1)
     kernel = functools.partial(_decode_kernel, scale=scale, block_t=bt,
-                               nt=nt, gp=gp)
+                               nt=nt, gp=gp, window=window)
     # Mosaic requires the last TWO block dims be (8,128)-tiled (or match the
     # array), so a [b, T, kv, d] cache cannot take a kv-dim block of 1.
     # View it as [b, T, kv*d] instead — contiguous, so the reshape is free —

@@ -56,7 +56,7 @@ def pick_block(seq: int, preferred: int) -> int:
 
 
 def _scores(q, k, qi, ki, *, scale, causal, block_q, block_k,
-            causal_offset, qs=None, ks=None):
+            causal_offset, qs=None, ks=None, window=None):
     """q@k^T with the shared bottom-right causal mask — the ONE definition
     of the masking convention, inlined into fwd and both bwd kernels.
     qs [block_q, 128] / ks [1, block_k] (lane/sublane-broadcast segment-id
@@ -69,7 +69,10 @@ def _scores(q, k, qi, ki, *, scale, causal, block_q, block_k,
             + qi * block_q
         k_ids = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) \
             + ki * block_k
-        s = jnp.where(q_ids + causal_offset >= k_ids, s, NEG_INF)
+        keep = q_ids + causal_offset >= k_ids
+        if window is not None:  # sliding window: trailing `window` keys
+            keep &= (q_ids + causal_offset) - k_ids < window
+        s = jnp.where(keep, s, NEG_INF)
     if qs is not None:
         qs_full = jnp.tile(qs, (1, block_k // 128))   # [block_q, block_k]
         s = jnp.where(qs_full == ks, s, NEG_INF)
@@ -78,7 +81,7 @@ def _scores(q, k, qi, ki, *, scale, causal, block_q, block_k,
 
 # ----------------------------------------------------------------- forward
 def _fwd_kernel(*refs, scale, causal, block_q, block_k, kv_blocks,
-                causal_offset, has_seg):
+                causal_offset, has_seg, window=None):
     """causal_offset = sk - sq: bottom-right-aligned causal mask (matches
     the naive path and the backward), so query i attends keys <= i+offset."""
     if has_seg:
@@ -100,12 +103,15 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, kv_blocks,
     if causal:
         # block [qi] attends kv blocks whose start <= last query's diag pos
         run = ki * block_k <= (qi + 1) * block_q - 1 + causal_offset
+        if window is not None:  # ...and whose end reaches the window band
+            run &= (ki + 1) * block_k - 1 >= \
+                qi * block_q + causal_offset - (window - 1)
 
     @pl.when(run)
     def _compute():
         s = _scores(q_ref[0, :, :], k_ref[0, :, :], qi, ki, scale=scale,
                     causal=causal, block_q=block_q, block_k=block_k,
-                    causal_offset=causal_offset,
+                    causal_offset=causal_offset, window=window,
                     qs=qs_ref[0] if has_seg else None,
                     ks=ks_ref[0, :1, :] if has_seg else None)
         m_prev = m_scr[:, :1]
@@ -141,7 +147,7 @@ def _seg_operands(segment_ids, heads):
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-               segment_ids=None, heads=1):
+               segment_ids=None, heads=1, window=None):
     """q: [bh, sq, d]; k/v: [bh_kv, sk, d] with bh % bh_kv == 0."""
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
@@ -153,7 +159,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, kv_blocks=kv_blocks, causal_offset=sk - sq,
-        has_seg=has_seg)
+        has_seg=has_seg, window=window)
 
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
@@ -200,7 +206,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k,
 
 # ---------------------------------------------------------------- backward
 def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, kv_blocks,
-                   causal_offset, has_seg):
+                   causal_offset, has_seg, window=None):
     if has_seg:
         (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qs_ref, ks_ref,
          dq_ref, acc) = refs
@@ -218,13 +224,16 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, kv_blocks,
     run = True
     if causal:
         run = ki * block_k <= (qi + 1) * block_q - 1 + causal_offset
+        if window is not None:
+            run &= (ki + 1) * block_k - 1 >= \
+                qi * block_q + causal_offset - (window - 1)
 
     @pl.when(run)
     def _compute():
         k = k_ref[0, :, :]
         s = _scores(q_ref[0, :, :], k, qi, ki, scale=scale, causal=causal,
                     block_q=block_q, block_k=block_k,
-                    causal_offset=causal_offset,
+                    causal_offset=causal_offset, window=window,
                     qs=qs_ref[0] if has_seg else None,
                     ks=ks_ref[0, :1, :] if has_seg else None)
         p = jnp.exp(s - lse_ref[0, :, :1])            # exact probs via lse
@@ -242,7 +251,7 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, kv_blocks,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, group,
-                    q_blocks, causal_offset, has_seg):
+                    q_blocks, causal_offset, has_seg, window=None):
     if has_seg:
         (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qs_ref, ks_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
@@ -262,13 +271,16 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, group,
     run = True
     if causal:
         run = kj * block_k <= (qi + 1) * block_q - 1 + causal_offset
+        if window is not None:
+            run &= (kj + 1) * block_k - 1 >= \
+                qi * block_q + causal_offset - (window - 1)
 
     @pl.when(run)
     def _compute():
         q = q_ref[0, :, :]
         s = _scores(q, k_ref[0, :, :], qi, kj, scale=scale, causal=causal,
                     block_q=block_q, block_k=block_k,
-                    causal_offset=causal_offset,
+                    causal_offset=causal_offset, window=window,
                     qs=qs_ref[0] if has_seg else None,
                     ks=ks_ref[0, :1, :] if has_seg else None)
         p = jnp.exp(s - lse_ref[0, :, :1])
@@ -293,7 +305,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, group,
 
 
 def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
-               segment_ids=None, heads=1):
+               segment_ids=None, heads=1, window=None):
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
     group = bh // bh_kv
@@ -364,7 +376,7 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
                           kv_blocks=kv_blocks, causal_offset=offset,
-                          has_seg=has_seg),
+                          has_seg=has_seg, window=window),
         grid=(bh, q_blocks, kv_blocks),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
@@ -378,7 +390,7 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, group=group,
                           q_blocks=q_blocks, causal_offset=offset,
-                          has_seg=has_seg),
+                          has_seg=has_seg, window=window),
         grid=(bh_kv, kv_blocks, group, q_blocks),
         in_specs=dkv_in_specs,
         out_specs=[
@@ -400,44 +412,50 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, window=None):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        window=window)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, window=None):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          window=window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
+def _flash_vjp_bwd(scale, causal, block_q, block_k, window, res, g):
     q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k)
+    return _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
+                      window=window)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 # -------------------------------------------------- flash with segment ids
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_seg(q, k, v, seg, scale, causal, block_q, block_k, heads):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_seg(q, k, v, seg, scale, causal, block_q, block_k, heads,
+               window=None):
     out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-                        segment_ids=seg, heads=heads)
+                        segment_ids=seg, heads=heads, window=window)
     return out
 
 
 def _flash_seg_vjp_fwd(q, k, v, seg, scale, causal, block_q, block_k,
-                       heads):
+                       heads, window=None):
     out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-                          segment_ids=seg, heads=heads)
+                          segment_ids=seg, heads=heads, window=window)
     return out, (q, k, v, seg, out, lse)
 
 
-def _flash_seg_vjp_bwd(scale, causal, block_q, block_k, heads, res, g):
+def _flash_seg_vjp_bwd(scale, causal, block_q, block_k, heads, window,
+                       res, g):
     q, k, v, seg, out, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, scale, causal,
-                            block_q, block_k, segment_ids=seg, heads=heads)
+                            block_q, block_k, segment_ids=seg, heads=heads,
+                            window=window)
     return dq, dk, dv, None  # int segment ids carry no cotangent
 
 
@@ -446,10 +464,14 @@ _flash_seg.defvjp(_flash_seg_vjp_fwd, _flash_seg_vjp_bwd)
 
 def flash_attention_bshd(query, key, value, causal=False, scale=None,
                          block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                         segment_ids=None):
+                         segment_ids=None, window=None):
     """Flash attention on [batch, seq, heads, head_dim] (paddle layout).
     ``segment_ids`` [b, s] (0 = pad) restricts attention to same-segment
-    pairs — packed-sequence training on the flash path."""
+    pairs — packed-sequence training on the flash path. ``window`` (with
+    causal) is sliding-window attention: only the trailing ``window``
+    keys per query."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     b, sq, h, d = query.shape
     _, sk, hk, _ = key.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -460,9 +482,9 @@ def flash_attention_bshd(query, key, value, causal=False, scale=None,
     v = jnp.swapaxes(value, 1, 2).reshape(b * hk, sk, d)
     if segment_ids is not None:
         out = _flash_seg(q, k, v, jnp.asarray(segment_ids, jnp.int32),
-                         scale, causal, block_q, block_k, h)
+                         scale, causal, block_q, block_k, h, window)
     else:
-        out = _flash(q, k, v, scale, causal, block_q, block_k)
+        out = _flash(q, k, v, scale, causal, block_q, block_k, window)
     return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
 
 
