@@ -10,6 +10,8 @@ from .ernie import (Ernie45MoeConfig, Ernie45MoeForCausalLM, ErnieConfig,
                     ErnieModel, ernie45_moe_tiny, ernie_tiny)
 from .qwen2 import (Qwen2Config, Qwen2ForCausalLM, Qwen2Model, qwen2_7b,
                     qwen2_tiny)
+from .deepseek_v2 import (DeepseekV2Config, DeepseekV2ForCausalLM,
+                          DeepseekV2Model, deepseek_v2_tiny)
 from .qwen2_moe import (DeepseekMoeConfig, DeepseekMoeForCausalLM,
                         Qwen2MoeConfig, Qwen2MoeForCausalLM, Qwen2MoeModel,
                         deepseek_moe_tiny, moe_lm_loss, qwen2_moe_tiny)

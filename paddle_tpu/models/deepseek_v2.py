@@ -1,0 +1,324 @@
+"""DeepSeek-V2/V3 family with Multi-head Latent Attention (reference:
+PaddleNLP paddlenlp/transformers/deepseek_v2/modeling.py —
+DeepseekV2Attention's q/kv low-rank compression, decoupled RoPE keys, and
+the fine-grained MoE with shared experts).
+
+MLA, TPU-native:
+- TRAIN/PREFILL: expand the compressed latents to per-head K/V and run
+  the ordinary fused attention (the MXU wants the big matmuls anyway).
+- DECODE: the ABSORBED form — fold ``W_uk`` into the query so attention
+  runs directly against the cached latent: scores = (q_nope W_uk) · c_kv
+  + q_pe · k_pe, out = (probs · c_kv) W_uv. The KV cache per token is
+  ``kv_lora_rank + qk_rope_head_dim`` floats instead of
+  ``2 * heads * head_dim`` — the ~10-50x cache compression that lets one
+  chip hold long contexts, and the whole point of MLA.
+- RoPE uses DeepSeek's INTERLEAVED (complex-pair) convention, applied
+  only to the decoupled q_pe / single-head k_pe dims.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn.layer import Layer
+from ..parallel.layers import (ColumnParallelLinear, RowParallelLinear,
+                               VocabParallelEmbedding)
+from ..parallel.moe import MoEMLP
+from ..parallel.sharding import constraint
+from .base import CausalLMBase
+from .llama import LlamaConfig, LlamaMLP, causal_lm_loss  # noqa: F401
+
+
+@dataclass
+class DeepseekV2Config(LlamaConfig):
+    vocab_size: int = 102400
+    hidden_size: int = 2048
+    intermediate_size: int = 10944         # dense layers' FFN width
+    # ---- MLA
+    q_lora_rank: Optional[int] = None      # None = full q proj (V2-Lite)
+    kv_lora_rank: int = 512
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+    # ---- MoE (DeepSeek fine-grained + shared)
+    num_experts: int = 64                  # n_routed_experts
+    num_experts_per_tok: int = 6
+    moe_intermediate_size: int = 1408
+    num_shared_experts: int = 2            # n_shared_experts
+    first_k_dense_replace: int = 1
+    routed_scaling_factor: float = 1.0
+    norm_topk_prob: bool = False           # normalize selected gates to 1
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.001
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    attention_bias: bool = False
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+
+def deepseek_v2_tiny(**overrides) -> DeepseekV2Config:
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                q_lora_rank=32, kv_lora_rank=32, qk_nope_head_dim=16,
+                qk_rope_head_dim=8, v_head_dim=16,
+                num_experts=4, num_experts_per_tok=2,
+                moe_intermediate_size=32, num_shared_experts=1,
+                first_k_dense_replace=1, max_position_embeddings=128,
+                dtype=jnp.float32)
+    base.update(overrides)
+    return DeepseekV2Config(**base)
+
+
+def rope_interleaved(x, positions, theta: float):
+    """DeepSeek's complex-pair RoPE: pairs are (x[2i], x[2i+1]) and
+    freqs index i — torch's view_as_complex convention, NOT rotate-half.
+    x [b, s, h, d]; positions [b, s]."""
+    d = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    ang = positions.astype(jnp.float32)[..., None] * inv    # [b, s, d/2]
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., ::2].astype(jnp.float32), x[..., 1::2].astype(jnp.float32)
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+class MLAttention(Layer):
+    """Multi-head Latent Attention (reference: DeepseekV2Attention)."""
+
+    def __init__(self, config: DeepseekV2Config):
+        super().__init__()
+        self.config = config
+        cfg = config
+        h = cfg.num_attention_heads
+        if cfg.q_lora_rank is None:
+            self.q_proj = ColumnParallelLinear(
+                cfg.hidden_size, h * cfg.qk_head_dim,
+                has_bias=cfg.attention_bias, gather_output=False)
+        else:
+            self.q_a_proj = nn.Linear(cfg.hidden_size, cfg.q_lora_rank,
+                                      bias_attr=cfg.attention_bias or False)
+            self.q_a_layernorm = nn.RMSNorm(cfg.q_lora_rank,
+                                            cfg.rms_norm_eps)
+            self.q_b_proj = ColumnParallelLinear(
+                cfg.q_lora_rank, h * cfg.qk_head_dim, has_bias=False,
+                gather_output=False)
+        # [h, kv_lora_rank + rope_dim]: latent + the single decoupled key
+        self.kv_a_proj_with_mqa = nn.Linear(
+            cfg.hidden_size, cfg.kv_lora_rank + cfg.qk_rope_head_dim,
+            bias_attr=cfg.attention_bias or False)
+        self.kv_a_layernorm = nn.RMSNorm(cfg.kv_lora_rank, cfg.rms_norm_eps)
+        self.kv_b_proj = ColumnParallelLinear(
+            cfg.kv_lora_rank,
+            h * (cfg.qk_nope_head_dim + cfg.v_head_dim),
+            has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(h * cfg.v_head_dim, cfg.hidden_size,
+                                        has_bias=cfg.attention_bias,
+                                        input_is_parallel=True)
+        self.scale = cfg.qk_head_dim ** -0.5
+
+    def _queries(self, x, positions):
+        cfg = self.config
+        b, s, _ = x.shape
+        h = cfg.num_attention_heads
+        if cfg.q_lora_rank is None:
+            q = self.q_proj(x)
+        else:
+            q = self.q_b_proj(self.q_a_layernorm(self.q_a_proj(x)))
+        q = q.reshape(b, s, h, cfg.qk_head_dim)
+        q_nope = q[..., :cfg.qk_nope_head_dim]
+        q_pe = rope_interleaved(q[..., cfg.qk_nope_head_dim:], positions,
+                                cfg.rope_theta)
+        return q_nope, q_pe
+
+    def _latents(self, x, positions):
+        """x -> (c_kv normed [b, s, r], k_pe roped [b, s, rope_d])."""
+        cfg = self.config
+        ckv = self.kv_a_proj_with_mqa(x)
+        c, k_pe = (ckv[..., :cfg.kv_lora_rank],
+                   ckv[..., cfg.kv_lora_rank:])
+        c = self.kv_a_layernorm(c)
+        k_pe = rope_interleaved(k_pe[:, :, None, :], positions,
+                                cfg.rope_theta)[:, :, 0]
+        return c, k_pe
+
+    def _expand(self, c):
+        """latent [b, s, r] -> (k_nope [b, s, h, nope], v [b, s, h, v])."""
+        cfg = self.config
+        h = cfg.num_attention_heads
+        kv = self.kv_b_proj(c).reshape(
+            c.shape[0], c.shape[1], h, cfg.qk_nope_head_dim + cfg.v_head_dim)
+        return kv[..., :cfg.qk_nope_head_dim], kv[..., cfg.qk_nope_head_dim:]
+
+    def forward(self, x, positions, kv_cache=None, cache_index=None,
+                attn_mask=None, attn_start=None):
+        cfg = self.config
+        b, s, _ = x.shape
+        h = cfg.num_attention_heads
+        q_nope, q_pe = self._queries(x, positions)
+        c, k_pe = self._latents(x, positions)
+
+        if kv_cache is not None:
+            cc, cpe = kv_cache  # [b, T, r], [b, T, rope_d]
+            cc = jax.lax.dynamic_update_slice(cc, c.astype(cc.dtype),
+                                              (0, cache_index, 0))
+            cpe = jax.lax.dynamic_update_slice(cpe, k_pe.astype(cpe.dtype),
+                                               (0, cache_index, 0))
+            new_cache = (cc, cpe)
+            T = cc.shape[1]
+            wkv = self.kv_b_proj.weight.reshape(
+                cfg.kv_lora_rank, h, cfg.qk_nope_head_dim + cfg.v_head_dim)
+            w_uk = wkv[..., :cfg.qk_nope_head_dim]   # [r, h, nope]
+            w_uv = wkv[..., cfg.qk_nope_head_dim:]   # [r, h, v]
+            # ABSORBED decode: queries project into latent space once,
+            # attention runs over the compressed cache directly
+            q_lat = jnp.einsum("bshn,rhn->bshr", q_nope, w_uk)
+            scores = (jnp.einsum("bshr,btr->bhst", q_lat, cc)
+                      + jnp.einsum("bshd,btd->bhst", q_pe, cpe)
+                      ).astype(jnp.float32) * self.scale
+            kpos = jnp.arange(T)[None, None, None, :]
+            qpos = cache_index + jnp.arange(s)[None, None, :, None]
+            keep = kpos <= qpos
+            if attn_start is not None:
+                # left-padded serving rows: mask each row's pad prefix
+                # out of the cache; pad-prefix queries keep themselves so
+                # no softmax row is fully masked (cf. llama.py)
+                pad_ok = kpos >= attn_start[:, None, None, None]
+                self_ok = kpos == qpos
+                keep = keep & (pad_ok | self_ok)
+            scores = jnp.where(keep, scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            o_lat = jnp.einsum("bhst,btr->bshr", probs, cc)
+            out = jnp.einsum("bshr,rhv->bshv", o_lat, w_uv)
+        else:
+            new_cache = None
+            k_nope, v = self._expand(c)
+            k = jnp.concatenate(
+                [k_nope, jnp.broadcast_to(k_pe[:, :, None, :],
+                                          (b, s, h, cfg.qk_rope_head_dim))],
+                axis=-1)
+            q = jnp.concatenate([q_nope, q_pe], axis=-1)
+            from ..ops.attention import dense_attention
+            out = dense_attention(q, k, v, causal=attn_mask is None,
+                                  attn_mask=attn_mask, scale=self.scale)
+        out = self.o_proj(out.reshape(b, s, h * cfg.v_head_dim))
+        return (out, new_cache) if kv_cache is not None else out
+
+
+class DeepseekV2DecoderLayer(Layer):
+    def __init__(self, config: DeepseekV2Config, layer_idx: int):
+        super().__init__()
+        self.config = config
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          config.rms_norm_eps)
+        self.self_attn = MLAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   config.rms_norm_eps)
+        self.is_dense = layer_idx < config.first_k_dense_replace
+        if self.is_dense:
+            self.mlp = LlamaMLP(config)
+        else:
+            self.mlp = MoEMLP(
+                config.hidden_size, config.moe_intermediate_size,
+                num_experts=config.num_experts,
+                top_k=config.num_experts_per_tok,
+                capacity_factor=config.capacity_factor,
+                num_shared_experts=config.num_shared_experts,
+                shared_intermediate_size=(config.moe_intermediate_size
+                                          * config.num_shared_experts),
+                aux_loss_weight=config.aux_loss_weight,
+                routed_scaling_factor=config.routed_scaling_factor,
+                norm_topk_prob=config.norm_topk_prob)
+
+    def forward(self, x, positions, kv_cache=None, cache_index=None,
+                attn_mask=None, attn_start=None):
+        attn = self.self_attn(self.input_layernorm(x), positions,
+                              kv_cache=kv_cache, cache_index=cache_index,
+                              attn_mask=attn_mask, attn_start=attn_start)
+        new_cache = None
+        if kv_cache is not None:
+            attn, new_cache = attn
+        x = x + attn
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        x = constraint(x, ("dp", "fsdp"), "sp", None)
+        return (x, new_cache) if kv_cache is not None else x
+
+
+class DeepseekV2Model(Layer):
+    def __init__(self, config: DeepseekV2Config):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        self.layers = nn.LayerList(
+            [DeepseekV2DecoderLayer(config, i)
+             for i in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        if config.dtype != jnp.float32:
+            self.to(dtype=config.dtype)
+
+    def forward(self, input_ids, positions=None, kv_caches=None,
+                cache_index=None, attn_mask=None, attn_start=None):
+        b, s = input_ids.shape
+        if positions is None:
+            start = cache_index if cache_index is not None else 0
+            positions = start + jnp.arange(s)[None, :].repeat(b, axis=0)
+            if attn_start is not None:
+                # RoPE position 0 sits at each row's first REAL token
+                positions = jnp.maximum(positions - attn_start[:, None], 0)
+        x = self.embed_tokens(input_ids)
+        x = constraint(x, ("dp", "fsdp"), "sp", None)
+        new_caches = [] if kv_caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if kv_caches is not None:
+                x, nc = layer(x, positions, kv_cache=kv_caches[i],
+                              cache_index=cache_index, attn_mask=attn_mask,
+                              attn_start=attn_start)
+                new_caches.append(nc)
+            else:
+                x = layer(x, positions, attn_mask=attn_mask)
+        x = self.norm(x)
+        return (x, new_caches) if kv_caches is not None else x
+
+
+class DeepseekV2ForCausalLM(CausalLMBase):
+    def __init__(self, config: Optional[DeepseekV2Config] = None):
+        super().__init__()
+        config = config or DeepseekV2Config()
+        self.config = config
+        self.model = DeepseekV2Model(config)
+        self.lm_head = ColumnParallelLinear(config.hidden_size,
+                                            config.vocab_size,
+                                            has_bias=False,
+                                            gather_output=True)
+        if config.dtype != jnp.float32:
+            self.lm_head.to(dtype=config.dtype)
+
+    def init_kv_caches(self, batch_size: int, max_len: int, dtype=None):
+        """MLA cache: (latent [b, T, kv_lora_rank], k_pe [b, T, rope_d])
+        per layer — kv_lora_rank + rope_d floats per token instead of
+        2 * heads * head_dim."""
+        cfg = self.config
+        dtype = dtype or cfg.dtype
+        return [(jnp.zeros((batch_size, max_len, cfg.kv_lora_rank), dtype),
+                 jnp.zeros((batch_size, max_len, cfg.qk_rope_head_dim),
+                           dtype))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def forward(self, input_ids, positions=None, kv_caches=None,
+                cache_index=None, attn_mask=None, attn_start=None):
+        out = self.model(input_ids, positions, kv_caches, cache_index,
+                         attn_mask, attn_start=attn_start)
+        caches = None
+        if kv_caches is not None:
+            out, caches = out
+        logits = self.lm_head(out).astype(jnp.float32)
+        return (logits, caches) if kv_caches is not None else logits

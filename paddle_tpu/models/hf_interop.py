@@ -158,6 +158,26 @@ def _convert_qwen2_moe(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
     return out
 
 
+_DSV2_LINEAR = re.compile(
+    r"self_attn\.(q_a_proj|q_b_proj|kv_a_proj_with_mqa|kv_b_proj)"
+    r"\.weight$")
+
+
+def _convert_deepseek_v2(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """DeepSeek-V2: the Qwen2-MoE expert stacking plus the MLA projection
+    transposes (q_a/q_b/kv_a/kv_b; q_proj/o_proj ride the Llama rule)."""
+    pre = {}
+    for k, v in hf.items():
+        if _DSV2_LINEAR.search(k):
+            pre[k] = v.T
+        else:
+            pre[k] = v
+    out = _convert_qwen2_moe(pre, cfg)
+    # the MLA weights were already transposed above; _convert_llama inside
+    # only touches its own regex, so no double-transpose
+    return out
+
+
 def _src_prefix(hf: Dict[str, np.ndarray]) -> str:
     for p in ("bert.", "ernie."):
         if any(k.startswith(p) for k in hf):
@@ -252,6 +272,7 @@ _CONVERTERS: Dict[str, Callable] = {
     "ernie4_5": _convert_llama,
     "qwen2_moe": _convert_qwen2_moe,
     "ernie4_5_moe": _convert_qwen2_moe,
+    "deepseek_v2": _convert_deepseek_v2,
     "bert": _convert_bert,
     "ernie": _convert_ernie,
 }
@@ -357,6 +378,46 @@ def config_from_hf(model_dir: str):
             dtype=_jax_dtype(hf),
         )
         return cls, cfg, mt
+    if mt == "deepseek_v2":
+        from .deepseek_v2 import DeepseekV2Config, DeepseekV2ForCausalLM
+        if hf.get("topk_method", "greedy") not in ("greedy",):
+            raise ValueError(
+                f"topk_method {hf.get('topk_method')!r} not supported "
+                "(group_limited_greedy routing is not implemented)")
+        if hf.get("moe_layer_freq", 1) != 1:
+            raise ValueError("moe_layer_freq != 1 not supported")
+        if hf.get("rope_scaling"):
+            raise ValueError(
+                "rope_scaling (yarn) is not implemented; real DeepSeek-V2 "
+                "checkpoints remap RoPE frequencies AND rescale the "
+                "softmax — loading without it would be silently wrong")
+        cfg = DeepseekV2Config(
+            **common,
+            intermediate_size=hf["intermediate_size"],
+            num_key_value_heads=hf.get("num_key_value_heads",
+                                       hf["num_attention_heads"]),
+            max_position_embeddings=hf.get("max_position_embeddings", 8192),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            attention_bias=hf.get("attention_bias", False),
+            q_lora_rank=hf.get("q_lora_rank"),
+            kv_lora_rank=hf.get("kv_lora_rank", 512),
+            qk_nope_head_dim=hf.get("qk_nope_head_dim", 128),
+            qk_rope_head_dim=hf.get("qk_rope_head_dim", 64),
+            v_head_dim=hf.get("v_head_dim", 128),
+            num_experts=hf.get("n_routed_experts", 64),
+            num_experts_per_tok=hf.get("num_experts_per_tok", 6),
+            moe_intermediate_size=hf.get("moe_intermediate_size", 1408),
+            num_shared_experts=hf.get("n_shared_experts") or 0,
+            first_k_dense_replace=hf.get("first_k_dense_replace", 1),
+            routed_scaling_factor=hf.get("routed_scaling_factor", 1.0),
+            # transformers' DeepseekV2 gate READS norm_topk_prob but never
+            # applies it on the greedy path — parity means matching that
+            # behavior, not the config flag
+            norm_topk_prob=False,
+            dtype=_jax_dtype(hf),
+        )
+        return DeepseekV2ForCausalLM, cfg, mt
     if mt in ("bert", "ernie"):
         from .bert import BertConfig, BertForPretraining
         from .ernie import ErnieConfig, ErnieForMaskedLM

@@ -73,7 +73,8 @@ class MoEMLP(Layer):
                  shared_intermediate_size: Optional[int] = None,
                  aux_loss_weight: float = 0.01,
                  use_shared_expert_gate: bool = False,
-                 norm_topk_prob: bool = False, name=None):
+                 norm_topk_prob: bool = False,
+                 routed_scaling_factor: float = 1.0, name=None):
         super().__init__(name)
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -82,6 +83,8 @@ class MoEMLP(Layer):
         self.capacity_factor = capacity_factor
         self.aux_loss_weight = aux_loss_weight
         self.norm_topk_prob = norm_topk_prob
+        # DeepSeek-V2/V3: the routed (not shared) output is scaled
+        self.routed_scaling_factor = routed_scaling_factor
         E, h, m = num_experts, hidden_size, intermediate_size
         init = I.XavierNormal()
         self.gate = Parameter(init(next_key(), (h, E)))  # router, replicated
@@ -132,6 +135,8 @@ class MoEMLP(Layer):
         ye = jnp.einsum("ecm,emh->ech", F.silu(g) * u, self.w_down)
         ye = constraint(ye, "ep", None, None)
         y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), ye)
+        if self.routed_scaling_factor != 1.0:
+            y = y * self.routed_scaling_factor
         if self.shared:
             sg = F.silu(xt @ self.shared_gate_proj) * (xt @ self.shared_up_proj)
             so = sg @ self.shared_down_proj
