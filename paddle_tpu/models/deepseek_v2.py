@@ -17,8 +17,9 @@ MLA, TPU-native:
 """
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +52,13 @@ class DeepseekV2Config(LlamaConfig):
     num_shared_experts: int = 2            # n_shared_experts
     first_k_dense_replace: int = 1
     routed_scaling_factor: float = 1.0
+    # DeepSeek group-limited-greedy routing (n_group=1 -> plain greedy)
+    n_group: int = 1
+    topk_group: int = 1
+    # yarn context extension (HF rope_scaling dict: factor, beta_fast/slow,
+    # mscale, mscale_all_dim, original_max_position_embeddings); None =
+    # plain RoPE. Real DeepSeek-V2 checkpoints all ship yarn.
+    rope_scaling: Optional[Dict[str, Any]] = None
     norm_topk_prob: bool = False           # normalize selected gates to 1
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.001
@@ -76,15 +84,67 @@ def deepseek_v2_tiny(**overrides) -> DeepseekV2Config:
     return DeepseekV2Config(**base)
 
 
-def rope_interleaved(x, positions, theta: float):
+def yarn_params(dim: int, theta: float, rope_scaling: Dict[str, Any],
+                max_position_embeddings: int):
+    """YaRN context extension (Peng et al. 2023; matches transformers'
+    _compute_yarn_parameters exactly): per-frequency blend between
+    interpolated (factor-divided) and extrapolated frequencies via a
+    linear ramp over the correction range, plus the attention factor
+    that scales cos/sin magnitudes (HF folds mscale there, which scales
+    q_pe . k_pe by attention_factor^2)."""
+    import numpy as np
+    factor = rope_scaling["factor"]
+    attention_factor = rope_scaling.get("attention_factor")
+    mscale = rope_scaling.get("mscale")
+    mscale_all_dim = rope_scaling.get("mscale_all_dim")
+    orig = (rope_scaling.get("original_max_position_embeddings")
+            or max_position_embeddings)
+
+    def get_mscale(scale, ms=1):
+        return 1.0 if scale <= 1 else 0.1 * ms * math.log(scale) + 1.0
+
+    if attention_factor is None:
+        if mscale and mscale_all_dim:
+            attention_factor = float(get_mscale(factor, mscale)
+                                     / get_mscale(factor, mscale_all_dim))
+        else:
+            attention_factor = get_mscale(factor)
+    beta_fast = rope_scaling.get("beta_fast") or 32
+    beta_slow = rope_scaling.get("beta_slow") or 1
+
+    def correction_dim(num_rot):
+        return (dim * math.log(orig / (num_rot * 2 * math.pi))
+                / (2 * math.log(theta)))
+
+    low, high = correction_dim(beta_fast), correction_dim(beta_slow)
+    if rope_scaling.get("truncate", True):
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, dim - 1)
+    if low == high:
+        high += 0.001
+    ramp = np.clip((np.arange(dim // 2, dtype=np.float32) - low)
+                   / (high - low), 0, 1)
+    pos_freqs = theta ** (np.arange(0, dim, 2, dtype=np.float32) / dim)
+    inv_extra = 1.0 / pos_freqs
+    inv_inter = 1.0 / (factor * pos_freqs)
+    extra_factor = 1.0 - ramp
+    inv_freq = inv_inter * (1 - extra_factor) + inv_extra * extra_factor
+    return jnp.asarray(inv_freq), float(attention_factor)
+
+
+def rope_interleaved(x, positions, theta: float, inv_freq=None,
+                     attention_scaling: float = 1.0):
     """DeepSeek's complex-pair RoPE: pairs are (x[2i], x[2i+1]) and
     freqs index i — torch's view_as_complex convention, NOT rotate-half.
-    x [b, s, h, d]; positions [b, s]."""
+    x [b, s, h, d]; positions [b, s]. ``inv_freq``/``attention_scaling``
+    override the plain schedule (yarn)."""
     d = x.shape[-1]
-    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    ang = positions.astype(jnp.float32)[..., None] * inv    # [b, s, d/2]
-    cos = jnp.cos(ang)[:, :, None, :]
-    sin = jnp.sin(ang)[:, :, None, :]
+    if inv_freq is None:
+        inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2,
+                                               dtype=jnp.float32) / d))
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [b, s, d/2]
+    cos = jnp.cos(ang)[:, :, None, :] * attention_scaling
+    sin = jnp.sin(ang)[:, :, None, :] * attention_scaling
     x1, x2 = x[..., ::2].astype(jnp.float32), x[..., 1::2].astype(jnp.float32)
     r1 = x1 * cos - x2 * sin
     r2 = x2 * cos + x1 * sin
@@ -124,6 +184,12 @@ class MLAttention(Layer):
                                         has_bias=cfg.attention_bias,
                                         input_is_parallel=True)
         self.scale = cfg.qk_head_dim ** -0.5
+        if getattr(cfg, "rope_scaling", None):
+            self._inv_freq, self._rope_af = yarn_params(
+                cfg.qk_rope_head_dim, cfg.rope_theta, cfg.rope_scaling,
+                cfg.max_position_embeddings)
+        else:
+            self._inv_freq, self._rope_af = None, 1.0
 
     def _queries(self, x, positions):
         cfg = self.config
@@ -136,7 +202,8 @@ class MLAttention(Layer):
         q = q.reshape(b, s, h, cfg.qk_head_dim)
         q_nope = q[..., :cfg.qk_nope_head_dim]
         q_pe = rope_interleaved(q[..., cfg.qk_nope_head_dim:], positions,
-                                cfg.rope_theta)
+                                cfg.rope_theta, self._inv_freq,
+                                self._rope_af)
         return q_nope, q_pe
 
     def _latents(self, x, positions):
@@ -147,7 +214,8 @@ class MLAttention(Layer):
                    ckv[..., cfg.kv_lora_rank:])
         c = self.kv_a_layernorm(c)
         k_pe = rope_interleaved(k_pe[:, :, None, :], positions,
-                                cfg.rope_theta)[:, :, 0]
+                                cfg.rope_theta, self._inv_freq,
+                                self._rope_af)[:, :, 0]
         return c, k_pe
 
     def _expand(self, c):
@@ -236,7 +304,8 @@ class DeepseekV2DecoderLayer(Layer):
                                           * config.num_shared_experts),
                 aux_loss_weight=config.aux_loss_weight,
                 routed_scaling_factor=config.routed_scaling_factor,
-                norm_topk_prob=config.norm_topk_prob)
+                norm_topk_prob=config.norm_topk_prob,
+                n_group=config.n_group, topk_group=config.topk_group)
 
     def forward(self, x, positions, kv_cache=None, cache_index=None,
                 attn_mask=None, attn_start=None):

@@ -380,17 +380,17 @@ def config_from_hf(model_dir: str):
         return cls, cfg, mt
     if mt == "deepseek_v2":
         from .deepseek_v2 import DeepseekV2Config, DeepseekV2ForCausalLM
-        if hf.get("topk_method", "greedy") not in ("greedy",):
+        if hf.get("topk_method", "greedy") not in (
+                "greedy", "group_limited_greedy"):
             raise ValueError(
-                f"topk_method {hf.get('topk_method')!r} not supported "
-                "(group_limited_greedy routing is not implemented)")
+                f"topk_method {hf.get('topk_method')!r} not supported")
         if hf.get("moe_layer_freq", 1) != 1:
             raise ValueError("moe_layer_freq != 1 not supported")
-        if hf.get("rope_scaling"):
+        rs_cfg = hf.get("rope_scaling")
+        if rs_cfg and rs_cfg.get("rope_type",
+                                 rs_cfg.get("type")) not in ("yarn",):
             raise ValueError(
-                "rope_scaling (yarn) is not implemented; real DeepSeek-V2 "
-                "checkpoints remap RoPE frequencies AND rescale the "
-                "softmax — loading without it would be silently wrong")
+                f"rope_scaling type {rs_cfg!r} not supported (yarn is)")
         cfg = DeepseekV2Config(
             **common,
             intermediate_size=hf["intermediate_size"],
@@ -411,6 +411,13 @@ def config_from_hf(model_dir: str):
             num_shared_experts=hf.get("n_shared_experts") or 0,
             first_k_dense_replace=hf.get("first_k_dense_replace", 1),
             routed_scaling_factor=hf.get("routed_scaling_factor", 1.0),
+            n_group=(hf.get("n_group", 1)
+                     if hf.get("topk_method") == "group_limited_greedy"
+                     else 1),
+            topk_group=(hf.get("topk_group", 1)
+                        if hf.get("topk_method") == "group_limited_greedy"
+                        else 1),
+            rope_scaling=hf.get("rope_scaling"),
             # transformers' DeepseekV2 gate READS norm_topk_prob but never
             # applies it on the greedy path — parity means matching that
             # behavior, not the config flag

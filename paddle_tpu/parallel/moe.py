@@ -30,14 +30,32 @@ from .sharding import constraint
 
 def top_k_routing(router_logits, k: int, capacity: int,
                   bias: Optional[jax.Array] = None,
-                  norm_topk_prob: bool = False):
+                  norm_topk_prob: bool = False,
+                  n_group: int = 1, topk_group: int = 1):
     """router_logits [T, E] -> (dispatch [T, E, C] bool, combine [T, E, C],
     aux_loss scalar). GShard top-k with per-expert capacity C.
     ``norm_topk_prob`` renormalizes the selected gates to sum to 1
-    (Qwen2-57B-A14B-style); False keeps raw softmax-over-all probs."""
+    (Qwen2-57B-A14B-style); False keeps raw softmax-over-all probs.
+    ``n_group > 1`` is DeepSeek's group-limited-greedy: experts split
+    into n_group groups, only the top ``topk_group`` groups (by max
+    member prob) stay eligible before the per-token top-k."""
     T, E = router_logits.shape
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
     select_scores = probs if bias is None else probs + bias[None, :]
+    if n_group > 1:
+        g = select_scores.reshape(T, n_group, E // n_group)
+        group_scores = jnp.max(g, axis=-1)                    # [T, G]
+        _, top_groups = jax.lax.top_k(group_scores, topk_group)
+        group_ok = jnp.any(
+            jnp.arange(n_group)[None, :, None] == top_groups[:, None, :],
+            axis=-1)                                          # [T, G]
+        # -inf, not 0: a loss-free-balancing bias can push eligible
+        # scores negative, and a 0-masked ineligible expert must never
+        # outrank them in top_k (gates come from the unmasked probs, so
+        # -inf never reaches the combine weights)
+        select_scores = jnp.where(
+            jnp.repeat(group_ok, E // n_group, axis=1), select_scores,
+            -jnp.inf)
     # top-k expert ids per token
     _, expert_ids = jax.lax.top_k(select_scores, k)          # [T, k]
     onehot = jax.nn.one_hot(expert_ids, E, dtype=jnp.float32)  # [T, k, E]
@@ -74,7 +92,8 @@ class MoEMLP(Layer):
                  aux_loss_weight: float = 0.01,
                  use_shared_expert_gate: bool = False,
                  norm_topk_prob: bool = False,
-                 routed_scaling_factor: float = 1.0, name=None):
+                 routed_scaling_factor: float = 1.0,
+                 n_group: int = 1, topk_group: int = 1, name=None):
         super().__init__(name)
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -85,6 +104,7 @@ class MoEMLP(Layer):
         self.norm_topk_prob = norm_topk_prob
         # DeepSeek-V2/V3: the routed (not shared) output is scaled
         self.routed_scaling_factor = routed_scaling_factor
+        self.n_group, self.topk_group = n_group, topk_group
         E, h, m = num_experts, hidden_size, intermediate_size
         init = I.XavierNormal()
         self.gate = Parameter(init(next_key(), (h, E)))  # router, replicated
@@ -125,7 +145,8 @@ class MoEMLP(Layer):
         logits = xt.astype(jnp.float32) @ self.gate.astype(jnp.float32)
         dispatch, combine, aux = top_k_routing(
             logits, self.top_k, C, bias=self.expert_bias,
-            norm_topk_prob=self.norm_topk_prob)
+            norm_topk_prob=self.norm_topk_prob,
+            n_group=self.n_group, topk_group=self.topk_group)
         # dispatch to expert buckets: [E, C, h], sharded over ep
         xe = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
         xe = constraint(xe, "ep", None, None)

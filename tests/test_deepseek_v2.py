@@ -48,6 +48,33 @@ class TestDeepseekV2Parity:
         np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
 
 
+class TestYarnParity:
+    def test_yarn_logits_match_torch(self, tmp_path):
+        """Real DeepSeek-V2 checkpoints all ship yarn rope_scaling; the
+        frequency remap + attention factor must match transformers."""
+        torch.manual_seed(0)
+        hf = transformers.DeepseekV2ForCausalLM(_hf_cfg(
+            rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                          "mscale": 0.707, "mscale_all_dim": 0.707,
+                          "beta_fast": 32, "beta_slow": 1,
+                          "original_max_position_embeddings": 32}))
+        hf.eval()
+        d = str(tmp_path)
+        hf.save_pretrained(d, safe_serialization=True)
+        model = from_pretrained(d)
+        # yarn params actually engaged
+        attn = model.model.layers[0].self_attn
+        assert attn._inv_freq is not None
+        for layer in model.model.layers:
+            if hasattr(layer.mlp, "capacity_factor"):
+                layer.mlp.capacity_factor = 2.0
+        ids = np.random.RandomState(3).randint(0, 128, (2, 48))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(model(jnp.asarray(ids)))
+        np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
 class TestMLADecode:
     def test_absorbed_decode_matches_prefill(self):
         """The absorbed latent-space decode must produce the same logits
@@ -93,3 +120,25 @@ class TestMLADecode:
         ids = jnp.asarray(np.random.RandomState(2).randint(0, 256, (1, 8)))
         out = model.generate(ids, max_new_tokens=6, temperature=0.0)
         assert out.shape == (1, 14)
+
+
+class TestGroupLimitedRouting:
+    def test_group_limited_logits_match_torch(self, tmp_path):
+        """DeepSeek-V2 (non-Lite) routing: only the top groups' experts
+        are eligible; parity vs transformers."""
+        torch.manual_seed(1)
+        hf = transformers.DeepseekV2ForCausalLM(_hf_cfg(
+            topk_method="group_limited_greedy", n_group=2, topk_group=1))
+        hf.eval()
+        d = str(tmp_path)
+        hf.save_pretrained(d, safe_serialization=True)
+        model = from_pretrained(d)
+        assert model.model.layers[1].mlp.n_group == 2
+        for layer in model.model.layers:
+            if hasattr(layer.mlp, "capacity_factor"):
+                layer.mlp.capacity_factor = 2.0
+        ids = np.random.RandomState(4).randint(0, 128, (2, 16))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(model(jnp.asarray(ids)))
+        np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
