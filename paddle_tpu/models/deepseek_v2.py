@@ -55,6 +55,12 @@ class DeepseekV2Config(LlamaConfig):
     # DeepSeek group-limited-greedy routing (n_group=1 -> plain greedy)
     n_group: int = 1
     topk_group: int = 1
+    # V3 router: sigmoid expert scores + top-2-sum group scores
+    scoring: str = "softmax"
+    group_score_mode: str = "max"
+    # V3 yarn: get_mscale(factor, mscale_all_dim)^2 multiplies the
+    # softmax scale (on top of the cos/sin attention factor)
+    yarn_mscale_all_in_scale: bool = False
     # yarn context extension (HF rope_scaling dict: factor, beta_fast/slow,
     # mscale, mscale_all_dim, original_max_position_embeddings); None =
     # plain RoPE. Real DeepSeek-V2 checkpoints all ship yarn.
@@ -84,6 +90,12 @@ def deepseek_v2_tiny(**overrides) -> DeepseekV2Config:
     return DeepseekV2Config(**base)
 
 
+def yarn_get_mscale(scale: float, mscale: float = 1.0) -> float:
+    """YaRN attention magnitude factor (one definition, used by both the
+    frequency table and V3's softmax-scale adjustment)."""
+    return 1.0 if scale <= 1 else 0.1 * mscale * math.log(scale) + 1.0
+
+
 def yarn_params(dim: int, theta: float, rope_scaling: Dict[str, Any],
                 max_position_embeddings: int):
     """YaRN context extension (Peng et al. 2023; matches transformers'
@@ -100,15 +112,13 @@ def yarn_params(dim: int, theta: float, rope_scaling: Dict[str, Any],
     orig = (rope_scaling.get("original_max_position_embeddings")
             or max_position_embeddings)
 
-    def get_mscale(scale, ms=1):
-        return 1.0 if scale <= 1 else 0.1 * ms * math.log(scale) + 1.0
-
     if attention_factor is None:
         if mscale and mscale_all_dim:
-            attention_factor = float(get_mscale(factor, mscale)
-                                     / get_mscale(factor, mscale_all_dim))
+            attention_factor = float(yarn_get_mscale(factor, mscale)
+                                     / yarn_get_mscale(factor,
+                                                       mscale_all_dim))
         else:
-            attention_factor = get_mscale(factor)
+            attention_factor = yarn_get_mscale(factor)
     beta_fast = rope_scaling.get("beta_fast") or 32
     beta_slow = rope_scaling.get("beta_slow") or 1
 
@@ -188,6 +198,10 @@ class MLAttention(Layer):
             self._inv_freq, self._rope_af = yarn_params(
                 cfg.qk_rope_head_dim, cfg.rope_theta, cfg.rope_scaling,
                 cfg.max_position_embeddings)
+            msall = cfg.rope_scaling.get("mscale_all_dim", 0)
+            if getattr(cfg, "yarn_mscale_all_in_scale", False) and msall:
+                ms = yarn_get_mscale(cfg.rope_scaling["factor"], msall)
+                self.scale = self.scale * ms * ms  # V3 semantics
         else:
             self._inv_freq, self._rope_af = None, 1.0
 
@@ -305,7 +319,9 @@ class DeepseekV2DecoderLayer(Layer):
                 aux_loss_weight=config.aux_loss_weight,
                 routed_scaling_factor=config.routed_scaling_factor,
                 norm_topk_prob=config.norm_topk_prob,
-                n_group=config.n_group, topk_group=config.topk_group)
+                n_group=config.n_group, topk_group=config.topk_group,
+                scoring=config.scoring,
+                group_score_mode=config.group_score_mode)
 
     def forward(self, x, positions, kv_cache=None, cache_index=None,
                 attn_mask=None, attn_start=None):

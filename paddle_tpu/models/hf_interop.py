@@ -144,11 +144,12 @@ def _convert_qwen2_moe(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
         if k.endswith(".mlp.shared_expert_gate.weight"):  # [1, h] -> [h, 1]
             out[k[:-len(".weight")]] = v.T
             continue
-        if k.endswith(".mlp.moe_statics.e_score_correction_bias"):
-            # ERNIE-4.5's aux-free routing correction == our loss-free
-            # balancing buffer (DeepSeek-V3 style)
-            out[k.replace(".moe_statics.e_score_correction_bias",
-                          ".expert_bias")] = v.reshape(-1)
+        if k.endswith(".mlp.moe_statics.e_score_correction_bias") or \
+                k.endswith(".mlp.gate.e_score_correction_bias"):
+            # ERNIE-4.5 / DeepSeek-V3 aux-free routing correction == our
+            # loss-free balancing buffer (selection-only bias)
+            out[k.rsplit(".mlp.", 1)[0] + ".mlp.expert_bias"] = \
+                v.reshape(-1)
             continue
         out.update(_convert_llama({k: v}, cfg))
     for name, by_id in experts.items():
@@ -273,6 +274,7 @@ _CONVERTERS: Dict[str, Callable] = {
     "qwen2_moe": _convert_qwen2_moe,
     "ernie4_5_moe": _convert_qwen2_moe,
     "deepseek_v2": _convert_deepseek_v2,
+    "deepseek_v3": _convert_deepseek_v2,
     "bert": _convert_bert,
     "ernie": _convert_ernie,
 }
@@ -378,9 +380,13 @@ def config_from_hf(model_dir: str):
             dtype=_jax_dtype(hf),
         )
         return cls, cfg, mt
-    if mt == "deepseek_v2":
+    if mt in ("deepseek_v2", "deepseek_v3"):
         from .deepseek_v2 import DeepseekV2Config, DeepseekV2ForCausalLM
-        if hf.get("topk_method", "greedy") not in (
+        v3 = mt == "deepseek_v3"
+        if v3 and hf.get("rope_interleave", True) is False:
+            raise ValueError("rope_interleave=False (rotate-half pairing) "
+                             "not supported; DeepSeek ships interleaved")
+        if not v3 and hf.get("topk_method", "greedy") not in (
                 "greedy", "group_limited_greedy"):
             raise ValueError(
                 f"topk_method {hf.get('topk_method')!r} not supported")
@@ -412,16 +418,20 @@ def config_from_hf(model_dir: str):
             first_k_dense_replace=hf.get("first_k_dense_replace", 1),
             routed_scaling_factor=hf.get("routed_scaling_factor", 1.0),
             n_group=(hf.get("n_group", 1)
-                     if hf.get("topk_method") == "group_limited_greedy"
-                     else 1),
+                     if v3 or hf.get("topk_method") ==
+                     "group_limited_greedy" else 1),
             topk_group=(hf.get("topk_group", 1)
-                        if hf.get("topk_method") == "group_limited_greedy"
-                        else 1),
+                        if v3 or hf.get("topk_method") ==
+                        "group_limited_greedy" else 1),
             rope_scaling=hf.get("rope_scaling"),
-            # transformers' DeepseekV2 gate READS norm_topk_prob but never
-            # applies it on the greedy path — parity means matching that
-            # behavior, not the config flag
-            norm_topk_prob=False,
+            # V3's sigmoid router APPLIES norm_topk_prob; transformers'
+            # V2 gate reads it but never applies it on the greedy path —
+            # parity means matching each reference's actual behavior
+            norm_topk_prob=hf.get("norm_topk_prob", True) if v3 else False,
+            scoring="sigmoid" if v3 else "softmax",
+            group_score_mode="top2_sum" if v3 else "max",
+            yarn_mscale_all_in_scale=v3,
+            aux_loss_weight=0.0 if v3 else 0.001,
             dtype=_jax_dtype(hf),
         )
         return DeepseekV2ForCausalLM, cfg, mt

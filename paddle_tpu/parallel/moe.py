@@ -28,23 +28,25 @@ from ..utils.rng import next_key
 from .sharding import constraint
 
 
-def top_k_routing(router_logits, k: int, capacity: int,
-                  bias: Optional[jax.Array] = None,
-                  norm_topk_prob: bool = False,
-                  n_group: int = 1, topk_group: int = 1):
-    """router_logits [T, E] -> (dispatch [T, E, C] bool, combine [T, E, C],
-    aux_loss scalar). GShard top-k with per-expert capacity C.
-    ``norm_topk_prob`` renormalizes the selected gates to sum to 1
-    (Qwen2-57B-A14B-style); False keeps raw softmax-over-all probs.
-    ``n_group > 1`` is DeepSeek's group-limited-greedy: experts split
-    into n_group groups, only the top ``topk_group`` groups (by max
-    member prob) stay eligible before the per-token top-k."""
+def _select_topk(router_logits, k, bias, n_group, topk_group, scoring,
+                 group_score_mode):
+    """The ONE definition of DeepSeek-family expert selection (scores,
+    bias correction, group limiting, top-k) — shared by the dispatch and
+    by ``update_loss_free_bias`` so the bias is always updated against
+    the loads the real router produces."""
     T, E = router_logits.shape
-    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    if scoring == "sigmoid":   # DeepSeek-V3: independent expert scores
+        probs = jax.nn.sigmoid(router_logits.astype(jnp.float32))
+    else:
+        probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
     select_scores = probs if bias is None else probs + bias[None, :]
     if n_group > 1:
         g = select_scores.reshape(T, n_group, E // n_group)
-        group_scores = jnp.max(g, axis=-1)                    # [T, G]
+        if group_score_mode == "top2_sum":   # DeepSeek-V3 group score
+            top2, _ = jax.lax.top_k(g, 2)
+            group_scores = jnp.sum(top2, axis=-1)             # [T, G]
+        else:
+            group_scores = jnp.max(g, axis=-1)                # [T, G]
         _, top_groups = jax.lax.top_k(group_scores, topk_group)
         group_ok = jnp.any(
             jnp.arange(n_group)[None, :, None] == top_groups[:, None, :],
@@ -56,8 +58,27 @@ def top_k_routing(router_logits, k: int, capacity: int,
         select_scores = jnp.where(
             jnp.repeat(group_ok, E // n_group, axis=1), select_scores,
             -jnp.inf)
-    # top-k expert ids per token
     _, expert_ids = jax.lax.top_k(select_scores, k)          # [T, k]
+    return probs, expert_ids
+
+
+def top_k_routing(router_logits, k: int, capacity: int,
+                  bias: Optional[jax.Array] = None,
+                  norm_topk_prob: bool = False,
+                  n_group: int = 1, topk_group: int = 1,
+                  scoring: str = "softmax",
+                  group_score_mode: str = "max"):
+    """router_logits [T, E] -> (dispatch [T, E, C] bool, combine [T, E, C],
+    aux_loss scalar). GShard top-k with per-expert capacity C.
+    ``norm_topk_prob`` renormalizes the selected gates to sum to 1
+    (Qwen2-57B-A14B-style); False keeps raw softmax-over-all probs.
+    ``n_group > 1`` is DeepSeek's group-limited-greedy: experts split
+    into n_group groups, only the top ``topk_group`` groups (by max
+    member prob) stay eligible before the per-token top-k."""
+    T, E = router_logits.shape
+    probs, expert_ids = _select_topk(router_logits, k, bias, n_group,
+                                     topk_group, scoring,
+                                     group_score_mode)
     onehot = jax.nn.one_hot(expert_ids, E, dtype=jnp.float32)  # [T, k, E]
     gates = probs[:, None, :] * onehot                        # gate per choice
     if norm_topk_prob:
@@ -73,9 +94,14 @@ def top_k_routing(router_logits, k: int, capacity: int,
     pos_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [T,k,E,C]
     dispatch = jnp.einsum("tke,tkec->tec", keep, pos_onehot)
     combine = jnp.einsum("tke,tkec->tec", gates * keep, pos_onehot)
-    # switch aux loss: E * sum_e mean_prob_e * mean_frac_e
+    # switch aux loss: E * sum_e mean_prob_e * mean_frac_e. Sigmoid
+    # scores normalize first (DeepSeek's seq-aux does the same) — the raw
+    # product would be minimized by driving EVERY score to 0, collapsing
+    # the router instead of balancing it.
     frac = jnp.mean(onehot[:, 0, :], axis=0)   # fraction routed (top-1 choice)
-    mean_prob = jnp.mean(probs, axis=0)
+    pn = probs / jnp.maximum(jnp.sum(probs, axis=-1, keepdims=True), 1e-9) \
+        if scoring == "sigmoid" else probs
+    mean_prob = jnp.mean(pn, axis=0)
     aux = E * jnp.sum(frac * mean_prob)
     return dispatch, combine, aux
 
@@ -93,7 +119,9 @@ class MoEMLP(Layer):
                  use_shared_expert_gate: bool = False,
                  norm_topk_prob: bool = False,
                  routed_scaling_factor: float = 1.0,
-                 n_group: int = 1, topk_group: int = 1, name=None):
+                 n_group: int = 1, topk_group: int = 1,
+                 scoring: str = "softmax",
+                 group_score_mode: str = "max", name=None):
         super().__init__(name)
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -105,6 +133,7 @@ class MoEMLP(Layer):
         # DeepSeek-V2/V3: the routed (not shared) output is scaled
         self.routed_scaling_factor = routed_scaling_factor
         self.n_group, self.topk_group = n_group, topk_group
+        self.scoring, self.group_score_mode = scoring, group_score_mode
         E, h, m = num_experts, hidden_size, intermediate_size
         init = I.XavierNormal()
         self.gate = Parameter(init(next_key(), (h, E)))  # router, replicated
@@ -146,7 +175,8 @@ class MoEMLP(Layer):
         dispatch, combine, aux = top_k_routing(
             logits, self.top_k, C, bias=self.expert_bias,
             norm_topk_prob=self.norm_topk_prob,
-            n_group=self.n_group, topk_group=self.topk_group)
+            n_group=self.n_group, topk_group=self.topk_group,
+            scoring=self.scoring, group_score_mode=self.group_score_mode)
         # dispatch to expert buckets: [E, C, h], sharded over ep
         xe = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
         xe = constraint(xe, "ep", None, None)
@@ -174,9 +204,12 @@ class MoEMLP(Layer):
 
     def update_loss_free_bias(self, router_logits, lr: float = 1e-3):
         """DeepSeek-V3 loss-free balancing: nudge per-expert bias opposite
-        to its load error (host-side, outside the gradient path)."""
-        probs = jax.nn.softmax(router_logits, axis=-1)
-        _, ids = jax.lax.top_k(probs + self.expert_bias[None, :], self.top_k)
+        to its load error (host-side, outside the gradient path). Uses
+        the SAME selection path as dispatch (scoring/group limiting), so
+        the measured load is the load the router actually produces."""
+        _, ids = _select_topk(router_logits, self.top_k, self.expert_bias,
+                              self.n_group, self.topk_group, self.scoring,
+                              self.group_score_mode)
         load = jnp.mean(jax.nn.one_hot(ids, self.num_experts).sum(1), axis=0)
         err = load - self.top_k / self.num_experts
         self._buffers["expert_bias"] = self.expert_bias - lr * jnp.sign(err)

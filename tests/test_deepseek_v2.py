@@ -142,3 +142,48 @@ class TestGroupLimitedRouting:
             ref = hf(torch.tensor(ids)).logits.numpy()
         got = np.asarray(model(jnp.asarray(ids)))
         np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+class TestDeepseekV3:
+    def test_v3_logits_match_torch(self, tmp_path):
+        """DeepSeek-V3/R1 architecture: sigmoid router with bias-corrected
+        top-2-sum group selection, applied top-k normalization, and yarn
+        with mscale^2 folded into the softmax scale."""
+        cfg = transformers.DeepseekV3Config(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, q_lora_rank=32, kv_lora_rank=32,
+            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+            head_dim=8, n_routed_experts=8, num_experts_per_tok=2,
+            moe_intermediate_size=32, n_shared_experts=1,
+            first_k_dense_replace=1, n_group=2, topk_group=1,
+            routed_scaling_factor=2.5, norm_topk_prob=True,
+            rope_scaling={"rope_type": "yarn", "factor": 8.0,
+                          "mscale": 1.0, "mscale_all_dim": 1.0,
+                          "original_max_position_embeddings": 16},
+            max_position_embeddings=128, rope_theta=10000.0,
+            rope_interleave=True, tie_word_embeddings=False,
+            torch_dtype="float32", attn_implementation="eager")
+        torch.manual_seed(2)
+        hf = transformers.DeepseekV3ForCausalLM(cfg)
+        hf.eval()
+        # give the aux-free bias real values so the selection correction
+        # is exercised (checkpoints ship trained biases)
+        with torch.no_grad():
+            for layer in hf.model.layers[1:]:
+                layer.mlp.gate.e_score_correction_bias.uniform_(-0.2, 0.2)
+        d = str(tmp_path)
+        hf.save_pretrained(d, safe_serialization=True)
+        model = from_pretrained(d)
+        mlp = model.model.layers[1].mlp
+        assert mlp.scoring == "sigmoid" and mlp.group_score_mode == "top2_sum"
+        assert float(np.abs(np.asarray(
+            model.model.layers[1].mlp.expert_bias)).sum()) > 0
+        for layer in model.model.layers:
+            if hasattr(layer.mlp, "capacity_factor"):
+                layer.mlp.capacity_factor = 4.0  # E/k: dropless
+        ids = np.random.RandomState(5).randint(0, 128, (2, 24))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(model(jnp.asarray(ids)))
+        np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
