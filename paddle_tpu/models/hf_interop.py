@@ -326,6 +326,15 @@ def config_from_hf(model_dir: str):
         from .qwen2 import Qwen2Config, Qwen2ForCausalLM
         cls, ccls = ((Qwen2ForCausalLM, Qwen2Config) if mt == "qwen2"
                      else (LlamaForCausalLM, LlamaConfig))
+        rs_cfg = hf.get("rope_scaling")
+        if rs_cfg:
+            rtype = rs_cfg.get("rope_type", rs_cfg.get("type"))
+            if rtype not in ("llama3", "default"):
+                raise ValueError(
+                    f"rope_scaling type {rtype!r} not supported for "
+                    f"{mt} (llama3 is)")
+            if rtype != "llama3":
+                rs_cfg = None
         cfg = ccls(
             **common,
             intermediate_size=hf["intermediate_size"],
@@ -340,6 +349,7 @@ def config_from_hf(model_dir: str):
                             if hf.get("use_sliding_window") else None),
             max_window_layers=(hf.get("max_window_layers")
                                if hf.get("use_sliding_window") else None),
+            rope_scaling=rs_cfg,
             dtype=_jax_dtype(hf),
         )
         return cls, cfg, mt
@@ -355,6 +365,15 @@ def config_from_hf(model_dir: str):
                 "decoder_sparse_step > 1 / mlp_only_layers are not "
                 "supported (this build places MoE on every layer past "
                 "first_k_dense_replace)")
+        rs_cfg = hf.get("rope_scaling")
+        if rs_cfg:
+            rtype = rs_cfg.get("rope_type", rs_cfg.get("type"))
+            if rtype not in ("llama3", "default"):
+                raise ValueError(
+                    f"rope_scaling type {rtype!r} not supported for "
+                    f"{mt} (llama3 is)")
+            if rtype != "llama3":
+                rs_cfg = None
         n_shared = hf.get("shared_expert_intermediate_size") or 0
         cfg = ccls(
             **common,
@@ -377,6 +396,7 @@ def config_from_hf(model_dir: str):
                                          hf.get("moe_layer_start_index", 0)),
             shared_expert_gate=qwen,
             norm_topk_prob=hf.get("norm_topk_prob", False),
+            rope_scaling=rs_cfg,
             dtype=_jax_dtype(hf),
         )
         return cls, cfg, mt

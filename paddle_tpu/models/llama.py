@@ -63,6 +63,10 @@ class LlamaConfig:
     # every layer slides)
     sliding_window: "Optional[int]" = None
     max_window_layers: "Optional[int]" = None
+    # Llama-3.1+ rope_scaling (HF type "llama3": factor,
+    # low/high_freq_factor, original_max_position_embeddings); None =
+    # plain RoPE
+    rope_scaling: "Optional[Dict[str, Any]]" = None
     sequence_parallel: bool = False  # ring attention over the sp axis
     dtype: Any = jnp.bfloat16
 
@@ -94,10 +98,34 @@ def llama_tiny(**overrides) -> LlamaConfig:
 
 
 # ------------------------------------------------------------------- RoPE
-def rotary_cos_sin(positions, head_dim: int, theta: float, dtype):
-    """positions [b, s] -> (cos, sin) [b, s, 1, head_dim/2], fp32 math."""
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
-                                / head_dim))
+def llama3_inv_freq(head_dim: int, theta: float,
+                    rope_scaling: "Dict[str, Any]"):
+    """Llama-3.1 frequency remap (matches transformers'
+    _compute_llama3_parameters): low-frequency bands divide by `factor`,
+    high-frequency bands stay, the middle band interpolates smoothly."""
+    import numpy as np
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32)
+                           / head_dim))
+    factor = rope_scaling["factor"]
+    low_f = rope_scaling["low_freq_factor"]
+    high_f = rope_scaling["high_freq_factor"]
+    old_ctx = rope_scaling["original_max_position_embeddings"]
+    wavelen = 2 * math.pi / inv
+    out = np.where(wavelen > old_ctx / low_f, inv / factor, inv)
+    smooth = (old_ctx / wavelen - low_f) / (high_f - low_f)
+    smoothed = (1 - smooth) * out / factor + smooth * out
+    medium = (wavelen >= old_ctx / high_f) & (wavelen <= old_ctx / low_f)
+    return jnp.asarray(np.where(medium, smoothed, out))
+
+
+def rotary_cos_sin(positions, head_dim: int, theta: float, dtype,
+                   inv_freq=None):
+    """positions [b, s] -> (cos, sin) [b, s, 1, head_dim/2], fp32 math.
+    ``inv_freq`` overrides the plain schedule (Llama-3.1 scaling)."""
+    if inv_freq is None:
+        inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                               dtype=jnp.float32)
+                                    / head_dim))
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [b,s,hd/2]
     return (jnp.cos(angles)[:, :, None, :].astype(dtype),
             jnp.sin(angles)[:, :, None, :].astype(dtype))
@@ -119,6 +147,10 @@ class LlamaAttention(Layer):
         self.window = (config.sliding_window
                        if getattr(config, "sliding_window", None) is not None
                        and (mwl is None or layer_idx >= mwl) else None)
+        rs = getattr(config, "rope_scaling", None)
+        self._inv_freq = (llama3_inv_freq(config.head_dim,
+                                          config.rope_theta, rs)
+                          if rs else None)
         h, kv = config.num_attention_heads, config.num_key_value_heads
         d = config.head_dim
         qkv_bias = config.attention_bias
@@ -144,7 +176,8 @@ class LlamaAttention(Layer):
         q = self.q_proj(x).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
         k = self.k_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
         v = self.v_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
-        cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta, q.dtype)
+        cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
+                                  q.dtype, inv_freq=self._inv_freq)
         q, k = apply_rotary(q, cos, sin), apply_rotary(k, cos, sin)
         # heads sharded over tp
         q = constraint(q, None, None, "tp", None)

@@ -227,3 +227,31 @@ def test_sharded_index_checkpoint(tmp_module, tmp_path):
     single = from_pretrained(d)
     np.testing.assert_allclose(np.asarray(model(jnp.asarray(ids))),
                                np.asarray(single(jnp.asarray(ids))), atol=0)
+
+
+def test_llama31_rope_scaling_logits_match(tmp_module):
+    """Llama-3.1+ checkpoints ship rope_scaling type 'llama3' (the
+    frequency remap); logits must match transformers with it engaged on
+    a context past the original window."""
+    cfg = _llama_cfg(max_position_embeddings=256, rope_theta=500000.0,
+                     rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                                   "low_freq_factor": 1.0,
+                                   "high_freq_factor": 4.0,
+                                   "original_max_position_embeddings": 32})
+    hf_model, d = _save_hf(tmp_module / "llama31",
+                           transformers.LlamaForCausalLM, cfg)
+    model = from_pretrained(d)
+    assert model.model.layers[0].self_attn._inv_freq is not None
+    ids = np.random.RandomState(7).randint(0, 128, (2, 64))  # > orig 32
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+    # and it genuinely differs from un-scaled rope
+    plain, d2 = _save_hf(tmp_module / "llama31_plain",
+                         transformers.LlamaForCausalLM,
+                         _llama_cfg(max_position_embeddings=256,
+                                    rope_theta=500000.0))
+    del plain
+    model2 = from_pretrained(d2)
+    assert model2.model.layers[0].self_attn._inv_freq is None
