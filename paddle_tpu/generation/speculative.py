@@ -69,11 +69,13 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
     per_key = per_draft.setdefault(draft, {})
     cached = per_key.get(cache_key)
     if cached is not None:
-        out, nfwd = cached(t_params, d_params, input_ids)
+        out, nfwd, n_end = cached(t_params, d_params, input_ids)
         if return_stats:
+            emitted = min(int(n_end), total) - prompt_len
             return out, {"target_forwards": int(nfwd),
+                         "emitted_tokens": emitted,
                          "tokens_per_forward":
-                         max_new_tokens / max(int(nfwd), 1)}
+                         emitted / max(int(nfwd), 1)}
         return out
 
     @jax.jit
@@ -153,11 +155,15 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
         pos = jnp.arange(tokens.shape[1])[None, :]
         tokens = jnp.where(pos < jnp.minimum(n_end, total), tokens,
                            pad_token_id)
-        return tokens[:, :total], nfwd
+        return tokens[:, :total], nfwd, n_end
 
     per_key[cache_key] = run
-    out, nfwd = run(t_params, d_params, input_ids)
+    out, nfwd, n_end = run(t_params, d_params, input_ids)
     if return_stats:
+        # emitted counts actual tokens (EOS can stop early) so the
+        # tokens-per-forward speedup figure is not overstated
+        emitted = min(int(n_end), total) - prompt_len
         return out, {"target_forwards": int(nfwd),
-                     "tokens_per_forward": max_new_tokens / max(int(nfwd), 1)}
+                     "emitted_tokens": emitted,
+                     "tokens_per_forward": emitted / max(int(nfwd), 1)}
     return out

@@ -306,9 +306,16 @@ def to_hf_state_dict(model) -> Dict[str, np.ndarray]:
 def _jax_dtype(hf: Dict[str, Any]):
     import jax.numpy as jnp
     # transformers >= 4.56 writes "dtype"; older wrote "torch_dtype"
-    return (jnp.float32
-            if hf.get("dtype", hf.get("torch_dtype")) == "float32"
-            else jnp.bfloat16)
+    dt = hf.get("dtype", hf.get("torch_dtype"))
+    if dt == "float32":
+        return jnp.float32
+    if dt == "float16":
+        # fp16 has no TPU fast path; bf16 keeps the exponent range but
+        # drops mantissa bits vs the checkpoint's training dtype
+        warnings.warn("checkpoint dtype float16 mapped to bfloat16 "
+                      "(TPU-native); pass dtype explicitly to override",
+                      stacklevel=3)
+    return jnp.bfloat16
 
 
 def config_from_hf(model_dir: str):

@@ -180,17 +180,20 @@ class Trainer:
         # not constants, and the optimizer sees only the trainable subset.
         tkeys = frozenset(self._trainable_keys)
 
-        def loss_of(p, batch, stepno):
+        def loss_of(p, batch, stepno, mbidx):
             # route next_key() through a per-step traced key so dropout
             # masks change every step (a bare next_key() during tracing
-            # would bake ONE host key in as a constant)
+            # would bake ONE host key in as a constant); fold the
+            # microbatch index in too so grad-accum microbatches don't
+            # share one dropout mask
             from .utils.rng import key_context
             key = jax.random.fold_in(jax.random.PRNGKey(args.seed), stepno)
+            key = jax.random.fold_in(key, mbidx)
             with key_context(key):
                 return self.loss_fn(fn, p, batch)
 
-        def scaled_loss(p, mb, sstate, stepno):
-            loss = loss_of(p, mb, stepno)
+        def scaled_loss(p, mb, sstate, stepno, mbidx):
+            loss = loss_of(p, mb, stepno, mbidx)
             scaled = scaler.scale(loss, sstate) if scaler else loss
             return scaled, loss
 
@@ -198,20 +201,23 @@ class Trainer:
             frozen = {k: v for k, v in params.items() if k not in tkeys}
             tp = {k: v for k, v in params.items() if k in tkeys}
             vg = jax.value_and_grad(
-                lambda t, b, ss: scaled_loss({**frozen, **t}, b, ss, stepno),
+                lambda t, b, ss, mi: scaled_loss({**frozen, **t}, b, ss,
+                                                 stepno, mi),
                 has_aux=True)
             if accum == 1:
-                (_, loss), grads = vg(tp, batch, sstate)
+                (_, loss), grads = vg(tp, batch, sstate, jnp.int32(0))
             else:
                 # batch leading dim = accum: scan microbatches, mean grads
-                # (dropout masks vary per step via stepno; within one
-                # step's scan the microbatches share a mask)
-                def micro(carry, mb):
+                # (dropout masks vary per step via stepno AND per
+                # microbatch via the scanned index)
+                def micro(carry, xs):
+                    mi, mb = xs
                     gsum, lsum = carry
-                    (_, l), g = vg(tp, mb, sstate)
+                    (_, l), g = vg(tp, mb, sstate, mi)
                     return (jax.tree.map(jnp.add, gsum, g), lsum + l), None
                 zeros = jax.tree.map(jnp.zeros_like, tp)
-                (gsum, lsum), _ = jax.lax.scan(micro, (zeros, 0.0), batch)
+                (gsum, lsum), _ = jax.lax.scan(
+                    micro, (zeros, 0.0), (jnp.arange(accum), batch))
                 grads = jax.tree.map(lambda g: g / accum, gsum)
                 loss = lsum / accum
             if scaler is None:

@@ -72,7 +72,11 @@ def compute_sequence_logps(model, input_ids, loss_mask, batch_size: int = 8):
     model.eval()
     try:
         fn, params = model.functional()
-        jf = jax.jit(lambda p, ids, m: sequence_logps(fn(p, ids), ids, m))
+        jf = getattr(model, "_seq_logps_jit", None)
+        if jf is None:
+            jf = jax.jit(
+                lambda p, ids, m: sequence_logps(fn(p, ids), ids, m))
+            model._seq_logps_jit = jf
         outs = []
         for i in range(0, input_ids.shape[0], batch_size):
             outs.append(jf(params, input_ids[i:i + batch_size],
