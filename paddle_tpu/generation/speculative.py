@@ -67,16 +67,17 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
     per_draft = _SPEC_CACHE.setdefault(
         target, weakref.WeakKeyDictionary())
     per_key = per_draft.setdefault(draft, {})
+    def _stats(nfwd, n_end):
+        # emitted counts actual tokens (EOS can stop early) so the
+        # tokens-per-forward speedup figure is not overstated
+        emitted = min(int(n_end), total) - prompt_len
+        return {"target_forwards": int(nfwd), "emitted_tokens": emitted,
+                "tokens_per_forward": emitted / max(int(nfwd), 1)}
+
     cached = per_key.get(cache_key)
     if cached is not None:
         out, nfwd, n_end = cached(t_params, d_params, input_ids)
-        if return_stats:
-            emitted = min(int(n_end), total) - prompt_len
-            return out, {"target_forwards": int(nfwd),
-                         "emitted_tokens": emitted,
-                         "tokens_per_forward":
-                         emitted / max(int(nfwd), 1)}
-        return out
+        return (out, _stats(nfwd, n_end)) if return_stats else out
 
     @jax.jit
     def run(t_params, d_params, input_ids):
@@ -159,11 +160,4 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
 
     per_key[cache_key] = run
     out, nfwd, n_end = run(t_params, d_params, input_ids)
-    if return_stats:
-        # emitted counts actual tokens (EOS can stop early) so the
-        # tokens-per-forward speedup figure is not overstated
-        emitted = min(int(n_end), total) - prompt_len
-        return out, {"target_forwards": int(nfwd),
-                     "emitted_tokens": emitted,
-                     "tokens_per_forward": emitted / max(int(nfwd), 1)}
-    return out
+    return (out, _stats(nfwd, n_end)) if return_stats else out

@@ -17,7 +17,6 @@ MLA, TPU-native:
 """
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
@@ -31,7 +30,8 @@ from ..parallel.layers import (ColumnParallelLinear, RowParallelLinear,
 from ..parallel.moe import MoEMLP
 from ..parallel.sharding import constraint
 from .base import CausalLMBase
-from .llama import LlamaConfig, LlamaMLP, causal_lm_loss  # noqa: F401
+from .llama import (LlamaConfig, LlamaMLP, causal_lm_loss,  # noqa: F401
+                    yarn_get_mscale, yarn_params)
 
 
 @dataclass
@@ -88,58 +88,6 @@ def deepseek_v2_tiny(**overrides) -> DeepseekV2Config:
                 dtype=jnp.float32)
     base.update(overrides)
     return DeepseekV2Config(**base)
-
-
-def yarn_get_mscale(scale: float, mscale: float = 1.0) -> float:
-    """YaRN attention magnitude factor (one definition, used by both the
-    frequency table and V3's softmax-scale adjustment)."""
-    return 1.0 if scale <= 1 else 0.1 * mscale * math.log(scale) + 1.0
-
-
-def yarn_params(dim: int, theta: float, rope_scaling: Dict[str, Any],
-                max_position_embeddings: int):
-    """YaRN context extension (Peng et al. 2023; matches transformers'
-    _compute_yarn_parameters exactly): per-frequency blend between
-    interpolated (factor-divided) and extrapolated frequencies via a
-    linear ramp over the correction range, plus the attention factor
-    that scales cos/sin magnitudes (HF folds mscale there, which scales
-    q_pe . k_pe by attention_factor^2)."""
-    import numpy as np
-    factor = rope_scaling["factor"]
-    attention_factor = rope_scaling.get("attention_factor")
-    mscale = rope_scaling.get("mscale")
-    mscale_all_dim = rope_scaling.get("mscale_all_dim")
-    orig = (rope_scaling.get("original_max_position_embeddings")
-            or max_position_embeddings)
-
-    if attention_factor is None:
-        if mscale and mscale_all_dim:
-            attention_factor = float(yarn_get_mscale(factor, mscale)
-                                     / yarn_get_mscale(factor,
-                                                       mscale_all_dim))
-        else:
-            attention_factor = yarn_get_mscale(factor)
-    beta_fast = rope_scaling.get("beta_fast") or 32
-    beta_slow = rope_scaling.get("beta_slow") or 1
-
-    def correction_dim(num_rot):
-        return (dim * math.log(orig / (num_rot * 2 * math.pi))
-                / (2 * math.log(theta)))
-
-    low, high = correction_dim(beta_fast), correction_dim(beta_slow)
-    if rope_scaling.get("truncate", True):
-        low, high = math.floor(low), math.ceil(high)
-    low, high = max(low, 0), min(high, dim - 1)
-    if low == high:
-        high += 0.001
-    ramp = np.clip((np.arange(dim // 2, dtype=np.float32) - low)
-                   / (high - low), 0, 1)
-    pos_freqs = theta ** (np.arange(0, dim, 2, dtype=np.float32) / dim)
-    inv_extra = 1.0 / pos_freqs
-    inv_inter = 1.0 / (factor * pos_freqs)
-    extra_factor = 1.0 - ramp
-    inv_freq = inv_inter * (1 - extra_factor) + inv_extra * extra_factor
-    return jnp.asarray(inv_freq), float(attention_factor)
 
 
 def rope_interleaved(x, positions, theta: float, inv_freq=None,

@@ -318,6 +318,20 @@ def _jax_dtype(hf: Dict[str, Any]):
     return jnp.bfloat16
 
 
+def _rope_scaling_cfg(hf, mt):
+    """Validate/normalize HF rope_scaling for the Llama-family loaders;
+    the model side dispatches via llama.rope_params_from_scaling."""
+    rs_cfg = hf.get("rope_scaling")
+    if not rs_cfg:
+        return None
+    from .llama import ROPE_SCALING_TYPES
+    rtype = rs_cfg.get("rope_type", rs_cfg.get("type"))
+    if rtype not in ROPE_SCALING_TYPES:
+        raise ValueError(f"rope_scaling type {rtype!r} not supported "
+                         f"for {mt} ({'/'.join(ROPE_SCALING_TYPES)} are)")
+    return None if rtype == "default" else rs_cfg
+
+
 def config_from_hf(model_dir: str):
     """Map an HF ``config.json`` to our config dataclass + model class."""
     with open(os.path.join(model_dir, "config.json")) as f:
@@ -333,15 +347,7 @@ def config_from_hf(model_dir: str):
         from .qwen2 import Qwen2Config, Qwen2ForCausalLM
         cls, ccls = ((Qwen2ForCausalLM, Qwen2Config) if mt == "qwen2"
                      else (LlamaForCausalLM, LlamaConfig))
-        rs_cfg = hf.get("rope_scaling")
-        if rs_cfg:
-            rtype = rs_cfg.get("rope_type", rs_cfg.get("type"))
-            if rtype not in ("llama3", "default"):
-                raise ValueError(
-                    f"rope_scaling type {rtype!r} not supported for "
-                    f"{mt} (llama3 is)")
-            if rtype != "llama3":
-                rs_cfg = None
+        rs_cfg = _rope_scaling_cfg(hf, mt)
         cfg = ccls(
             **common,
             intermediate_size=hf["intermediate_size"],
@@ -372,15 +378,7 @@ def config_from_hf(model_dir: str):
                 "decoder_sparse_step > 1 / mlp_only_layers are not "
                 "supported (this build places MoE on every layer past "
                 "first_k_dense_replace)")
-        rs_cfg = hf.get("rope_scaling")
-        if rs_cfg:
-            rtype = rs_cfg.get("rope_type", rs_cfg.get("type"))
-            if rtype not in ("llama3", "default"):
-                raise ValueError(
-                    f"rope_scaling type {rtype!r} not supported for "
-                    f"{mt} (llama3 is)")
-            if rtype != "llama3":
-                rs_cfg = None
+        rs_cfg = _rope_scaling_cfg(hf, mt)
         n_shared = hf.get("shared_expert_intermediate_size") or 0
         cfg = ccls(
             **common,

@@ -118,17 +118,105 @@ def llama3_inv_freq(head_dim: int, theta: float,
     return jnp.asarray(np.where(medium, smoothed, out))
 
 
+def yarn_get_mscale(scale: float, mscale: float = 1.0) -> float:
+    """YaRN attention magnitude factor (one definition, used by both the
+    frequency table and DeepSeek-V3's softmax-scale adjustment)."""
+    return 1.0 if scale <= 1 else 0.1 * mscale * math.log(scale) + 1.0
+
+
+def yarn_params(dim: int, theta: float, rope_scaling: "Dict[str, Any]",
+                max_position_embeddings: int):
+    """YaRN context extension (Peng et al. 2023; matches transformers'
+    _compute_yarn_parameters exactly): per-frequency blend between
+    interpolated (factor-divided) and extrapolated frequencies via a
+    linear ramp over the correction range, plus the attention factor
+    that scales cos/sin magnitudes (HF folds mscale there, which scales
+    q . k by attention_factor^2). Convention-agnostic: the returned
+    inv_freq table indexes frequency i in [0, dim/2), valid for both
+    rotate-half (Llama/Qwen) and interleaved (DeepSeek) RoPE."""
+    import numpy as np
+    factor = rope_scaling["factor"]
+    attention_factor = rope_scaling.get("attention_factor")
+    mscale = rope_scaling.get("mscale")
+    mscale_all_dim = rope_scaling.get("mscale_all_dim")
+    orig = (rope_scaling.get("original_max_position_embeddings")
+            or max_position_embeddings)
+
+    if attention_factor is None:
+        if mscale and mscale_all_dim:
+            attention_factor = float(yarn_get_mscale(factor, mscale)
+                                     / yarn_get_mscale(factor,
+                                                       mscale_all_dim))
+        else:
+            attention_factor = yarn_get_mscale(factor)
+    beta_fast = rope_scaling.get("beta_fast") or 32
+    beta_slow = rope_scaling.get("beta_slow") or 1
+
+    def correction_dim(num_rot):
+        return (dim * math.log(orig / (num_rot * 2 * math.pi))
+                / (2 * math.log(theta)))
+
+    low, high = correction_dim(beta_fast), correction_dim(beta_slow)
+    if rope_scaling.get("truncate", True):
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, dim - 1)
+    if low == high:
+        high += 0.001
+    ramp = np.clip((np.arange(dim // 2, dtype=np.float32) - low)
+                   / (high - low), 0, 1)
+    pos_freqs = theta ** (np.arange(0, dim, 2, dtype=np.float32) / dim)
+    inv_extra = 1.0 / pos_freqs
+    inv_inter = 1.0 / (factor * pos_freqs)
+    extra_factor = 1.0 - ramp
+    inv_freq = inv_inter * (1 - extra_factor) + inv_extra * extra_factor
+    return jnp.asarray(inv_freq), float(attention_factor)
+
+
+ROPE_SCALING_TYPES = ("llama3", "yarn", "linear", "default")
+
+
+def rope_params_from_scaling(head_dim: int, theta: float,
+                             rope_scaling: "Optional[Dict[str, Any]]",
+                             max_position_embeddings: int):
+    """HF ``rope_scaling`` dict -> (inv_freq override or None,
+    attention_scaling). Dispatches on type: llama3 (3.1 wavelength
+    interpolation), yarn, linear (positional interpolation), default.
+    Reference: transformers modeling_rope_utils ROPE_INIT_FUNCTIONS."""
+    if not rope_scaling:
+        return None, 1.0
+    rtype = rope_scaling.get("rope_type", rope_scaling.get("type",
+                                                           "default"))
+    if rtype == "default":
+        return None, 1.0
+    if rtype == "llama3":
+        return llama3_inv_freq(head_dim, theta, rope_scaling), 1.0
+    if rtype == "yarn":
+        return yarn_params(head_dim, theta, rope_scaling,
+                           max_position_embeddings)
+    if rtype == "linear":
+        inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                          dtype=jnp.float32) / head_dim))
+        return inv / rope_scaling["factor"], 1.0
+    raise ValueError(f"rope_scaling type {rtype!r} not supported "
+                     f"({'/'.join(ROPE_SCALING_TYPES)} are)")
+
+
 def rotary_cos_sin(positions, head_dim: int, theta: float, dtype,
-                   inv_freq=None):
+                   inv_freq=None, attention_scaling: float = 1.0):
     """positions [b, s] -> (cos, sin) [b, s, 1, head_dim/2], fp32 math.
-    ``inv_freq`` overrides the plain schedule (Llama-3.1 scaling)."""
+    ``inv_freq`` overrides the plain schedule (Llama-3.1 / yarn / linear
+    scaling); ``attention_scaling`` multiplies the magnitudes (YaRN's
+    mscale — scales q.k by its square, as transformers does)."""
     if inv_freq is None:
         inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
                                                dtype=jnp.float32)
                                     / head_dim))
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [b,s,hd/2]
-    return (jnp.cos(angles)[:, :, None, :].astype(dtype),
-            jnp.sin(angles)[:, :, None, :].astype(dtype))
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    if attention_scaling != 1.0:
+        cos, sin = cos * attention_scaling, sin * attention_scaling
+    return (cos[:, :, None, :].astype(dtype),
+            sin[:, :, None, :].astype(dtype))
 
 
 def apply_rotary(x, cos, sin):
@@ -148,9 +236,9 @@ class LlamaAttention(Layer):
                        if getattr(config, "sliding_window", None) is not None
                        and (mwl is None or layer_idx >= mwl) else None)
         rs = getattr(config, "rope_scaling", None)
-        self._inv_freq = (llama3_inv_freq(config.head_dim,
-                                          config.rope_theta, rs)
-                          if rs else None)
+        self._inv_freq, self._attn_scaling = rope_params_from_scaling(
+            config.head_dim, config.rope_theta, rs,
+            config.max_position_embeddings)
         h, kv = config.num_attention_heads, config.num_key_value_heads
         d = config.head_dim
         qkv_bias = config.attention_bias
@@ -177,7 +265,8 @@ class LlamaAttention(Layer):
         k = self.k_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
         v = self.v_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
         cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
-                                  q.dtype, inv_freq=self._inv_freq)
+                                  q.dtype, inv_freq=self._inv_freq,
+                                  attention_scaling=self._attn_scaling)
         q, k = apply_rotary(q, cos, sin), apply_rotary(k, cos, sin)
         # heads sharded over tp
         q = constraint(q, None, None, "tp", None)

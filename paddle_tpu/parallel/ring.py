@@ -44,25 +44,49 @@ def _block_pv(p, v, h):
 
 
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, segment_ids=None,
+                   window: Optional[int] = None):
     """Blockwise ring attention. Call inside shard_map with q/k/v
     [b, s_local, h|kvh, d] sharded on the sequence dim over `axis_name`.
-    Returns [b, s_local, h, d] (the local Q block's full attention)."""
+    Returns [b, s_local, h, d] (the local Q block's full attention).
+
+    ``segment_ids`` [b, s_local] (the LOCAL shard of the packed-sequence
+    ids, same convention as the flash kernel: attention only within equal
+    ids) rotates around the ring alongside K/V, so packed SFT composes
+    with context parallelism. ``window`` (requires causal) keeps only the
+    trailing ``window`` keys per query — sliding-window attention under
+    sp. Positions are global (block index * s_local + offset), so both
+    masks are exact across shard boundaries."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding-window "
+                         "attention narrows the causal band)")
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, s, h, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    has_seg = segment_ids is not None
+    sc0 = (jnp.asarray(segment_ids, jnp.int32) if has_seg
+           else jnp.zeros((b, 0), jnp.int32))  # empty: nothing to rotate
 
     def tick(carry, step):
-        o, m, l, kc, vc = carry
+        o, m, l, kc, vc, sc = carry
         kv_idx = (idx - step) % n  # whose sequence block we currently hold
         s_scores = _block_scores(q, kc, scale)  # [b,h,sq,sk]
-        if causal:
+        if causal or has_seg:
             qpos = idx * s + jnp.arange(s)[:, None]
             kpos = kv_idx * s + jnp.arange(s)[None, :]
-            mask = (kpos <= qpos)[None, None]
-            s_scores = jnp.where(mask, s_scores, NEG_INF)
+            if causal:
+                keep = kpos <= qpos
+                if window is not None:
+                    keep &= qpos - kpos < window
+            else:
+                keep = jnp.ones((s, s), bool)
+            keep = keep[None, None]                      # [1,1,sq,sk]
+            if has_seg:
+                keep = keep & (segment_ids[:, None, :, None]
+                               == sc[:, None, None, :])  # [b,1,sq,sk]
+            s_scores = jnp.where(keep, s_scores, NEG_INF)
         m_new = jnp.maximum(m, s_scores.max(axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s_scores - m_new[..., None])
@@ -72,12 +96,15 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
             + pv.astype(o.dtype)
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return (o_new, m_new, l_new, kc, vc), None
+        if has_seg:
+            sc = lax.ppermute(sc, axis_name, perm)
+        return (o_new, m_new, l_new, kc, vc, sc), None
 
     o0 = jnp.zeros((b, s, h, d), jnp.float32)
     m0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s), jnp.float32)
-    (o, m, l, _, _), _ = lax.scan(tick, (o0, m0, l0, k, v), jnp.arange(n))
+    (o, m, l, _, _, _), _ = lax.scan(tick, (o0, m0, l0, k, v, sc0),
+                                     jnp.arange(n))
     denom = jnp.swapaxes(l, 1, 2)[..., None]  # [b,sq,h,1]
     return (o / jnp.maximum(denom, 1e-20)).astype(q.dtype)
 

@@ -255,3 +255,59 @@ def test_llama31_rope_scaling_logits_match(tmp_module):
     del plain
     model2 = from_pretrained(d2)
     assert model2.model.layers[0].self_attn._inv_freq is None
+
+
+def test_yarn_rope_scaling_logits_match(tmp_module):
+    """YaRN context extension for the Llama/Qwen2 family (VERDICT r3
+    item 7): a long-context checkpoint with rope_scaling type 'yarn'
+    must load and match transformers' _compute_yarn_parameters logits
+    past the original window (yarn blends interpolated/extrapolated
+    frequencies AND scales attention by mscale^2)."""
+    cfg = _llama_cfg(max_position_embeddings=256, rope_theta=10000.0,
+                     rope_scaling={"rope_type": "yarn", "factor": 8.0,
+                                   "original_max_position_embeddings": 32})
+    hf_model, d = _save_hf(tmp_module / "llama_yarn",
+                           transformers.LlamaForCausalLM, cfg)
+    model = from_pretrained(d)
+    attn = model.model.layers[0].self_attn
+    assert attn._inv_freq is not None and attn._attn_scaling > 1.0
+    ids = np.random.RandomState(11).randint(0, 128, (2, 64))  # > orig 32
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_qwen2_yarn_long_context_loads(tmp_module):
+    """Long-context Qwen2 checkpoints (e.g. Qwen2-*-128k) ship yarn
+    rope_scaling; hf_interop used to hard-reject them."""
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 64},
+        tie_word_embeddings=False, torch_dtype="float32",
+        attn_implementation="eager")
+    hf_model, d = _save_hf(tmp_module / "qwen2_yarn",
+                           transformers.Qwen2ForCausalLM, cfg)
+    model = from_pretrained(d)
+    ids = np.random.RandomState(3).randint(0, 128, (1, 96))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_linear_rope_scaling_logits_match(tmp_module):
+    """Positional-interpolation ('linear') rope_scaling parity."""
+    cfg = _llama_cfg(max_position_embeddings=256,
+                     rope_scaling={"rope_type": "linear", "factor": 4.0})
+    hf_model, d = _save_hf(tmp_module / "llama_linear",
+                           transformers.LlamaForCausalLM, cfg)
+    model = from_pretrained(d)
+    ids = np.random.RandomState(5).randint(0, 128, (2, 48))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
