@@ -110,13 +110,17 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
-                      scale: Optional[float] = None, attn_fn=None):
+                      scale: Optional[float] = None, attn_fn=None,
+                      segment_ids=None, window: Optional[int] = None):
     """DeepSpeed-Ulysses sequence parallelism (reference: sep_degree path):
     all_to_all trades the sequence shard for a head shard, runs ordinary
     (full-sequence) attention on h/n heads, and trades back. Cheaper than
-    ring when heads >= sp degree; requires num_heads % sp == 0."""
-    from ..ops.attention import dense_attention
-    attn_fn = attn_fn or functools.partial(dense_attention, scale=scale)
+    ring when heads >= sp degree; requires num_heads % sp == 0.
+
+    ``segment_ids`` is the LOCAL [b, s/n] shard (all-gathered to the full
+    sequence, since each device sees every position after the swap);
+    ``window`` narrows the causal band (sliding-window attention)."""
+    from ..ops.attention import dense_attention, segment_mask
     n = lax.axis_size(axis_name)
 
     def swap_in(x):   # [b, s/n, h, d] -> [b, s, h/n, d]
@@ -127,16 +131,25 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
+    kw = {}
+    if segment_ids is not None:
+        seg_full = lax.all_gather(jnp.asarray(segment_ids, jnp.int32),
+                                  axis_name, axis=1, tiled=True)
+        kw["attn_mask"] = segment_mask(seg_full)
+    if window is not None:
+        kw["window"] = window
+    attn_fn = attn_fn or functools.partial(dense_attention, scale=scale)
     kvh = k.shape[2]
     if kvh < n:  # too few KV heads to split: replicate them up to sp degree
         k = jnp.repeat(k, n // math.gcd(n, kvh), axis=2)
         v = jnp.repeat(v, n // math.gcd(n, kvh), axis=2)
-    out = attn_fn(swap_in(q), swap_in(k), swap_in(v), causal=causal)
+    out = attn_fn(swap_in(q), swap_in(k), swap_in(v), causal=causal, **kw)
     return swap_out(out)
 
 
 def ring_flash_attention(q, k, v, axis_name: str = "sp",
-                         causal: bool = False, scale: Optional[float] = None):
+                         causal: bool = False, scale: Optional[float] = None,
+                         segment_ids=None, window: Optional[int] = None):
     """Ring attention with the Pallas flash kernel doing each block pair
     (reference semantics identical to `ring_attention`; this is the fast
     path for long sequences on TPU).
@@ -151,7 +164,17 @@ def ring_flash_attention(q, k, v, axis_name: str = "sp",
 
     Note: call inside `shard_map(..., check_vma=False)` — pallas_call
     does not yet declare varying-across-mesh info for its outputs.
+
+    ``segment_ids``/``window`` route to the online-softmax block path
+    (`ring_attention`): the per-block flash kernel has no cross-shard
+    position offset, so the masked variants use the dense block pairs —
+    per-device blocks are modest (s/n) and XLA fuses them; the flash
+    fast path covers the plain/causal long-context case.
     """
+    if segment_ids is not None or window is not None:
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                              scale=scale, segment_ids=segment_ids,
+                              window=window)
     from ..ops.pallas.flash_attention import flash_attention_with_lse
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)

