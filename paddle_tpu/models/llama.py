@@ -308,21 +308,28 @@ class LlamaAttention(Layer):
                     mask = mask & (pad_ok | self_ok)[:, None]  # [b,1,s,T]
                 out = dense_attention(q, ck, cv, attn_mask=mask)
         elif cfg.sequence_parallel and attn_mask is None and \
-                segment_ids is None and self.window is None and \
                 self._sp_degree() > 1:
-            # (segment_ids and sliding windows fall through to the
-            # segment/window-aware paths below: the ring KV rotation has
-            # neither masking)
-            # ring attention: seq stays sp-sharded; KV blocks rotate on ICI
+            # ring attention: seq stays sp-sharded; KV blocks rotate on
+            # ICI. segment_ids (packed SFT) rotate with the KV blocks and
+            # a sliding window narrows the causal band with GLOBAL
+            # positions — both compose with context parallelism.
             import functools
             from jax.sharding import PartitionSpec as P
             from ..distributed.env import get_mesh
             from ..parallel.ring import ring_attention
             spec = P(("dp", "fsdp"), "sp", "tp", None)
-            out = jax.shard_map(
-                functools.partial(ring_attention, axis_name="sp", causal=True),
-                mesh=get_mesh(), in_specs=(spec,) * 3, out_specs=spec,
-                check_vma=False)(q, k, v)
+            ring = functools.partial(ring_attention, axis_name="sp",
+                                     causal=True, window=self.window)
+            if segment_ids is not None:
+                sspec = P(("dp", "fsdp"), "sp")
+                out = jax.shard_map(
+                    lambda q, k, v, seg: ring(q, k, v, segment_ids=seg),
+                    mesh=get_mesh(), in_specs=(spec,) * 3 + (sspec,),
+                    out_specs=spec, check_vma=False)(q, k, v, segment_ids)
+            else:
+                out = jax.shard_map(
+                    ring, mesh=get_mesh(), in_specs=(spec,) * 3,
+                    out_specs=spec, check_vma=False)(q, k, v)
         elif cfg.use_flash_attention and attn_mask is None and use_flash(q, k, None, 0.0):
             # segment_ids ride the flash kernel (packed sequences): the
             # same-segment mask applies inside the online softmax; a
@@ -565,9 +572,24 @@ def llama_pipeline_functional(model: "LlamaForCausalLM", pp: int,
                      for k, v in pp_grads["head"]["lm"].items()})
         return flat
 
+    # MoE decoder layers return (x, aux_loss); the pipeline threads the
+    # aux term through each stage's own backward (pp x ep composition)
+    probe = jax.eval_shape(
+        lambda lp: layer_fn(lp, jnp.zeros((1, 8, cfg.hidden_size)),
+                            jnp.zeros((1, 8), jnp.int32)), layer_p0)
+    layer_has_aux = isinstance(probe, (tuple, list))
+
     def stage_fn(sp, x):
         b, sl = x.shape[0], x.shape[1]
         positions = jnp.broadcast_to(jnp.arange(sl)[None, :], (b, sl))
+
+        if layer_has_aux:
+            def one(carry, lp):
+                xx, aux = carry
+                yy, a = layer_fn(lp, xx, positions)
+                return (yy, aux + a), None
+            (y, aux), _ = _lax.scan(one, (x, jnp.float32(0.0)), sp)
+            return y, aux
 
         def one(xx, lp):
             return layer_fn(lp, xx, positions), None

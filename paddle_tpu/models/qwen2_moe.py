@@ -195,6 +195,21 @@ class Qwen2MoeForCausalLM(CausalLMBase):
             return (logits, aux, caches) if return_aux else (logits, caches)
         return (logits, aux) if return_aux else logits
 
+    def pipeline_functional(self, pp: int, logits_loss=None, vpp: int = 1):
+        """1F1B pipeline over ``pp`` stages, composed with expert
+        parallelism: the MoE layers' aux loss rides each stage's own
+        backward (reference: fleet pp+ep hybrid topology). Requires
+        uniform layers (first_k_dense_replace == 0) so stage params
+        stack."""
+        if self.config.first_k_dense_replace:
+            raise ValueError(
+                "pipeline_functional needs uniform MoE layers "
+                "(first_k_dense_replace=0): dense and MoE layer params "
+                "cannot stack into one [pp, n_per, ...] tree")
+        from .llama import llama_pipeline_functional
+        return llama_pipeline_functional(self, pp, logits_loss=logits_loss,
+                                         vpp=vpp)
+
 
 def moe_lm_loss(logits, aux_loss, labels, ignore_index: int = -100):
     """Next-token CE + router balancing aux loss."""

@@ -167,7 +167,7 @@ def pipeline_value_and_grad(embed_fn: Callable, stage_fn: Callable,
 
     def run(params, tokens, labels):
         m = mesh or get_mesh()
-        validate_pp_mesh(m, axis_name, dp_axis)
+        validate_pp_mesh(m, axis_name)
         pp = n_stages
         stage_specs = jax.tree.map(lambda _: P(axis_name), params["stages"])
         in_specs = ({"embed": jax.tree.map(lambda _: P(), params["embed"]),
@@ -190,6 +190,14 @@ def pipeline_value_and_grad(embed_fn: Callable, stage_fn: Callable,
 
             x_sd = jax.eval_shape(embed_fn, eparams, toks[0])
             xdt = x_sd.dtype
+            # MoE stages return (y, aux_loss): every stage seeds its OWN
+            # aux cotangent at its backward tick (the router-balancing
+            # term is per-layer, so total = CE + psum(aux) and the dx
+            # chain upstream already carries d aux/dx) — this is how
+            # pp composes with ep without shipping aux to the last stage.
+            out_sd = jax.eval_shape(stage_fn, sparams,
+                                    jax.ShapeDtypeStruct(x_sd.shape, xdt))
+            has_aux = isinstance(out_sd, (tuple, list))
             zeros_h = jax.tree.map(jnp.zeros_like, hparams)
             zeros_e = jax.tree.map(jnp.zeros_like, eparams)
 
@@ -206,6 +214,8 @@ def pipeline_value_and_grad(embed_fn: Callable, stage_fn: Callable,
                     lambda: jnp.zeros(x_sd.shape, xdt))
                 x_in = jnp.where(is_first, x0, c["recv_f"])
                 y = stage_fn(sparams, x_in)
+                if has_aux:
+                    y = y[0]
                 y = jnp.where(live_f, y, jnp.zeros_like(y))
                 slot_f = mf_c % K
                 old = lax.dynamic_index_in_dim(c["xbuf"], slot_f, 0,
@@ -222,7 +232,12 @@ def pipeline_value_and_grad(embed_fn: Callable, stage_fn: Callable,
                 tok_b = lax.dynamic_index_in_dim(toks, mb_c, 0, keepdims=False)
                 lab_b = lax.dynamic_index_in_dim(labs, mb_c, 0, keepdims=False)
                 # per-stage remat: recompute fwd, get the stage vjp
-                y_b, stage_vjp = jax.vjp(stage_fn, sparams, x_sv)
+                if has_aux:
+                    (y_b, aux_b), stage_vjp = jax.vjp(stage_fn, sparams,
+                                                      x_sv)
+                else:
+                    y_b, stage_vjp = jax.vjp(stage_fn, sparams, x_sv)
+                    aux_b = jnp.float32(0.0)
 
                 # only the LAST stage pays the [h x V] head matmul + CE
                 def head_branch():
@@ -238,7 +253,10 @@ def pipeline_value_and_grad(embed_fn: Callable, stage_fn: Callable,
                     lambda: (jnp.float32(0.0), zeros_h,
                              jnp.zeros(x_sd.shape, xdt)))
                 dy = jnp.where(is_last, dy_head, c["recv_b"])
-                g_st_m, dx = stage_vjp(dy)
+                if has_aux:
+                    g_st_m, dx = stage_vjp((dy, jnp.ones((), aux_b.dtype)))
+                else:
+                    g_st_m, dx = stage_vjp(dy)
 
                 # only stage 0 pays the embedding backward
                 def embed_branch():
@@ -252,8 +270,10 @@ def pipeline_value_and_grad(embed_fn: Callable, stage_fn: Callable,
                     g_st=_tree_add_where(live_b, c["g_st"], g_st_m),
                     g_h=_tree_add_where(live_b & is_last, c["g_h"], g_h_m),
                     g_e=_tree_add_where(live_b & is_first, c["g_e"], g_e_m),
-                    loss=c["loss"] + jnp.where(live_b & is_last,
-                                               loss_m, 0.0),
+                    # CE lands at the last stage; each stage adds its own
+                    # (already-weighted) router aux at its backward tick
+                    loss=c["loss"] + jnp.where(live_b & is_last, loss_m, 0.0)
+                    + jnp.where(live_b, aux_b.astype(jnp.float32), 0.0),
                     # ring handoffs: activations downstream, cotangents up
                     recv_f=lax.ppermute(y, axis_name,
                                         [(i, (i + 1) % pp) for i in range(pp)]),
@@ -293,12 +313,12 @@ def pipeline_value_and_grad(embed_fn: Callable, stage_fn: Callable,
     return run
 
 
-def validate_pp_mesh(mesh, axis_name: str = "pp", dp_axis: str = "dp"):
+def validate_pp_mesh(mesh, axis_name: str = "pp"):
     """The 1F1B body is manual over ``pp`` with every other axis left to
-    GSPMD — tp/sp/fsdp/dp compose. Expert parallelism's capacity-bucketed
-    all_to_all inside a stage is the one remaining exclusion."""
-    if mesh.shape.get("ep", 1) > 1:
-        raise ValueError(
-            "pipeline_value_and_grad does not compose with expert "
-            "parallelism (ep); run MoE models under GSPMD pipelining "
-            "or an ep-only mesh")
+    GSPMD — tp/sp/fsdp/dp AND ep compose: expert parallelism is pure
+    GSPMD (capacity-bucketed dispatch under `constraint` hints, XLA
+    inserts the ep all_to_all inside each stage), and MoE stages'
+    router-aux term rides the per-stage backward (see the has_aux path
+    in pipeline_value_and_grad)."""
+    if axis_name not in mesh.shape:
+        raise ValueError(f"mesh has no {axis_name!r} axis: {mesh.shape}")

@@ -140,6 +140,12 @@ def interleaved_pipeline_value_and_grad(
 
             x_sd = jax.eval_shape(embed_fn, eparams, toks[0])
             xdt = x_sd.dtype
+            # MoE chunks return (y, aux): same per-stage aux seeding as
+            # pipeline_value_and_grad (pp x ep composition)
+            out_sd = jax.eval_shape(
+                chunk_fn, jax.tree.map(lambda p: p[0], cparams),
+                jax.ShapeDtypeStruct(x_sd.shape, xdt))
+            has_aux = isinstance(out_sd, (tuple, list))
             zeros_h = jax.tree.map(jnp.zeros_like, hparams)
             zeros_e = jax.tree.map(jnp.zeros_like, eparams)
 
@@ -162,6 +168,8 @@ def interleaved_pipeline_value_and_grad(
                     lambda: jnp.zeros(x_sd.shape, xdt))
                 x_in = jnp.where(first_stage, x0, c["recv_f"])
                 y = chunk_fn(chunk_at(fc), x_in)
+                if has_aux:
+                    y = y[0]
                 y = jnp.where(flive, y, jnp.zeros_like(y))
                 slot_f = fm_c % K
                 old = c["xbuf"][fc, slot_f]
@@ -173,7 +181,12 @@ def interleaved_pipeline_value_and_grad(
                 x_sv = xbuf[bc, bm_c % K]
                 lab_b = lax.dynamic_index_in_dim(labs, bm_c, 0,
                                                  keepdims=False)
-                y_b, chunk_vjp = jax.vjp(chunk_fn, chunk_at(bc), x_sv)
+                if has_aux:
+                    (y_b, aux_b), chunk_vjp = jax.vjp(chunk_fn,
+                                                      chunk_at(bc), x_sv)
+                else:
+                    y_b, chunk_vjp = jax.vjp(chunk_fn, chunk_at(bc), x_sv)
+                    aux_b = jnp.float32(0.0)
 
                 last_stage = is_last_dev & (bc == v - 1)
 
@@ -190,7 +203,10 @@ def interleaved_pipeline_value_and_grad(
                     lambda: (jnp.float32(0.0), zeros_h,
                              jnp.zeros(x_sd.shape, xdt)))
                 dy = jnp.where(last_stage, dy_head, c["recv_b"])
-                g_ch_m, dx = chunk_vjp(dy)
+                if has_aux:
+                    g_ch_m, dx = chunk_vjp((dy, jnp.ones((), aux_b.dtype)))
+                else:
+                    g_ch_m, dx = chunk_vjp(dy)
 
                 first_bwd = is_dev0 & (bc == 0)
 
@@ -212,8 +228,9 @@ def interleaved_pipeline_value_and_grad(
                     g_st=g_st,
                     g_h=_tree_add_where(blive & last_stage, c["g_h"], g_h_m),
                     g_e=_tree_add_where(blive & first_bwd, c["g_e"], g_e_m),
-                    loss=c["loss"] + jnp.where(blive & last_stage,
-                                               loss_m, 0.0),
+                    loss=c["loss"] + jnp.where(blive & last_stage, loss_m,
+                                               0.0)
+                    + jnp.where(blive, aux_b.astype(jnp.float32), 0.0),
                     recv_f=lax.ppermute(
                         y, axis_name,
                         [(i, (i + 1) % pp) for i in range(pp)]),
