@@ -71,6 +71,11 @@ class DeepseekV2Config(LlamaConfig):
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-6
     attention_bias: bool = False
+    # ---- V3 multi-token prediction (HF config name): D extra depth
+    # modules, each predicting one token further ahead. The loss weight
+    # (the paper's lambda, 0.3 early / 0.1 late) is a TRAINING
+    # hyperparameter — pass it to deepseek_mtp_loss, not the config.
+    num_nextn_predict_layers: int = 0
 
     @property
     def qk_head_dim(self) -> int:
@@ -285,6 +290,33 @@ class DeepseekV2DecoderLayer(Layer):
         return (x, new_cache) if kv_cache is not None else x
 
 
+class DeepseekV3MTP(Layer):
+    """One V3 multi-token-prediction depth module (reference: DeepSeek-V3
+    tech report §2.2 / HF checkpoint layout model.layers.{L+k}): RMSNorm
+    the previous depth's hidden and the (k+1)-shifted token embedding,
+    concat, project 2h -> h, run one full (MoE) decoder block. The final
+    norm lives here; the LM head is SHARED with the main model."""
+
+    def __init__(self, config: DeepseekV2Config):
+        super().__init__()
+        h = config.hidden_size
+        self.enorm = nn.RMSNorm(h, config.rms_norm_eps)
+        self.hnorm = nn.RMSNorm(h, config.rms_norm_eps)
+        self.eh_proj = nn.Linear(2 * h, h, bias_attr=False)
+        # MTP blocks are MoE in V3 (they sit past first_k_dense_replace)
+        self.block = DeepseekV2DecoderLayer(config,
+                                            config.num_hidden_layers)
+        self.norm = nn.RMSNorm(h, config.rms_norm_eps)
+        if config.dtype != jnp.float32:
+            self.to(dtype=config.dtype)
+
+    def forward(self, h_prev, emb_next, positions, attn_mask=None):
+        x = self.eh_proj(jnp.concatenate(
+            [self.hnorm(h_prev), self.enorm(emb_next)], axis=-1))
+        x = self.block(x, positions, attn_mask=attn_mask)
+        return self.norm(x)
+
+
 class DeepseekV2Model(Layer):
     def __init__(self, config: DeepseekV2Config):
         super().__init__()
@@ -299,7 +331,8 @@ class DeepseekV2Model(Layer):
             self.to(dtype=config.dtype)
 
     def forward(self, input_ids, positions=None, kv_caches=None,
-                cache_index=None, attn_mask=None, attn_start=None):
+                cache_index=None, attn_mask=None, attn_start=None,
+                return_prenorm: bool = False):
         b, s = input_ids.shape
         if positions is None:
             start = cache_index if cache_index is not None else 0
@@ -318,7 +351,11 @@ class DeepseekV2Model(Layer):
                 new_caches.append(nc)
             else:
                 x = layer(x, positions, attn_mask=attn_mask)
+        pre = x  # the MTP modules consume the PRE-final-norm hidden
         x = self.norm(x)
+        if return_prenorm:
+            return (x, pre, new_caches) if kv_caches is not None \
+                else (x, pre)
         return (x, new_caches) if kv_caches is not None else x
 
 
@@ -332,6 +369,10 @@ class DeepseekV2ForCausalLM(CausalLMBase):
                                             config.vocab_size,
                                             has_bias=False,
                                             gather_output=True)
+        if config.num_nextn_predict_layers > 0:
+            self.mtp = nn.LayerList(
+                [DeepseekV3MTP(config)
+                 for _ in range(config.num_nextn_predict_layers)])
         if config.dtype != jnp.float32:
             self.lm_head.to(dtype=config.dtype)
 
@@ -347,7 +388,47 @@ class DeepseekV2ForCausalLM(CausalLMBase):
                 for _ in range(cfg.num_hidden_layers)]
 
     def forward(self, input_ids, positions=None, kv_caches=None,
-                cache_index=None, attn_mask=None, attn_start=None):
+                cache_index=None, attn_mask=None, attn_start=None,
+                return_mtp: bool = False):
+        """``return_mtp`` (training-time, no cache): additionally return
+        the list of MTP depth logits — depth k's logits[:, i] predict
+        token i+2+k. The MTP chain consumes the pre-final-norm hidden
+        and the (k+1)-shifted token embedding; the LM head is shared."""
+        if return_mtp:
+            if kv_caches is not None:
+                raise ValueError("return_mtp is a training-time path "
+                                 "(no kv cache)")
+            D = self.config.num_nextn_predict_layers
+            if D == 0:
+                raise ValueError("config.num_nextn_predict_layers == 0")
+            out, pre = self.model(input_ids, positions, attn_mask=attn_mask,
+                                  attn_start=attn_start,
+                                  return_prenorm=True)
+            logits = self.lm_head(out).astype(jnp.float32)
+            b, s = input_ids.shape
+            # the MTP blocks see the SAME attention context as the main
+            # stack: per-row shifted positions (left padding) and any
+            # segment/packing mask, sliced to each depth's length
+            if positions is None:
+                positions_full = jnp.arange(s)[None, :].repeat(b, axis=0)
+                if attn_start is not None:
+                    positions_full = jnp.maximum(
+                        positions_full - attn_start[:, None], 0)
+            else:
+                positions_full = positions
+            mtp_logits = []
+            h = pre
+            for k, mod in enumerate(self.mtp):
+                # depth k: h[:, : s-1-k] pairs with emb of tokens shifted
+                # k+1 right; the chained h shrinks by one each depth
+                sl = s - 1 - k
+                emb = self.model.embed_tokens(input_ids[:, k + 1:])
+                am = (None if attn_mask is None
+                      else attn_mask[:, :, :sl, :sl])
+                h = mod(h[:, :sl], emb, positions_full[:, :sl],
+                        attn_mask=am)
+                mtp_logits.append(self.lm_head(h).astype(jnp.float32))
+            return logits, mtp_logits
         out = self.model(input_ids, positions, kv_caches, cache_index,
                          attn_mask, attn_start=attn_start)
         caches = None
@@ -355,3 +436,22 @@ class DeepseekV2ForCausalLM(CausalLMBase):
             out, caches = out
         logits = self.lm_head(out).astype(jnp.float32)
         return (logits, caches) if kv_caches is not None else logits
+
+
+def deepseek_mtp_loss(logits, mtp_logits, labels, weight: float = 0.1,
+                      ignore_index: int = -100):
+    """V3 training objective: main next-token CE plus ``weight`` (the
+    paper's lambda) times the mean over MTP depths of each depth's CE —
+    depth k's logits[:, i] predict token i+2+k (reference: DeepSeek-V3
+    tech report eq. 24-25)."""
+    from ..nn import functional as F
+    loss = causal_lm_loss(logits, labels, ignore_index)
+    if not mtp_logits:
+        return loss
+    mtp = jnp.float32(0.0)
+    for k, ml in enumerate(mtp_logits):
+        sl = labels.shape[1] - 2 - k
+        mtp = mtp + F.cross_entropy(ml[:, :sl], labels[:, 2 + k:],
+                                    ignore_index=ignore_index,
+                                    reduction="mean")
+    return loss + weight * mtp / len(mtp_logits)

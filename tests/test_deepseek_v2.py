@@ -1,5 +1,6 @@
 """DeepSeek-V2 MLA (C22 flagship-family addition): torch logits parity,
 absorbed-decode == expanded-prefill consistency, cache compression."""
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -187,3 +188,66 @@ class TestDeepseekV3:
             ref = hf(torch.tensor(ids)).logits.numpy()
         got = np.asarray(model(jnp.asarray(ids)))
         np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+
+
+class TestMTP:
+    """DeepSeek-V3 multi-token prediction (VERDICT r3 item 9)."""
+
+    def _model(self, D=1):
+        import paddle_tpu as pt
+        from paddle_tpu.models.deepseek_v2 import (DeepseekV2ForCausalLM,
+                                                   deepseek_v2_tiny)
+        pt.seed(0)
+        return DeepseekV2ForCausalLM(deepseek_v2_tiny(
+            num_nextn_predict_layers=D, scoring="sigmoid",
+            group_score_mode="top2sum"))
+
+    def test_mtp_shapes_and_main_parity(self):
+        """MTP depth k logits have length s-1-k; adding the MTP module
+        must NOT change the main head's logits."""
+        import paddle_tpu as pt
+        from paddle_tpu.models.deepseek_v2 import (DeepseekV2ForCausalLM,
+                                                   deepseek_v2_tiny)
+        model = self._model(D=2)
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 16)))
+        logits, mtp = model(ids, return_mtp=True)
+        assert [m.shape for m in mtp] == [(2, 15, 256), (2, 14, 256)]
+        np.testing.assert_array_equal(np.asarray(model(ids)),
+                                      np.asarray(logits))
+        # and a same-seed model WITHOUT mtp produces identical main logits
+        pt.seed(0)
+        base = DeepseekV2ForCausalLM(deepseek_v2_tiny(
+            scoring="sigmoid", group_score_mode="top2sum"))
+        np.testing.assert_allclose(np.asarray(base(ids)),
+                                   np.asarray(logits), rtol=1e-6)
+
+    def test_mtp_training_decreases_both_losses(self):
+        """V3 recipe: one jitted step on CE + lambda*MTP; both the main
+        CE and the MTP CE must fall when overfitting one batch."""
+        import paddle_tpu as pt
+        from paddle_tpu.models.deepseek_v2 import (causal_lm_loss,
+                                                   deepseek_mtp_loss)
+        model = self._model(D=1)
+        fn, params = model.functional()
+        ids = jnp.asarray(np.random.RandomState(1).randint(0, 256, (2, 16)))
+        opt = pt.optimizer.AdamW(learning_rate=3e-3)
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state, i):
+            def loss_fn(p):
+                logits, mtp = fn(p, ids, return_mtp=True)
+                main = causal_lm_loss(logits, ids)
+                total = deepseek_mtp_loss(logits, mtp, ids, weight=0.1)
+                return total, (main, total - main)
+            (_, (main, mtp_part)), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            params, state = opt.apply(params, g, state, i)
+            return params, state, main, mtp_part
+
+        mains, mtps = [], []
+        for i in range(30):
+            params, state, main, mtp_part = step(params, state, i)
+            mains.append(float(main)); mtps.append(float(mtp_part))
+        assert mains[-1] < mains[0] * 0.7, (mains[0], mains[-1])
+        assert mtps[-1] < mtps[0] * 0.7, (mtps[0], mtps[-1])
