@@ -36,6 +36,11 @@ class CLIPTextConfig:
     num_hidden_layers: int = 12
     num_attention_heads: int = 8
     layer_norm_eps: float = 1e-5
+    hidden_act: str = "quick_gelu"   # OpenAI default; "gelu" for OpenCLIP
+    # pooling position: None/2 = highest token id (original OpenAI CLIP,
+    # where EOT is the largest vocab entry); otherwise the FIRST
+    # occurrence of this id (transformers' semantics for custom eos)
+    eos_token_id: "Optional[int]" = None
     dtype: Any = jnp.float32
 
     @property
@@ -90,9 +95,11 @@ class CLIPTextBlock(Layer):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn = dense_attention(q, k, v, causal=True)  # CLIP text is causal
         x = x + self.proj(attn.reshape(b, s, nh * d))
-        # quick-gelu matches OpenAI/EVA CLIP numerics
         h = self.fc1(self.norm2(x))
-        x = x + self.fc2(h * F.sigmoid(1.702 * h))
+        # quick-gelu matches OpenAI/EVA CLIP numerics; OpenCLIP exports gelu
+        h = (F.quick_gelu(h) if self.config.hidden_act == "quick_gelu"
+             else F.gelu(h))
+        x = x + self.fc2(h)
         return x
 
 
@@ -120,8 +127,14 @@ class CLIPTextModel(Layer):
         for block in self.blocks:
             x = block(x)
         x = self.final_norm(x)
-        # pooled = feature at the EOT token (highest token id, per CLIP)
-        eot = jnp.argmax(input_ids, axis=-1)
+        # pooled = feature at the EOT token
+        eos_id = self.config.eos_token_id
+        if eos_id is None or eos_id == 2:
+            # highest token id (original OpenAI CLIP vocab layout)
+            eot = jnp.argmax(input_ids, axis=-1)
+        else:
+            eot = jnp.argmax((input_ids == eos_id).astype(jnp.int32),
+                             axis=-1)
         pooled = x[jnp.arange(x.shape[0]), eot]
         return x, pooled
 

@@ -267,6 +267,161 @@ def _convert_ernie(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
     return out
 
 
+# --------------------------------------------------- GPT-2 / ViT / CLIP
+
+_GPT2_LAYER = {
+    "ln_1": "ln_1", "ln_2": "ln_2",
+    "attn.c_attn": "attn.qkv_proj", "attn.c_proj": "attn.out_proj",
+    "mlp.c_fc": "mlp.fc_in", "mlp.c_proj": "mlp.fc_out",
+}
+
+
+def _convert_gpt2(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """HF GPT2LMHeadModel -> our GPT (models/gpt.py). GPT-2's Conv1D
+    already stores weights [in, out] (the jax matmul layout), so unlike
+    every torch nn.Linear family NO transpose is needed; the fused c_attn
+    q|k|v column order matches our qkv_proj reshape [3, nh, d]."""
+    out = {}
+    for k, v in hf.items():
+        if k.endswith((".attn.bias", ".attn.masked_bias")):
+            continue  # causal-mask buffers
+        if k == "lm_head.weight":
+            continue  # GPT-2 always ties; our tied path reuses embeddings
+        if k.startswith("transformer."):
+            k = k[len("transformer."):]
+        if k == "wte.weight":
+            out["model.embed_tokens.weight"] = v
+        elif k == "wpe.weight":
+            out["model.embed_positions"] = v
+        elif k.startswith("ln_f."):
+            out["model.ln_f." + k[len("ln_f."):]] = v
+        else:
+            m = re.match(r"h\.(\d+)\.(.+)\.(weight|bias)$", k)
+            if m is None:
+                raise KeyError(f"unmapped GPT-2 key {k!r}")
+            n, sub, wb = m.groups()
+            out[f"model.layers.{n}.{_GPT2_LAYER[sub]}.{wb}"] = v
+    return out
+
+
+def _fuse_qkv(hf: Dict[str, np.ndarray], q: str, k: str, v: str):
+    """Three torch [out, in] projections -> one fused [in, 3*out] weight
+    + [3*out] bias (our qkv reshape order is [3, heads, head_dim])."""
+    w = np.concatenate([hf[q + ".weight"].T, hf[k + ".weight"].T,
+                        hf[v + ".weight"].T], axis=1)
+    b = np.concatenate([hf[q + ".bias"], hf[k + ".bias"], hf[v + ".bias"]])
+    return w, b
+
+
+def _convert_vit(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """HF ViTModel / ViTForImageClassification -> our ViT
+    (models/vit.py). Separate q/k/v fuse into our single qkv matmul;
+    conv patch embedding stays OIHW (both torch layout)."""
+    src = {k[4:] if k.startswith("vit.") else k: v for k, v in hf.items()}
+    out = {}
+    n_layers = cfg.num_hidden_layers
+    out["vit.cls_token"] = src["embeddings.cls_token"]
+    out["vit.pos_embed"] = src["embeddings.position_embeddings"]
+    out["vit.patch_embed.proj.weight"] = \
+        src["embeddings.patch_embeddings.projection.weight"]
+    out["vit.patch_embed.proj.bias"] = \
+        src["embeddings.patch_embeddings.projection.bias"]
+    for i in range(n_layers):
+        p = f"encoder.layer.{i}."
+        o = f"vit.blocks.{i}."
+        at = p + "attention.attention."
+        w, b = _fuse_qkv(src, at + "query", at + "key", at + "value")
+        out[o + "attn.qkv.weight"], out[o + "attn.qkv.bias"] = w, b
+        out[o + "attn.proj.weight"] = \
+            src[p + "attention.output.dense.weight"].T
+        out[o + "attn.proj.bias"] = src[p + "attention.output.dense.bias"]
+        out[o + "fc1.weight"] = src[p + "intermediate.dense.weight"].T
+        out[o + "fc1.bias"] = src[p + "intermediate.dense.bias"]
+        out[o + "fc2.weight"] = src[p + "output.dense.weight"].T
+        out[o + "fc2.bias"] = src[p + "output.dense.bias"]
+        for hf_ln, ours in (("layernorm_before", "norm1"),
+                            ("layernorm_after", "norm2")):
+            out[o + ours + ".weight"] = src[p + hf_ln + ".weight"]
+            out[o + ours + ".bias"] = src[p + hf_ln + ".bias"]
+    out["vit.norm.weight"] = src["layernorm.weight"]
+    out["vit.norm.bias"] = src["layernorm.bias"]
+    if "classifier.weight" in src:
+        out["head.weight"] = src["classifier.weight"].T
+        out["head.bias"] = src["classifier.bias"]
+    return out
+
+
+def _convert_clip_tower(src: Dict[str, np.ndarray], hp: str, op: str,
+                        n_layers: int, out: Dict[str, np.ndarray]):
+    """One CLIP transformer tower's blocks (text or vision share the
+    encoder.layers layout)."""
+    for i in range(n_layers):
+        p = f"{hp}encoder.layers.{i}."
+        o = f"{op}{i}."
+        at = p + "self_attn."
+        w, b = _fuse_qkv(src, at + "q_proj", at + "k_proj", at + "v_proj")
+        out[o + "qkv.weight"], out[o + "qkv.bias"] = w, b
+        out[o + "proj.weight"] = src[at + "out_proj.weight"].T
+        out[o + "proj.bias"] = src[at + "out_proj.bias"]
+        out[o + "fc1.weight"] = src[p + "mlp.fc1.weight"].T
+        out[o + "fc1.bias"] = src[p + "mlp.fc1.bias"]
+        out[o + "fc2.weight"] = src[p + "mlp.fc2.weight"].T
+        out[o + "fc2.bias"] = src[p + "mlp.fc2.bias"]
+        for hf_ln, ours in (("layer_norm1", "norm1"),
+                            ("layer_norm2", "norm2")):
+            out[o + ours + ".weight"] = src[p + hf_ln + ".weight"]
+            out[o + ours + ".bias"] = src[p + hf_ln + ".bias"]
+
+
+def _convert_clip(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """HF CLIPModel -> our CLIP (models/clip.py): both towers' separate
+    q/k/v fuse; the vision class embedding becomes the [1,1,h] cls
+    token; HF's bias-free patch conv gets explicit zero bias (identical
+    math); vision attn.qkv names differ from the text tower (ViT blocks
+    nest attention under .attn)."""
+    src = dict(hf)
+    out = {}
+    out["logit_scale"] = src["logit_scale"].reshape(())
+    out["text_projection"] = src["text_projection.weight"].T
+    out["visual_projection"] = src["visual_projection.weight"].T
+    # text tower
+    tp = "text_model."
+    out["text_model.token_embedding.weight"] = \
+        src[tp + "embeddings.token_embedding.weight"]
+    out["text_model.position_embedding"] = \
+        src[tp + "embeddings.position_embedding.weight"]
+    _convert_clip_tower(src, tp, "text_model.blocks.",
+                        cfg.text.num_hidden_layers, out)
+    out["text_model.final_norm.weight"] = \
+        src[tp + "final_layer_norm.weight"]
+    out["text_model.final_norm.bias"] = src[tp + "final_layer_norm.bias"]
+    # the text tower writes flat qkv/proj/fc names (CLIPTextBlock);
+    # _convert_clip_tower emitted them correctly already
+    # vision tower
+    vp = "vision_model."
+    h = cfg.vision.hidden_size
+    out["vision_model.cls_token"] = \
+        src[vp + "embeddings.class_embedding"].reshape(1, 1, h)
+    out["vision_model.pos_embed"] = \
+        src[vp + "embeddings.position_embedding.weight"][None]
+    out["vision_model.patch_embed.proj.weight"] = \
+        src[vp + "embeddings.patch_embedding.weight"]
+    out["vision_model.patch_embed.proj.bias"] = np.zeros((h,), np.float32)
+    out["vision_model.pre_norm.weight"] = src[vp + "pre_layrnorm.weight"]
+    out["vision_model.pre_norm.bias"] = src[vp + "pre_layrnorm.bias"]
+    vtmp: Dict[str, np.ndarray] = {}
+    _convert_clip_tower(src, vp, "vision_model.blocks.",
+                        cfg.vision.num_hidden_layers, vtmp)
+    for k, v in vtmp.items():
+        # ViT blocks nest attention params under .attn
+        k = k.replace(".qkv.", ".attn.qkv.").replace(".proj.",
+                                                     ".attn.proj.")
+        out[k] = v
+    out["vision_model.norm.weight"] = src[vp + "post_layernorm.weight"]
+    out["vision_model.norm.bias"] = src[vp + "post_layernorm.bias"]
+    return out
+
+
 _CONVERTERS: Dict[str, Callable] = {
     "llama": _convert_llama,
     "qwen2": _convert_llama,   # Llama backbone + qkv bias (qwen2.py)
@@ -277,13 +432,17 @@ _CONVERTERS: Dict[str, Callable] = {
     "deepseek_v3": _convert_deepseek_v2,
     "bert": _convert_bert,
     "ernie": _convert_ernie,
+    "gpt2": _convert_gpt2,
+    "vit": _convert_vit,
+    "clip": _convert_clip,
 }
 
 # missing keys under these prefixes are heads a bare encoder checkpoint
 # legitimately lacks; they stay at init and we warn instead of raising.
 _OPTIONAL_HEAD_PREFIXES = ("mlm_head.", "nsp_head.", "bert.pooler.",
                            "ernie.encoder.pooler.",
-                           "ernie.task_type_embeddings")
+                           "ernie.task_type_embeddings",
+                           "head.")  # bare ViTModel has no classifier
 
 
 def convert_hf_state_dict(hf_sd: Dict[str, np.ndarray], cfg,
@@ -337,6 +496,71 @@ def config_from_hf(model_dir: str):
     with open(os.path.join(model_dir, "config.json")) as f:
         hf = json.load(f)
     mt = hf.get("model_type", "")
+    if mt == "gpt2":
+        from .gpt import GPTConfig, GPTForCausalLM
+        cfg = GPTConfig(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["n_embd"],
+            intermediate_size=hf.get("n_inner") or 4 * hf["n_embd"],
+            num_hidden_layers=hf["n_layer"],
+            num_attention_heads=hf["n_head"],
+            max_position_embeddings=hf.get("n_positions", 1024),
+            layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
+            tie_word_embeddings=True,  # GPT-2 checkpoints always tie
+            dtype=_jax_dtype(hf),
+        )
+        return GPTForCausalLM, cfg, mt
+    if mt == "vit":
+        from .vit import ViTConfig, ViTForImageClassification
+        cfg = ViTConfig(
+            image_size=hf.get("image_size", 224),
+            patch_size=hf.get("patch_size", 16),
+            in_channels=hf.get("num_channels", 3),
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_hidden_layers=hf["num_hidden_layers"],
+            num_attention_heads=hf["num_attention_heads"],
+            num_classes=len(hf.get("id2label") or {}) or 2,
+            layer_norm_eps=hf.get("layer_norm_eps", 1e-12),
+            dtype=_jax_dtype(hf),
+        )
+        return ViTForImageClassification, cfg, mt
+    if mt == "clip":
+        from .clip import CLIPConfig, CLIPModel, CLIPTextConfig
+        from .vit import ViTConfig
+        t, v = hf["text_config"], hf["vision_config"]
+        cfg = CLIPConfig(
+            text=CLIPTextConfig(
+                vocab_size=t["vocab_size"],
+                max_position_embeddings=t.get("max_position_embeddings",
+                                              77),
+                hidden_size=t["hidden_size"],
+                intermediate_size=t["intermediate_size"],
+                num_hidden_layers=t["num_hidden_layers"],
+                num_attention_heads=t["num_attention_heads"],
+                layer_norm_eps=t.get("layer_norm_eps", 1e-5),
+                eos_token_id=t.get("eos_token_id"),
+                hidden_act=t.get("hidden_act", "quick_gelu"),
+            ),
+            vision=ViTConfig(
+                image_size=v.get("image_size", 224),
+                patch_size=v.get("patch_size", 32),
+                in_channels=v.get("num_channels", 3),
+                hidden_size=v["hidden_size"],
+                intermediate_size=v["intermediate_size"],
+                num_hidden_layers=v["num_hidden_layers"],
+                num_attention_heads=v["num_attention_heads"],
+                num_classes=0,
+                layer_norm_eps=v.get("layer_norm_eps", 1e-5),
+                pre_norm=True,             # HF CLIP's pre_layrnorm
+                hidden_act=v.get("hidden_act", "quick_gelu"),
+                dtype=_jax_dtype(hf),
+            ),
+            projection_dim=hf.get("projection_dim", 512),
+            dtype=_jax_dtype(hf),
+        )
+        cfg.text.dtype = _jax_dtype(hf)
+        return CLIPModel, cfg, mt
     common = dict(
         vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
         num_hidden_layers=hf["num_hidden_layers"],
@@ -504,6 +728,9 @@ def from_pretrained(model_dir: str, dtype: Optional[Any] = None,
     cls, cfg, mt = config_from_hf(model_dir)
     if dtype is not None:
         cfg.dtype = dtype
+        for sub in ("text", "vision"):  # CLIP towers read their own dtype
+            if hasattr(cfg, sub):
+                getattr(cfg, sub).dtype = dtype
     if model_cls is not None:
         cls = model_cls
     model = cls(cfg)

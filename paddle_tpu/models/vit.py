@@ -38,6 +38,10 @@ class ViTConfig:
     layer_norm_eps: float = 1e-6
     use_class_token: bool = True
     global_pool: bool = False      # mean-pool instead of CLS for the head
+    # HF-CLIP vision tower compat: LayerNorm after the embeddings
+    # (transformers' pre_layrnorm) and OpenAI's quick-gelu activation
+    pre_norm: bool = False
+    hidden_act: str = "gelu"       # "gelu" (erf) | "quick_gelu"
     dtype: Any = jnp.float32
 
     @property
@@ -105,6 +109,7 @@ class ViTBlock(Layer):
 
     def __init__(self, config: ViTConfig):
         super().__init__()
+        self.config = config
         eps = config.layer_norm_eps
         self.norm1 = nn.LayerNorm(config.hidden_size, epsilon=eps)
         self.attn = ViTAttention(config)
@@ -119,7 +124,10 @@ class ViTBlock(Layer):
 
     def forward(self, x):
         x = x + self.dropout(self.attn(self.norm1(x)))
-        x = x + self.dropout(self.fc2(F.gelu(self.fc1(self.norm2(x)))))
+        h = self.fc1(self.norm2(x))
+        h = (F.quick_gelu(h) if self.config.hidden_act == "quick_gelu"
+             else F.gelu(h))
+        x = x + self.dropout(self.fc2(h))
         return constraint(x, ("dp", "fsdp"), None, None)
 
 
@@ -135,6 +143,9 @@ class ViTModel(Layer):
         if config.use_class_token:
             self.cls_token = Parameter(
                 jnp.zeros((1, 1, config.hidden_size)))
+        if config.pre_norm:
+            self.pre_norm = nn.LayerNorm(config.hidden_size,
+                                         epsilon=config.layer_norm_eps)
         self.blocks = nn.LayerList(
             [ViTBlock(config) for _ in range(config.num_hidden_layers)])
         self.norm = nn.LayerNorm(config.hidden_size,
@@ -150,6 +161,8 @@ class ViTModel(Layer):
                                    (x.shape[0], 1, x.shape[2]))
             x = jnp.concatenate([cls.astype(x.dtype), x], axis=1)
         x = x + self.pos_embed.astype(x.dtype)
+        if cfg.pre_norm:
+            x = self.pre_norm(x)
         x = constraint(x, ("dp", "fsdp"), None, None)
         for block in self.blocks:
             x = block(x)

@@ -102,6 +102,11 @@ def sigmoid(x):
     return jax.nn.sigmoid(x)
 
 
+def quick_gelu(x):
+    """OpenAI CLIP/GPT quick-gelu: x * sigmoid(1.702 x)."""
+    return x * sigmoid(1.702 * x)
+
+
 def log_sigmoid(x):
     return jax.nn.log_sigmoid(x)
 
