@@ -275,6 +275,26 @@ class LlamaAttention(Layer):
 
         new_cache = None
         if kv_cache is not None:
+            from ..generation.paged import (PagedKV,
+                                            paged_decode_attention,
+                                            paged_decode_write,
+                                            paged_prefill_write)
+        if kv_cache is not None and isinstance(kv_cache, PagedKV):
+            # paged serving (generation/paged.py): block-table cache.
+            # s == 1: scatter-write this token, attend over the row's
+            # gathered blocks up to its length. s > 1: prefill — write
+            # the prompt's K/V into its blocks, plain causal attention
+            # over the prompt itself (pad tail lands in the garbage
+            # block and produces discarded rows).
+            if s == 1:
+                new_cache = paged_decode_write(kv_cache, k, v)
+                out = paged_decode_attention(q, new_cache,
+                                             window=self.window)
+            else:
+                new_cache = paged_prefill_write(kv_cache, k, v)
+                out = dense_attention(q, k, v, causal=True,
+                                      window=self.window)
+        elif kv_cache is not None:
             # static-shape decode: write current k/v at cache_index
             ck, cv = kv_cache
             ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),

@@ -1,0 +1,131 @@
+"""Paged-KV continuous batching (VERDICT r3 item 3): exactness vs
+generate(), mid-decode admission, block recycling, and the throughput
+win over whole-batch serving."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.generation.paged import PagedEngine
+from paddle_tpu.models import LlamaForCausalLM
+from paddle_tpu.models.llama import llama_tiny
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    return LlamaForCausalLM(llama_tiny())
+
+
+def _engine(model, **kw):
+    base = dict(max_slots=4, num_blocks=32, block_size=8,
+                max_blocks_per_seq=8, prefill_buckets=(16, 32))
+    base.update(kw)
+    return PagedEngine(model, **base)
+
+
+def _greedy_new(model, ids, n, eos=None):
+    out = model.generate(jnp.asarray(ids), max_new_tokens=n,
+                         temperature=0.0, eos_token_id=eos)
+    return np.asarray(out)[0, ids.shape[1]:]
+
+
+class TestPagedExactness:
+    def test_mixed_length_stream_matches_generate(self, model):
+        """Six mixed-length requests through 4 slots: every output equals
+        that request's own greedy decode."""
+        eng = _engine(model)
+        rs = np.random.RandomState(0)
+        prompts = {f"r{i}": rs.randint(1, 256, (1, rs.randint(4, 14)))
+                   for i in range(6)}
+        for rid, ids in prompts.items():
+            eng.submit(rid, ids, max_new_tokens=12)
+        out = eng.run()
+        for rid, ids in prompts.items():
+            np.testing.assert_array_equal(
+                np.asarray(out[rid]), _greedy_new(model, ids, 12),
+                err_msg=rid)
+
+    def test_admission_mid_decode(self, model):
+        """A request submitted AFTER decoding started is admitted into a
+        recycled slot and still decodes exactly — the capability the
+        bucketed Predictor lacks."""
+        eng = _engine(model, max_slots=2)
+        rs = np.random.RandomState(1)
+        a = rs.randint(1, 256, (1, 6))
+        b = rs.randint(1, 256, (1, 10))
+        eng.submit("a", a, max_new_tokens=16)
+        eng.submit("b", b, max_new_tokens=16)
+        for _ in range(5):
+            eng.step()
+        c = rs.randint(1, 256, (1, 5))
+        eng.submit("c", c, max_new_tokens=6)  # lands mid-stream
+        out = eng.run()
+        assert set(out) == {"a", "b", "c"}
+        for rid, ids, n in (("a", a, 16), ("b", b, 16), ("c", c, 6)):
+            np.testing.assert_array_equal(
+                np.asarray(out[rid]), _greedy_new(model, ids, n),
+                err_msg=rid)
+
+    def test_eos_frees_slot_early(self, model):
+        eng = _engine(model)
+        rs = np.random.RandomState(2)
+        ids = rs.randint(1, 256, (1, 8))
+        ref = _greedy_new(model, ids, 24, eos=7)
+        ref = ref[:np.argmax(ref == 7) + 1] if (ref == 7).any() else ref
+        eng.submit("x", ids, max_new_tokens=24, eos_token_id=7)
+        out = eng.run()
+        np.testing.assert_array_equal(np.asarray(out["x"]), ref)
+
+    def test_sliding_window_model(self):
+        pt.seed(3)
+        m = LlamaForCausalLM(llama_tiny(sliding_window=8))
+        eng = _engine(m)
+        ids = np.random.RandomState(3).randint(1, 256, (1, 12))
+        eng.submit("w", ids, max_new_tokens=10)
+        out = eng.run()
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      _greedy_new(m, ids, 10))
+
+
+class TestPagedScheduling:
+    def test_blocks_recycle(self, model):
+        eng = _engine(model)
+        n_free0 = len(eng.free_blocks)
+        rs = np.random.RandomState(4)
+        for i in range(5):
+            eng.submit(i, rs.randint(1, 256, (1, 9)), max_new_tokens=10)
+        eng.run()
+        assert len(eng.free_blocks) == n_free0
+        assert all(s is None for s in eng.slots)
+
+    def test_throughput_beats_whole_batch(self, model):
+        """One long + seven short requests: continuous batching recycles
+        short slots while the long one runs. The whole-batch bucketed
+        path pays (rows x max_new per batch); paged pays only the
+        active slot-steps."""
+        eng = _engine(model)
+        rs = np.random.RandomState(5)
+        long_ids = rs.randint(1, 256, (1, 8))
+        eng.submit("long", long_ids, max_new_tokens=48)
+        shorts = {}
+        for i in range(7):
+            shorts[f"s{i}"] = rs.randint(1, 256, (1, 6))
+            eng.submit(f"s{i}", shorts[f"s{i}"], max_new_tokens=8)
+        out = eng.run()
+        np.testing.assert_array_equal(np.asarray(out["long"]),
+                                      _greedy_new(model, long_ids, 48))
+        # whole-batch serving with 4-slot batches: [long + 3 short]
+        # runs 48 steps x 4 rows, [4 short] runs 8 x 4 rows
+        whole_batch_row_steps = 48 * 4 + 8 * 4
+        assert eng.stats["active_slot_steps"] < whole_batch_row_steps, \
+            eng.stats
+        # and the useful work is most of what was computed
+        useful = 48 + 7 * 8
+        assert eng.stats["active_slot_steps"] <= useful + 8, eng.stats
+
+    def test_oversized_request_rejected(self, model):
+        eng = _engine(model)
+        with pytest.raises(ValueError, match="max_blocks_per_seq"):
+            eng.submit("big", np.ones((1, 60), np.int32),
+                       max_new_tokens=32)
