@@ -222,15 +222,83 @@ def _child_decode():
     model = LlamaForCausalLM(_bench_config("tiny"))
     gen = {}
     new_tok = 16 if smoke else 64
-    for bs in (1, 8):
-        ids = jnp.asarray(rs.randint(0, model.config.vocab_size, (bs, 32)))
-        out = model.generate(ids, max_new_tokens=new_tok, temperature=0.0)
+
+    def time_generate(m, bs, tag):
+        ids = jnp.asarray(rs.randint(0, m.config.vocab_size, (bs, 32)))
+        out = m.generate(ids, max_new_tokens=new_tok, temperature=0.0)
         np.asarray(out)  # compile + force execution (see time_it)
         t0 = time.perf_counter()
-        out = model.generate(ids, max_new_tokens=new_tok, temperature=0.0)
+        out = m.generate(ids, max_new_tokens=new_tok, temperature=0.0)
         np.asarray(out)
         dt_s = time.perf_counter() - t0
-        gen[f"generate_tokens_per_sec_bs{bs}"] = round(bs * new_tok / dt_s, 1)
+        gen[tag] = round(bs * new_tok / dt_s, 1)
+
+    for bs in (1, 8):
+        time_generate(model, bs, f"generate_tokens_per_sec_bs{bs}")
+
+    # fused q/k/v + gate/up projections (VERDICT r3 item 2: attack the
+    # decode while_loop body) — same weights, fewer matmul launches
+    try:
+        from paddle_tpu.nn.fuse import fuse_projections
+        pt.seed(0)
+        fused = fuse_projections(LlamaForCausalLM(_bench_config("tiny")))
+        for bs in (1, 8):
+            time_generate(fused, bs,
+                          f"generate_fused_tokens_per_sec_bs{bs}")
+    except Exception as e:  # keep the rung's other numbers
+        gen["fused_error"] = repr(e)[:120]
+
+    # speculative decoding with a 1-layer draft of the same family
+    # (VERDICT r3 weak #5: a measured tokens/s comparison)
+    try:
+        from paddle_tpu.generation.speculative import speculative_generate
+        pt.seed(0)
+        cfg = _bench_config("tiny")
+        cfg.num_hidden_layers = 1
+        draft = LlamaForCausalLM(cfg)
+        ids = jnp.asarray(rs.randint(0, model.config.vocab_size, (1, 32)))
+        out = speculative_generate(model, draft, ids,
+                                   max_new_tokens=new_tok,
+                                   num_draft_tokens=4)
+        np.asarray(out)
+        t0 = time.perf_counter()
+        out, stats = speculative_generate(model, draft, ids,
+                                          max_new_tokens=new_tok,
+                                          num_draft_tokens=4,
+                                          return_stats=True)
+        np.asarray(out)
+        dt_s = time.perf_counter() - t0
+        gen["speculative_tokens_per_sec_bs1"] = round(new_tok / dt_s, 1)
+        gen["speculative_tokens_per_forward"] = round(
+            stats["tokens_per_forward"], 2)
+    except Exception as e:  # keep the rung's other numbers
+        gen["speculative_error"] = repr(e)[:120]
+
+    # paged continuous batching: mixed-length stream throughput
+    try:
+        from paddle_tpu.generation.paged import PagedEngine
+        eng = PagedEngine(model, max_slots=8, num_blocks=64,
+                          block_size=32, max_blocks_per_seq=8,
+                          prefill_buckets=(32,))
+        rs2 = np.random.RandomState(1)
+        # warmup: compile the prefill + decode executables untimed,
+        # like every other number in this rung
+        eng.submit("warm", rs2.randint(1, model.config.vocab_size,
+                                       (1, 32)), max_new_tokens=2)
+        eng.run()
+        for i in range(16):
+            eng.submit(i, rs2.randint(1, model.config.vocab_size,
+                                      (1, 32)), max_new_tokens=new_tok)
+        t0 = time.perf_counter()
+        res = eng.run()
+        dt_s = time.perf_counter() - t0
+        n_tok = sum(len(v) for v in res.values())
+        gen["paged_tokens_per_sec"] = round(n_tok / dt_s, 1)
+        gen["paged_active_slot_frac"] = round(
+            eng.stats["active_slot_steps"]
+            / max(eng.stats["slot_steps"], 1), 3)
+    except Exception as e:
+        gen["paged_error"] = repr(e)[:120]
 
     print(json.dumps({"decode": {
         "attn_ms_dense": round(ms_dense, 3),

@@ -261,9 +261,18 @@ class LlamaAttention(Layer):
                 segment_ids=None):
         cfg = self.config
         b, s, _ = x.shape
-        q = self.q_proj(x).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
-        k = self.k_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
-        v = self.v_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+        nh, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                      cfg.head_dim)
+        if hasattr(self, "qkv_proj"):
+            # serving fusion (nn.fuse.fuse_projections): ONE matmul
+            qkv = self.qkv_proj(x)
+            q = qkv[..., :nh * d].reshape(b, s, nh, d)
+            k = qkv[..., nh * d:(nh + kvh) * d].reshape(b, s, kvh, d)
+            v = qkv[..., (nh + kvh) * d:].reshape(b, s, kvh, d)
+        else:
+            q = self.q_proj(x).reshape(b, s, nh, d)
+            k = self.k_proj(x).reshape(b, s, kvh, d)
+            v = self.v_proj(x).reshape(b, s, kvh, d)
         cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
                                   q.dtype, inv_freq=self._inv_freq,
                                   attention_scaling=self._attn_scaling)
@@ -390,6 +399,11 @@ class LlamaMLP(Layer):
                                            input_is_parallel=True)
 
     def forward(self, x):
+        if hasattr(self, "gate_up_proj"):
+            # serving fusion (nn.fuse.fuse_projections): ONE matmul
+            gu = self.gate_up_proj(x)
+            gate, up = jnp.split(gu, 2, axis=-1)
+            return self.down_proj(F.silu(gate) * up)
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
 
 

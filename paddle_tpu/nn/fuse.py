@@ -1,0 +1,60 @@
+"""Serving-time projection fusion (reference: PaddleNLP's
+``fuse_attention_qkv`` / ``fuse_attention_ffn`` flags on the Llama
+family).
+
+Decode is HBM-bound: each token step reads every weight matrix once, and
+launching q/k/v (and gate/up) as separate small matmuls leaves MXU tiles
+idle while XLA cannot always merge them horizontally. ``fuse_projections``
+rewrites a loaded model IN PLACE — concat the q/k/v weights into one
+``[h, (nh + 2*kvh) * d]`` matmul and gate/up into one ``[h, 2*ffn]`` —
+the attention/MLP forwards detect the fused module and split the single
+product.
+
+Apply AFTER from_pretrained / checkpoint load (the pass consumes the
+unfused weights), like the quantization pass. Single-chip / replicated
+serving only: the fused column order is not tp-head-aligned, so under a
+tp mesh keep the unfused layout.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..parallel.layers import ColumnParallelLinear
+
+__all__ = ["fuse_projections"]
+
+
+def _fuse_linears(mods, has_bias: bool):
+    """Concat N same-input ColumnParallelLinear along the out dim."""
+    from . import initializer as I
+    w = jnp.concatenate([m.weight for m in mods], axis=1)
+    # Constant init: no random matrix materialized, no global RNG key
+    # consumed — the fused weight overwrites it immediately
+    fused = ColumnParallelLinear(w.shape[0], w.shape[1],
+                                 weight_attr=I.Constant(0.0),
+                                 has_bias=has_bias, gather_output=False)
+    fused.weight = w
+    if has_bias:
+        fused.bias = jnp.concatenate([m.bias for m in mods])
+    return fused
+
+
+def fuse_projections(model, attention: bool = True, mlp: bool = True):
+    """Fuse q/k/v (and gate/up) projections of every Llama-family block
+    of ``model`` in place; returns the model. Idempotent."""
+    for layer in getattr(model, "model", model).layers:
+        attn = getattr(layer, "self_attn", None)
+        if attention and attn is not None and \
+                hasattr(attn, "q_proj") and not hasattr(attn, "qkv_proj"):
+            has_bias = attn.q_proj.bias is not None
+            attn.qkv_proj = _fuse_linears(
+                [attn.q_proj, attn.k_proj, attn.v_proj], has_bias)
+            del attn.q_proj, attn.k_proj, attn.v_proj
+        mlp_mod = getattr(layer, "mlp", None)
+        if mlp and mlp_mod is not None and \
+                hasattr(mlp_mod, "gate_proj") and \
+                not hasattr(mlp_mod, "gate_up_proj"):
+            mlp_mod.gate_up_proj = _fuse_linears(
+                [mlp_mod.gate_proj, mlp_mod.up_proj], has_bias=False)
+            del mlp_mod.gate_proj, mlp_mod.up_proj
+    return model

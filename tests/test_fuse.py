@@ -1,0 +1,42 @@
+"""Serving-time projection fusion (nn/fuse.py): fused q/k/v and
+gate/up matmuls must be numerically identical to the unfused model."""
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models import LlamaForCausalLM
+from paddle_tpu.models.llama import llama_tiny
+from paddle_tpu.nn.fuse import fuse_projections
+
+
+def test_fuse_preserves_logits_and_decode():
+    pt.seed(0)
+    m = LlamaForCausalLM(llama_tiny(attention_bias=True))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 16)))
+    ref = np.asarray(m(ids))
+    want = m.generate(ids[:1], max_new_tokens=12, temperature=0.0)
+    fuse_projections(m)
+    sd = m.state_dict()
+    assert any("qkv_proj" in k for k in sd)
+    assert any("gate_up_proj" in k for k in sd)
+    assert not any(".q_proj." in k for k in sd)
+    np.testing.assert_allclose(np.asarray(m(ids)), ref,
+                               rtol=2e-5, atol=2e-5)
+    got = m.generate(ids[:1], max_new_tokens=12, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    fuse_projections(m)  # idempotent
+    np.testing.assert_allclose(np.asarray(m(ids)), ref,
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fuse_attention_only():
+    pt.seed(1)
+    m = LlamaForCausalLM(llama_tiny())
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 256, (1, 8)))
+    ref = np.asarray(m(ids))
+    fuse_projections(m, mlp=False)
+    sd = m.state_dict()
+    assert any("qkv_proj" in k for k in sd)
+    assert any(".gate_proj." in k for k in sd)
+    np.testing.assert_allclose(np.asarray(m(ids)), ref,
+                               rtol=2e-5, atol=2e-5)
