@@ -334,6 +334,33 @@ def _run_child(mode, timeout):
     return rc, parsed, err[-800:]
 
 
+def _last_known_good():
+    """Best previously-banked TPU numbers (BENCH_SELF_*.json): embedded
+    in failure JSON so the driver artifact always carries the best
+    available evidence even when the tunnel is down."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    import glob
+    for f in sorted(glob.glob(os.path.join(here, "BENCH_SELF_*.json"))):
+        try:
+            with open(f) as fh:
+                data = json.load(fh)
+            # self-run files wrap the train-rung JSON under "train"
+            data = data.get("train", data) if isinstance(data, dict) else {}
+            if data.get("value"):
+                if best is None or data.get("mfu", 0) >= best[1].get("mfu",
+                                                                     0):
+                    best = (os.path.basename(f), data)
+        except Exception:
+            continue  # one corrupt file must not discard the others
+    if best is None:
+        return None
+    return {"file": best[0],
+            **{k: best[1][k] for k in ("value", "unit", "mfu",
+                                       "vs_baseline", "config", "device")
+               if k in best[1]}}
+
+
 def main():
     budget = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET", 450))
     t0 = time.monotonic()
@@ -344,14 +371,16 @@ def main():
     failures = []
     attempts = 0
 
-    # (a) probe: is the backend even reachable?
+    # (a) probe: is the backend even reachable? The first attempt is
+    # CHEAP (25s): when the tunnel hangs (its usual failure mode) the
+    # whole probe phase burns ~100s instead of 150s of the budget.
     probe = None
-    for _ in range(2):
+    for probe_t in (25.0, 75.0):
         if remaining() < 20:
             break
         attempts += 1
         rc, parsed, err = _run_child(
-            "probe", min(75.0, max(remaining() - 10, 15)))
+            "probe", min(probe_t, max(remaining() - 10, 15)))
         if rc == 0 and parsed and parsed.get("probe_ok"):
             probe = parsed
             break
@@ -362,6 +391,7 @@ def main():
             "metric": "llama_train_tokens_per_sec_per_chip",
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": "backend unreachable: jax.devices() probe failed/hung",
+            "last_known_good": _last_known_good(),
             "probe": failures, "attempts": attempts,
             "budget_s": budget, "elapsed_s": round(time.monotonic() - t0, 1),
         }))
@@ -426,6 +456,7 @@ def main():
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
         "error": "probe ok but all bench rungs failed",
+        "last_known_good": _last_known_good(),
         "probe": probe, "failures": failures, "attempts": attempts,
         "budget_s": budget, "elapsed_s": round(time.monotonic() - t0, 1),
     }))

@@ -5,3 +5,5 @@ from .weight_only import (QuantizedLinear, dequantize_weight,
                           weight_only_linear)
 from .qat import FakeQuantLinear, fake_quant
 from .ptq import PTQ, AbsMaxObserver, W8A8Linear
+from .gptq_awq import (AWQLinear, awq_quantize_model, awq_search_scale,
+                       gptq_quantize_model, gptq_quantize_weight)

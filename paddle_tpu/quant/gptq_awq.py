@@ -1,0 +1,204 @@
+"""Error-compensating PTQ: GPTQ and AWQ (reference: PaddleNLP llm
+quantization recipes — PaddleSlim's GPTQ/AWQ passes; Frantar et al. 2022,
+Lin et al. 2023).
+
+Both emit the SAME blockwise (qweight, scales) layout as
+``quantize_blockwise``, so the quantized model reuses ``QuantizedLinear``
+and the fused Pallas dequant-matmul decode path unchanged — the
+algorithms only improve WHICH int codes get stored:
+
+- **GPTQ** quantizes input-channels one at a time and redistributes each
+  channel's rounding error onto the not-yet-quantized channels through
+  the inverse Hessian of the calibration activations (H = X^T X) — the
+  classic OBS update, run offline on host in float64.
+- **AWQ** scales salient input channels UP before rounding (s_j =
+  act_j^alpha / w_j^(1-alpha), alpha grid-searched per layer against the
+  calibration reconstruction error) and folds the inverse scale into the
+  activation path at runtime.
+
+Calibration inputs are captured with ``Layer`` forward-pre-hooks — no
+graph surgery, works on any model tree.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Parameter
+from .weight_only import (QuantizedLinear, dequantize_weight,
+                          linear_quant_meta, pack_int4, quantize_blockwise,
+                          quantize_model)
+
+__all__ = ["gptq_quantize_weight", "awq_search_scale",
+           "gptq_quantize_model", "awq_quantize_model",
+           "capture_linear_inputs"]
+
+
+# ------------------------------------------------------------------- GPTQ
+
+def gptq_quantize_weight(w, x_cal, bits: int = 4, block_size: int = 128,
+                         percdamp: float = 0.01):
+    """GPTQ on a [in, out] weight with calibration activations
+    [n, in]. Returns (qweight, scales) in quantize_blockwise's layout.
+
+    Column order is the natural 0..in-1 (grouped scales need contiguous
+    blocks); the damped Cholesky handles rank-deficient H.
+    """
+    w = np.asarray(w, np.float64)                       # [in, out]
+    x = np.asarray(x_cal, np.float64).reshape(-1, w.shape[0])
+    din, dout = w.shape
+    if din % block_size:
+        raise ValueError(f"in_features {din} % block {block_size} != 0")
+    qmax = 127.0 if bits == 8 else 7.0
+
+    H = x.T @ x                                          # [in, in]
+    damp = percdamp * np.mean(np.diag(H))
+    H[np.diag_indices(din)] += max(damp, 1e-8)
+    # dead channels (no calibration signal): keep H invertible
+    Hinv = np.linalg.cholesky(np.linalg.inv(H)).T        # upper, Hinv chol
+    W = w.copy()
+    Q = np.zeros_like(W)
+    scales = np.zeros((din // block_size, dout))
+
+    for b0 in range(0, din, block_size):
+        b1 = b0 + block_size
+        # group scales from the CURRENT (error-compensated) block values
+        blk = b0 // block_size
+        scales[blk] = np.maximum(np.abs(W[b0:b1]).max(axis=0) / qmax,
+                                 1e-12)
+        for i in range(b0, b1):
+            s = scales[blk]
+            qi = np.clip(np.round(W[i] / s), -qmax, qmax)
+            Q[i] = qi
+            err = (W[i] - qi * s) / Hinv[i, i]
+            # push the rounding error onto later channels
+            W[i + 1:] -= np.outer(Hinv[i, i + 1:], err)
+    q = jnp.asarray(Q.astype(np.int8))
+    if bits == 4:
+        q = pack_int4(q)
+    return q, jnp.asarray(scales, jnp.bfloat16)
+
+
+# -------------------------------------------------------------------- AWQ
+
+def awq_search_scale(w, x_cal, bits: int = 4, block_size: int = 128,
+                     n_grid: int = 20):
+    """Per-input-channel AWQ scale for a [in, out] weight: grid-search
+    alpha in [0, 1) minimizing || x @ W  -  (x/s) @ RTN(W * s) || on the
+    calibration sample. Returns the [in] scale vector (float32)."""
+    x = np.asarray(x_cal, np.float32).reshape(-1, w.shape[0])
+    wnp = np.asarray(w, np.float32)
+    act = np.maximum(np.abs(x).mean(axis=0), 1e-8)       # [in]
+    wmax = np.maximum(np.abs(wnp).max(axis=1), 1e-8)     # [in]
+    ref = x @ wnp
+    best_s, best_err = np.ones_like(act), np.inf
+    for g in range(n_grid):
+        alpha = g / n_grid
+        s = act ** alpha / wmax ** (1 - alpha)
+        s = s / np.sqrt(s.max() * s.min())               # center the range
+        qw, sc = quantize_blockwise(jnp.asarray(wnp * s[:, None]),
+                                    bits, block_size)
+        deq = np.asarray(dequantize_weight(qw, sc, bits, block_size,
+                                           jnp.float32))
+        err = float(np.mean((ref - (x / s) @ deq) ** 2))
+        if err < best_err:
+            best_err, best_s = err, s
+    return jnp.asarray(best_s, jnp.float32)
+
+
+class AWQLinear(QuantizedLinear):
+    """QuantizedLinear whose input is divided by the AWQ channel scale
+    (the weight was multiplied by it before rounding — same product,
+    int codes spend their range on the salient channels)."""
+
+    def __init__(self, *args, awq_scales=None, **kw):
+        super().__init__(*args, **kw)
+        self.awq_inv = Parameter(1.0 / awq_scales, trainable=False)
+
+    def forward(self, x):
+        return super().forward(x * self.awq_inv.astype(x.dtype))
+
+
+# ---------------------------------------------------------- model passes
+
+def capture_linear_inputs(model, batches, max_tokens: int = 512,
+                          skip: Optional[List[str]] = None
+                          ) -> Dict[str, np.ndarray]:
+    """Run ``model`` over ``batches`` (list of model-call args tuples or
+    arrays) recording up to ``max_tokens`` input rows per eligible
+    linear, via forward-pre-hooks. Returns {layer_path: [n, in]}."""
+    from ..nn.common import Linear
+    from ..parallel.layers import ColumnParallelLinear, RowParallelLinear
+    skip = skip or []
+    captured: Dict[str, list] = {}
+    handles = []
+
+    def make_hook(path):
+        def hook(layer, inputs):
+            x = np.asarray(inputs[0], np.float32)
+            x = x.reshape(-1, x.shape[-1])
+            have = sum(a.shape[0] for a in captured[path])
+            if have < max_tokens:
+                captured[path].append(x[:max_tokens - have])
+            return None
+        return hook
+
+    for path, sub in model.named_sublayers(include_self=False):
+        if isinstance(sub, (Linear, ColumnParallelLinear,
+                            RowParallelLinear)) \
+                and not any(s in path for s in skip):
+            captured[path] = []
+            key = sub.register_forward_pre_hook(make_hook(path))
+            handles.append((sub, key))
+    try:
+        for b in batches:
+            model(*b) if isinstance(b, tuple) else model(b)
+    finally:
+        for sub, key in handles:
+            sub._forward_pre_hooks.pop(key, None)
+    return {p: np.concatenate(a) for p, a in captured.items() if a}
+
+
+def gptq_quantize_model(model, batches, bits: int = 4,
+                        block_size: int = 128,
+                        skip: Optional[List[str]] = None,
+                        percdamp: float = 0.01) -> int:
+    """Calibrate + GPTQ-quantize every eligible linear in place (one
+    traversal definition: weight_only.quantize_model drives the swap).
+    Returns the number of swapped layers."""
+    calib = capture_linear_inputs(model, batches, skip=skip)
+
+    def build(sub, path):
+        q, s = gptq_quantize_weight(sub.weight, calib[path], bits,
+                                    block_size, percdamp)
+        return QuantizedLinear.from_linear(sub, bits=bits,
+                                           block_size=block_size,
+                                           qweight=q, scales=s)
+
+    return quantize_model(model, bits, block_size, skip, build=build,
+                          extra_filter=lambda p: p in calib)
+
+
+def awq_quantize_model(model, batches, bits: int = 4,
+                       block_size: int = 128,
+                       skip: Optional[List[str]] = None,
+                       n_grid: int = 20) -> int:
+    """Calibrate + AWQ-quantize every eligible linear in place."""
+    calib = capture_linear_inputs(model, batches, skip=skip)
+
+    def build(sub, path):
+        s = awq_search_scale(sub.weight, calib[path], bits, block_size,
+                             n_grid)
+        q, sc = quantize_blockwise(sub.weight * s[:, None], bits,
+                                   block_size)
+        wp, bp, in_axis, out_axis = linear_quant_meta(sub)
+        return AWQLinear(q, sc, getattr(sub, "bias", None), bits,
+                         block_size, weight_partition=wp,
+                         bias_partition=bp, awq_scales=s,
+                         input_parallel_axis=in_axis,
+                         output_parallel_axis=out_axis)
+
+    return quantize_model(model, bits, block_size, skip, build=build,
+                          extra_filter=lambda p: p in calib)

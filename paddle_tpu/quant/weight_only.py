@@ -39,11 +39,33 @@ def quantize_blockwise(w, bits: int = 8, block_size: int = 128):
     q = jnp.clip(jnp.round(wf / safe[:, None, :]), -qmax, qmax)
     q = q.reshape(din, dout).astype(jnp.int8)
     if bits == 4:
-        # pack consecutive input-dim pairs: low nibble = even row, high = odd
-        lo = q[0::2] & 0x0F
-        hi = (q[1::2] & 0x0F) << 4
-        q = (lo | hi).astype(jnp.int8)
+        q = pack_int4(q)
     return q, scales.astype(jnp.bfloat16)
+
+
+def pack_int4(q):
+    """Pack consecutive input-dim pairs: low nibble = even row, high =
+    odd. ONE definition — dequantize_weight and the Pallas quant_matmul
+    kernel unpack this exact layout."""
+    lo = q[0::2] & 0x0F
+    hi = (q[1::2] & 0x0F) << 4
+    return (lo | hi).astype(jnp.int8)
+
+
+def linear_quant_meta(linear):
+    """The tp-sharding metadata from_linear moves onto a quantized
+    layer, WITHOUT quantizing anything: (weight_partition,
+    bias_partition, input_parallel_axis, output_parallel_axis)."""
+    from ..parallel.layers import ColumnParallelLinear, RowParallelLinear
+    w_meta = linear._param_meta.get("weight")
+    b_meta = linear._param_meta.get("bias")
+    in_axis = out_axis = None
+    if isinstance(linear, ColumnParallelLinear) and not linear.gather_output:
+        out_axis = "tp"
+    if isinstance(linear, RowParallelLinear) and linear.input_is_parallel:
+        in_axis = "tp"
+    return (w_meta.partition if w_meta else None,
+            b_meta.partition if b_meta else None, in_axis, out_axis)
 
 
 def dequantize_weight(qweight, scales, bits: int = 8, block_size: int = 128,
@@ -131,21 +153,16 @@ class QuantizedLinear(Layer):
             self.bias = None
 
     @classmethod
-    def from_linear(cls, linear, bits: int = 8, block_size: int = 128):
-        from ..parallel.layers import ColumnParallelLinear, RowParallelLinear
-        q, s = quantize_blockwise(linear.weight, bits, block_size)
-        bias = getattr(linear, "bias", None)
-        w_meta = linear._param_meta.get("weight")
-        b_meta = linear._param_meta.get("bias")
-        in_axis = out_axis = None
-        if isinstance(linear, ColumnParallelLinear) \
-                and not linear.gather_output:
-            out_axis = "tp"
-        if isinstance(linear, RowParallelLinear) and linear.input_is_parallel:
-            in_axis = "tp"
-        return cls(q, s, bias, bits, block_size,
-                   weight_partition=w_meta.partition if w_meta else None,
-                   bias_partition=b_meta.partition if b_meta else None,
+    def from_linear(cls, linear, bits: int = 8, block_size: int = 128,
+                    qweight=None, scales=None):
+        """``qweight``/``scales`` override the default RTN quantization
+        (the GPTQ pass computes better codes in the same layout)."""
+        if qweight is None:
+            qweight, scales = quantize_blockwise(linear.weight, bits,
+                                                 block_size)
+        wp, bp, in_axis, out_axis = linear_quant_meta(linear)
+        return cls(qweight, scales, getattr(linear, "bias", None), bits,
+                   block_size, weight_partition=wp, bias_partition=bp,
                    input_parallel_axis=in_axis,
                    output_parallel_axis=out_axis)
 
@@ -165,23 +182,31 @@ class QuantizedLinear(Layer):
 
 
 def quantize_model(layer, bits: int = 8, block_size: int = 128,
-                   skip: Optional[list] = None):
+                   skip: Optional[list] = None, build=None,
+                   extra_filter=None):
     """Post-training weight-only quantization: swap every eligible
     nn.Linear / parallel linear in the tree for QuantizedLinear
     (reference: PaddleNLP's quantization pass over the model graph).
 
     `skip`: substrings of layer paths to leave in full precision (heads,
     embeddings are typical — lm_head quantization costs accuracy).
+    `build(sub, path) -> Layer` swaps in a custom quantized layer (the
+    GPTQ/AWQ passes); `extra_filter(path) -> bool` narrows eligibility
+    further. ONE traversal/eligibility definition for every PTQ pass.
     """
     from ..nn.common import Linear
     from ..parallel.layers import ColumnParallelLinear, RowParallelLinear
     skip = skip or []
+    build = build or (lambda sub, path:
+                      QuantizedLinear.from_linear(sub, bits, block_size))
 
     def eligible(path, sub):
         if not isinstance(sub, (Linear, ColumnParallelLinear,
                                 RowParallelLinear)):
             return False
         if any(s in path for s in skip):
+            return False
+        if extra_filter is not None and not extra_filter(path):
             return False
         return sub.weight.shape[0] % block_size == 0
 
@@ -190,7 +215,6 @@ def quantize_model(layer, bits: int = 8, block_size: int = 128,
         for name, sub in list(parent._sub_layers.items()):
             child_path = f"{path}.{name}" if path else name
             if eligible(child_path, sub):
-                parent._sub_layers[name] = QuantizedLinear.from_linear(
-                    sub, bits, block_size)
+                parent._sub_layers[name] = build(sub, child_path)
                 swapped += 1
     return swapped

@@ -1,0 +1,84 @@
+"""GPTQ + AWQ error-compensating PTQ (VERDICT r3 missing #6): both must
+beat plain RTN blockwise quantization on calibration-shaped data, and
+the model passes must swap layers in place and keep the model usable."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import LlamaForCausalLM
+from paddle_tpu.models.llama import llama_tiny
+from paddle_tpu.quant.gptq_awq import (awq_quantize_model,
+                                       awq_search_scale,
+                                       capture_linear_inputs,
+                                       gptq_quantize_model,
+                                       gptq_quantize_weight)
+from paddle_tpu.quant.weight_only import (dequantize_weight,
+                                          quantize_blockwise)
+
+
+def _calib_problem():
+    rs = np.random.RandomState(0)
+    din, dout, n = 128, 64, 256
+    base = rs.randn(n, 16) @ rs.randn(16, din)   # correlated features
+    x = base + 0.1 * rs.randn(n, din)
+    x[:, :4] *= 30.0                             # salient channels
+    w = rs.randn(din, dout).astype(np.float32) * 0.05
+    return x, w
+
+
+def _recon_err(x, w, deq, s=None):
+    ref = x @ np.asarray(w, np.float64)
+    xq = x / np.asarray(s) if s is not None else x
+    return float(np.mean((ref - xq @ np.asarray(deq, np.float64)) ** 2))
+
+
+def test_gptq_beats_rtn_int4():
+    x, w = _calib_problem()
+    q0, s0 = quantize_blockwise(jnp.asarray(w), bits=4, block_size=32)
+    e_rtn = _recon_err(x, w, dequantize_weight(q0, s0, 4, 32, jnp.float32))
+    qg, sg = gptq_quantize_weight(w, x, bits=4, block_size=32)
+    e_gptq = _recon_err(x, w,
+                        dequantize_weight(qg, sg, 4, 32, jnp.float32))
+    assert e_gptq < e_rtn * 0.5, (e_gptq, e_rtn)
+
+
+def test_awq_beats_rtn_int4():
+    x, w = _calib_problem()
+    q0, s0 = quantize_blockwise(jnp.asarray(w), bits=4, block_size=32)
+    e_rtn = _recon_err(x, w, dequantize_weight(q0, s0, 4, 32, jnp.float32))
+    s = awq_search_scale(jnp.asarray(w), x, bits=4, block_size=32)
+    qa, sa = quantize_blockwise(
+        jnp.asarray(w * np.asarray(s)[:, None]), 4, 32)
+    e_awq = _recon_err(x, w,
+                       dequantize_weight(qa, sa, 4, 32, jnp.float32), s=s)
+    assert e_awq < e_rtn * 0.7, (e_awq, e_rtn)
+
+
+@pytest.mark.parametrize("pass_fn", [gptq_quantize_model,
+                                     awq_quantize_model])
+def test_model_pass_swaps_and_generates(pass_fn):
+    pt.seed(0)
+    m = LlamaForCausalLM(llama_tiny(hidden_size=64, intermediate_size=128))
+    rs = np.random.RandomState(1)
+    batches = [jnp.asarray(rs.randint(0, 256, (2, 16))) for _ in range(2)]
+    ids = batches[0]
+    ref = np.asarray(m(ids))
+    n = pass_fn(m, batches, bits=8, block_size=32,
+                skip=["lm_head", "embed"])
+    assert n > 0
+    got = np.asarray(m(ids))
+    # int8 weight-only on a tiny model: logits stay close
+    assert np.mean(np.abs(got - ref)) < 0.1, np.mean(np.abs(got - ref))
+    out = m.generate(ids[:1], max_new_tokens=8, temperature=0.0)
+    assert out.shape == (1, 24)
+
+
+def test_capture_hooks_removed():
+    pt.seed(2)
+    m = LlamaForCausalLM(llama_tiny())
+    ids = jnp.asarray(np.random.RandomState(3).randint(0, 256, (1, 8)))
+    calib = capture_linear_inputs(m, [ids], max_tokens=64)
+    assert calib and all(v.shape[0] <= 64 for v in calib.values())
+    assert all(not s._forward_pre_hooks
+               for _, s in m.named_sublayers(include_self=False))
