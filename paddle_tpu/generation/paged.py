@@ -102,14 +102,17 @@ def paged_decode_attention(q, pk: PagedKV, scale: Optional[float] = None,
 
 
 class _Slot:
-    __slots__ = ("request_id", "prompt_len", "max_new", "eos", "tokens",
-                 "blocks")
+    __slots__ = ("request_id", "prompt", "max_new", "eos", "tokens",
+                 "blocks", "prefix", "admit_seq")
 
-    def __init__(self, request_id, prompt_len, max_new, eos):
+    def __init__(self, request_id, prompt, max_new, eos, prefix,
+                 admit_seq):
         self.request_id = request_id
-        self.prompt_len = prompt_len
-        self.max_new = max_new
+        self.prompt = prompt            # ids the prefill ran over
+        self.max_new = max_new          # tokens still to emit
         self.eos = eos
+        self.prefix = prefix            # tokens emitted before preemption
+        self.admit_seq = admit_seq      # preemption picks the youngest
         self.tokens: List[int] = []
         self.blocks: List[int] = []
 
@@ -146,7 +149,8 @@ class PagedEngine:
         self.slots: List[Optional[_Slot]] = [None] * self.R
         self.queue: List[tuple] = []
         self.results: Dict[Any, List[int]] = {}
-        self.stats = {"decode_steps": 0, "prefills": 0,
+        self._admit_counter = 0
+        self.stats = {"decode_steps": 0, "prefills": 0, "preemptions": 0,
                       "slot_steps": 0, "active_slot_steps": 0}
         # pools are donated: XLA aliases input to output so a decode
         # step costs one scatter, not a full pool copy
@@ -190,7 +194,8 @@ class PagedEngine:
                              f"{self.M * self.B}")
         if self._blocks_needed(total) > self.P - 1:
             raise ValueError("request alone exceeds the block pool")
-        self.queue.append((request_id, ids, max_new_tokens, eos_token_id))
+        self.queue.append((request_id, ids, max_new_tokens, eos_token_id,
+                           []))
 
     def _blocks_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.B - 1) // self.B
@@ -199,7 +204,7 @@ class PagedEngine:
         """Prefill ONE queued request into a free slot if blocks allow."""
         if not self.queue:
             return False
-        rid, ids, max_new, eos = self.queue[0]
+        rid, ids, max_new, eos, prefix = self.queue[0]
         try:
             slot_id = self.slots.index(None)
         except ValueError:
@@ -208,7 +213,8 @@ class PagedEngine:
         if len(self.free_blocks) < need:
             return False
         self.queue.pop(0)
-        slot = _Slot(rid, len(ids), max_new, eos)
+        self._admit_counter += 1
+        slot = _Slot(rid, ids, max_new, eos, prefix, self._admit_counter)
         slot.blocks = [self.free_blocks.pop() for _ in range(need)]
         self.slots[slot_id] = slot
         row = np.zeros((self.M,), np.int32)
@@ -250,23 +256,48 @@ class PagedEngine:
 
     def _finish(self, slot_id: int):
         slot = self.slots[slot_id]
-        self.results[slot.request_id] = slot.tokens
-        self.free_blocks.extend(slot.blocks)
+        self.results[slot.request_id] = slot.prefix + slot.tokens
+        self._release(slot_id)
+
+    def _release(self, slot_id: int):
+        self.free_blocks.extend(self.slots[slot_id].blocks)
         self.block_tables[slot_id] = 0
         self.seq_lens[slot_id] = 0
         self.slots[slot_id] = None
 
+    def _preempt_youngest(self, exclude: int) -> bool:
+        """Memory pressure: requeue the most recently admitted OTHER
+        request (vLLM's recompute-mode preemption — its emitted tokens
+        fold into the prompt, so the re-prefill rebuilds the same KV
+        deterministically and the output stays exact)."""
+        cands = [i for i, s in enumerate(self.slots)
+                 if s is not None and i != exclude]
+        if not cands:
+            return False
+        victim = max(cands, key=lambda i: self.slots[i].admit_seq)
+        s = self.slots[victim]
+        self.queue.insert(0, (
+            s.request_id, s.prompt + s.tokens,
+            s.max_new - len(s.tokens), s.eos,
+            s.prefix + s.tokens))
+        self._release(victim)
+        self.stats["preemptions"] += 1
+        return True
+
     def step(self):
         """One scheduler tick: admit, then one decode for all slots."""
         self._try_admit()
+        for i in range(self.R):
+            if self.slots[i] is None:
+                continue
+            while not self._ensure_block(i):
+                if not self._preempt_youngest(exclude=i):
+                    raise RuntimeError(
+                        "paged KV pool cannot hold even one request; "
+                        "raise num_blocks")
         active = [i for i, s in enumerate(self.slots) if s is not None]
         if not active:
             return
-        for i in active:
-            if not self._ensure_block(i):
-                raise RuntimeError(
-                    "paged KV pool exhausted mid-decode; raise num_blocks "
-                    "(preemption is not implemented)")
         last = np.zeros((self.R,), np.int32)
         for i in active:
             last[i] = self.slots[i].tokens[-1]

@@ -57,10 +57,12 @@ def iter_hf_checkpoint_shards(model_dir: str) -> Iterator[Dict[str, np.ndarray]]
         for shard in sorted(set(weight_map.values())):
             yield _load_safetensors_file(os.path.join(model_dir, shard))
         return
-    single = os.path.join(model_dir, "model.safetensors")
-    if os.path.exists(single):
-        yield _load_safetensors_file(single)
-        return
+    for name in ("model.safetensors",
+                 "diffusion_pytorch_model.safetensors"):  # diffusers
+        single = os.path.join(model_dir, name)
+        if os.path.exists(single):
+            yield _load_safetensors_file(single)
+            return
     binp = os.path.join(model_dir, "pytorch_model.bin")
     if os.path.exists(binp):
         import torch
@@ -422,6 +424,125 @@ def _convert_clip(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
     return out
 
 
+
+
+# ------------------------------------------- diffusers AutoencoderKL (VAE)
+
+def _vae_name_map(cfg):
+    """Deterministic (diffusers_name -> our_name) prefix pairs, built by
+    replaying Encoder/Decoder's construction loops (models/vae.py). The
+    diffusers layout nests resnets/downsamplers per block; ours is a
+    flat Sequential index."""
+    pairs = []
+    n_blocks = len(cfg.channel_multipliers)
+
+    def resnet(dst, src_p, in_ch, out_ch):
+        for a, b in (("norm1", "norm1"), ("conv1", "conv1"),
+                     ("norm2", "norm2"), ("conv2", "conv2")):
+            pairs.append((f"{src_p}.{a}", f"{dst}.{b}"))
+        if in_ch != out_ch:
+            pairs.append((f"{src_p}.conv_shortcut", f"{dst}.short"))
+
+    ch = cfg.base_channels
+    # encoder
+    k, in_ch = 0, ch
+    for b, mult in enumerate(cfg.channel_multipliers):
+        out_ch = ch * mult
+        for r in range(cfg.layers_per_block):
+            resnet(f"encoder.down.{k}",
+                   f"encoder.down_blocks.{b}.resnets.{r}", in_ch, out_ch)
+            in_ch = out_ch
+            k += 1
+        if b != n_blocks - 1:
+            pairs.append((f"encoder.down_blocks.{b}.downsamplers.0.conv",
+                          f"encoder.down.{k}.conv"))
+            k += 1
+    resnet("encoder.mid.0", "encoder.mid_block.resnets.0", in_ch, in_ch)
+    pairs.append(("encoder.mid_block.attentions.0", "encoder.mid.1"))
+    resnet("encoder.mid.2", "encoder.mid_block.resnets.1", in_ch, in_ch)
+    pairs.append(("encoder.conv_norm_out", "encoder.norm_out"))
+    for n in ("encoder.conv_in", "encoder.conv_out", "quant_conv",
+              "post_quant_conv", "decoder.conv_in", "decoder.conv_out"):
+        pairs.append((n, n))
+    # decoder (diffusers up_blocks[0] = deepest, same order as our loop)
+    in_ch = ch * cfg.channel_multipliers[-1]
+    resnet("decoder.mid.0", "decoder.mid_block.resnets.0", in_ch, in_ch)
+    pairs.append(("decoder.mid_block.attentions.0", "decoder.mid.1"))
+    resnet("decoder.mid.2", "decoder.mid_block.resnets.1", in_ch, in_ch)
+    k = 0
+    for b, mult in enumerate(reversed(cfg.channel_multipliers)):
+        out_ch = ch * mult
+        for r in range(cfg.layers_per_block + 1):
+            resnet(f"decoder.up.{k}",
+                   f"decoder.up_blocks.{b}.resnets.{r}", in_ch, out_ch)
+            in_ch = out_ch
+            k += 1
+        if b != n_blocks - 1:
+            pairs.append((f"decoder.up_blocks.{b}.upsamplers.0.conv",
+                          f"decoder.up.{k}.conv"))
+            k += 1
+    pairs.append(("decoder.conv_norm_out", "decoder.norm_out"))
+    return pairs
+
+
+def _vae_attn(hf, src_p, dst_p, out):
+    """Diffusers spatial attention (group_norm + to_q/k/v + to_out.0;
+    1x1-conv weights in old CompVis exports squeeze to linear) -> our
+    fused AttnBlock (norm + qkv + proj)."""
+    def lin(name):
+        w = hf[f"{src_p}.{name}.weight"]
+        if w.ndim == 4:                  # [c, c, 1, 1] conv form
+            w = w[..., 0, 0]
+        return w.T, hf[f"{src_p}.{name}.bias"]
+    gname = ("group_norm" if f"{src_p}.group_norm.weight" in hf
+             else "norm")
+    out[f"{dst_p}.norm.weight"] = hf[f"{src_p}.{gname}.weight"]
+    out[f"{dst_p}.norm.bias"] = hf[f"{src_p}.{gname}.bias"]
+    ws, bs = zip(lin("to_q"), lin("to_k"), lin("to_v"))
+    out[f"{dst_p}.qkv.weight"] = np.concatenate(ws, axis=1)
+    out[f"{dst_p}.qkv.bias"] = np.concatenate(bs)
+    pw, pb = lin("to_out.0")
+    out[f"{dst_p}.proj.weight"] = pw
+    out[f"{dst_p}.proj.bias"] = pb
+
+
+def _convert_vae(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """diffusers AutoencoderKL checkpoint -> our AutoencoderKL
+    (models/vae.py). Convs stay OIHW; attention linears fuse. NOTE:
+    verified by construction + round-trip (diffusers itself is not in
+    this image for a numerics-parity test)."""
+    out: Dict[str, np.ndarray] = {}
+    for src_p, dst_p in _vae_name_map(cfg):
+        if src_p.endswith("attentions.0"):
+            _vae_attn(hf, src_p, dst_p, out)
+            continue
+        for suf in ("weight", "bias"):
+            out[f"{dst_p}.{suf}"] = hf[f"{src_p}.{suf}"]
+    return out
+
+
+def _revert_vae(sd: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """Inverse of _convert_vae (to_hf export + the round-trip test)."""
+    out: Dict[str, np.ndarray] = {}
+    for src_p, dst_p in _vae_name_map(cfg):
+        if src_p.endswith("attentions.0"):
+            qkv = np.asarray(sd[f"{dst_p}.qkv.weight"])
+            qb = np.asarray(sd[f"{dst_p}.qkv.bias"])
+            c = qkv.shape[0]
+            for i, n in enumerate(("to_q", "to_k", "to_v")):
+                out[f"{src_p}.{n}.weight"] = qkv[:, i * c:(i + 1) * c].T
+                out[f"{src_p}.{n}.bias"] = qb[i * c:(i + 1) * c]
+            out[f"{src_p}.group_norm.weight"] = sd[f"{dst_p}.norm.weight"]
+            out[f"{src_p}.group_norm.bias"] = sd[f"{dst_p}.norm.bias"]
+            out[f"{src_p}.to_out.0.weight"] = \
+                np.asarray(sd[f"{dst_p}.proj.weight"]).T
+            out[f"{src_p}.to_out.0.bias"] = sd[f"{dst_p}.proj.bias"]
+            continue
+        for suf in ("weight", "bias"):
+            out[f"{src_p}.{suf}"] = np.asarray(sd[f"{dst_p}.{suf}"])
+    return out
+
+
 _CONVERTERS: Dict[str, Callable] = {
     "llama": _convert_llama,
     "qwen2": _convert_llama,   # Llama backbone + qkv bias (qwen2.py)
@@ -435,6 +556,7 @@ _CONVERTERS: Dict[str, Callable] = {
     "gpt2": _convert_gpt2,
     "vit": _convert_vit,
     "clip": _convert_clip,
+    "autoencoder_kl": _convert_vae,
 }
 
 # missing keys under these prefixes are heads a bare encoder checkpoint
@@ -496,6 +618,26 @@ def config_from_hf(model_dir: str):
     with open(os.path.join(model_dir, "config.json")) as f:
         hf = json.load(f)
     mt = hf.get("model_type", "")
+    if not mt and hf.get("_class_name") == "AutoencoderKL":
+        from .vae import AutoencoderKL, VAEConfig
+        if hf.get("use_quant_conv") is False or \
+                hf.get("use_post_quant_conv") is False or \
+                hf.get("shift_factor"):
+            raise ValueError(
+                "AutoencoderKL variant without quant convs / with "
+                "shift_factor (SD3/FLUX VAE) is not supported yet; "
+                "the SD1/2-family layout is")
+        bout = hf.get("block_out_channels", [128, 256, 512, 512])
+        cfg = VAEConfig(
+            in_channels=hf.get("in_channels", 3),
+            latent_channels=hf.get("latent_channels", 4),
+            base_channels=bout[0],
+            channel_multipliers=[c // bout[0] for c in bout],
+            layers_per_block=hf.get("layers_per_block", 2),
+            norm_groups=hf.get("norm_num_groups", 32),
+            scaling_factor=hf.get("scaling_factor", 0.18215),
+        )
+        return AutoencoderKL, cfg, "autoencoder_kl"
     if mt == "gpt2":
         from .gpt import GPTConfig, GPTForCausalLM
         cfg = GPTConfig(

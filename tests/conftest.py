@@ -23,7 +23,7 @@ jax.config.update("jax_platforms", "cpu")
 # dedupe those (different callables), the HLO-keyed persistent cache can —
 # both within one suite run and across runs/subprocess children.
 jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_test_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 
 import numpy as np
 import pytest
@@ -112,6 +112,18 @@ _HEAVY = (
     # deepseek-v2: torch parity + absorbed-decode proofs stay; generate
     # rides the shared while_loop machinery
     "TestMLADecode::test_generate_runs",
+    # round-4 timing pass: subsystems keep the named cheaper/stronger
+    # representative in the default tier
+    "test_speedup_4_workers",            # <- order_matches_serial
+    "TestCLIP::test_contrastive_roundtrip",  # <- interop clip parity
+    "TestPPOCR::test_db_loss",           # <- heavy dbnet_maps/svtr
+    "TestResNet::test_feature_pyramid",  # <- vit/resnet interop + heavy
+    "test_custom_logits_loss_under_pp",  # <- compose_with_tp_dp (same
+    # machinery; the logits_loss hook itself is 5 lines re-verified there)
+    "TestDPO::test_sequence_logps_and_precompute",  # <- dpo_trainer test
+    "test_packed_fallback_for_models_without_segment_ids",  # <- packing
+    "test_round3_flat_ops",              # <- per-op coverage in test_nn
+    "test_mtp_module_does_not_shift_trunk_init",  # <- shapes_and_parity
 )
 
 

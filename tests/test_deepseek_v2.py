@@ -212,14 +212,24 @@ class TestMTP:
         ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 16)))
         logits, mtp = model(ids, return_mtp=True)
         assert [m.shape for m in mtp] == [(2, 15, 256), (2, 14, 256)]
+        # the plain forward (no MTP) yields the SAME main-head logits
         np.testing.assert_array_equal(np.asarray(model(ids)),
                                       np.asarray(logits))
-        # and a same-seed model WITHOUT mtp produces identical main logits
+
+    def test_mtp_module_does_not_shift_trunk_init(self):
+        """Same seed with and without MTP heads: the trunk parameters
+        (and main logits) must be identical — the MTP LayerList is
+        constructed AFTER the trunk so it cannot consume trunk RNG."""
+        import paddle_tpu as pt
+        from paddle_tpu.models.deepseek_v2 import (DeepseekV2ForCausalLM,
+                                                   deepseek_v2_tiny)
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (1, 8)))
+        model = self._model(D=1)
         pt.seed(0)
         base = DeepseekV2ForCausalLM(deepseek_v2_tiny(
             scoring="sigmoid", group_score_mode="top2sum"))
         np.testing.assert_allclose(np.asarray(base(ids)),
-                                   np.asarray(logits), rtol=1e-6)
+                                   np.asarray(model(ids)), rtol=1e-6)
 
     def test_mtp_training_decreases_both_losses(self):
         """V3 recipe: one jitted step on CE + lambda*MTP; both the main

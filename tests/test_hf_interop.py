@@ -397,3 +397,42 @@ def test_vit_bare_encoder_loads(tmp_module):
         ref = hf_model(torch.tensor(px)).last_hidden_state.numpy()
     got = np.asarray(model.vit(jnp.asarray(px)))
     np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_vae_diffusers_roundtrip(tmp_module):
+    """diffusers-format AutoencoderKL interop: our tiny VAE exports to
+    the diffusers name layout (_revert_vae), saves as a diffusers-style
+    checkpoint dir, and from_pretrained rebuilds a model whose
+    encode/decode outputs are bit-identical. Verifies the name map is
+    complete and invertible both ways (diffusers itself is not in this
+    image, so numerics parity vs upstream is documented as pending)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.hf_interop import _revert_vae, from_pretrained
+    from paddle_tpu.models.vae import AutoencoderKL, vae_tiny
+    from safetensors.numpy import save_file
+
+    pt.seed(0)
+    cfg = vae_tiny()
+    m = AutoencoderKL(cfg)
+    d = tmp_module / "vae_diffusers"
+    d.mkdir()
+    hf_sd = _revert_vae(m.state_dict(), cfg)
+    save_file({k: np.ascontiguousarray(v) for k, v in hf_sd.items()},
+              str(d / "diffusion_pytorch_model.safetensors"))
+    (d / "config.json").write_text(json.dumps({
+        "_class_name": "AutoencoderKL",
+        "block_out_channels": [cfg.base_channels * m_
+                               for m_ in cfg.channel_multipliers],
+        "layers_per_block": cfg.layers_per_block,
+        "latent_channels": cfg.latent_channels,
+        "in_channels": cfg.in_channels,
+        "norm_num_groups": cfg.norm_groups,
+        "scaling_factor": cfg.scaling_factor,
+    }))
+    m2 = from_pretrained(str(d))
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 3, 16, 16),
+                    jnp.float32)
+    r1, p1 = m(x)
+    r2, p2 = m2(x)
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+    np.testing.assert_array_equal(np.asarray(p1.mean), np.asarray(p2.mean))

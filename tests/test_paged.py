@@ -129,3 +129,23 @@ class TestPagedScheduling:
         with pytest.raises(ValueError, match="max_blocks_per_seq"):
             eng.submit("big", np.ones((1, 60), np.int32),
                        max_new_tokens=32)
+
+
+class TestPreemption:
+    def test_preemption_keeps_outputs_exact(self, model):
+        """A pool too small for all requests at once: the youngest slot
+        is preempted (recompute mode — emitted tokens fold into the
+        requeued prompt) and every output still equals greedy."""
+        eng = _engine(model, max_slots=3, num_blocks=7, block_size=8,
+                      max_blocks_per_seq=6)
+        rs = np.random.RandomState(6)
+        prompts = {f"p{i}": rs.randint(1, 256, (1, 7)) for i in range(3)}
+        for rid, ids in prompts.items():
+            eng.submit(rid, ids, max_new_tokens=24)
+        out = eng.run()
+        assert eng.stats["preemptions"] > 0, eng.stats
+        for rid, ids in prompts.items():
+            np.testing.assert_array_equal(
+                np.asarray(out[rid]), _greedy_new(model, ids, 24),
+                err_msg=rid)
+        assert len(eng.free_blocks) == 6  # all recycled (block 0 reserved)

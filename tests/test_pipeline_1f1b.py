@@ -61,7 +61,7 @@ def test_1f1b_single_microbatch():
     np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
 
 
-def _moe_pp_setup():
+def _moe_pp_setup(n_layers=2):
     """Tiny uniform-MoE model on a pp=2 x ep=2 x dp=2 mesh + its
     per-microbatch sequential reference (CE + router aux)."""
     from paddle_tpu.models.qwen2_moe import (Qwen2MoeForCausalLM,
@@ -71,7 +71,8 @@ def _moe_pp_setup():
     model = Qwen2MoeForCausalLM(qwen2_moe_tiny(
         vocab_size=128, hidden_size=32, intermediate_size=64,
         moe_intermediate_size=32, num_experts=4, num_experts_per_tok=2,
-        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        num_hidden_layers=n_layers, num_attention_heads=4,
+        num_key_value_heads=2,
         first_k_dense_replace=0, num_shared_experts=0))
     env.init_parallel_env({"pp": 2, "ep": 2, "dp": 2},
                           devices=jax.devices()[:8])
@@ -95,7 +96,7 @@ def test_1f1b_composes_with_ep_moe():
     own backward, ep stays a GSPMD auto axis inside stages; loss AND
     grads must match the per-microbatch sequential MoE step."""
     model, params, reference = _moe_pp_setup()
-    tokens = jnp.asarray(np.random.RandomState(1).randint(0, 128, (3, 2, 16)))
+    tokens = jnp.asarray(np.random.RandomState(1).randint(0, 128, (2, 2, 16)))
 
     loss_pp, grads_pp = jax.jit(model.pipeline_functional(2))(
         dict(params), tokens)
@@ -113,8 +114,8 @@ def test_1f1b_composes_with_ep_moe():
 def test_interleaved_vpp_composes_with_ep_moe():
     """pp=2 x vpp=2 x ep=2 on the interleaved schedule: MoE chunks'
     aux seeding matches sequential too."""
-    model, params, reference = _moe_pp_setup()
-    tokens = jnp.asarray(np.random.RandomState(4).randint(0, 128, (3, 2, 16)))
+    model, params, reference = _moe_pp_setup(n_layers=4)  # pp*vpp chunks
+    tokens = jnp.asarray(np.random.RandomState(4).randint(0, 128, (2, 2, 16)))
 
     loss_pp, grads_pp = jax.jit(model.pipeline_functional(2, vpp=2))(
         dict(params), tokens)
