@@ -255,8 +255,9 @@ def _child_decode():
         from paddle_tpu.quant import quantize_model
         pt.seed(0)
         qmodel = LlamaForCausalLM(_bench_config("tiny"))
-        quantize_model(qmodel, bits=8, block_size=128,
-                       skip=["lm_head", "embed"])
+        n_swapped = quantize_model(qmodel, bits=8, block_size=128,
+                                   skip=["lm_head", "embed"])
+        assert n_swapped > 0, "quantize_model swapped nothing"
         for bs in (1, 8):
             time_generate(qmodel, bs,
                           f"generate_int8_tokens_per_sec_bs{bs}")
