@@ -113,6 +113,23 @@ class Predictor:
         """Autoregressive generation with the model's KV cache path."""
         return self.model.generate(jnp.asarray(input_ids), **kwargs)
 
+    def serve_stream(self, requests, max_new_tokens: int = 64,
+                     eos_token_id=None, **engine_kw):
+        """Continuous-batching service for a mixed-length request
+        stream (reference: PaddleNLP llm predictor's block-attention
+        path): ``requests`` maps request_id -> input_ids. Each request
+        is admitted the moment a slot and KV blocks free up, so short
+        requests never wait on long ones. Greedy, exact per request
+        vs ``generate``. Returns request_id -> generated ids."""
+        from .generation.paged import PagedEngine
+        eng = PagedEngine(self.model, **engine_kw)
+        for rid, ids in requests.items():
+            eng.submit(rid, ids, max_new_tokens=max_new_tokens,
+                       eos_token_id=eos_token_id)
+        out = eng.run()
+        self.last_serve_stats = dict(eng.stats)
+        return out
+
     @classmethod
     def from_checkpoint(cls, model_factory: Callable[[], Any], path: str,
                         config: Optional[Config] = None):

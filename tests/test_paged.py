@@ -149,3 +149,19 @@ class TestPreemption:
                 np.asarray(out[rid]), _greedy_new(model, ids, 24),
                 err_msg=rid)
         assert len(eng.free_blocks) == 6  # all recycled (block 0 reserved)
+
+
+def test_predictor_serve_stream(model):
+    """inference.Predictor exposes the continuous-batching path."""
+    from paddle_tpu.inference import Config, Predictor
+    pred = Predictor(model, Config())
+    rs = np.random.RandomState(7)
+    reqs = {f"q{i}": rs.randint(1, 256, (1, 6 + i)) for i in range(3)}
+    out = pred.serve_stream(reqs, max_new_tokens=8, max_slots=2,
+                            num_blocks=16, block_size=8,
+                            max_blocks_per_seq=4, prefill_buckets=(16,))
+    for rid, ids in reqs.items():
+        np.testing.assert_array_equal(np.asarray(out[rid]),
+                                      _greedy_new(model, ids, 8),
+                                      err_msg=rid)
+    assert pred.last_serve_stats["prefills"] == 3
