@@ -69,6 +69,8 @@ class Predictor:
             quantize_model(model, bits=self.config.quant_bits,
                            skip=self.config.quant_skip)
         model.eval()
+        self.last_serve_stats = {}
+        self._paged_engines = {}
         self._fn, self._params = model.functional()
         # weights live on device once; every run reuses them
         self._params = jax.device_put(self._params)
@@ -117,16 +119,27 @@ class Predictor:
                      eos_token_id=None, **engine_kw):
         """Continuous-batching service for a mixed-length request
         stream (reference: PaddleNLP llm predictor's block-attention
-        path): ``requests`` maps request_id -> input_ids. Each request
-        is admitted the moment a slot and KV blocks free up, so short
-        requests never wait on long ones. Greedy, exact per request
-        vs ``generate``. Returns request_id -> generated ids."""
+        path): ``requests`` maps request_id -> input_ids. Admission is
+        FIFO: a request enters the moment a slot AND its blocks free
+        up, backfilling slots that finished mid-decode (a large
+        request at the queue head can delay the ones behind it — size
+        the pool for the large case). Greedy, exact per request vs
+        ``generate``. Returns request_id -> generated ids.
+
+        The engine (pools + compiled prefill/decode executables) is
+        cached per ``engine_kw`` shape, so repeated calls pay no
+        recompile and no pool re-allocation."""
         from .generation.paged import PagedEngine
-        eng = PagedEngine(self.model, **engine_kw)
+        key = tuple(sorted(engine_kw.items()))
+        eng = self._paged_engines.get(key)
+        if eng is None:
+            eng = PagedEngine(self.model, **engine_kw)
+            self._paged_engines[key] = eng
         for rid, ids in requests.items():
             eng.submit(rid, ids, max_new_tokens=max_new_tokens,
                        eos_token_id=eos_token_id)
         out = eng.run()
+        eng.results.clear()  # the caller owns them now
         self.last_serve_stats = dict(eng.stats)
         return out
 

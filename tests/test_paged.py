@@ -165,3 +165,23 @@ def test_predictor_serve_stream(model):
                                       _greedy_new(model, ids, 8),
                                       err_msg=rid)
     assert pred.last_serve_stats["prefills"] == 3
+
+
+def test_predictor_serve_stream_reuses_engine(model):
+    from paddle_tpu.inference import Config, Predictor
+    pred = Predictor(model, Config())
+    assert pred.last_serve_stats == {}
+    kw = dict(max_slots=2, num_blocks=16, block_size=8,
+              max_blocks_per_seq=4, prefill_buckets=(16,))
+    rs = np.random.RandomState(8)
+    a = {f"a{i}": rs.randint(1, 256, (1, 6)) for i in range(2)}
+    b = {f"b{i}": rs.randint(1, 256, (1, 9)) for i in range(2)}
+    out_a = pred.serve_stream(a, max_new_tokens=6, **kw)
+    eng = next(iter(pred._paged_engines.values()))
+    out_b = pred.serve_stream(b, max_new_tokens=6, **kw)
+    assert len(pred._paged_engines) == 1  # same engine, no recompile
+    for reqs, out in ((a, out_a), (b, out_b)):
+        for rid, ids in reqs.items():
+            np.testing.assert_array_equal(np.asarray(out[rid]),
+                                          _greedy_new(model, ids, 6),
+                                          err_msg=rid)
