@@ -543,6 +543,39 @@ def _revert_vae(sd: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
     return out
 
 
+
+
+def _convert_resnet(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """HF ResNetForImageClassification / ResNetModel (v1.5: stride on
+    the 3x3 middle conv, first stage unstrided — exactly our "b"
+    variant) -> our ResNet (models/resnet.py)."""
+    p = "resnet." if any(k.startswith("resnet.") for k in hf) else ""
+    out: Dict[str, np.ndarray] = {}
+
+    def convbn(src, dst):
+        out[dst + ".conv.weight"] = hf[src + ".convolution.weight"]
+        for a, b in (("weight", "weight"), ("bias", "bias"),
+                     ("running_mean", "_mean"),
+                     ("running_var", "_variance")):
+            out[f"{dst}.bn.{b}"] = hf[f"{src}.normalization.{a}"]
+
+    convbn(p + "embedder.embedder", "stem")
+    blocks, block_cls = cfg.block_plan()
+    names = ("conv0", "conv1", "conv2")[:3 if block_cls.expansion == 4
+                                        else 2]
+    for s, nb in enumerate(blocks):
+        for i in range(nb):
+            base = f"{p}encoder.stages.{s}.layers.{i}"
+            for j, nm in enumerate(names):
+                convbn(f"{base}.layer.{j}", f"stages.{s}.{i}.{nm}")
+            if f"{base}.shortcut.convolution.weight" in hf:
+                convbn(f"{base}.shortcut", f"stages.{s}.{i}.short")
+    if "classifier.1.weight" in hf:
+        out["head.weight"] = hf["classifier.1.weight"].T
+        out["head.bias"] = hf["classifier.1.bias"]
+    return out
+
+
 _CONVERTERS: Dict[str, Callable] = {
     "llama": _convert_llama,
     "qwen2": _convert_llama,   # Llama backbone + qkv bias (qwen2.py)
@@ -557,6 +590,7 @@ _CONVERTERS: Dict[str, Callable] = {
     "vit": _convert_vit,
     "clip": _convert_clip,
     "autoencoder_kl": _convert_vae,
+    "resnet": _convert_resnet,
 }
 
 # missing keys under these prefixes are heads a bare encoder checkpoint
@@ -667,6 +701,30 @@ def config_from_hf(model_dir: str):
             dtype=_jax_dtype(hf),
         )
         return ViTForImageClassification, cfg, mt
+    if mt == "resnet":
+        from .resnet import ResNet, ResNetConfig
+        depths = hf.get("depths", [3, 4, 6, 3])
+        bottleneck = hf.get("layer_type", "bottleneck") == "bottleneck"
+        exp = 4 if bottleneck else 1
+        w = hf.get("embedding_size", 64)
+        want = [w * (2 ** i) * exp for i in range(len(depths))]
+        if hf.get("hidden_sizes", want) != want:
+            raise ValueError(
+                f"non-standard ResNet hidden_sizes {hf.get('hidden_sizes')}"
+                f" (expected {want}); custom widths are not supported")
+        if hf.get("downsample_in_first_stage") or \
+                hf.get("downsample_in_bottleneck"):
+            raise ValueError("ResNet v1 downsample placement differs from "
+                             "our v1.5 ('b') layout")
+        cfg = ResNetConfig(
+            depth=50 if bottleneck else 18,   # selects the block class
+            layers=list(depths),
+            num_classes=len(hf.get("id2label") or {}) or 2,
+            in_channels=hf.get("num_channels", 3),
+            stem_width=w,
+            dtype=_jax_dtype(hf),
+        )
+        return ResNet, cfg, mt
     if mt == "clip":
         from .clip import CLIPConfig, CLIPModel, CLIPTextConfig
         from .vit import ViTConfig

@@ -316,6 +316,16 @@ class LlamaAttention(Layer):
                 # native, no KV repeat) / grouped-einsum fallback
                 out = decode_attention(q, ck, cv, cache_index,
                                        window=self.window)
+            elif isinstance(cache_index, int) and cache_index == 0 \
+                    and attn_start is None and cfg.use_flash_attention \
+                    and use_flash(q, k, None, 0.0):
+                # prefill at cache start: nothing earlier in the cache
+                # can be attended, so this is plain causal attention
+                # over the prompt — take the flash kernel instead of
+                # the masked-dense-over-full-cache path (O(s*T) scores
+                # and memory for a [s, T] mask)
+                out = flash_attention(q, k, v, causal=True,
+                                      window=self.window)
             else:
                 # prefill-with-cache (and left-padded serving batches):
                 # mask positions beyond cache_index+s; with attn_start,

@@ -129,7 +129,12 @@ class ResNet(Layer):
             stage = []
             for i in range(num_blocks):
                 stride = 2 if stage_idx > 0 and i == 0 else 1
-                shortcut = (i != 0)
+                # identity shortcut iff shapes already line up — the
+                # canonical rule (torch/paddle ResNet): basic stage 0
+                # block 0 is identity, bottleneck stage 0 needs the
+                # 1x1 expand
+                shortcut = (in_ch == out_ch * block_cls.expansion
+                            and stride == 1)
                 stage.append(block_cls(in_ch, out_ch, stride=stride,
                                        shortcut=shortcut,
                                        variant=config.variant))

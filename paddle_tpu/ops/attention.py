@@ -27,7 +27,10 @@ def _platform() -> str:
 def _flash_enabled() -> bool:
     if os.environ.get("PADDLE_TPU_DISABLE_FLASH"):
         return False
-    return _platform() == "tpu"
+    # interpret mode counts: CPU tests must be able to exercise every
+    # branch that will select the kernel on hardware
+    return _platform() == "tpu" or \
+        bool(os.environ.get("PADDLE_TPU_PALLAS_INTERPRET"))
 
 
 def use_flash(query, key, attn_mask, dropout_p) -> bool:

@@ -217,3 +217,30 @@ class TestSlidingWindow:
         assert half.model.layers[0].self_attn.window is None
         assert half.model.layers[1].self_attn.window == 8
         assert not np.allclose(np.asarray(half(ids)), np.asarray(full(ids)))
+
+
+class TestFlashPrefillBranch:
+    def test_generate_prefill_flash_matches_dense(self, monkeypatch):
+        """The cache_index==0 prefill branch routes to the flash kernel
+        (interpret mode here; hardware via tools/tpu_validate.py) and
+        must match the masked-dense-over-cache path exactly."""
+        monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+        import paddle_tpu as pt
+        from paddle_tpu.models import LlamaForCausalLM
+        from paddle_tpu.models.llama import llama_tiny
+        pt.seed(0)
+        mf = LlamaForCausalLM(llama_tiny(max_position_embeddings=256))
+        pt.seed(0)
+        md = LlamaForCausalLM(llama_tiny(max_position_embeddings=256,
+                                         use_flash_attention=False))
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (2, 128)))
+        cf = mf.init_kv_caches(2, 160)
+        lf, _ = mf(ids, kv_caches=cf, cache_index=0)
+        cd = md.init_kv_caches(2, 160)
+        ld, _ = md(ids, kv_caches=cd, cache_index=0)
+        np.testing.assert_allclose(np.asarray(lf), np.asarray(ld),
+                                   rtol=2e-4, atol=2e-4)
+        a = mf.generate(ids, max_new_tokens=8, temperature=0.0)
+        b = md.generate(ids, max_new_tokens=8, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

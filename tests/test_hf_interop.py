@@ -436,3 +436,39 @@ def test_vae_diffusers_roundtrip(tmp_module):
     r2, p2 = m2(x)
     np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
     np.testing.assert_array_equal(np.asarray(p1.mean), np.asarray(p2.mean))
+
+
+def test_resnet_logits_match(tmp_module):
+    """ResNet interop (v1.5 conv/bn stacks + running stats): eval-mode
+    logits parity with transformers."""
+    cfg = transformers.ResNetConfig(
+        embedding_size=16, hidden_sizes=[16, 32], depths=[1, 1],
+        layer_type="basic", num_channels=3,
+        id2label={i: str(i) for i in range(10)},
+        label2id={str(i): i for i in range(10)}, torch_dtype="float32")
+    hf_model, d = _save_hf(tmp_module / "resnet",
+                           transformers.ResNetForImageClassification, cfg)
+    model = from_pretrained(d)
+    model.eval()
+    px = np.random.RandomState(31).randn(2, 3, 32, 32).astype("float32")
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(px)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(px)))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_resnet50_bottleneck_logits_match(tmp_module):
+    cfg = transformers.ResNetConfig(
+        embedding_size=8, hidden_sizes=[32, 64], depths=[1, 2],
+        layer_type="bottleneck", num_channels=3,
+        id2label={i: str(i) for i in range(4)},
+        label2id={str(i): i for i in range(4)}, torch_dtype="float32")
+    hf_model, d = _save_hf(tmp_module / "resnet_bn",
+                           transformers.ResNetForImageClassification, cfg)
+    model = from_pretrained(d)
+    model.eval()
+    px = np.random.RandomState(32).randn(1, 3, 32, 32).astype("float32")
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(px)).logits.numpy()
+    got = np.asarray(model(jnp.asarray(px)))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)

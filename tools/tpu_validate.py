@@ -100,6 +100,32 @@ def deco():
     assert err < 3e-2, err
 check("decode_kernel", deco)
 
+def prefill_flash():
+    # the generate() prefill branch: flash at cache_index==0 must match
+    # the masked-dense-over-cache path it replaced (llama.py)
+    import paddle_tpu as pt
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.models.llama import llama_tiny
+    pt.seed(0)
+    mf = LlamaForCausalLM(llama_tiny(hidden_size=256,
+                                     num_attention_heads=4,
+                                     max_position_embeddings=512,
+                                     dtype=jnp.bfloat16))
+    pt.seed(0)
+    md = LlamaForCausalLM(llama_tiny(hidden_size=256,
+                                     num_attention_heads=4,
+                                     max_position_embeddings=512,
+                                     dtype=jnp.bfloat16,
+                                     use_flash_attention=False))
+    ids = jnp.asarray(rs.randint(0, 256, (2, 256)))
+    cf = mf.init_kv_caches(2, 384)
+    lf, _ = mf(ids, kv_caches=cf, cache_index=0)
+    cd = md.init_kv_caches(2, 384)
+    ld, _ = md(ids, kv_caches=cd, cache_index=0)
+    err = float(jnp.max(jnp.abs(lf - ld)))
+    assert err < 5e-2, err
+check("prefill_flash_vs_dense", prefill_flash)
+
 print("KERNELS_JSON " + json.dumps(results), flush=True)
 """
 
