@@ -323,8 +323,11 @@ class LlamaAttention(Layer):
                 # can be attended, so this is plain causal attention
                 # over the prompt — take the flash kernel instead of
                 # the masked-dense-over-full-cache path (O(s*T) scores
-                # and memory for a [s, T] mask)
-                out = flash_attention(q, k, v, causal=True,
+                # and memory for a [s, T] mask). K/V go through the
+                # cache dtype so prefill numerics match what decode
+                # steps will read back
+                out = flash_attention(q, k.astype(ck.dtype),
+                                      v.astype(cv.dtype), causal=True,
                                       window=self.window)
             else:
                 # prefill-with-cache (and left-padded serving batches):

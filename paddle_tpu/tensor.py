@@ -913,3 +913,326 @@ def cdist(x, y, p=2.0):
 def block_diag(inputs):
     import jax.scipy.linalg as _jsl
     return _jsl.block_diag(*inputs)
+
+
+# ---------------------------------------------------------------- round 4
+# flat-namespace widening (reference: python/paddle/tensor/* op lists)
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def digamma(x):
+    from jax.scipy.special import digamma as _dg
+    return _dg(x)
+
+
+def lgamma(x):
+    from jax.scipy.special import gammaln
+    return gammaln(x)
+
+
+def erfinv(x):
+    from jax.scipy.special import erfinv as _ei
+    return _ei(x)
+
+
+def signbit(x):
+    return jnp.signbit(x)
+
+
+def sgn(x):
+    """Complex-aware sign: x/|x| for complex, jnp.sign otherwise."""
+    if jnp.iscomplexobj(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+def ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x) - jnp.log1p(-x)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def t(x):
+    if x.ndim > 2:
+        raise ValueError(f"paddle.t expects ndim <= 2, got {x.ndim}")
+    return x.T
+
+
+def mv(x, vec):
+    return x @ vec
+
+
+def permute(x, *perm):
+    if len(perm) == 1 and isinstance(perm[0], (list, tuple)):
+        perm = tuple(perm[0])
+    return jnp.transpose(x, perm)
+
+
+def rank(x):
+    return jnp.asarray(jnp.ndim(x))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def _cum_extreme(x, axis, pick_right):
+    """cummax/cummin with indices via one associative scan over
+    (value, index) pairs — compiler-friendly, no python loop."""
+    import jax as _jax
+    axis = axis % x.ndim
+    idx = jnp.broadcast_to(
+        jnp.arange(x.shape[axis]).reshape(
+            [-1 if i == axis else 1 for i in range(x.ndim)]), x.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        takeb = pick_right(av, bv)
+        return (jnp.where(takeb, bv, av), jnp.where(takeb, bi, ai))
+
+    v, i = _jax.lax.associative_scan(combine, (x, idx), axis=axis)
+    return v, i.astype(jnp.int64)
+
+
+def cummax(x, axis=-1):
+    """(values, indices); ties keep the LAST occurrence (torch/paddle)."""
+    return _cum_extreme(x, axis, lambda a, b: b >= a)
+
+
+def cummin(x, axis=-1):
+    return _cum_extreme(x, axis, lambda a, b: b <= a)
+
+
+def dist(x, y, p=2.0):
+    d = (x - y).reshape(-1)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == 0:
+        return jnp.sum(d != 0).astype(x.dtype)
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+def dsplit(x, num_or_indices):
+    return jnp.dsplit(x, num_or_indices)
+
+
+def hsplit(x, num_or_indices):
+    return jnp.hsplit(x, num_or_indices)
+
+
+def vsplit(x, num_or_indices):
+    return jnp.vsplit(x, num_or_indices)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=dtype)
+
+
+def mode(x, axis=-1, keepdim=False):
+    """Most frequent value per slice; on count ties the LARGEST value
+    (torch/paddle convention). O(n^2) pairwise counting — op-parity
+    surface, not a hot path."""
+    axis = axis % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    eq = xm[..., :, None] == xm[..., None, :]
+    counts = jnp.sum(eq, axis=-1)
+    # rank by (count, value) so ties pick the largest value; integer
+    # key (count * (n+1) + value-rank) stays exact where a float key
+    # would absorb the rank term past 2^24
+    n = xm.shape[-1]
+    vrank = jnp.argsort(jnp.argsort(xm, axis=-1), axis=-1)
+    order = counts.astype(jnp.int32) * (n + 1) + vrank.astype(jnp.int32)
+    pos = jnp.argmax(order, axis=-1)
+    vals = jnp.take_along_axis(xm, pos[..., None], axis=-1)[..., 0]
+    # paddle returns the LAST index equal to the mode along the axis
+    is_mode = xm == vals[..., None]
+    idx = jnp.max(jnp.where(is_mode, jnp.arange(xm.shape[-1]), -1),
+                  axis=-1)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def nansum(x, axis=None, keepdim=False, dtype=None):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def index_put(x, indices, value, accumulate=False):
+    if accumulate:
+        return x.at[tuple(indices)].add(value)
+    return x.at[tuple(indices)].set(value)
+
+
+def index_sample(x, index):
+    """x [b, n], index [b, k] -> [b, k]: per-row gather."""
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def scatter_nd(index, updates, shape):
+    out = jnp.zeros(shape, updates.dtype)
+    return out.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    """Map global ids to shard-local ids; ids outside this shard become
+    ignore_value (reference: paddle.shard_index for sharded softmax)."""
+    per = (index_num + nshards - 1) // nshards
+    lo = shard_id * per
+    local = x - lo
+    ok = (x >= lo) & (x < lo + per)
+    return jnp.where(ok, local, ignore_value)
+
+
+def take(x, index, mode="raise"):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    idx = jnp.asarray(index)
+    if mode == "wrap":
+        idx = idx % n
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    else:  # "raise": jit cannot raise; paddle docs allow negative wrap
+        idx = jnp.where(idx < 0, idx + n, idx)
+    return flat[idx]
+
+
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def unfold(x, axis, size, step):
+    """Sliding windows: paddle.Tensor.unfold (torch layout — the window
+    dim appended last)."""
+    axis = axis % x.ndim
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    windows = jnp.stack(
+        [jax.lax.dynamic_slice_in_dim(x, int(s), size, axis)
+         for s in starts], axis=axis)
+    return jnp.moveaxis(windows, axis + 1, -1)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    """Collapse consecutive duplicates (paddle/torch semantics). Host
+    sync: output size is data-dependent — not for use inside jit."""
+    import numpy as _np
+    xs = _np.asarray(x)
+    if axis is None:
+        flatx = xs.reshape(-1)
+        keep = _np.ones(flatx.shape[0], bool)
+        keep[1:] = flatx[1:] != flatx[:-1]
+        out = jnp.asarray(flatx[keep])
+    else:
+        moved = _np.moveaxis(xs, axis, 0)
+        keep = _np.ones(moved.shape[0], bool)
+        keep[1:] = _np.any(
+            moved[1:].reshape(moved.shape[0] - 1, -1)
+            != moved[:-1].reshape(moved.shape[0] - 1, -1), axis=1)
+        out = jnp.asarray(_np.moveaxis(moved[keep], 0, axis))
+    res = (out,)
+    if return_inverse:
+        res += (jnp.asarray(_np.cumsum(keep) - 1),)
+    if return_counts:
+        res += (jnp.asarray(_np.diff(
+            _np.append(_np.flatnonzero(keep), keep.shape[0]))),)
+    return res if len(res) > 1 else res[0]
+
+
+def unstack(x, axis=0, num=None):
+    n = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(s, axis)
+            for s in jnp.split(x, n, axis=axis)]
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def polar(abs_, angle_):
+    return abs_ * (jnp.cos(angle_) + 1j * jnp.sin(angle_))

@@ -1,0 +1,149 @@
+"""Round-4 flat-namespace ops vs numpy/torch semantics (SURVEY C1)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+torch = pytest.importorskip("torch")
+
+
+def _r(*s, seed=0):
+    return np.random.RandomState(seed).randn(*s).astype("float32")
+
+
+def test_elementwise_batch():
+    x = _r(3, 4) * 2
+    y = _r(3, 4, seed=1) * 2 + 0.1
+    for name in ("acosh", "asinh", "atanh", "deg2rad", "rad2deg",
+                 "digamma", "lgamma", "frac", "signbit"):
+        arg = np.abs(x) + 1.5 if name == "acosh" else \
+            np.clip(x, -0.9, 0.9) if name == "atanh" else np.abs(x) + 0.5
+        got = np.asarray(getattr(pt, name)(jnp.asarray(arg)))
+        ref = getattr(torch, name)(torch.tensor(arg)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5,
+                                   err_msg=name)
+    for name in ("hypot", "logaddexp", "fmax", "fmin", "nextafter"):
+        got = np.asarray(getattr(pt, name)(jnp.asarray(x), jnp.asarray(y)))
+        ref = getattr(torch, name)(torch.tensor(x),
+                                   torch.tensor(y)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-6, err_msg=name)
+
+
+def test_cummax_cummin_match_torch():
+    x = np.random.RandomState(2).randint(0, 5, (4, 7)).astype("float32")
+    for name in ("cummax", "cummin"):
+        gv, gi = getattr(pt, name)(jnp.asarray(x), axis=1)
+        rv, ri = getattr(torch, name)(torch.tensor(x), dim=1)
+        np.testing.assert_array_equal(np.asarray(gv), rv.numpy(),
+                                      err_msg=name)
+        np.testing.assert_array_equal(np.asarray(gi), ri.numpy(),
+                                      err_msg=name + " indices")
+
+
+def test_mode_matches_torch():
+    x = np.random.RandomState(3).randint(0, 4, (5, 9)).astype("float32")
+    gv, gi = pt.mode(jnp.asarray(x), axis=-1)
+    rv, _ = torch.mode(torch.tensor(x), dim=-1)
+    # torch.mode picks the SMALLEST most-frequent value; paddle the
+    # largest — compare counts, not raw equality, plus paddle semantics
+    for r in range(x.shape[0]):
+        row = x[r]
+        c_got = (row == float(gv[r])).sum()
+        c_ref = (row == float(rv[r])).sum()
+        assert c_got == c_ref, (r, float(gv[r]), float(rv[r]))
+        assert row[int(gi[r])] == float(gv[r])
+
+
+def test_gather_scatter_family():
+    x = _r(4, 6)
+    idx = np.random.RandomState(4).randint(0, 6, (4, 3))
+    np.testing.assert_array_equal(
+        np.asarray(pt.index_sample(jnp.asarray(x), jnp.asarray(idx))),
+        np.take_along_axis(x, idx, axis=1))
+    # scatter_nd accumulates
+    index = np.array([[1], [1], [3]])
+    ups = np.array([1.0, 2.0, 4.0], "float32")
+    out = np.asarray(pt.scatter_nd(jnp.asarray(index), jnp.asarray(ups),
+                                   (5,)))
+    np.testing.assert_allclose(out, [0, 3, 0, 4, 0])
+    # index_put with accumulate
+    base = jnp.zeros((3, 3))
+    got = pt.index_put(base, (jnp.asarray([0, 0]), jnp.asarray([1, 1])),
+                       jnp.asarray([1.0, 2.0]), accumulate=True)
+    assert float(got[0, 1]) == 3.0
+    # take modes
+    flat = jnp.asarray(np.arange(6.0))
+    np.testing.assert_allclose(
+        np.asarray(pt.take(flat, jnp.asarray([7, -1]), mode="wrap")),
+        [1.0, 5.0])
+    np.testing.assert_allclose(
+        np.asarray(pt.take(flat, jnp.asarray([7]), mode="clip")), [5.0])
+
+
+def test_linalg_and_shapes():
+    x = _r(3, 3)
+    np.testing.assert_allclose(np.asarray(pt.inverse(jnp.asarray(x))),
+                               np.linalg.inv(x), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(pt.trace(jnp.asarray(x))),
+                               np.trace(x), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(pt.mv(jnp.asarray(x), jnp.asarray(x[0]))), x @ x[0],
+        rtol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(pt.t(jnp.asarray(x))), x.T)
+    np.testing.assert_array_equal(
+        np.asarray(pt.permute(jnp.asarray(_r(2, 3, 4)), 2, 0, 1)),
+        _r(2, 3, 4).transpose(2, 0, 1))
+    parts = pt.unstack(jnp.asarray(x), axis=0)
+    assert len(parts) == 3 and parts[0].shape == (3,)
+    np.testing.assert_array_equal(
+        np.asarray(pt.vander(jnp.asarray(np.array([1.0, 2, 3])), n=3)),
+        np.vander([1.0, 2, 3], 3))
+    assert int(pt.rank(jnp.zeros((2, 3)))) == 2
+
+
+def test_unfold_matches_torch():
+    x = _r(2, 10)
+    got = np.asarray(pt.unfold(jnp.asarray(x), axis=1, size=4, step=3))
+    ref = torch.tensor(x).unfold(1, 4, 3).numpy()
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_unique_consecutive_matches_torch():
+    x = np.array([1, 1, 2, 2, 3, 1, 1, 2], "int32")
+    out, inv, counts = pt.unique_consecutive(jnp.asarray(x),
+                                             return_inverse=True,
+                                             return_counts=True)
+    ro, ri, rc = torch.unique_consecutive(torch.tensor(x),
+                                          return_inverse=True,
+                                          return_counts=True)
+    np.testing.assert_array_equal(np.asarray(out), ro.numpy())
+    np.testing.assert_array_equal(np.asarray(inv), ri.numpy())
+    np.testing.assert_array_equal(np.asarray(counts), rc.numpy())
+
+
+def test_misc_numerics():
+    x = _r(8)
+    y = _r(8, seed=5)
+    np.testing.assert_allclose(
+        np.asarray(pt.dist(jnp.asarray(x), jnp.asarray(y), p=2)),
+        np.linalg.norm(x - y), rtol=1e-5)
+    p = np.clip(np.abs(x), 0.01, 0.99)
+    np.testing.assert_allclose(
+        np.asarray(pt.logit(jnp.asarray(p))),
+        torch.logit(torch.tensor(p)).numpy(), rtol=2e-5, atol=1e-6)
+    z = np.asarray(pt.polar(jnp.asarray(np.abs(x)), jnp.asarray(y)))
+    ref = torch.polar(torch.tensor(np.abs(x)), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(z, ref, rtol=1e-5, atol=1e-6)
+    a = np.array([4, 6, 9]); b = np.array([6, 4, 6])
+    np.testing.assert_array_equal(
+        np.asarray(pt.gcd(jnp.asarray(a), jnp.asarray(b))), [2, 2, 3])
+    np.testing.assert_array_equal(
+        np.asarray(pt.lcm(jnp.asarray(a), jnp.asarray(b))), [12, 12, 18])
+    np.testing.assert_array_equal(
+        np.asarray(pt.shard_index(jnp.asarray(np.array([0, 5, 9, 15])),
+                                  16, 4, 1)), [-1, 1, -1, -1])
+    got = np.asarray(pt.kron(jnp.asarray(np.eye(2)),
+                             jnp.asarray(np.ones((2, 2)))))
+    np.testing.assert_array_equal(got, np.kron(np.eye(2), np.ones((2, 2))))
