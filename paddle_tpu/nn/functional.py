@@ -322,9 +322,20 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, n):
     return out
 
 
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    return _convnd_transpose(x, weight, bias, stride, padding,
+                             output_padding, dilation, groups, 1)
+
+
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1):
-    n = 2
+    return _convnd_transpose(x, weight, bias, stride, padding,
+                             output_padding, dilation, groups, 2)
+
+
+def _convnd_transpose(x, weight, bias, stride, padding, output_padding,
+                      dilation, groups, n):
     stride = _norm_tuple(stride, n)
     dilation = _norm_tuple(dilation, n)
     p = _norm_tuple(padding, n)
@@ -356,29 +367,39 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 
 # ------------------------------------------------------------------ pooling
-def max_pool2d(x, kernel_size, stride=None, padding=0):
-    k = _norm_tuple(kernel_size, 2)
-    s = _norm_tuple(stride if stride is not None else kernel_size, 2)
-    p = _norm_tuple(padding, 2)
+def _pool_nd(x, kernel_size, stride, padding, nd, op, exclusive=True):
+    """One reduce_window pooling definition for every rank (1/2/3-D)."""
+    k = _norm_tuple(kernel_size, nd)
+    s = _norm_tuple(stride if stride is not None else kernel_size, nd)
+    p = _norm_tuple(padding, nd)
     pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
-    # -inf (the max-monoid identity) lets JAX recognise this as
-    # reduce_window_max, which has a transpose rule; finfo.min would fall
-    # into the generic reduce_window with no reverse-mode autodiff.
-    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-    return lax.reduce_window(x, neg, lax.max, (1, 1) + k, (1, 1) + s, pads)
+    if op == "max":
+        # -inf (the max-monoid identity) lets JAX recognise this as
+        # reduce_window_max, which has a transpose rule; finfo.min would
+        # fall into the generic reduce_window with no reverse-mode
+        # autodiff.
+        neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, neg, lax.max, (1, 1) + k,
+                                 (1, 1) + s, pads)
+    summed = lax.reduce_window(x, 0.0, lax.add, (1, 1) + k,
+                               (1, 1) + s, pads)
+    if exclusive and any(p):
+        # padded positions do not count toward the average (paddle's
+        # exclusive=True / torch count_include_pad=False)
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, (1, 1) + k,
+                                   (1, 1) + s, pads)
+        return summed / counts
+    return summed / math.prod(k)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0):
+    return _pool_nd(x, kernel_size, stride, padding, 2, "max")
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True):
-    k = _norm_tuple(kernel_size, 2)
-    s = _norm_tuple(stride if stride is not None else kernel_size, 2)
-    p = _norm_tuple(padding, 2)
-    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
-    summed = lax.reduce_window(x, 0.0, lax.add, (1, 1) + k, (1, 1) + s, pads)
-    if exclusive and any(p):
-        ones = jnp.ones_like(x)
-        counts = lax.reduce_window(ones, 0.0, lax.add, (1, 1) + k, (1, 1) + s, pads)
-        return summed / counts
-    return summed / math.prod(k)
+    return _pool_nd(x, kernel_size, stride, padding, 2, "avg", exclusive)
 
 
 def adaptive_avg_pool2d(x, output_size):
@@ -688,4 +709,374 @@ def ctc_loss(log_probs, labels, input_lengths=None, label_lengths=None,
         return jnp.mean(loss / jnp.maximum(label_lengths, 1))
     if reduction == "sum":
         return jnp.sum(loss)
+    return loss
+
+
+# ---------------------------------------------------------------- round 4
+# functional surface widening (reference: python/paddle/nn/functional/*)
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    """paddle.nn.functional.pad. Partial specs ([left, right, top,
+    bottom, ...]) apply to the TRAILING dims innermost-first (the
+    torch/paddle spatial convention); a FULL spec (len == 2 * ndim)
+    applies pairs from dim 0 outward (paddle's convention)."""
+    if data_format not in ("NCHW", "NCL", "NCDHW"):
+        raise NotImplementedError(
+            f"data_format {data_format!r}: channels-last layouts are "
+            "not supported (TPU path is channels-first)")
+    pad = list(pad)
+    if len(pad) % 2:
+        raise ValueError("pad length must be even")
+    n_pairs = len(pad) // 2
+    cfg = [(0, 0)] * x.ndim
+    if n_pairs == x.ndim:
+        for i in range(n_pairs):
+            cfg[i] = (pad[2 * i], pad[2 * i + 1])
+    else:
+        for i in range(n_pairs):
+            # pair i applies to dim -(i+1)
+            cfg[x.ndim - 1 - i] = (pad[2 * i], pad[2 * i + 1])
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def zeropad2d(x, padding):
+    l, r, t, b = _norm_tuple(padding, 4) if not isinstance(padding, int) \
+        else (padding,) * 4
+    return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "max")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "avg", exclusive)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "max")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "avg", exclusive)
+
+
+def adaptive_avg_pool1d(x, output_size):
+    n, c, l = x.shape
+    out = output_size if isinstance(output_size, int) else output_size[0]
+    assert l % out == 0, "adaptive pool needs divisible sizes"
+    return avg_pool1d(x, l // out, l // out)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """Scatter pooled values back to their argmax positions (indices as
+    flat h*w offsets, the paddle/torch convention)."""
+    k = _norm_tuple(kernel_size, 2)
+    s = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    n, c, h, w = x.shape
+    if output_size is None:
+        oh = (h - 1) * s[0] + k[0] - 2 * _norm_tuple(padding, 2)[0]
+        ow = (w - 1) * s[1] + k[1] - 2 * _norm_tuple(padding, 2)[1]
+    else:
+        oh, ow = output_size[-2:]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = flat.at[
+        jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+        indices.reshape(n, c, -1)].set(x.reshape(n, c, -1))
+    return out.reshape(n, c, oh, ow)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1):
+    """col2im — the inverse of unfold: overlapping patches sum back
+    (paddle.nn.functional.fold)."""
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    p = _norm_tuple(paddings, 2)
+    d = _norm_tuple(dilations, 2)
+    oh_img, ow_img = _norm_tuple(output_sizes, 2)
+    n, ckk, L = x.shape
+    c = ckk // (k[0] * k[1])
+    oh = (oh_img + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+    ow = (ow_img + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+    assert oh * ow == L, (oh, ow, L)
+    cols = x.reshape(n, c, k[0], k[1], oh, ow)
+    out = jnp.zeros((n, c, oh_img + 2 * p[0], ow_img + 2 * p[1]), x.dtype)
+    for i in range(k[0]):
+        for j in range(k[1]):
+            out = out.at[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                         j * d[1]: j * d[1] + ow * s[1]: s[1]].add(
+                cols[:, :, i, j])
+    return out[:, :, p[0]: p[0] + oh_img, p[1]: p[1] + ow_img]
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """theta [n, 2, 3] -> sampling grid [n, h, w, 2] (normalized xy),
+    matching paddle/torch affine_grid."""
+    n, _, h, w = out_shape
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) + 0.5) * 2.0 / h - 1.0
+        xs = (jnp.arange(w) + 0.5) * 2.0 / w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [h, w, 3]
+    return jnp.einsum("hwk,nck->nhwc", base, theta)          # [n,h,w,2]
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """x [n, c, h, w], grid [n, oh, ow, 2] normalized xy -> sampled
+    [n, c, oh, ow]. Bilinear/nearest, zeros/border padding."""
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sample padding_mode {padding_mode!r} (zeros/border)")
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1.0) * (w - 1) / 2.0
+        fy = (gy + 1.0) * (h - 1) / 2.0
+    else:
+        fx = ((gx + 1.0) * w - 1.0) / 2.0
+        fy = ((gy + 1.0) * h - 1.0) / 2.0
+
+    def sample_at(ix, iy):
+        inb = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        cx = jnp.clip(ix, 0, w - 1)
+        cy = jnp.clip(iy, 0, h - 1)
+        v = x[jnp.arange(n)[:, None, None, None],
+              jnp.arange(c)[None, :, None, None],
+              cy[:, None], cx[:, None]]
+        if padding_mode == "zeros":
+            v = v * inb[:, None]
+        return v
+
+    if mode == "nearest":
+        return sample_at(jnp.round(fx).astype(jnp.int32),
+                         jnp.round(fy).astype(jnp.int32))
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    wx = (fx - x0)[:, None]
+    wy = (fy - y0)[:, None]
+    v00 = sample_at(x0, y0)
+    v01 = sample_at(x0 + 1, y0)
+    v10 = sample_at(x0, y0 + 1)
+    v11 = sample_at(x0 + 1, y0 + 1)
+    return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+            + v10 * (1 - wx) * wy + v11 * wx * wy)
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    return x.reshape(n, groups, c // groups, h, w) \
+        .transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+
+def pixel_unshuffle(x, downscale_factor):
+    n, c, h, w = x.shape
+    r = downscale_factor
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    return x.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r,
+                                                 h // r, w // r)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    half = size // 2
+    pads = ((0, 0), (half, size - 1 - half), (0, 0), (0, 0))
+    acc = lax.reduce_window(sq, 0.0, lax.add, (1, size, 1, 1),
+                            (1, 1, 1, 1), pads)
+    return x / (k + alpha * acc / size) ** beta
+
+
+def alpha_dropout(x, p=0.5, training=True, key=None):
+    """SELU-preserving dropout (paddle/torch formula)."""
+    if not training or p == 0.0:
+        return x
+    from ..utils.rng import next_key
+    key = key if key is not None else next_key()
+    alpha_p = -1.7580993408473766
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+    return a * jnp.where(keep, x, alpha_p) + b
+
+
+def dropout3d(x, p=0.5, training=True, key=None):
+    if not training or p == 0.0:
+        return x
+    from ..utils.rng import next_key
+    key = key if key is not None else next_key()
+    mask = jax.random.bernoulli(key, 1.0 - p, x.shape[:2] + (1, 1, 1))
+    return x * mask / (1.0 - p)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="bool"):
+    """maxlen=None reads max(lengths) on the HOST — pass an explicit
+    (static) maxlen under jit."""
+    ml = int(maxlen) if maxlen is not None else int(jnp.max(lengths))
+    return (jnp.arange(ml)[None, :]
+            < jnp.asarray(lengths)[..., None]).astype(dtype)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    """paddle.nn.functional.bilinear: weight [out, in1, in2]."""
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    return out + bias if bias is not None else out
+
+
+def maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    shape = list(x.shape)
+    shape[axis: axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True, key=None):
+    if not training:
+        return jnp.where(x >= 0, x, x * (lower + upper) / 2)
+    from ..utils.rng import next_key
+    key = key if key is not None else next_key()
+    slope = jax.random.uniform(key, x.shape, minval=lower, maxval=upper)
+    return jnp.where(x >= 0, x, x * slope)
+
+
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+# ------------------------------------------------------------ round-4 losses
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return -label * jnp.log(input + epsilon) \
+        - (1.0 - label) * jnp.log(1.0 - input + epsilon)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = label * jnp.log(label + epsilon) - label \
+            + 0.5 * jnp.log(2 * jnp.pi * (label + epsilon))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1.0, input,
+                     jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0,
+                        reduction="mean"):
+    loss = jnp.maximum(0.0, -label * (input - other) + margin)
+    return _reduce(loss, reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean"):
+    return _reduce(jnp.log1p(jnp.exp(-label * input)), reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    sim = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1)
+        * jnp.linalg.norm(input2, axis=-1), 1e-12)
+    loss = jnp.where(label == 1, 1.0 - sim,
+                     jnp.maximum(0.0, sim - margin))
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(anchor, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, reduction="mean"):
+    dp = jnp.sum(jnp.abs(anchor - positive) ** p, axis=-1) ** (1.0 / p)
+    dn = jnp.sum(jnp.abs(anchor - negative) ** p, axis=-1) ** (1.0 / p)
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = jnp.sum(jnp.abs(x - y + epsilon) ** p, axis=-1) ** (1.0 / p)
+    return d[..., None] if keepdim else d
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum"):
+    p = sigmoid(logit)
+    ce = -(label * jax.nn.log_sigmoid(logit)
+           + (1 - label) * jax.nn.log_sigmoid(-logit))
+    pt_ = label * p + (1 - label) * (1 - p)
+    a = label * alpha + (1 - label) * (1 - alpha)
+    loss = a * (1 - pt_) ** gamma * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """input [n, ..., c] probabilities, label [n, ..., 1] int."""
+    c = input.shape[-1]
+    oh = jax.nn.one_hot(label.squeeze(-1), c, dtype=input.dtype)
+    red = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * oh, axis=red)
+    union = jnp.sum(input + oh, axis=red)
+    return jnp.mean(1.0 - 2.0 * inter / (union + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair loss (reference semantics: paddle.nn.functional.npair_loss)."""
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), axis=-1))
+                    + jnp.mean(jnp.sum(jnp.square(positive), axis=-1))) / 4
+    sim = anchor @ positive.T
+    lab = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    lab = lab / jnp.sum(lab, axis=1, keepdims=True)
+    ce = -jnp.mean(jnp.sum(lab * jax.nn.log_softmax(sim, axis=1), axis=1))
+    return ce + reg
+
+
+def hsigmoid_loss(*args, **kw):
+    raise NotImplementedError(
+        "hierarchical sigmoid needs a host-side Huffman tree; use "
+        "margin_cross_entropy / cross_entropy on TPU (the reference's "
+        "GPU kernel has no XLA analogue worth the tree plumbing)")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-family margin softmax (reference:
+    paddle.nn.functional.margin_cross_entropy, single-rank case):
+    cos(m1*theta + m2) - m3 applied to the target logit."""
+    c = logits.shape[-1]
+    cos = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    tgt = jnp.cos(margin1 * theta + margin2) - margin3
+    oh = jax.nn.one_hot(label, c, dtype=logits.dtype)
+    out = scale * (oh * tgt + (1 - oh) * cos)
+    logp = jax.nn.log_softmax(out, axis=-1)
+    loss = _reduce(-jnp.sum(oh * logp, axis=-1), reduction)
+    if return_softmax:
+        return loss, jnp.exp(logp)
     return loss

@@ -1,0 +1,228 @@
+"""Round-4 nn.functional surface vs torch semantics (SURVEY C4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+TF = torch.nn.functional
+
+
+def _r(*s, seed=0):
+    return np.random.RandomState(seed).randn(*s).astype("float32")
+
+
+def test_pad_modes():
+    x = _r(2, 3, 4, 5)
+    for mode in ("constant", "reflect", "replicate", "circular"):
+        got = np.asarray(F.pad(jnp.asarray(x), [1, 2, 2, 1], mode=mode))
+        ref = TF.pad(torch.tensor(x), [1, 2, 2, 1], mode=mode).numpy()
+        np.testing.assert_array_equal(got, ref, err_msg=mode)
+    got = np.asarray(F.zeropad2d(jnp.asarray(x), (1, 2, 3, 4)))
+    ref = TF.pad(torch.tensor(x), [1, 2, 3, 4]).numpy()
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_pool_1d_3d():
+    x1 = _r(2, 3, 12)
+    np.testing.assert_allclose(
+        np.asarray(F.max_pool1d(jnp.asarray(x1), 3, 2, 1)),
+        TF.max_pool1d(torch.tensor(x1), 3, 2, 1).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(F.avg_pool1d(jnp.asarray(x1), 2, 2)),
+        TF.avg_pool1d(torch.tensor(x1), 2, 2).numpy(), rtol=1e-6)
+    x3 = _r(1, 2, 6, 6, 6)
+    np.testing.assert_allclose(
+        np.asarray(F.max_pool3d(jnp.asarray(x3), 2, 2)),
+        TF.max_pool3d(torch.tensor(x3), 2, 2).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(F.adaptive_avg_pool1d(jnp.asarray(x1), 4)),
+        TF.adaptive_avg_pool1d(torch.tensor(x1), 4).numpy(), rtol=1e-6)
+
+
+def test_unpool_roundtrip():
+    x = _r(1, 2, 8, 8)
+    tx = torch.tensor(x)
+    pooled, idx = TF.max_pool2d(tx, 2, 2, return_indices=True)
+    got = np.asarray(F.max_unpool2d(jnp.asarray(pooled.numpy()),
+                                    jnp.asarray(idx.numpy()), 2, 2))
+    ref = TF.max_unpool2d(pooled, idx, 2, 2).numpy()
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_fold_unfold_roundtrip():
+    x = _r(2, 3, 8, 8)
+    cols = F.unfold(jnp.asarray(x), 3, stride=2, padding=1)
+    ref_cols = TF.unfold(torch.tensor(x), 3, stride=2, padding=1)
+    np.testing.assert_allclose(np.asarray(cols), ref_cols.numpy(),
+                               rtol=1e-6)
+    back = F.fold(cols, (8, 8), 3, strides=2, paddings=1)
+    ref_back = TF.fold(ref_cols, (8, 8), 3, stride=2, padding=1)
+    np.testing.assert_allclose(np.asarray(back), ref_back.numpy(),
+                               rtol=1e-6)
+
+
+def test_grid_sample_and_affine_grid():
+    x = _r(2, 3, 6, 7)
+    theta = np.asarray([[[0.8, 0.1, 0.05], [-0.1, 0.9, -0.02]]] * 2,
+                       dtype="float32")
+    for ac in (True, False):
+        grid = F.affine_grid(jnp.asarray(theta), (2, 3, 5, 6),
+                             align_corners=ac)
+        rgrid = TF.affine_grid(torch.tensor(theta), (2, 3, 5, 6),
+                               align_corners=ac)
+        np.testing.assert_allclose(np.asarray(grid), rgrid.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        got = np.asarray(F.grid_sample(jnp.asarray(x), grid,
+                                       align_corners=ac))
+        ref = TF.grid_sample(torch.tensor(x), rgrid,
+                             align_corners=ac).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"ac={ac}")
+
+
+def test_shuffles_and_norm():
+    x = _r(2, 8, 4, 4)
+    np.testing.assert_array_equal(
+        np.asarray(F.channel_shuffle(jnp.asarray(x), 4)),
+        TF.channel_shuffle(torch.tensor(x), 4).numpy())
+    np.testing.assert_array_equal(
+        np.asarray(F.pixel_unshuffle(jnp.asarray(x), 2)),
+        TF.pixel_unshuffle(torch.tensor(x), 2).numpy())
+    np.testing.assert_allclose(
+        np.asarray(F.local_response_norm(jnp.asarray(x), 3)),
+        TF.local_response_norm(torch.tensor(x), 3).numpy(),
+        rtol=1e-5)
+
+
+def test_round4_losses_match_torch():
+    a, b = _r(4, 6), _r(4, 6, seed=1)
+    lab = np.sign(_r(4, seed=2)).astype("float32")
+    cases = [
+        (F.margin_ranking_loss(jnp.asarray(a[:, 0]), jnp.asarray(b[:, 0]),
+                               jnp.asarray(lab), margin=0.3),
+         TF.margin_ranking_loss(torch.tensor(a[:, 0]),
+                                torch.tensor(b[:, 0]),
+                                torch.tensor(lab), margin=0.3)),
+        (F.soft_margin_loss(jnp.asarray(a), jnp.asarray(np.sign(b))),
+         TF.soft_margin_loss(torch.tensor(a),
+                             torch.tensor(np.sign(b)))),
+        (F.hinge_embedding_loss(jnp.asarray(a), jnp.asarray(np.sign(b))),
+         TF.hinge_embedding_loss(torch.tensor(a),
+                                 torch.tensor(np.sign(b)))),
+        (F.cosine_embedding_loss(jnp.asarray(a), jnp.asarray(b),
+                                 jnp.asarray(lab)),
+         TF.cosine_embedding_loss(torch.tensor(a), torch.tensor(b),
+                                  torch.tensor(lab))),
+        (F.triplet_margin_loss(jnp.asarray(a), jnp.asarray(b),
+                               jnp.asarray(_r(4, 6, seed=3))),
+         TF.triplet_margin_loss(torch.tensor(a), torch.tensor(b),
+                                torch.tensor(_r(4, 6, seed=3)))),
+        (F.poisson_nll_loss(jnp.asarray(a), jnp.asarray(np.abs(b))),
+         TF.poisson_nll_loss(torch.tensor(a), torch.tensor(np.abs(b)))),
+        (F.multi_label_soft_margin_loss(
+            jnp.asarray(a), jnp.asarray((b > 0).astype("float32"))),
+         TF.multilabel_soft_margin_loss(
+             torch.tensor(a), torch.tensor((b > 0).astype("float32")))),
+    ]
+    for i, (got, ref) in enumerate(cases):
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-4,
+                                   err_msg=str(i))
+    np.testing.assert_allclose(
+        np.asarray(F.pairwise_distance(jnp.asarray(a), jnp.asarray(b))),
+        TF.pairwise_distance(torch.tensor(a), torch.tensor(b)).numpy(),
+        rtol=1e-4)
+
+
+def test_misc_activations_and_utils():
+    x = _r(3, 8)
+    np.testing.assert_allclose(
+        np.asarray(F.thresholded_relu(jnp.asarray(x), 0.5)),
+        TF.threshold(torch.tensor(x), 0.5, 0.0).numpy())
+    np.testing.assert_allclose(
+        np.asarray(F.maxout(jnp.asarray(x), 2)),
+        np.max(x.reshape(3, 4, 2), axis=2))
+    m = np.asarray(F.sequence_mask(jnp.asarray([1, 3, 2]), maxlen=4))
+    np.testing.assert_array_equal(
+        m, [[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+    w = _r(5, 6, 7, seed=9)
+    got = np.asarray(F.bilinear(jnp.asarray(x[:, :6]),
+                                jnp.asarray(_r(3, 7, seed=8)),
+                                jnp.asarray(w)))
+    ref = TF.bilinear(torch.tensor(x[:, :6]),
+                      torch.tensor(_r(3, 7, seed=8)),
+                      torch.tensor(w)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # rrelu eval mode is deterministic
+    np.testing.assert_allclose(
+        np.asarray(F.rrelu(jnp.asarray(x), training=False)),
+        TF.rrelu(torch.tensor(x), training=False).numpy(), rtol=1e-6)
+
+
+def test_focal_and_dice():
+    logit = _r(4, 3)
+    lab = (np.abs(_r(4, 3, seed=5)) > 0.5).astype("float32")
+    got = float(F.sigmoid_focal_loss(jnp.asarray(logit),
+                                     jnp.asarray(lab)))
+    # torchvision is absent: check against the formula directly
+    p_ = 1.0 / (1.0 + np.exp(-logit))
+    ce = -(lab * np.log(p_) + (1 - lab) * np.log(1 - p_))
+    pt_ = lab * p_ + (1 - lab) * (1 - p_)
+    a = lab * 0.25 + (1 - lab) * 0.75
+    ref = float(np.sum(a * (1 - pt_) ** 2.0 * ce))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_dice_loss_perfect_prediction_is_zero():
+    lab = np.array([[0], [1], [2]], "int64")[:, :]
+    probs = np.eye(3, dtype="float32")[lab.squeeze(-1)]
+    loss = float(F.dice_loss(jnp.asarray(probs),
+                             jnp.asarray(lab)))
+    assert loss < 1e-4
+
+
+def test_hsigmoid_raises_with_guidance():
+    with pytest.raises(NotImplementedError, match="margin_cross_entropy"):
+        F.hsigmoid_loss()
+
+
+def test_margin_cross_entropy_reduces_to_ce_at_zero_margins():
+    feats = _r(4, 8)
+    cos = feats / np.linalg.norm(feats, axis=1, keepdims=True)
+    lab = np.array([0, 1, 2, 3])
+    got = float(F.margin_cross_entropy(jnp.asarray(cos), jnp.asarray(lab),
+                                       margin1=1.0, margin2=0.0,
+                                       margin3=0.0, scale=10.0))
+    ref = float(TF.cross_entropy(torch.tensor(cos * 10.0),
+                                 torch.tensor(lab)))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_pad_full_length_leading_dims():
+    """Full spec (2*ndim entries) pads from dim 0 (paddle convention)."""
+    x = jnp.zeros((2, 3, 4, 5))
+    out = F.pad(x, [1, 1, 0, 0, 0, 0, 0, 0])
+    assert out.shape == (4, 3, 4, 5)
+    with pytest.raises(NotImplementedError, match="channels-last"):
+        F.pad(x, [1, 1], data_format="NHWC")
+
+
+def test_avg_pool1d_exclusive_padding():
+    """Padded positions don't count toward the average (paddle
+    exclusive=True), matching avg_pool2d and torch
+    count_include_pad=False."""
+    x = jnp.ones((1, 1, 4))
+    got = np.asarray(F.avg_pool1d(x, 2, 2, padding=1))
+    np.testing.assert_allclose(got, [[[1.0, 1.0, 1.0]]])
+    ref = TF.avg_pool1d(torch.ones(1, 1, 4), 2, 2, padding=1,
+                        count_include_pad=False).numpy()
+    np.testing.assert_allclose(got, ref)
+
+
+def test_grid_sample_rejects_reflection():
+    x = jnp.zeros((1, 1, 4, 4))
+    grid = jnp.zeros((1, 2, 2, 2))
+    with pytest.raises(NotImplementedError, match="padding_mode"):
+        F.grid_sample(x, grid, padding_mode="reflection")
