@@ -899,8 +899,8 @@ def alpha_dropout(x, p=0.5, training=True, key=None):
     """SELU-preserving dropout (paddle/torch formula)."""
     if not training or p == 0.0:
         return x
-    from ..utils.rng import next_key
-    key = key if key is not None else next_key()
+    assert key is not None, \
+        "alpha_dropout in training mode needs an explicit PRNG key"
     alpha_p = -1.7580993408473766
     keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
     a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
@@ -911,13 +911,13 @@ def alpha_dropout(x, p=0.5, training=True, key=None):
 def dropout3d(x, p=0.5, training=True, key=None):
     if not training or p == 0.0:
         return x
-    from ..utils.rng import next_key
-    key = key if key is not None else next_key()
+    assert key is not None, \
+        "dropout3d in training mode needs an explicit PRNG key"
     mask = jax.random.bernoulli(key, 1.0 - p, x.shape[:2] + (1, 1, 1))
     return x * mask / (1.0 - p)
 
 
-def sequence_mask(lengths, maxlen=None, dtype="bool"):
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
     """maxlen=None reads max(lengths) on the HOST — pass an explicit
     (static) maxlen under jit."""
     ml = int(maxlen) if maxlen is not None else int(jnp.max(lengths))
@@ -932,6 +932,7 @@ def bilinear(x1, x2, weight, bias=None):
 
 
 def maxout(x, groups, axis=1):
+    axis = axis % x.ndim
     c = x.shape[axis]
     shape = list(x.shape)
     shape[axis: axis + 1] = [c // groups, groups]
@@ -941,8 +942,8 @@ def maxout(x, groups, axis=1):
 def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True, key=None):
     if not training:
         return jnp.where(x >= 0, x, x * (lower + upper) / 2)
-    from ..utils.rng import next_key
-    key = key if key is not None else next_key()
+    assert key is not None, \
+        "rrelu in training mode needs an explicit PRNG key"
     slope = jax.random.uniform(key, x.shape, minval=lower, maxval=upper)
     return jnp.where(x >= 0, x, x * slope)
 
@@ -988,7 +989,8 @@ def margin_ranking_loss(input, other, label, margin=0.0,
 
 
 def soft_margin_loss(input, label, reduction="mean"):
-    return _reduce(jnp.log1p(jnp.exp(-label * input)), reduction)
+    # softplus(-y*x) == log(1 + exp(-y*x)) without the exp overflow
+    return _reduce(jax.nn.softplus(-label * input), reduction)
 
 
 def multi_label_soft_margin_loss(input, label, weight=None,
@@ -1012,8 +1014,10 @@ def cosine_embedding_loss(input1, input2, label, margin=0.0,
 
 def triplet_margin_loss(anchor, positive, negative, margin=1.0, p=2.0,
                         epsilon=1e-6, reduction="mean"):
-    dp = jnp.sum(jnp.abs(anchor - positive) ** p, axis=-1) ** (1.0 / p)
-    dn = jnp.sum(jnp.abs(anchor - negative) ** p, axis=-1) ** (1.0 / p)
+    # epsilon inside the distance keeps the p-root differentiable at
+    # zero distance (torch semantics; reuses pairwise_distance)
+    dp = pairwise_distance(anchor, positive, p, epsilon)
+    dn = pairwise_distance(anchor, negative, p, epsilon)
     return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
 
 

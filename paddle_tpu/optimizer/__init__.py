@@ -2,5 +2,6 @@
 from . import lr
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
                    global_norm)
-from .optimizers import (SGD, Adafactor, Adagrad, Adam, AdamW, Lamb, Momentum,
-                         Optimizer, RMSProp)
+from .optimizers import (SGD, Adadelta, Adafactor, Adagrad, Adam, Adamax,
+                         AdamW, Lamb, Momentum, NAdam, Optimizer, RAdam,
+                         RMSProp, Rprop)

@@ -409,3 +409,185 @@ def _like(ref, flat_nested):
         cls = type(ref)
         return cls((k, _like(ref[k], flat_nested[k])) for k in ref)
     return flat_nested
+
+
+# ---------------------------------------------------------------- round 4
+def _map_update(params, grads, slots, upd):
+    """Shared per-leaf update walk (paths not needed)."""
+    flat_p = _flatten_with_path(params)
+    new_p, new_s = {}, {}
+    for path, p in flat_p.items():
+        np_, ns_ = upd(p, _get_path(grads, path), _get_path(slots, path))
+        _set_path(new_p, path, np_)
+        _set_path(new_s, path, ns_)
+    return _like(params, new_p), _like(slots, new_s)
+
+
+class Adadelta(Optimizer):
+    """reference: python/paddle/optimizer/adadelta.py (no LR warmup
+    needed: the unit-correcting accumulator ratio sets the scale)."""
+
+    def __init__(self, learning_rate=1.0, rho=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=0.0, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.rho, self.epsilon = rho, epsilon
+
+    def _init_slot(self, p):
+        return {"avg_sq": jnp.zeros_like(p, dtype=jnp.float32),
+                "acc_delta": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def _update(self, params, grads, slots, lr, step):
+        def upd(p, g, s):
+            g = g.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            avg = self.rho * s["avg_sq"] + (1 - self.rho) * jnp.square(g)
+            delta = jnp.sqrt(s["acc_delta"] + self.epsilon) \
+                / jnp.sqrt(avg + self.epsilon) * g
+            acc = self.rho * s["acc_delta"] + (1 - self.rho) \
+                * jnp.square(delta)
+            return (p.astype(jnp.float32) - lr * delta).astype(p.dtype), \
+                {"avg_sq": avg, "acc_delta": acc}
+        return _map_update(params, grads, slots, upd)
+
+
+class Adamax(Optimizer):
+    """Adam with the infinity norm (reference:
+    python/paddle/optimizer/adamax.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.0,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slot(self, p):
+        return {"m": jnp.zeros_like(p, dtype=jnp.float32),
+                "u": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def _update(self, params, grads, slots, lr, step):
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        bc1 = 1.0 - self.beta1 ** t
+
+        def upd(p, g, s):
+            g = g.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            m = self.beta1 * s["m"] + (1 - self.beta1) * g
+            u = jnp.maximum(self.beta2 * s["u"], jnp.abs(g))
+            update = (m / bc1) / (u + self.epsilon)
+            return (p.astype(jnp.float32) - lr * update).astype(p.dtype), \
+                {"m": m, "u": u}
+        return _map_update(params, grads, slots, upd)
+
+
+class NAdam(Optimizer):
+    """Nesterov Adam (reference: python/paddle/optimizer/nadam.py;
+    Dozat 2016, with the mu-product momentum schedule)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=0.0, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.momentum_decay = momentum_decay
+
+    def _init_slot(self, p):
+        return {"m": jnp.zeros_like(p, dtype=jnp.float32),
+                "v": jnp.zeros_like(p, dtype=jnp.float32),
+                "mu_prod": jnp.ones((), jnp.float32)}
+
+    def _update(self, params, grads, slots, lr, step):
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        mu_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.momentum_decay))
+        mu_t1 = self.beta1 * (1.0 - 0.5 * 0.96
+                              ** ((t + 1.0) * self.momentum_decay))
+        bc2 = 1.0 - self.beta2 ** t
+
+        def upd(p, g, s):
+            g = g.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            mu_prod = s["mu_prod"] * mu_t
+            m = self.beta1 * s["m"] + (1 - self.beta1) * g
+            v = self.beta2 * s["v"] + (1 - self.beta2) * jnp.square(g)
+            m_hat = mu_t1 * m / (1.0 - mu_prod * mu_t1) \
+                + (1.0 - mu_t) * g / (1.0 - mu_prod)
+            denom = jnp.sqrt(v / bc2) + self.epsilon
+            return (p.astype(jnp.float32) - lr * m_hat / denom) \
+                .astype(p.dtype), {"m": m, "v": v, "mu_prod": mu_prod}
+        return _map_update(params, grads, slots, upd)
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference: python/paddle/optimizer/radam.py;
+    Liu et al. 2020): SGD-with-momentum until the variance estimate's
+    rectification term becomes usable (rho_t > 5)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.0,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slot(self, p):
+        return {"m": jnp.zeros_like(p, dtype=jnp.float32),
+                "v": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def _update(self, params, grads, slots, lr, step):
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        bc1 = 1.0 - self.beta1 ** t
+        bc2 = 1.0 - self.beta2 ** t
+        rho_inf = 2.0 / (1.0 - self.beta2) - 1.0
+        rho_t = rho_inf - 2.0 * t * self.beta2 ** t / bc2
+        r_num = (rho_t - 4.0) * (rho_t - 2.0) * rho_inf
+        r_den = (rho_inf - 4.0) * (rho_inf - 2.0) * rho_t
+        rect = jnp.sqrt(jnp.maximum(r_num, 0.0)
+                        / jnp.maximum(r_den, 1e-12))
+
+        def upd(p, g, s):
+            g = g.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            m = self.beta1 * s["m"] + (1 - self.beta1) * g
+            v = self.beta2 * s["v"] + (1 - self.beta2) * jnp.square(g)
+            m_hat = m / bc1
+            adaptive = rect * m_hat / (jnp.sqrt(v / bc2) + self.epsilon)
+            plain = m_hat
+            update = jnp.where(rho_t > 5.0, adaptive, plain)
+            return (p.astype(jnp.float32) - lr * update).astype(p.dtype), \
+                {"m": m, "v": v}
+        return _map_update(params, grads, slots, upd)
+
+
+class Rprop(Optimizer):
+    """Sign-based resilient propagation (reference:
+    python/paddle/optimizer/rprop.py) — full-batch regimes only."""
+
+    def __init__(self, learning_rate=0.01, learning_rate_range=(1e-5, 50.0),
+                 etas=(0.5, 1.2), parameters=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, 0.0, grad_clip)
+        self.lr_min, self.lr_max = learning_rate_range
+        self.eta_neg, self.eta_pos = etas
+
+    def _init_slot(self, p):
+        return {"prev_g": jnp.zeros_like(p, dtype=jnp.float32),
+                "step_size": jnp.full_like(
+                    p, float(self._lr if not callable(self._lr) else 0.01), dtype=jnp.float32)}
+
+    def _update(self, params, grads, slots, lr, step):
+        def upd(p, g, s):
+            g = g.astype(jnp.float32)
+            sign = jnp.sign(g * s["prev_g"])
+            scale = jnp.where(sign > 0, self.eta_pos,
+                              jnp.where(sign < 0, self.eta_neg, 1.0))
+            step_size = jnp.clip(s["step_size"] * scale, self.lr_min,
+                                 self.lr_max)
+            # on sign change: no step, zero the stored gradient
+            g_eff = jnp.where(sign < 0, 0.0, g)
+            new_p = p.astype(jnp.float32) - jnp.sign(g_eff) * step_size
+            return new_p.astype(p.dtype), {"prev_g": g_eff,
+                                           "step_size": step_size}
+        return _map_update(params, grads, slots, upd)

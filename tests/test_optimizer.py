@@ -1,4 +1,5 @@
 """Optimizer/update-rule numerics vs torch (SURVEY.md §4)."""
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -153,3 +154,56 @@ def test_jitted_train_step():
         losses.append(float(loss))
     assert len(traces) == 1, "train step retraced"
     assert losses[-1] < losses[0]
+
+
+class TestRound4Optimizers:
+    """Adadelta/Adamax/NAdam/RAdam/Rprop vs torch.optim single-tensor
+    references (SURVEY C5)."""
+
+    def _compare(self, make_ours, make_torch, steps=6, rtol=2e-4):
+        import torch
+        rs = np.random.RandomState(0)
+        p0 = rs.randn(4, 3).astype("float32")
+        grads = [rs.randn(4, 3).astype("float32") for _ in range(steps)]
+        opt = make_ours()
+        params = {"w": jnp.asarray(p0)}
+        state = opt.init(params)
+        for i, g in enumerate(grads):
+            params, state = opt.apply(params, {"w": jnp.asarray(g)},
+                                      state, i)
+        tp = torch.nn.Parameter(torch.tensor(p0))
+        topt = make_torch([tp])
+        for g in grads:
+            topt.zero_grad()
+            tp.grad = torch.tensor(g)
+            topt.step()
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   tp.detach().numpy(), rtol=rtol,
+                                   atol=1e-5)
+
+    def test_adadelta(self):
+        import torch
+        self._compare(lambda: pt.optimizer.Adadelta(learning_rate=1.0,
+                                                    rho=0.9),
+                      lambda ps: torch.optim.Adadelta(ps, lr=1.0, rho=0.9))
+
+    def test_adamax(self):
+        import torch
+        self._compare(lambda: pt.optimizer.Adamax(learning_rate=0.002),
+                      lambda ps: torch.optim.Adamax(ps, lr=0.002))
+
+    def test_nadam(self):
+        import torch
+        self._compare(lambda: pt.optimizer.NAdam(learning_rate=0.002),
+                      lambda ps: torch.optim.NAdam(ps, lr=0.002))
+
+    def test_radam(self):
+        import torch
+        self._compare(lambda: pt.optimizer.RAdam(learning_rate=0.01),
+                      lambda ps: torch.optim.RAdam(ps, lr=0.01),
+                      steps=8)
+
+    def test_rprop(self):
+        import torch
+        self._compare(lambda: pt.optimizer.Rprop(learning_rate=0.01),
+                      lambda ps: torch.optim.Rprop(ps, lr=0.01))
