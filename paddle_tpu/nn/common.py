@@ -135,3 +135,208 @@ class CosineSimilarity(Layer):
 
     def forward(self, x1, x2):
         return F.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+# ---------------------------------------------------------------- round 4
+class _PoolNDBase(Layer):
+    _op = None
+    _nd = None
+
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = (kernel_size, stride,
+                                                       padding)
+
+    def forward(self, x):
+        from .functional import _pool_nd
+        return _pool_nd(x, self.kernel_size, self.stride, self.padding,
+                        self._nd, self._op)
+
+
+class MaxPool1D(_PoolNDBase):
+    _op, _nd = "max", 1
+
+
+class MaxPool3D(_PoolNDBase):
+    _op, _nd = "max", 3
+
+
+class AvgPool1D(_PoolNDBase):
+    _op, _nd = "avg", 1
+
+
+class AvgPool3D(_PoolNDBase):
+    _op, _nd = "avg", 3
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = (kernel_size, stride,
+                                                       padding)
+
+    def forward(self, x, indices, output_size=None):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, output_size)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1):
+        super().__init__()
+        self.args = (output_sizes, kernel_sizes, strides, paddings,
+                     dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self.args)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self.args)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups):
+        super().__init__()
+        self.groups = groups
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0):
+        super().__init__()
+        self.args = (size, alpha, beta, k)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.args)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        return F.alpha_dropout(x, self.p, training=True, key=next_key())
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        return F.dropout3d(x, self.p, training=True, key=next_key())
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding):
+        super().__init__()
+        self.padding = padding
+
+    def forward(self, x):
+        return F.zeropad2d(x, self.padding)
+
+
+class Pad1D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0):
+        super().__init__()
+        self.padding, self.mode, self.value = padding, mode, value
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value,
+                     data_format="NCL")
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        if not self.training:
+            return F.rrelu(x, self.lower, self.upper, training=False)
+        return F.rrelu(x, self.lower, self.upper, training=True,
+                       key=next_key())
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F.thresholded_relu(x, self.threshold)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features,
+                 bias_attr=None):
+        super().__init__()
+        from .initializer import XavierNormal
+        init = XavierNormal()
+        self.weight = Parameter(init(next_key(),
+                                     (out_features, in1_features,
+                                      in2_features)))
+        self.bias = Parameter(jnp.zeros((out_features,))) \
+            if bias_attr is not False else None
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, getattr(self, "bias", None))
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False):
+        super().__init__()
+        self.args = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, *self.args)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor,
+                             mode="bilinear", align_corners=True)

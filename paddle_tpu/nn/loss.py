@@ -1,6 +1,8 @@
 """Loss layers (reference: python/paddle/nn/layer/loss.py)."""
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from . import functional as F
 from .layer import Layer
 
@@ -100,3 +102,84 @@ class CTCLoss(Layer):
                 label_lengths=None):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           blank=self.blank, reduction=self.reduction)
+
+
+# ---------------------------------------------------------------- round 4
+class _SimpleLoss(Layer):
+    """reduction-carrying wrapper over an F.* loss."""
+    _fn = None
+
+    def __init__(self, reduction="mean", **kw):
+        super().__init__()
+        self.reduction = reduction
+        self.kw = kw
+
+    def forward(self, *args):
+        return type(self)._fn(*args, reduction=self.reduction, **self.kw)
+
+
+class TripletMarginLoss(_SimpleLoss):
+    _fn = staticmethod(F.triplet_margin_loss)
+
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, reduction="mean"):
+        super().__init__(reduction, margin=margin, p=p, epsilon=epsilon)
+
+
+class MarginRankingLoss(_SimpleLoss):
+    _fn = staticmethod(F.margin_ranking_loss)
+
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__(reduction, margin=margin)
+
+
+class SoftMarginLoss(_SimpleLoss):
+    _fn = staticmethod(F.soft_margin_loss)
+
+
+class HingeEmbeddingLoss(_SimpleLoss):
+    _fn = staticmethod(F.hinge_embedding_loss)
+
+    def __init__(self, margin=1.0, reduction="mean"):
+        super().__init__(reduction, margin=margin)
+
+
+class CosineEmbeddingLoss(_SimpleLoss):
+    _fn = staticmethod(F.cosine_embedding_loss)
+
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__(reduction, margin=margin)
+
+
+class PoissonNLLLoss(_SimpleLoss):
+    _fn = staticmethod(F.poisson_nll_loss)
+
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean"):
+        super().__init__(reduction, log_input=log_input, full=full,
+                         epsilon=epsilon)
+
+
+class MultiLabelSoftMarginLoss(_SimpleLoss):
+    _fn = staticmethod(F.multi_label_soft_margin_loss)
+
+    def __init__(self, weight=None, reduction="mean"):
+        super().__init__(reduction, weight=weight)
+
+
+class GaussianNLLLoss(Layer):
+    """reference: paddle.nn.GaussianNLLLoss."""
+
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean"):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        var = jnp.maximum(variance, self.epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+        if self.full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi))
+        if self.reduction == "mean":
+            return jnp.mean(loss)
+        if self.reduction == "sum":
+            return jnp.sum(loss)
+        return loss

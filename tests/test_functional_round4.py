@@ -226,3 +226,27 @@ def test_grid_sample_rejects_reflection():
     grid = jnp.zeros((1, 2, 2, 2))
     with pytest.raises(NotImplementedError, match="padding_mode"):
         F.grid_sample(x, grid, padding_mode="reflection")
+
+
+def test_new_nn_classes_smoke_and_gaussian_nll():
+    """Layer-class wrappers over the round-4 functional surface."""
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    pt.seed(0)
+    x = jnp.asarray(_r(2, 8, 6, 6))
+    x1d = jnp.asarray(_r(2, 4, 12, seed=1))
+    assert nn.MaxPool1D(2)(x1d).shape == (2, 4, 6)
+    assert nn.Fold((6, 6), 3, paddings=1)(
+        nn.Unfold(3, paddings=1)(x)).shape == x.shape
+    assert nn.Maxout(2)(x).shape == (2, 4, 6, 6)
+    assert nn.UpsamplingBilinear2D(scale_factor=2)(x).shape == \
+        (2, 8, 12, 12)
+    got = nn.GaussianNLLLoss()(x[:, 0], x[:, 1], jnp.abs(x[:, 2]) + 0.1)
+    ref = torch.nn.GaussianNLLLoss(eps=1e-6)(
+        torch.tensor(np.asarray(x[:, 0])),
+        torch.tensor(np.asarray(x[:, 1])),
+        torch.tensor(np.abs(np.asarray(x[:, 2])) + 0.1))
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    loss = nn.TripletMarginLoss(margin=0.5)(
+        x[:, 0, 0], x[:, 1, 0], x[:, 2, 0])
+    assert np.isfinite(float(loss))
