@@ -139,8 +139,7 @@ class CosineSimilarity(Layer):
 
 # ---------------------------------------------------------------- round 4
 class _PoolNDBase(Layer):
-    _op = None
-    _nd = None
+    _fn = None
 
     def __init__(self, kernel_size, stride=None, padding=0):
         super().__init__()
@@ -148,25 +147,24 @@ class _PoolNDBase(Layer):
                                                        padding)
 
     def forward(self, x):
-        from .functional import _pool_nd
-        return _pool_nd(x, self.kernel_size, self.stride, self.padding,
-                        self._nd, self._op)
+        return type(self)._fn(x, self.kernel_size, self.stride,
+                              self.padding)
 
 
 class MaxPool1D(_PoolNDBase):
-    _op, _nd = "max", 1
+    _fn = staticmethod(F.max_pool1d)
 
 
 class MaxPool3D(_PoolNDBase):
-    _op, _nd = "max", 3
+    _fn = staticmethod(F.max_pool3d)
 
 
 class AvgPool1D(_PoolNDBase):
-    _op, _nd = "avg", 1
+    _fn = staticmethod(F.avg_pool1d)
 
 
 class AvgPool3D(_PoolNDBase):
-    _op, _nd = "avg", 3
+    _fn = staticmethod(F.avg_pool3d)
 
 
 class AdaptiveAvgPool1D(Layer):
@@ -311,8 +309,7 @@ class Bilinear(Layer):
     def __init__(self, in1_features, in2_features, out_features,
                  bias_attr=None):
         super().__init__()
-        from .initializer import XavierNormal
-        init = XavierNormal()
+        init = I.XavierNormal()
         self.weight = Parameter(init(next_key(),
                                      (out_features, in1_features,
                                       in2_features)))

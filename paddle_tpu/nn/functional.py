@@ -724,6 +724,8 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
         raise NotImplementedError(
             f"data_format {data_format!r}: channels-last layouts are "
             "not supported (TPU path is channels-first)")
+    if isinstance(pad, int):  # pad every spatial side equally
+        pad = [pad, pad] * (x.ndim - 2)
     pad = list(pad)
     if len(pad) % 2:
         raise ValueError("pad length must be even")

@@ -174,6 +174,9 @@ class GaussianNLLLoss(Layer):
         self.full, self.epsilon, self.reduction = full, epsilon, reduction
 
     def forward(self, input, label, variance):
+        # torch raises on negative variance; a traced value cannot
+        # branch on data, so the TPU-native contract is an explicit
+        # clamp — document rather than silently diverge
         var = jnp.maximum(variance, self.epsilon)
         loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
         if self.full:
