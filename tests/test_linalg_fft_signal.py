@@ -179,3 +179,57 @@ def test_save_load_inference_model(tmp_path):
     predict = pt.jit.load_inference_model(prefix)
     got = np.asarray(predict(x))
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+class TestLinalgRound4:
+    def test_vector_matrix_norms_and_exp(self):
+        import torch
+        rs = np.random.RandomState(0)
+        a = rs.randn(5, 4).astype("float32")
+        np.testing.assert_allclose(
+            np.asarray(linalg.vector_norm(jnp.asarray(a), 3, axis=0)),
+            torch.linalg.vector_norm(torch.tensor(a), 3, dim=0).numpy(),
+            rtol=1e-5)
+        sq = rs.randn(4, 4).astype("float32") * 0.1
+        np.testing.assert_allclose(
+            np.asarray(linalg.matrix_exp(jnp.asarray(sq))),
+            torch.matrix_exp(torch.tensor(sq)).numpy(), rtol=1e-4,
+            atol=1e-5)
+        np.testing.assert_allclose(
+            float(linalg.matrix_norm(jnp.asarray(a))),
+            float(torch.linalg.matrix_norm(torch.tensor(a))), rtol=1e-5)
+
+    def test_householder_ormqr_solve_triangular(self):
+        import torch
+        rs = np.random.RandomState(1)
+        a = rs.randn(5, 4).astype("float32")
+        A, tau = torch.geqrf(torch.tensor(a))
+        np.testing.assert_allclose(
+            np.asarray(linalg.householder_product(
+                jnp.asarray(A.numpy()), jnp.asarray(tau.numpy()))),
+            torch.linalg.householder_product(A, tau).numpy(),
+            rtol=1e-4, atol=1e-5)
+        y = rs.randn(5, 3).astype("float32")
+        np.testing.assert_allclose(
+            np.asarray(linalg.ormqr(jnp.asarray(A.numpy()),
+                                       jnp.asarray(tau.numpy()),
+                                       jnp.asarray(y))),
+            torch.ormqr(A, tau, torch.tensor(y)).numpy(), rtol=1e-4,
+            atol=1e-5)
+        tri = np.triu(rs.randn(4, 4).astype("float32")) \
+            + 4 * np.eye(4, dtype="float32")
+        b = rs.randn(4, 2).astype("float32")
+        np.testing.assert_allclose(
+            np.asarray(linalg.solve_triangular(jnp.asarray(tri),
+                                                  jnp.asarray(b))),
+            torch.linalg.solve_triangular(torch.tensor(tri),
+                                          torch.tensor(b),
+                                          upper=True).numpy(), rtol=1e-4)
+
+    def test_pca_lowrank_recovers_low_rank(self):
+        rs = np.random.RandomState(2)
+        base = (rs.randn(20, 3) @ rs.randn(3, 10)).astype("float32")
+        u, s, v = linalg.pca_lowrank(jnp.asarray(base), q=3,
+                                        center=False)
+        rec = np.asarray(u) * np.asarray(s) @ np.asarray(v).T
+        np.testing.assert_allclose(rec, base, rtol=1e-3, atol=1e-3)
