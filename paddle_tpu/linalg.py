@@ -229,53 +229,62 @@ def matrix_exp(x):
         .reshape(x.shape)
 
 
-def solve_triangular(x, y, upper=True, transpose=False,
-                     unitriangular=False):
-    import jax.scipy.linalg as jsl
-    return jsl.solve_triangular(x, y, lower=not upper,
-                                trans=1 if transpose else 0,
-                                unit_diagonal=unitriangular)
+# paddle exposes both names for the same semantics
+solve_triangular = triangular_solve
 
 
-def _householder_q(a, t):
-    """Full [m, m] Q from LAPACK-style (geqrf) reflectors."""
+def _apply_reflectors(a, tau, y, adjoint):
+    """Apply Q (adjoint=False) or Q^H (adjoint=True) from LAPACK geqrf
+    reflectors H_i = I - tau_i v_i v_i^H to y [m, cols] — O(k*m*cols),
+    never materializing Q."""
     m = a.shape[0]
-    q = jnp.eye(m, dtype=a.dtype)
-    for i in range(t.shape[0]):
+    k = tau.shape[0]
+    idx = range(k) if adjoint else range(k - 1, -1, -1)
+    for i in idx:
         v = jnp.where(jnp.arange(m) == i, 1.0,
                       jnp.where(jnp.arange(m) > i, a[:, i], 0.0))
-        q = q - t[i] * (q @ v)[:, None] * v[None, :]
-    return q
+        t = jnp.conj(tau[i]) if adjoint else tau[i]
+        y = y - t * v[:, None] * (jnp.conj(v) @ y)[None, :]
+    return y
 
 
 def householder_product(x, tau):
     """Assemble Q's first n columns from geqrf reflectors (reference:
-    paddle.linalg.householder_product): Q = H_0 H_1 ... H_{k-1}."""
+    paddle.linalg.householder_product): Q = H_0 H_1 ... H_{k-1},
+    built by applying the reflectors to eye(m, n) — O(k*m*n)."""
     m, n = x.shape[-2], x.shape[-1]
+
+    def one(a, t):
+        return _apply_reflectors(a, t, jnp.eye(m, n, dtype=a.dtype),
+                                 adjoint=False)
+
     if x.ndim == 2:
-        return _householder_q(x, tau)[:, :n]
+        return one(x, tau)
     lead = x.shape[:-2]
-    flat = jax.vmap(_householder_q)(x.reshape((-1, m, n)),
-                                    tau.reshape((-1, tau.shape[-1])))
-    return flat[:, :, :n].reshape(lead + (m, n))
+    flat = jax.vmap(one)(x.reshape((-1, m, n)),
+                         tau.reshape((-1, tau.shape[-1])))
+    return flat.reshape(lead + (m, n))
 
 
 def pca_lowrank(x, q=None, center=True, niter=2):
     """Randomized PCA (reference: paddle.linalg.pca_lowrank; Halko et
-    al. 2011 subspace iteration). Deterministic: the range-finder seed
-    is fixed (explicit-key policy, no global RNG inside)."""
+    al. 2011 subspace iteration, QR re-orthonormalized every step so
+    float32 keeps the small singular directions). Batched over leading
+    dims. Deterministic: the range-finder seed is fixed (explicit-key
+    policy, no global RNG inside)."""
     m, n = x.shape[-2], x.shape[-1]
     q = q if q is not None else min(6, m, n)
     a = x - x.mean(axis=-2, keepdims=True) if center else x
+    a_h = jnp.swapaxes(jnp.conj(a), -1, -2)
     key = jax.random.PRNGKey(0)
     omega = jax.random.normal(key, (n, q), a.dtype)
-    y = a @ omega
+    y, _ = jnp.linalg.qr(a @ omega)
     for _ in range(niter):
-        y = a @ (a.T @ y)
-    qmat, _ = jnp.linalg.qr(y)
-    b = qmat.T @ a
+        z, _ = jnp.linalg.qr(a_h @ y)
+        y, _ = jnp.linalg.qr(a @ z)
+    b = jnp.swapaxes(jnp.conj(y), -1, -2) @ a
     u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
-    return qmat @ u_b, s, vt.T
+    return y @ u_b, s, jnp.swapaxes(jnp.conj(vt), -1, -2)
 
 
 def svd_lowrank(x, q=6, niter=2):
@@ -284,8 +293,11 @@ def svd_lowrank(x, q=6, niter=2):
 
 
 def ormqr(x, tau, y, left=True, transpose=False):
-    """Multiply y by the FULL Q (from geqrf reflectors): Q@y / Q^T@y /
-    y@Q (reference: paddle.linalg.ormqr)."""
-    q = _householder_q(x, tau)
-    q = q.T if transpose else q
-    return q @ y if left else y @ q
+    """Multiply y by Q / Q^H from geqrf reflectors WITHOUT forming Q
+    (reference: paddle.linalg.ormqr / LAPACK unmqr)."""
+    if left:
+        return _apply_reflectors(x, tau, y, adjoint=transpose)
+    # y @ Q == (Q^H @ y^H)^H
+    yh = jnp.swapaxes(jnp.conj(y), -1, -2)
+    out = _apply_reflectors(x, tau, yh, adjoint=not transpose)
+    return jnp.swapaxes(jnp.conj(out), -1, -2)
