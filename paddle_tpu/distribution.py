@@ -21,6 +21,10 @@ __all__ = [
     "Distribution", "Normal", "LogNormal", "Uniform", "Categorical",
     "Bernoulli", "Beta", "Dirichlet", "Gamma", "Exponential", "Laplace",
     "kl_divergence", "register_kl",
+    "Gumbel", "Cauchy", "Geometric", "Poisson", "Binomial", "Multinomial",
+    "MultivariateNormal", "Chi2", "StudentT", "Transform",
+    "AffineTransform", "AbsTransform", "ExpTransform", "SigmoidTransform",
+    "TransformedDistribution", "Independent", "ContinuousBernoulli",
 ]
 
 
@@ -337,3 +341,421 @@ def _kl_bernoulli(p, q):
 @register_kl(Uniform, Uniform)
 def _kl_uniform(p, q):
     return jnp.log((q.high - q.low) / (p.high - p.low))
+
+
+# ---------------------------------------------------------------- round 4
+# (reference: python/paddle/distribution/* — the remaining families,
+# transforms, and composition wrappers)
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * 0.5772156649015329  # Euler gamma
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6) * self.scale ** 2
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return self.loc + self.scale * jax.random.gumbel(_key(key), shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def entropy(self):
+        return jnp.log(self.scale) + 1.0 + 0.5772156649015329
+
+    def cdf(self, value):
+        return jnp.exp(-jnp.exp(-(value - self.loc) / self.scale))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return self.loc + self.scale * jax.random.cauchy(_key(key), shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -jnp.log(math.pi * self.scale * (1 + z ** 2))
+
+    def entropy(self):
+        return jnp.log(4 * math.pi * self.scale)
+
+    def cdf(self, value):
+        return jnp.arctan((value - self.loc) / self.scale) / math.pi + 0.5
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k >= 0 failures before the first success."""
+
+    def __init__(self, probs):
+        self.probs = jnp.asarray(probs, jnp.float32)
+
+    @property
+    def mean(self):
+        return (1 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1 - self.probs) / self.probs ** 2
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + self.probs.shape
+        u = jax.random.uniform(_key(key), shape, minval=1e-7, maxval=1.0)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs))
+
+    def log_prob(self, value):
+        return value * jnp.log1p(-self.probs) + jnp.log(self.probs)
+
+    def entropy(self):
+        p = self.probs
+        return (-(1 - p) * jnp.log1p(-p) - p * jnp.log(p)) / p
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = jnp.asarray(rate, jnp.float32)
+
+    mean = property(lambda self: self.rate)
+    variance = property(lambda self: self.rate)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + self.rate.shape
+        return jax.random.poisson(_key(key), self.rate,
+                                  shape).astype(jnp.float32)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        return value * jnp.log(self.rate) - self.rate \
+            - gammaln(value + 1.0)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = jnp.asarray(total_count, jnp.float32)
+        self.probs = jnp.asarray(probs, jnp.float32)
+
+    mean = property(lambda self: self.total_count * self.probs)
+    variance = property(
+        lambda self: self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.total_count.shape, self.probs.shape)
+        return jax.random.binomial(_key(key), self.total_count,
+                                   self.probs, shape)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        n, p = self.total_count, jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        comb = gammaln(n + 1) - gammaln(value + 1) - gammaln(n - value + 1)
+        return comb + value * jnp.log(p) + (n - value) * jnp.log1p(-p)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = jnp.asarray(probs, jnp.float32)
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    def sample(self, shape=(), key=None):
+        k = _key(key)
+        cat = jax.random.categorical(
+            k, jnp.log(self.probs),
+            shape=tuple(shape) + (self.total_count,))
+        return jax.nn.one_hot(cat, self.probs.shape[-1]).sum(axis=-2)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        p = jnp.clip(self.probs, 1e-12, 1.0)
+        return gammaln(jnp.asarray(self.total_count + 1.0)) \
+            - jnp.sum(gammaln(value + 1.0), axis=-1) \
+            + jnp.sum(value * jnp.log(p), axis=-1)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        if scale_tril is None:
+            if covariance_matrix is None:
+                raise ValueError("need covariance_matrix or scale_tril")
+            scale_tril = jnp.linalg.cholesky(
+                jnp.asarray(covariance_matrix, jnp.float32))
+        self.scale_tril = jnp.asarray(scale_tril, jnp.float32)
+
+    mean = property(lambda self: self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return self.scale_tril @ jnp.swapaxes(self.scale_tril, -1, -2)
+
+    def sample(self, shape=(), key=None):
+        d = self.loc.shape[-1]
+        shape = tuple(shape) + self.loc.shape
+        eps = jax.random.normal(_key(key), shape)
+        return self.loc + jnp.einsum("...ij,...j->...i",
+                                     self.scale_tril, eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        d = self.loc.shape[-1]
+        diff = value - self.loc
+        sol = jax.scipy.linalg.solve_triangular(self.scale_tril, diff[..., None],
+                                                lower=True)[..., 0]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self.scale_tril, axis1=-2,
+                                              axis2=-1)), axis=-1)
+        return -0.5 * jnp.sum(sol ** 2, axis=-1) - logdet \
+            - 0.5 * d * math.log(2 * math.pi)
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self.scale_tril, axis1=-2,
+                                              axis2=-1)), axis=-1)
+        return 0.5 * d * (1 + math.log(2 * math.pi)) + logdet
+
+
+class Chi2(Distribution):
+    def __init__(self, df):
+        self.df = jnp.asarray(df, jnp.float32)
+        self._gamma = Gamma(self.df / 2.0, 0.5)
+
+    mean = property(lambda self: self.df)
+    variance = property(lambda self: 2.0 * self.df)
+
+    def sample(self, shape=(), key=None):
+        return self._gamma.sample(shape, key)
+
+    def log_prob(self, value):
+        return self._gamma.log_prob(value)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = jnp.asarray(df, jnp.float32)
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    @property
+    def mean(self):
+        return jnp.where(self.df > 1, self.loc, jnp.nan)
+
+    @property
+    def variance(self):
+        return jnp.where(self.df > 2, self.scale ** 2 * self.df
+                         / (self.df - 2), jnp.nan)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape)
+        return self.loc + self.scale * jax.random.t(_key(key), self.df,
+                                                    shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        z = (value - self.loc) / self.scale
+        d = self.df
+        return gammaln((d + 1) / 2) - gammaln(d / 2) \
+            - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale) \
+            - (d + 1) / 2 * jnp.log1p(z ** 2 / d)
+
+
+# ------------------------------------------------------------- transforms
+
+class Transform:
+    """Bijector base (reference: paddle.distribution.Transform)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class AbsTransform(Transform):
+    """y = |x| (not bijective: inverse returns the positive branch)."""
+
+    def forward(self, x):
+        return jnp.abs(x)
+
+    def inverse(self, y):
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a chain of transforms."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+
+    def sample(self, shape=(), key=None):
+        x = self.base.sample(shape, key)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = jnp.zeros_like(jnp.asarray(value, jnp.float32))
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            lp = lp - t.forward_log_det_jacobian(x)
+            y = x
+        return lp + self.base.log_prob(y)
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost batch dims as event dims (sums
+    log_prob over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape=(), key=None):
+        return self.base.sample(shape, key)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return jnp.sum(lp, axis=tuple(range(-self.rank, 0)))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        return jnp.sum(ent, axis=tuple(range(-self.rank, 0)))
+
+
+class ContinuousBernoulli(Distribution):
+    """reference: paddle.distribution.ContinuousBernoulli (Loaiza-
+    Ganem & Cunningham 2019)."""
+
+    def __init__(self, probs):
+        self.probs = jnp.clip(jnp.asarray(probs, jnp.float32), 1e-6,
+                              1 - 1e-6)
+
+    def _log_norm(self):
+        p = self.probs
+        near_half = jnp.abs(p - 0.5) < 1e-3
+        safe = jnp.where(near_half, 0.25, p)
+        c = jnp.log(jnp.abs(2.0 * jnp.arctanh(1.0 - 2.0 * safe))) \
+            - jnp.log(jnp.abs(1.0 - 2.0 * safe))
+        return jnp.where(near_half, math.log(2.0), c)
+
+    def log_prob(self, value):
+        p = self.probs
+        return value * jnp.log(p) + (1 - value) * jnp.log1p(-p) \
+            + self._log_norm()
+
+    def sample(self, shape=(), key=None):
+        # inverse-CDF sampling
+        shape = tuple(shape) + self.probs.shape
+        u = jax.random.uniform(_key(key), shape, minval=1e-6,
+                               maxval=1 - 1e-6)
+        p = self.probs
+        near_half = jnp.abs(p - 0.5) < 1e-3
+        safe = jnp.where(near_half, 0.25, p)
+        x = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+             / (jnp.log(safe) - jnp.log1p(-safe)))
+        return jnp.where(near_half, u, x)
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel(p, q):
+    # Monte-Carlo-free closed form exists only for equal scales; use the
+    # standard cross-entropy expansion
+    g = 0.5772156649015329
+    return (jnp.log(q.scale) - jnp.log(p.scale)
+            + g * (p.scale / q.scale - 1.0)
+            + jnp.expm1((q.loc - p.loc) / q.scale
+                        + jax.scipy.special.gammaln(
+                            1.0 + p.scale / q.scale))
+            - (q.loc - p.loc) / q.scale)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    d = p.loc.shape[-1]
+    qinv = jax.scipy.linalg.solve_triangular(
+        q.scale_tril, jnp.broadcast_to(jnp.eye(d), q.scale_tril.shape),
+        lower=True)
+    m = qinv @ p.scale_tril
+    tr = jnp.sum(m ** 2, axis=(-2, -1))
+    diff = q.loc - p.loc
+    maha = jnp.sum((qinv @ diff[..., None])[..., 0] ** 2, axis=-1)
+    logdet = (jnp.sum(jnp.log(jnp.diagonal(q.scale_tril, axis1=-2,
+                                           axis2=-1)), axis=-1)
+              - jnp.sum(jnp.log(jnp.diagonal(p.scale_tril, axis1=-2,
+                                             axis2=-1)), axis=-1))
+    return 0.5 * (tr + maha - d) + logdet
