@@ -478,11 +478,17 @@ class Multinomial(Distribution):
         return self.total_count * self.probs
 
     def sample(self, shape=(), key=None):
-        k = _key(key)
+        # batched probs [*B, K] follow torch/paddle semantics: result is
+        # shape + B + (K,). The draw axis (total_count) sits between the
+        # requested shape and the batch dims so each batch lane samples
+        # from its own categorical before the one-hot count collapse.
+        shape = tuple(shape)
+        batch = self.probs.shape[:-1]
         cat = jax.random.categorical(
-            k, jnp.log(self.probs),
-            shape=tuple(shape) + (self.total_count,))
-        return jax.nn.one_hot(cat, self.probs.shape[-1]).sum(axis=-2)
+            _key(key), jnp.log(self.probs),
+            shape=shape + (self.total_count,) + batch)
+        return jax.nn.one_hot(cat, self.probs.shape[-1]).sum(
+            axis=len(shape))
 
     def log_prob(self, value):
         from jax.scipy.special import gammaln

@@ -954,7 +954,12 @@ def from_pretrained(model_dir: str, dtype: Optional[Any] = None,
                     # buffer: ours, never in an HF checkpoint
     if hard_missing and strict:
         raise KeyError(f"checkpoint missing model keys: {hard_missing[:8]}")
-    if missing:
-        warnings.warn(f"{len(missing)} keys left at random init "
-                      f"(e.g. {missing[:4]})", stacklevel=2)
+    # expert_bias is OUR loss-free-balancing buffer, zeros-initialized and
+    # mutated online during training; checkpoints without an
+    # e_score_correction_bias (e.g. Qwen2-MoE, which balances via aux loss)
+    # correctly start it at zero — that is "loaded", not "left at random".
+    warn_missing = [k for k in missing if not k.endswith(".expert_bias")]
+    if warn_missing:
+        warnings.warn(f"{len(warn_missing)} keys left at random init "
+                      f"(e.g. {warn_missing[:4]})", stacklevel=2)
     return model

@@ -264,11 +264,17 @@ class LlamaAttention(Layer):
         nh, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
                       cfg.head_dim)
         if hasattr(self, "qkv_proj"):
-            # serving fusion (nn.fuse.fuse_projections): ONE matmul
+            # serving fusion (nn.fuse.fuse_projections): ONE matmul. The
+            # fused columns are rank-interleaved [q_t|k_t|v_t per tp rank
+            # t] so this split is shard-local under a tp mesh: expose the
+            # T axis, slice heads inside each rank's chunk, merge back
+            # (T == 1 degenerates to the plain [q|k|v] split).
             qkv = self.qkv_proj(x)
-            q = qkv[..., :nh * d].reshape(b, s, nh, d)
-            k = qkv[..., nh * d:(nh + kvh) * d].reshape(b, s, kvh, d)
-            v = qkv[..., (nh + kvh) * d:].reshape(b, s, kvh, d)
+            T = getattr(self, "_fused_tp", 1)
+            qkv = qkv.reshape(b, s, T, (nh + 2 * kvh) // T, d)
+            q = qkv[:, :, :, :nh // T].reshape(b, s, nh, d)
+            k = qkv[:, :, :, nh // T:(nh + kvh) // T].reshape(b, s, kvh, d)
+            v = qkv[:, :, :, (nh + kvh) // T:].reshape(b, s, kvh, d)
         else:
             q = self.q_proj(x).reshape(b, s, nh, d)
             k = self.k_proj(x).reshape(b, s, kvh, d)
@@ -413,9 +419,15 @@ class LlamaMLP(Layer):
 
     def forward(self, x):
         if hasattr(self, "gate_up_proj"):
-            # serving fusion (nn.fuse.fuse_projections): ONE matmul
+            # serving fusion (nn.fuse.fuse_projections): ONE matmul with
+            # rank-interleaved [gate_t|up_t] columns — shard-local split
+            # under tp, plain halves when T == 1
             gu = self.gate_up_proj(x)
-            gate, up = jnp.split(gu, 2, axis=-1)
+            T = getattr(self, "_fused_tp", 1)
+            ffn = gu.shape[-1] // 2
+            gu = gu.reshape(*gu.shape[:-1], T, 2, ffn // T)
+            gate = gu[..., 0, :].reshape(*gu.shape[:-3], ffn)
+            up = gu[..., 1, :].reshape(*gu.shape[:-3], ffn)
             return self.down_proj(F.silu(gate) * up)
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
 

@@ -10,10 +10,19 @@ rewrites a loaded model IN PLACE — concat the q/k/v weights into one
 the attention/MLP forwards detect the fused module and split the single
 product.
 
+TP-safe via a RANK-INTERLEAVED column order: with an active ``tp`` mesh
+axis of degree T, the fused columns are laid out as
+``[q_0 k_0 v_0 | q_1 k_1 v_1 | ...]`` where ``x_t`` is rank t's head
+shard of projection x. A (None, "tp") partition then puts exactly
+``q_t|k_t|v_t`` on device t — the same columns the unfused layout puts
+there — so the split in the forward (a reshape exposing the T axis, a
+shard-local slice, a reshape back) never crosses a shard boundary and
+no resharding collective is inserted. T is recorded on the module
+(``_fused_tp``); T == 1 degenerates to the plain concat.
+
 Apply AFTER from_pretrained / checkpoint load (the pass consumes the
-unfused weights), like the quantization pass. Single-chip / replicated
-serving only: the fused column order is not tp-head-aligned, so under a
-tp mesh keep the unfused layout.
+unfused weights), like the quantization pass, and after the serving mesh
+is set (the layout bakes in the tp degree).
 """
 from __future__ import annotations
 
@@ -24,10 +33,37 @@ from ..parallel.layers import ColumnParallelLinear
 __all__ = ["fuse_projections"]
 
 
-def _fuse_linears(mods, has_bias: bool):
+def _tp_degree() -> int:
+    from ..distributed.env import get_mesh, has_mesh
+    return get_mesh().shape.get("tp", 1) if has_mesh() else 1
+
+
+def _interleave(ws, tp: int):
+    """Concat [h, out_i] weights column-wise, rank-interleaved: reshape
+    each to [h, tp, out_i/tp], concat the shard axis, flatten."""
+    if tp == 1:
+        return jnp.concatenate(ws, axis=1)
+    parts = []
+    for w in ws:
+        if w.shape[1] % tp:
+            raise ValueError(
+                f"fuse_projections: out dim {w.shape[1]} not divisible "
+                f"by tp degree {tp}; keep the unfused layout")
+        parts.append(w.reshape(w.shape[0], tp, w.shape[1] // tp))
+    return jnp.concatenate(parts, axis=2).reshape(ws[0].shape[0], -1)
+
+
+def _interleave_bias(bs, tp: int):
+    if tp == 1:
+        return jnp.concatenate(bs)
+    return jnp.concatenate(
+        [b.reshape(tp, b.shape[0] // tp) for b in bs], axis=1).reshape(-1)
+
+
+def _fuse_linears(mods, has_bias: bool, tp: int):
     """Concat N same-input ColumnParallelLinear along the out dim."""
     from . import initializer as I
-    w = jnp.concatenate([m.weight for m in mods], axis=1)
+    w = _interleave([m.weight for m in mods], tp)
     # Constant init: no random matrix materialized, no global RNG key
     # consumed — the fused weight overwrites it immediately
     fused = ColumnParallelLinear(w.shape[0], w.shape[1],
@@ -35,26 +71,45 @@ def _fuse_linears(mods, has_bias: bool):
                                  has_bias=has_bias, gather_output=False)
     fused.weight = w
     if has_bias:
-        fused.bias = jnp.concatenate([m.bias for m in mods])
+        fused.bias = _interleave_bias([m.bias for m in mods], tp)
     return fused
 
 
 def fuse_projections(model, attention: bool = True, mlp: bool = True):
     """Fuse q/k/v (and gate/up) projections of every Llama-family block
-    of ``model`` in place; returns the model. Idempotent."""
+    of ``model`` in place; returns the model. Idempotent. The active
+    mesh's tp degree is baked into the fused column order (see module
+    docstring)."""
+    tp = _tp_degree()
+    if tp > 1:
+        # validate BEFORE mutating: a mid-pass failure would leave the
+        # model half-fused with the unfused weights already deleted
+        cfg = model.config
+        if attention and (cfg.num_attention_heads % tp
+                          or cfg.num_key_value_heads % tp):
+            raise ValueError(
+                f"fuse_projections: heads ({cfg.num_attention_heads}q/"
+                f"{cfg.num_key_value_heads}kv) not divisible by tp "
+                f"degree {tp}")
+        if mlp and cfg.intermediate_size % tp:
+            raise ValueError(
+                f"fuse_projections: intermediate_size "
+                f"{cfg.intermediate_size} not divisible by tp degree {tp}")
     for layer in getattr(model, "model", model).layers:
         attn = getattr(layer, "self_attn", None)
         if attention and attn is not None and \
                 hasattr(attn, "q_proj") and not hasattr(attn, "qkv_proj"):
             has_bias = attn.q_proj.bias is not None
             attn.qkv_proj = _fuse_linears(
-                [attn.q_proj, attn.k_proj, attn.v_proj], has_bias)
+                [attn.q_proj, attn.k_proj, attn.v_proj], has_bias, tp)
+            attn._fused_tp = tp
             del attn.q_proj, attn.k_proj, attn.v_proj
         mlp_mod = getattr(layer, "mlp", None)
         if mlp and mlp_mod is not None and \
                 hasattr(mlp_mod, "gate_proj") and \
                 not hasattr(mlp_mod, "gate_up_proj"):
             mlp_mod.gate_up_proj = _fuse_linears(
-                [mlp_mod.gate_proj, mlp_mod.up_proj], has_bias=False)
+                [mlp_mod.gate_proj, mlp_mod.up_proj], False, tp)
+            mlp_mod._fused_tp = tp
             del mlp_mod.gate_proj, mlp_mod.up_proj
     return model

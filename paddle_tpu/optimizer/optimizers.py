@@ -573,9 +573,13 @@ class Rprop(Optimizer):
         self.eta_neg, self.eta_pos = etas
 
     def _init_slot(self, p):
+        # a schedule's step-0 value seeds the per-element step size (the
+        # schedule does not otherwise drive Rprop — step sizes evolve by
+        # the eta rules after initialization)
+        lr0 = self._lr(0) if callable(self._lr) else self._lr
         return {"prev_g": jnp.zeros_like(p, dtype=jnp.float32),
-                "step_size": jnp.full_like(
-                    p, float(self._lr if not callable(self._lr) else 0.01), dtype=jnp.float32)}
+                "step_size": jnp.full_like(p, float(lr0),
+                                           dtype=jnp.float32)}
 
     def _update(self, params, grads, slots, lr, step):
         def upd(p, g, s):

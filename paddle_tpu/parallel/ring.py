@@ -138,6 +138,29 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         kw["attn_mask"] = segment_mask(seg_full)
     if window is not None:
         kw["window"] = window
+    if attn_fn is not None and kw:
+        # contract: a custom attn_fn must accept (q, k, v, causal=...,
+        # **kw) for whichever of attn_mask/window the caller sets here.
+        # Fail with the contract spelled out instead of a TypeError from
+        # deep inside the wrapped function.
+        import inspect
+        try:
+            sig = inspect.signature(attn_fn)
+            has_var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                             for p in sig.parameters.values())
+            missing = [k for k in kw if k not in sig.parameters] \
+                if not has_var_kw else []
+        except (TypeError, ValueError):   # builtins/partials w/o signature
+            missing = []
+        if missing:
+            cause = "/".join(
+                n for n, set_ in (("segment_ids", segment_ids is not None),
+                                  ("window", window is not None)) if set_)
+            raise TypeError(
+                f"ulysses_attention: custom attn_fn {attn_fn!r} does not "
+                f"accept {missing} — required because {cause} was set. "
+                "attn_fn must take (q, k, v, *, causal, attn_mask, "
+                "window) like ops.attention.dense_attention.")
     attn_fn = attn_fn or functools.partial(dense_attention, scale=scale)
     kvh = k.shape[2]
     if kvh < n:  # too few KV heads to split: replicate them up to sp degree

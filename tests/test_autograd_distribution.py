@@ -210,3 +210,26 @@ def test_jacobian_batch_axis():
     J = autograd.jacobian(f, x, batch_axis=0)
     assert J.shape == (2, 2, 2)  # per-sample jacobians, no cross blocks
     np.testing.assert_allclose(np.asarray(J[1]), np.diag([6.0, 8.0]))
+
+
+def test_multinomial_batched_probs():
+    """Advisor r4: batched probs must follow torch semantics — result is
+    shape + batch + (K,), each batch lane sampling its own categorical."""
+    from paddle_tpu.distribution import Multinomial
+    probs = jnp.asarray([[0.9, 0.1, 0.0], [0.0, 0.1, 0.9]])
+    d = Multinomial(20, probs)
+    s = d.sample((5,), key=jax.random.PRNGKey(0))
+    assert s.shape == (5, 2, 3)
+    np.testing.assert_array_equal(np.asarray(s.sum(-1)), 20)
+    # lanes draw from their OWN probs: lane 0 never emits class 2,
+    # lane 1 never emits class 0
+    assert float(s[:, 0, 2].max()) == 0.0
+    assert float(s[:, 1, 0].max()) == 0.0
+    lp = d.log_prob(s)
+    assert lp.shape == (5, 2)
+    assert np.all(np.isfinite(np.asarray(lp)))
+    # 1-D probs unchanged: shape + (K,)
+    d1 = Multinomial(7, jnp.asarray([0.5, 0.5]))
+    s1 = d1.sample((3,), key=jax.random.PRNGKey(1))
+    assert s1.shape == (3, 2)
+    np.testing.assert_array_equal(np.asarray(s1.sum(-1)), 7)

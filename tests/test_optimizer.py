@@ -207,3 +207,16 @@ class TestRound4Optimizers:
         import torch
         self._compare(lambda: pt.optimizer.Rprop(learning_rate=0.01),
                       lambda ps: torch.optim.Rprop(ps, lr=0.01))
+
+
+def test_rprop_schedule_seeds_initial_step_size():
+    """Advisor r4: a callable/schedule learning rate must seed Rprop's
+    initial per-element step size with its step-0 value, not 0.01."""
+    import jax.numpy as jnp
+    opt = pt.optimizer.Rprop(
+        learning_rate=pt.optimizer.lr.CosineAnnealingDecay(0.2, T_max=10))
+    slot = opt._init_slot(jnp.zeros((3,)))
+    np.testing.assert_allclose(np.asarray(slot["step_size"]), 0.2)
+    opt2 = pt.optimizer.Rprop(learning_rate=lambda step: 0.05)
+    slot2 = opt2._init_slot(jnp.zeros((3,)))
+    np.testing.assert_allclose(np.asarray(slot2["step_size"]), 0.05)

@@ -17,7 +17,7 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
-OUT = os.path.join(REPO, "BENCH_SELF_r04.json")
+OUT = os.path.join(REPO, "BENCH_SELF_r05.json")
 
 KERNEL_CHECK = r"""
 import json, time, numpy as np
@@ -149,7 +149,7 @@ def run_stage(name, cmd, timeout, env=None):
 
 
 def main():
-    report = {"comment": "Self-run TPU validation, round 4. Stages run "
+    report = {"comment": "Self-run TPU validation, round 5. Stages run "
                          "in subprocesses with timeouts (tunnel flaps).",
               "started": time.strftime("%Y-%m-%d %H:%M:%S")}
 
