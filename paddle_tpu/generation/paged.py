@@ -65,12 +65,16 @@ def paged_decode_write(pk: PagedKV, k, v):
     return pk._replace(kp=kp, vp=vp)
 
 
-def paged_prefill_write(pk: PagedKV, k, v, garbage_block: int = 0):
-    """Scatter a [1, s, kvh, d] prompt's K/V into row 0's blocks; pad
-    positions (>= seq_lens[0]) go to the garbage block."""
+def paged_prefill_write(pk: PagedKV, k, v, positions=None,
+                        garbage_block: int = 0):
+    """Scatter a [1, s, kvh, d] prompt's (or prompt chunk's) K/V into
+    row 0's blocks; pad positions (>= seq_lens[0]) go to the garbage
+    block. ``positions`` [s] are the tokens' GLOBAL positions (default
+    0..s-1 — the whole-prompt case); a chunk passes start..start+s-1
+    and seq_lens[0] = start + live-chunk-length."""
     B = pk.block_size
     s = k.shape[1]
-    pos = jnp.arange(s)
+    pos = positions if positions is not None else jnp.arange(s)
     live = pos < pk.seq_lens[0]
     bidx = jnp.where(live, pk.block_tables[0, pos // B], garbage_block)
     boff = pos % B
@@ -79,15 +83,48 @@ def paged_prefill_write(pk: PagedKV, k, v, garbage_block: int = 0):
     return pk._replace(kp=kp, vp=vp)
 
 
+def paged_chunk_attention(q, pk: PagedKV, positions,
+                          window: Optional[int] = None):
+    """Chunked-prefill attention: q [1, s, h, d] chunk queries at global
+    positions [1, s] attend over row 0's gathered blocks — the
+    previously cached chunks AND (causally) this chunk's own tokens,
+    which ``paged_prefill_write`` scattered in just before. Stale or
+    never-written table positions sit beyond every query's position (or
+    in unallocated garbage-block slots) and are masked by the causal
+    compare."""
+    from ..ops.attention import dense_attention
+    kvh, d = pk.kp.shape[2], pk.kp.shape[3]
+    ks = pk.kp[pk.block_tables[0]].reshape(1, -1, kvh, d)   # [1, T, ...]
+    vs = pk.vp[pk.block_tables[0]].reshape(1, -1, kvh, d)
+    kpos = jnp.arange(ks.shape[1])[None, :]                 # [1, T]
+    qpos = positions[0][:, None]                            # [s, 1]
+    keep = kpos <= qpos                                     # [s, T]
+    if window is not None:
+        keep &= qpos - kpos < window
+    return dense_attention(q, ks, vs, attn_mask=keep[None, None])
+
+
 def paged_decode_attention(q, pk: PagedKV, scale: Optional[float] = None,
                            window: Optional[int] = None):
-    """q [R, 1, h, d] against each row's gathered blocks, masked to the
-    row's length (inclusive of the token written this step). The math is
-    dense_attention's — only the block gather and per-row length mask
+    """q [R, 1, h, d] against each row's blocks, masked to the row's
+    length (inclusive of the token written this step).
+
+    Fast path: the Pallas paged kernel streams only each row's LIVE
+    blocks (scalar-prefetched block table, HBM bytes ∝ actual context).
+    Fallback (CPU tests / odd shapes): dense whole-table gather — the
+    math is dense_attention's, only the gather and per-row length mask
     live here."""
     from ..ops.attention import dense_attention
+    from ..ops.pallas.paged_attention import (paged_attention_pallas,
+                                              use_paged_kernel)
     R = q.shape[0]
     kvh, d = pk.kp.shape[2], pk.kp.shape[3]
+    if use_paged_kernel(q, pk.kp):
+        sc = scale if scale is not None else d ** -0.5
+        out = paged_attention_pallas(q[:, 0], pk.kp, pk.vp,
+                                     pk.block_tables, pk.seq_lens, sc,
+                                     window=window)
+        return out[:, None]
     ks = pk.kp[pk.block_tables]                  # [R, M, B, kvh, d]
     vs = pk.vp[pk.block_tables]
     T = ks.shape[1] * ks.shape[2]
@@ -101,20 +138,35 @@ def paged_decode_attention(q, pk: PagedKV, scale: Optional[float] = None,
                            scale=scale)
 
 
-class _Slot:
+class _Request:
+    """Queued/running request state. Sampling params are per-request and
+    ride into the jitted step as row arrays; ``key`` is the row's PRNG
+    stream — each emitted token consumes exactly one split, whether it
+    was sampled at prefill or at a decode tick, so a preempted request
+    that re-prefills continues the SAME stream (sampled outputs stay
+    reproducible under preemption, like the greedy recompute path)."""
     __slots__ = ("request_id", "prompt", "max_new", "eos", "tokens",
-                 "blocks", "prefix", "admit_seq")
+                 "blocks", "prefix", "prefix_lps", "admit_seq",
+                 "temperature", "top_k", "top_p", "key", "lps",
+                 "prefill_pos")
 
-    def __init__(self, request_id, prompt, max_new, eos, prefix,
-                 admit_seq):
+    def __init__(self, request_id, prompt, max_new, eos, temperature,
+                 top_k, top_p, key, prefix=None, prefix_lps=None):
         self.request_id = request_id
-        self.prompt = prompt            # ids the prefill ran over
+        self.prompt = prompt            # ids the prefill runs over
         self.max_new = max_new          # tokens still to emit
         self.eos = eos
-        self.prefix = prefix            # tokens emitted before preemption
-        self.admit_seq = admit_seq      # preemption picks the youngest
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.key = key                  # [2] uint32 PRNG state
+        self.prefix = prefix or []      # tokens emitted before preemption
+        self.prefix_lps = prefix_lps or []
+        self.admit_seq = 0              # preemption picks the youngest
         self.tokens: List[int] = []
+        self.lps: List[float] = []      # chosen-token logprobs
         self.blocks: List[int] = []
+        self.prefill_pos = 0            # prompt tokens already cached
 
 
 class PagedEngine:
@@ -130,13 +182,25 @@ class PagedEngine:
 
     def __init__(self, model, max_slots: int = 8, num_blocks: int = 128,
                  block_size: int = 16, max_blocks_per_seq: int = 16,
-                 prefill_buckets=(32, 64, 128)):
+                 prefill_buckets=(32, 64, 128),
+                 chunk_prefill_tokens: Optional[int] = None):
         cfg = model.config
         self.model = model
         self.fn, self.params = model.functional()
         self.R, self.P, self.B, self.M = (max_slots, num_blocks,
                                           block_size, max_blocks_per_seq)
         self.prefill_buckets = sorted(prefill_buckets)
+        # chunked prefill (vLLM-style): prompts enter the cache
+        # chunk_prefill_tokens at a time, interleaved with decode ticks,
+        # so one long prompt never stalls the active slots for its whole
+        # length. None = whole-prompt prefill at admission (one bucketed
+        # call). Quantized to block_size so chunk boundaries align with
+        # block boundaries and every chunk reuses ONE compiled shape.
+        if chunk_prefill_tokens is not None:
+            chunk_prefill_tokens = max(
+                block_size,
+                -(-chunk_prefill_tokens // block_size) * block_size)
+        self.chunk = chunk_prefill_tokens
         L = cfg.num_hidden_layers
         kvh, d = cfg.num_key_value_heads, cfg.head_dim
         self.pools = [(jnp.zeros((self.P, self.B, kvh, d), cfg.dtype),
@@ -146,44 +210,108 @@ class PagedEngine:
         self.free_blocks = list(range(1, self.P))
         self.block_tables = np.zeros((self.R, self.M), np.int32)
         self.seq_lens = np.zeros((self.R,), np.int32)
-        self.slots: List[Optional[_Slot]] = [None] * self.R
-        self.queue: List[tuple] = []
+        # per-row sampling params (inactive rows: greedy, key unused)
+        self.temps = np.zeros((self.R,), np.float32)
+        self.top_ks = np.zeros((self.R,), np.int32)
+        self.top_ps = np.ones((self.R,), np.float32)
+        self.keys = np.zeros((self.R, 2), np.uint32)
+        self.slots: List[Optional[_Request]] = [None] * self.R
+        self.queue: List[_Request] = []
         self.results: Dict[Any, List[int]] = {}
+        self.logprobs: Dict[Any, List[float]] = {}
         self._admit_counter = 0
+        self._submit_counter = 0
         self.stats = {"decode_steps": 0, "prefills": 0, "preemptions": 0,
-                      "slot_steps": 0, "active_slot_steps": 0}
+                      "prefill_chunks": 0, "slot_steps": 0,
+                      "active_slot_steps": 0}
         # pools are donated: XLA aliases input to output so a decode
         # step costs one scatter, not a full pool copy
         self._decode_jit = jax.jit(self._decode_step, donate_argnums=(1,))
+        self._decode_greedy_jit = jax.jit(self._decode_step_greedy,
+                                          donate_argnums=(1,))
         self._prefill_jit = jax.jit(self._prefill, donate_argnums=(1,),
                                     static_argnames=("bucket",))
+        self._chunk_jit = jax.jit(self._chunk_prefill, donate_argnums=(1,),
+                                  static_argnames=("bucket",))
 
     # ------------------------------------------------------------ jitted
     def _paged_caches(self, pools, tables, lens):
         return [PagedKV(kp, vp, tables, lens) for kp, vp in pools]
 
-    def _decode_step(self, params, pools, tables, lens, last_tokens):
+    def _decode_step(self, params, pools, tables, lens, last_tokens,
+                     keys, temps, tks, tps):
+        from .sampling import sample_token_rows
         caches = self._paged_caches(pools, tables, lens)
         logits, new_caches = self.fn(params, last_tokens[:, None],
                                      kv_caches=caches,
                                      positions=lens[:, None])
-        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
-        return nxt.astype(jnp.int32), [(c.kp, c.vp) for c in new_caches]
+        nxt, lps, new_keys = sample_token_rows(logits[:, -1], keys,
+                                               temps, tks, tps)
+        return nxt, lps, new_keys, [(c.kp, c.vp) for c in new_caches]
 
-    def _prefill(self, params, pools, table_row, ids, length, *,
-                 bucket: int):
+    def _decode_step_greedy(self, params, pools, tables, lens,
+                            last_tokens):
+        """Argmax-only tick for the common all-greedy batch: skips the
+        sort/softmax/categorical machinery (and the key splits) that
+        sample_token_rows pays on the hottest serving path."""
+        caches = self._paged_caches(pools, tables, lens)
+        logits, new_caches = self.fn(params, last_tokens[:, None],
+                                     kv_caches=caches,
+                                     positions=lens[:, None])
+        raw = logits[:, -1].astype(jnp.float32)
+        nxt = jnp.argmax(raw, axis=-1).astype(jnp.int32)
+        lps = jnp.take_along_axis(jax.nn.log_softmax(raw, axis=-1),
+                                  nxt[:, None], axis=-1)[:, 0]
+        return nxt, lps, [(c.kp, c.vp) for c in new_caches]
+
+    def _prefill(self, params, pools, table_row, ids, length, key,
+                 temp, tk, tp, *, bucket: int):
+        from .sampling import sample_token_rows
         tables = jnp.broadcast_to(table_row[None], (1, self.M))
         lens = jnp.asarray([length], jnp.int32)
         caches = self._paged_caches(pools, tables, lens)
         positions = jnp.arange(bucket)[None, :]
         logits, new_caches = self.fn(params, ids, kv_caches=caches,
                                      positions=positions)
-        nxt = jnp.argmax(logits[0, length - 1].astype(jnp.float32))
-        return nxt.astype(jnp.int32), [(c.kp, c.vp) for c in new_caches]
+        row = logits[0, length - 1][None]          # [1, V]
+        nxt, lps, new_key = sample_token_rows(row, key[None],
+                                              temp[None], tk[None],
+                                              tp[None])
+        return (nxt[0], lps[0], new_key[0],
+                [(c.kp, c.vp) for c in new_caches])
+
+    def _chunk_prefill(self, params, pools, table_row, ids, start,
+                       total_len, key, temp, tk, tp, *, bucket: int):
+        """One prompt chunk at global positions [start, start+bucket):
+        writes its K/V (live = positions < total_len) and attends to the
+        already-cached chunks. The chosen-token sample at the last live
+        position is returned EVERY chunk (one executable); the host only
+        keeps it — and the advanced key — for the final chunk, so a
+        request still consumes exactly one split per emitted token."""
+        from .sampling import sample_token_rows
+        tables = jnp.broadcast_to(table_row[None], (1, self.M))
+        lens = jnp.asarray([total_len], jnp.int32)
+        caches = self._paged_caches(pools, tables, lens)
+        positions = start + jnp.arange(bucket)[None, :]
+        logits, new_caches = self.fn(params, ids, kv_caches=caches,
+                                     positions=positions,
+                                     paged_chunk=True)
+        row = logits[0, total_len - start - 1][None]
+        nxt, lps, new_key = sample_token_rows(row, key[None],
+                                              temp[None], tk[None],
+                                              tp[None])
+        return (nxt[0], lps[0], new_key[0],
+                [(c.kp, c.vp) for c in new_caches])
 
     # ------------------------------------------------------------- host
     def submit(self, request_id, input_ids, max_new_tokens: int = 32,
-               eos_token_id: Optional[int] = None):
+               eos_token_id: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: Optional[int] = None):
+        """temperature <= 0 keeps the bit-exact greedy path; a sampled
+        request gets its own PRNG stream seeded by ``seed`` (default: a
+        per-engine submission counter), so outputs are reproducible per
+        request regardless of what else shares the batch."""
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         ids = list(np.asarray(input_ids).reshape(-1))
@@ -194,8 +322,17 @@ class PagedEngine:
                              f"{self.M * self.B}")
         if self._blocks_needed(total) > self.P - 1:
             raise ValueError("request alone exceeds the block pool")
-        self.queue.append((request_id, ids, max_new_tokens, eos_token_id,
-                           []))
+        self._submit_counter += 1
+        if seed is None:
+            # monotone per-engine counter: never resets (results may be
+            # cleared by serve_stream between calls), so repeated
+            # unseeded sampled requests get distinct streams
+            seed = self._submit_counter
+        key = np.asarray(jax.random.key_data(jax.random.PRNGKey(seed)),
+                         np.uint32)
+        self.queue.append(_Request(request_id, ids, max_new_tokens,
+                                   eos_token_id, float(temperature),
+                                   int(top_k), float(top_p), key))
 
     def _blocks_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.B - 1) // self.B
@@ -204,22 +341,34 @@ class PagedEngine:
         """Prefill ONE queued request into a free slot if blocks allow."""
         if not self.queue:
             return False
-        rid, ids, max_new, eos, prefix = self.queue[0]
+        req = self.queue[0]
         try:
             slot_id = self.slots.index(None)
         except ValueError:
             return False
+        ids = req.prompt
         need = self._blocks_needed(len(ids) + 1)
         if len(self.free_blocks) < need:
             return False
         self.queue.pop(0)
         self._admit_counter += 1
-        slot = _Slot(rid, ids, max_new, eos, prefix, self._admit_counter)
-        slot.blocks = [self.free_blocks.pop() for _ in range(need)]
-        self.slots[slot_id] = slot
+        req.admit_seq = self._admit_counter
+        req.blocks = [self.free_blocks.pop() for _ in range(need)]
+        self.slots[slot_id] = req
         row = np.zeros((self.M,), np.int32)
-        row[:need] = slot.blocks
+        row[:need] = req.blocks
         self.block_tables[slot_id] = row
+        self.temps[slot_id] = req.temperature
+        self.top_ks[slot_id] = req.top_k
+        self.top_ps[slot_id] = req.top_p
+        self.keys[slot_id] = req.key
+
+        if self.chunk is not None:
+            # chunked mode: admission only claims the slot + blocks; the
+            # prompt enters the cache chunk-by-chunk on later ticks
+            req.prefill_pos = 0
+            self.seq_lens[slot_id] = 0
+            return True
 
         bucket = next((b for b in self.prefill_buckets if b >= len(ids)),
                       None)
@@ -229,17 +378,54 @@ class PagedEngine:
                 bucket *= 2
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(ids)] = ids
-        nxt, self.pools = self._prefill_jit(
+        nxt, lp, new_key, self.pools = self._prefill_jit(
             self.params, self.pools, jnp.asarray(row),
-            jnp.asarray(padded), np.int32(len(ids)), bucket=bucket)
+            jnp.asarray(padded), np.int32(len(ids)),
+            jnp.asarray(req.key), np.float32(req.temperature),
+            np.int32(req.top_k), np.float32(req.top_p), bucket=bucket)
         self.stats["prefills"] += 1
         first = int(nxt)
-        slot.tokens.append(first)
+        self.keys[slot_id] = np.asarray(new_key)
+        req.key = self.keys[slot_id].copy()
+        req.tokens.append(first)
+        req.lps.append(float(lp))
+        req.prefill_pos = len(ids)
         self.seq_lens[slot_id] = len(ids)
-        if slot.max_new <= 1 or (slot.eos is not None
-                                 and first == slot.eos):
+        if req.max_new <= 1 or (req.eos is not None
+                                and first == req.eos):
             self._finish(slot_id)
         return True
+
+    def _advance_chunk(self, slot_id: int):
+        """Run ONE chunk of slot's prompt prefill; on the final chunk the
+        first generated token materializes and the slot joins decode."""
+        req = self.slots[slot_id]
+        ids = req.prompt
+        start = req.prefill_pos
+        live = min(self.chunk, len(ids) - start)
+        last = start + live >= len(ids)
+        padded = np.zeros((1, self.chunk), np.int32)
+        padded[0, :live] = ids[start:start + live]
+        row = self.block_tables[slot_id]
+        nxt, lp, new_key, self.pools = self._chunk_jit(
+            self.params, self.pools, jnp.asarray(row),
+            jnp.asarray(padded), np.int32(start),
+            np.int32(start + live), jnp.asarray(req.key),
+            np.float32(req.temperature), np.int32(req.top_k),
+            np.float32(req.top_p), bucket=self.chunk)
+        self.stats["prefill_chunks"] += 1
+        req.prefill_pos = start + live
+        self.seq_lens[slot_id] = req.prefill_pos
+        if last:
+            self.stats["prefills"] += 1
+            self.keys[slot_id] = np.array(new_key)
+            req.key = self.keys[slot_id].copy()
+            first = int(nxt)
+            req.tokens.append(first)
+            req.lps.append(float(lp))
+            if req.max_new <= 1 or (req.eos is not None
+                                    and first == req.eos):
+                self._finish(slot_id)
 
     def _ensure_block(self, slot_id: int) -> bool:
         """The next decode writes at seq_lens[slot_id]; allocate the
@@ -257,54 +443,87 @@ class PagedEngine:
     def _finish(self, slot_id: int):
         slot = self.slots[slot_id]
         self.results[slot.request_id] = slot.prefix + slot.tokens
+        self.logprobs[slot.request_id] = slot.prefix_lps + slot.lps
         self._release(slot_id)
 
     def _release(self, slot_id: int):
         self.free_blocks.extend(self.slots[slot_id].blocks)
         self.block_tables[slot_id] = 0
         self.seq_lens[slot_id] = 0
+        self.temps[slot_id] = 0.0
+        self.top_ks[slot_id] = 0
+        self.top_ps[slot_id] = 1.0
         self.slots[slot_id] = None
 
     def _preempt_youngest(self, exclude: int) -> bool:
         """Memory pressure: requeue the most recently admitted OTHER
         request (vLLM's recompute-mode preemption — its emitted tokens
         fold into the prompt, so the re-prefill rebuilds the same KV
-        deterministically and the output stays exact)."""
+        deterministically and the output stays exact; the carried PRNG
+        key means a SAMPLED victim also resumes its stream exactly —
+        every emitted token consumed one split, prefill or decode)."""
         cands = [i for i, s in enumerate(self.slots)
                  if s is not None and i != exclude]
         if not cands:
             return False
         victim = max(cands, key=lambda i: self.slots[i].admit_seq)
         s = self.slots[victim]
-        self.queue.insert(0, (
-            s.request_id, s.prompt + s.tokens,
-            s.max_new - len(s.tokens), s.eos,
-            s.prefix + s.tokens))
+        # s.key is the authoritative stream state: synced from the jit
+        # after every decode tick / final chunk, and NOT perturbed by the
+        # all-rows key split that garbage-advances self.keys for rows
+        # still mid-chunk-prefill
+        requeued = _Request(s.request_id, s.prompt + s.tokens,
+                            s.max_new - len(s.tokens), s.eos,
+                            s.temperature, s.top_k, s.top_p,
+                            s.key.copy(),
+                            prefix=s.prefix + s.tokens,
+                            prefix_lps=s.prefix_lps + s.lps)
+        self.queue.insert(0, requeued)
         self._release(victim)
         self.stats["preemptions"] += 1
         return True
 
     def step(self):
-        """One scheduler tick: admit, then one decode for all slots."""
-        self._try_admit()
+        """One scheduler tick: admit EVERY queued request that fits
+        (slots + blocks), advance one prefill chunk per prefilling slot,
+        then one decode for all prefill-complete slots."""
+        while self._try_admit():
+            pass
+        if self.chunk is not None:
+            for i in range(self.R):
+                s = self.slots[i]
+                if s is not None and s.prefill_pos < len(s.prompt):
+                    self._advance_chunk(i)
         for i in range(self.R):
-            if self.slots[i] is None:
+            if self.slots[i] is None or \
+                    self.slots[i].prefill_pos < len(self.slots[i].prompt):
                 continue
             while not self._ensure_block(i):
                 if not self._preempt_youngest(exclude=i):
                     raise RuntimeError(
                         "paged KV pool cannot hold even one request; "
                         "raise num_blocks")
-        active = [i for i, s in enumerate(self.slots) if s is not None]
+        active = [i for i, s in enumerate(self.slots)
+                  if s is not None and s.tokens]
         if not active:
             return
         last = np.zeros((self.R,), np.int32)
         for i in active:
             last[i] = self.slots[i].tokens[-1]
-        nxt, self.pools = self._decode_jit(
-            self.params, self.pools, jnp.asarray(self.block_tables),
-            jnp.asarray(self.seq_lens), jnp.asarray(last))
+        if np.all(self.temps[active] <= 0.0):
+            # all-greedy tick: the argmax-only executable
+            nxt, lps, self.pools = self._decode_greedy_jit(
+                self.params, self.pools, jnp.asarray(self.block_tables),
+                jnp.asarray(self.seq_lens), jnp.asarray(last))
+        else:
+            nxt, lps, new_keys, self.pools = self._decode_jit(
+                self.params, self.pools, jnp.asarray(self.block_tables),
+                jnp.asarray(self.seq_lens), jnp.asarray(last),
+                jnp.asarray(self.keys), jnp.asarray(self.temps),
+                jnp.asarray(self.top_ks), jnp.asarray(self.top_ps))
+            self.keys = np.array(new_keys)  # copy: jax views read-only
         nxt = np.asarray(nxt)
+        lps = np.asarray(lps)
         self.stats["decode_steps"] += 1
         self.stats["slot_steps"] += self.R
         self.stats["active_slot_steps"] += len(active)
@@ -313,6 +532,8 @@ class PagedEngine:
             self.seq_lens[i] += 1   # the decode wrote last token's K/V
             tok = int(nxt[i])
             slot.tokens.append(tok)
+            slot.lps.append(float(lps[i]))
+            slot.key = self.keys[i].copy()
             done = len(slot.tokens) >= slot.max_new or \
                 (slot.eos is not None and tok == slot.eos)
             if done:

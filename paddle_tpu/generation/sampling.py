@@ -50,6 +50,61 @@ def repetition_penalty(logits, generated_mask, penalty: float):
     return jnp.where(seen, penalized, logits)
 
 
+def sample_token_rows(logits, keys, temperature, top_k, top_p):
+    """Per-ROW sampling for continuous batching: every parameter is an
+    array over rows, so one jitted decode step serves a mixed stream of
+    greedy and sampled requests (reference: PaddleNLP llm predictor's
+    per-request sampling config).
+
+    logits [R, V] (raw); keys [R, 2] uint32 per-row PRNG states;
+    temperature [R] f32 (<= 0 means greedy — BIT-exact argmax of the raw
+    fp32 logits, the same op the all-greedy step used); top_k [R] i32
+    (<= 0 disables); top_p [R] f32 (>= 1 disables). Unlike the static
+    processors above, k and p are traced values: top-k thresholds via
+    take_along_axis on the sorted row, not lax.top_k.
+
+    Returns (tokens [R] i32, logprobs [R] f32, new_keys [R, 2]).
+    Logprobs are of the CHOSEN token under the unfiltered softmax (what
+    serving APIs report), greedy rows included."""
+    raw = logits.astype(jnp.float32)
+    R, V = raw.shape
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+
+    lt = raw / jnp.maximum(temperature, 1e-6)[:, None]
+    # per-row top-k: k-th largest value as threshold (k <= 0: keep all)
+    sd = jnp.sort(lt, axis=-1)[..., ::-1]
+    kth = jnp.take_along_axis(
+        sd, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=-1)
+    lt = jnp.where((top_k[:, None] > 0) & (lt < kth), NEG_INF, lt)
+    # the top-k-filtered logits in sorted order, derived from the ONE
+    # sort: rank >= k is masked (ties at the k-th value are all kept by
+    # the filter above but counted once in the top-p cumsum)
+    rank = jnp.arange(V)[None, :]
+    sd2 = jnp.where((top_k[:, None] <= 0) | (rank < top_k[:, None]),
+                    sd, NEG_INF)
+    probs = jax.nn.softmax(sd2, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < top_p[:, None]   # always keeps argmax
+    thresh = jnp.min(jnp.where(keep_sorted, sd2, jnp.inf), axis=-1,
+                     keepdims=True)
+    lt = jnp.where((top_p[:, None] < 1.0) & (lt < thresh), NEG_INF, lt)
+
+    keys = jnp.asarray(keys, jnp.uint32)
+    pairs = jax.vmap(lambda k: jax.random.split(
+        jax.random.wrap_key_data(k, impl="threefry2x32")))(keys)
+    carry = jax.vmap(jax.random.key_data)(pairs[:, 0])
+    sampled = jax.vmap(
+        lambda k, l: jax.random.categorical(k, l))(pairs[:, 1], lt)
+    tokens = jnp.where(temperature <= 0.0,
+                       jnp.argmax(raw, axis=-1), sampled).astype(jnp.int32)
+    logprobs = jnp.take_along_axis(jax.nn.log_softmax(raw, axis=-1),
+                                   tokens[:, None].astype(jnp.int32),
+                                   axis=-1)[:, 0]
+    return tokens, logprobs, carry
+
+
 def sample_token(logits, key, temperature=1.0, top_k=0, top_p=1.0,
                  do_sample=True):
     """logits [b, vocab] -> token ids [b]."""

@@ -116,15 +116,18 @@ class Predictor:
         return self.model.generate(jnp.asarray(input_ids), **kwargs)
 
     def serve_stream(self, requests, max_new_tokens: int = 64,
-                     eos_token_id=None, **engine_kw):
+                     eos_token_id=None, sampling=None, **engine_kw):
         """Continuous-batching service for a mixed-length request
         stream (reference: PaddleNLP llm predictor's block-attention
         path): ``requests`` maps request_id -> input_ids. Admission is
         FIFO: a request enters the moment a slot AND its blocks free
         up, backfilling slots that finished mid-decode (a large
         request at the queue head can delay the ones behind it — size
-        the pool for the large case). Greedy, exact per request vs
-        ``generate``. Returns request_id -> generated ids.
+        the pool for the large case). Greedy by default — exact per
+        request vs ``generate``; ``sampling`` maps request_id -> dict
+        of per-request overrides (temperature/top_k/top_p/seed), and
+        chosen-token logprobs land in ``self.last_logprobs``. Returns
+        request_id -> generated ids.
 
         The engine (pools + compiled prefill/decode executables) is
         cached per ``engine_kw`` shape, so repeated calls pay no
@@ -137,9 +140,12 @@ class Predictor:
             self._paged_engines[key] = eng
         for rid, ids in requests.items():
             eng.submit(rid, ids, max_new_tokens=max_new_tokens,
-                       eos_token_id=eos_token_id)
+                       eos_token_id=eos_token_id,
+                       **((sampling or {}).get(rid, {})))
         out = eng.run()
         eng.results.clear()  # the caller owns them now
+        self.last_logprobs = dict(eng.logprobs)
+        eng.logprobs.clear()
         self.last_serve_stats = dict(eng.stats)
         return out
 

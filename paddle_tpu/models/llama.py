@@ -258,7 +258,7 @@ class LlamaAttention(Layer):
 
     def forward(self, x, positions, kv_cache: Optional[Tuple] = None,
                 cache_index=None, attn_mask=None, attn_start=None,
-                segment_ids=None):
+                segment_ids=None, paged_chunk: bool = False):
         cfg = self.config
         b, s, _ = x.shape
         nh, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
@@ -290,7 +290,7 @@ class LlamaAttention(Layer):
 
         new_cache = None
         if kv_cache is not None:
-            from ..generation.paged import (PagedKV,
+            from ..generation.paged import (PagedKV, paged_chunk_attention,
                                             paged_decode_attention,
                                             paged_decode_write,
                                             paged_prefill_write)
@@ -298,13 +298,21 @@ class LlamaAttention(Layer):
             # paged serving (generation/paged.py): block-table cache.
             # s == 1: scatter-write this token, attend over the row's
             # gathered blocks up to its length. s > 1: prefill — write
-            # the prompt's K/V into its blocks, plain causal attention
-            # over the prompt itself (pad tail lands in the garbage
-            # block and produces discarded rows).
+            # the prompt's K/V into its blocks; whole-prompt prefill is
+            # plain causal attention over the prompt itself (pad tail
+            # lands in the garbage block and produces discarded rows),
+            # while a CHUNK (paged_chunk=True, positions carry the
+            # global offset) must also attend to the earlier chunks
+            # already in the row's blocks.
             if s == 1:
                 new_cache = paged_decode_write(kv_cache, k, v)
                 out = paged_decode_attention(q, new_cache,
                                              window=self.window)
+            elif paged_chunk:
+                new_cache = paged_prefill_write(kv_cache, k, v,
+                                                positions=positions[0])
+                out = paged_chunk_attention(q, new_cache, positions,
+                                            window=self.window)
             else:
                 new_cache = paged_prefill_write(kv_cache, k, v)
                 out = dense_attention(q, k, v, causal=True,
@@ -443,11 +451,13 @@ class LlamaDecoderLayer(Layer):
         self.mlp = LlamaMLP(config)
 
     def forward(self, x, positions, kv_cache=None, cache_index=None,
-                attn_mask=None, attn_start=None, segment_ids=None):
+                attn_mask=None, attn_start=None, segment_ids=None,
+                paged_chunk: bool = False):
         attn_out = self.self_attn(self.input_layernorm(x), positions,
                                   kv_cache=kv_cache, cache_index=cache_index,
                                   attn_mask=attn_mask, attn_start=attn_start,
-                                  segment_ids=segment_ids)
+                                  segment_ids=segment_ids,
+                                  paged_chunk=paged_chunk)
         new_cache = None
         if kv_cache is not None:
             attn_out, new_cache = attn_out
@@ -475,7 +485,7 @@ class LlamaModel(Layer):
 
     def forward(self, input_ids, positions=None, kv_caches=None,
                 cache_index=None, attn_mask=None, attn_start=None,
-                segment_ids=None):
+                segment_ids=None, paged_chunk: bool = False):
         b, s = input_ids.shape
         if positions is None:
             start = cache_index if cache_index is not None else 0
@@ -498,7 +508,8 @@ class LlamaModel(Layer):
             else:
                 out = layer(x, positions, kv_cache=cache_i,
                             cache_index=cache_index, attn_mask=attn_mask,
-                            attn_start=attn_start, segment_ids=segment_ids)
+                            attn_start=attn_start, segment_ids=segment_ids,
+                            paged_chunk=paged_chunk)
             if kv_caches is not None:
                 x, nc = out
                 new_caches.append(nc)
@@ -532,9 +543,10 @@ class LlamaForCausalLM(CausalLMBase):
 
     def forward(self, input_ids, positions=None, kv_caches=None,
                 cache_index=None, attn_mask=None, attn_start=None,
-                segment_ids=None):
+                segment_ids=None, paged_chunk: bool = False):
         out = self.model(input_ids, positions, kv_caches, cache_index,
-                         attn_mask, attn_start, segment_ids=segment_ids)
+                         attn_mask, attn_start, segment_ids=segment_ids,
+                         paged_chunk=paged_chunk)
         caches = None
         if kv_caches is not None:
             out, caches = out

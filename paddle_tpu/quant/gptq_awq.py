@@ -39,12 +39,25 @@ __all__ = ["gptq_quantize_weight", "awq_search_scale",
 # ------------------------------------------------------------------- GPTQ
 
 def gptq_quantize_weight(w, x_cal, bits: int = 4, block_size: int = 128,
-                         percdamp: float = 0.01):
+                         percdamp: float = 0.01, act_order: bool = False):
     """GPTQ on a [in, out] weight with calibration activations
     [n, in]. Returns (qweight, scales) in quantize_blockwise's layout.
 
-    Column order is the natural 0..in-1 (grouped scales need contiguous
-    blocks); the damped Cholesky handles rank-deficient H.
+    ``act_order=False``: channels quantized 0..in-1, group scales taken
+    from the current (error-compensated) block values at block start.
+
+    ``act_order=True`` (the accuracy-critical reference variant):
+    channels are VISITED by descending diag(H) — the most activation-
+    salient channels quantize first, while every later channel can still
+    absorb their rounding error — but each channel keeps the scale of
+    its ORIGINAL contiguous block, and the int codes are permuted back,
+    so the emitted (qweight, scales) layout is exactly
+    quantize_blockwise's: QuantizedLinear and the Pallas dequant-matmul
+    decode path need no g_idx indirection. Scales are fixed up front
+    from the uncompensated weights (the visit order no longer walks
+    blocks contiguously).
+
+    The damped Cholesky handles rank-deficient H either way.
     """
     w = np.asarray(w, np.float64)                       # [in, out]
     x = np.asarray(x_cal, np.float64).reshape(-1, w.shape[0])
@@ -56,25 +69,40 @@ def gptq_quantize_weight(w, x_cal, bits: int = 4, block_size: int = 128,
     H = x.T @ x                                          # [in, in]
     damp = percdamp * np.mean(np.diag(H))
     H[np.diag_indices(din)] += max(damp, 1e-8)
-    # dead channels (no calibration signal): keep H invertible
-    Hinv = np.linalg.cholesky(np.linalg.inv(H)).T        # upper, Hinv chol
-    W = w.copy()
-    Q = np.zeros_like(W)
-    scales = np.zeros((din // block_size, dout))
+    Q = np.zeros_like(w)
 
-    for b0 in range(0, din, block_size):
-        b1 = b0 + block_size
-        # group scales from the CURRENT (error-compensated) block values
-        blk = b0 // block_size
-        scales[blk] = np.maximum(np.abs(W[b0:b1]).max(axis=0) / qmax,
-                                 1e-12)
-        for i in range(b0, b1):
-            s = scales[blk]
+    if act_order:
+        perm = np.argsort(-np.diag(H))                   # salient first
+        Hp = H[perm][:, perm]
+        # dead channels (no calibration signal): keep H invertible
+        Hinv = np.linalg.cholesky(np.linalg.inv(Hp)).T   # upper
+        W = w[perm].copy()
+        scales = np.maximum(
+            np.abs(w).reshape(din // block_size, block_size, dout)
+            .max(axis=1) / qmax, 1e-12)
+        for i in range(din):
+            s = scales[perm[i] // block_size]
             qi = np.clip(np.round(W[i] / s), -qmax, qmax)
-            Q[i] = qi
+            Q[perm[i]] = qi
             err = (W[i] - qi * s) / Hinv[i, i]
-            # push the rounding error onto later channels
+            # push the rounding error onto later-visited channels
             W[i + 1:] -= np.outer(Hinv[i, i + 1:], err)
+    else:
+        Hinv = np.linalg.cholesky(np.linalg.inv(H)).T    # upper
+        W = w.copy()
+        scales = np.zeros((din // block_size, dout))
+        for b0 in range(0, din, block_size):
+            b1 = b0 + block_size
+            # group scales from the CURRENT (error-compensated) values
+            blk = b0 // block_size
+            scales[blk] = np.maximum(np.abs(W[b0:b1]).max(axis=0) / qmax,
+                                     1e-12)
+            for i in range(b0, b1):
+                s = scales[blk]
+                qi = np.clip(np.round(W[i] / s), -qmax, qmax)
+                Q[i] = qi
+                err = (W[i] - qi * s) / Hinv[i, i]
+                W[i + 1:] -= np.outer(Hinv[i, i + 1:], err)
     q = jnp.asarray(Q.astype(np.int8))
     if bits == 4:
         q = pack_int4(q)
@@ -164,7 +192,8 @@ def capture_linear_inputs(model, batches, max_tokens: int = 512,
 def gptq_quantize_model(model, batches, bits: int = 4,
                         block_size: int = 128,
                         skip: Optional[List[str]] = None,
-                        percdamp: float = 0.01) -> int:
+                        percdamp: float = 0.01,
+                        act_order: bool = False) -> int:
     """Calibrate + GPTQ-quantize every eligible linear in place (one
     traversal definition: weight_only.quantize_model drives the swap).
     Returns the number of swapped layers."""
@@ -172,7 +201,7 @@ def gptq_quantize_model(model, batches, bits: int = 4,
 
     def build(sub, path):
         q, s = gptq_quantize_weight(sub.weight, calib[path], bits,
-                                    block_size, percdamp)
+                                    block_size, percdamp, act_order)
         return QuantizedLinear.from_linear(sub, bits=bits,
                                            block_size=block_size,
                                            qweight=q, scales=s)

