@@ -214,7 +214,8 @@ def beam_search(model, input_ids, config: GenerationConfig, params=None):
 
 from .pipeline import TextGenerationPipeline  # noqa: E402
 from .paged import PagedEngine, PagedKV  # noqa: E402
-from .speculative import speculative_generate  # noqa: E402
+from .speculative import (speculative_generate,  # noqa: E402
+                          mtp_speculative_generate)
 
 __all__ += ["TextGenerationPipeline", "speculative_generate",
-            "PagedEngine", "PagedKV"]
+            "mtp_speculative_generate", "PagedEngine", "PagedKV"]

@@ -33,12 +33,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["speculative_generate"]
+__all__ = ["speculative_generate", "mtp_speculative_generate"]
 
 # target -> draft -> {static key -> compiled run}: without this every call
 # would retrace the draft-scan + verify while_loop (cf. generation's
 # _GEN_CACHE) — fatal for the serving latency this feature exists for.
 _SPEC_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _spec_stats(nfwd, n_end, total, prompt_len, bsz):
+    # emitted counts actual tokens (EOS can stop early) so the
+    # tokens-per-forward speedup figure is not overstated
+    nfwd = np.asarray(nfwd).reshape(-1)
+    emitted = np.minimum(np.asarray(n_end).reshape(-1), total) - prompt_len
+    tpf = emitted / np.maximum(nfwd, 1)
+    if bsz == 1:
+        return {"target_forwards": int(nfwd[0]),
+                "emitted_tokens": int(emitted[0]),
+                "tokens_per_forward": float(tpf[0])}
+    return {"target_forwards": nfwd.tolist(),
+            "emitted_tokens": emitted.tolist(),
+            "tokens_per_forward": tpf.tolist()}
 
 
 def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
@@ -76,19 +91,7 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
     per_key = per_draft.setdefault(draft, {})
 
     def _stats(nfwd, n_end):
-        # emitted counts actual tokens (EOS can stop early) so the
-        # tokens-per-forward speedup figure is not overstated
-        nfwd = np.asarray(nfwd).reshape(-1)
-        emitted = np.minimum(np.asarray(n_end).reshape(-1), total) \
-            - prompt_len
-        tpf = emitted / np.maximum(nfwd, 1)
-        if bsz == 1:
-            return {"target_forwards": int(nfwd[0]),
-                    "emitted_tokens": int(emitted[0]),
-                    "tokens_per_forward": float(tpf[0])}
-        return {"target_forwards": nfwd.tolist(),
-                "emitted_tokens": emitted.tolist(),
-                "tokens_per_forward": tpf.tolist()}
+        return _spec_stats(nfwd, n_end, total, prompt_len, bsz)
 
     cached = per_key.get(cache_key)
     if cached is not None:
@@ -186,4 +189,183 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
 
     per_key[cache_key] = call
     out, nfwd, n_end = call(t_params, d_params, input_ids)
+    return (out, _stats(nfwd, n_end)) if return_stats else out
+
+
+def mtp_speculative_generate(model, input_ids, max_new_tokens: int = 64,
+                             num_draft_tokens: int = 4,
+                             eos_token_id: Optional[int] = None,
+                             pad_token_id: int = 0, params=None,
+                             return_stats: bool = False):
+    """Greedy decode accelerated by the model's OWN multi-token-prediction
+    head — no second model (reference: DeepSeek-V3 tech report §2.2 "MTP
+    for speculative decoding"; PaddleNLP llm draft-model inference).
+
+    The depth-0 MTP module is the draft: it consumes the target's
+    pre-final-norm hidden at position i and the embedding of token i+1
+    and predicts token i+2 through the SHARED lm_head. Drafting ``k``
+    tokens chains the module autoregressively (Eagle-style): each step
+    feeds its own pre-norm block output as the next step's hidden. The
+    chain keeps one MLA KV cache of its own; entries for COMMITTED
+    positions are always rewritten from the target's true hidden during
+    the post-verify bulk pass, so draft quality does not degrade over
+    the sequence, and speculative entries past the cursor are garbage
+    that the next pass overwrites before they become readable (same
+    rewind-free trick as the target cache).
+
+    Exactness does not depend on draft quality: the verify/accept step
+    is identical to :func:`speculative_generate`, so the output equals
+    ``model.generate(..., temperature=0.0)`` row by row.
+    """
+    cfg = model.config
+    if getattr(cfg, "num_nextn_predict_layers", 0) < 1:
+        raise ValueError("model has no MTP depth modules "
+                         "(config.num_nextn_predict_layers == 0)")
+    bsz = input_ids.shape[0]
+    k = int(num_draft_tokens)
+    if k < 1:
+        raise ValueError("num_draft_tokens must be >= 1")
+    fn, p0 = model.functional()
+    t_params = params if params is not None else p0
+    prompt_len = input_ids.shape[1]
+    total = prompt_len + max_new_tokens
+    eos = eos_token_id
+    hdim = cfg.hidden_size
+
+    mtp0 = model.mtp[0]
+    embed = model.model.embed_tokens
+    lm_head = model.lm_head
+
+    def m_fn(p, h_prev, tok, positions, cache, cache_index):
+        # pure draft step: depth-0 MTP block over |tok| positions with its
+        # own cache; returns (shared-head logits, PRE-norm hidden, cache)
+        with model.bound(p):
+            normed, pre, cache = mtp0(h_prev, embed(tok), positions,
+                                      kv_cache=cache,
+                                      cache_index=cache_index)
+            logits = lm_head(normed).astype(jnp.float32)
+        return logits, pre, cache
+
+    cache_key = ("mtp", bsz, prompt_len, max_new_tokens, k, eos,
+                 pad_token_id, hash(tuple(p0)))
+    per_draft = _SPEC_CACHE.setdefault(model, weakref.WeakKeyDictionary())
+    per_key = per_draft.setdefault(model, {})
+
+    def _stats(nfwd, n_end):
+        return _spec_stats(nfwd, n_end, total, prompt_len, bsz)
+
+    cached = per_key.get(cache_key)
+    if cached is not None:
+        out, nfwd, n_end = cached(t_params, input_ids)
+        return (out, _stats(nfwd, n_end)) if return_stats else out
+
+    def run(t_params, input_ids):
+        L = total + k + 1
+        t_caches = model.init_kv_caches(1, L)
+        m_cache = model.init_mtp_cache(1, L)
+        t_logits, pre, t_caches = fn(t_params, input_ids,
+                                     kv_caches=t_caches, cache_index=0,
+                                     return_prenorm=True)
+        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(input_ids.dtype)
+        tokens = jnp.concatenate(
+            [input_ids, jnp.full((1, max_new_tokens + k + 1), pad_token_id,
+                                 input_ids.dtype)], axis=1)
+        tokens = tokens.at[:, prompt_len].set(first)
+        # MTP prefill fills the draft cache for every prompt position and
+        # yields d0 (the draft for position prompt_len+1): position i
+        # pairs h_i with emb(t_{i+1}), so the shifted-token stream is
+        # prompt[1:] + [first]
+        m_toks = jnp.concatenate([input_ids[:, 1:], first[:, None]], axis=1)
+        m_pos = jnp.arange(prompt_len)[None, :]
+        m_logits, m_pre, m_cache = m_fn(t_params, pre, m_toks, m_pos,
+                                        m_cache, 0)
+        d0 = jnp.argmax(m_logits[:, -1], axis=-1).astype(tokens.dtype)
+        h_last = m_pre[:, -1:]                       # position prompt_len-1
+        n0 = jnp.int32(prompt_len + 1)
+        done0 = jnp.bool_(False) if eos is None else (first[0] == eos)
+
+        def chain_step(carry, _):
+            # one Eagle-chained draft step at position cur: h_prev is the
+            # previous mtp PRE-norm output (position cur-1), tok_prev the
+            # draft at position cur+1's predecessor — predicts cur+2
+            m_cache, tokens, h_prev, tok_prev, cur = carry
+            lg, pre1, m_cache = m_fn(t_params, h_prev, tok_prev[:, None],
+                                     cur[None, None], m_cache, cur)
+            nxt = jnp.argmax(lg[:, -1], axis=-1).astype(tokens.dtype)
+            tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None],
+                                                  (0, cur + 2))
+            return (m_cache, tokens, pre1[:, -1:], nxt, cur + 1), None
+
+        def body(state):
+            tokens, t_caches, m_cache, n, done, nfwd, h_last, d0 = state
+            tokens = jax.lax.dynamic_update_slice(tokens, d0[:, None],
+                                                  (0, n))
+            if k > 1:
+                (m_cache, tokens, _, _, _), _ = jax.lax.scan(
+                    chain_step, (m_cache, tokens, h_last, d0, n - 1),
+                    None, length=k - 1)
+            # verify: ONE target forward over [t_{n-1}, d_0 .. d_{k-1}],
+            # also yielding the true hiddens for the re-draft bulk pass
+            chunk = jax.lax.dynamic_slice(tokens, (0, n - 1), (1, k + 1))
+            t_logits, h_ctx, t_caches = fn(t_params, chunk,
+                                           kv_caches=t_caches,
+                                           cache_index=n - 1,
+                                           return_prenorm=True)
+            g = jnp.argmax(t_logits[0].astype(jnp.float32), axis=-1) \
+                .astype(tokens.dtype)
+            d = jax.lax.dynamic_slice(tokens, (0, n), (1, k))[0]
+            match = jnp.cumprod((d == g[:k]).astype(jnp.int32))
+            m = jnp.sum(match)
+            write = jnp.where(jnp.arange(k + 1) <= m, g,
+                              pad_token_id).astype(tokens.dtype)
+            tokens = jax.lax.dynamic_update_slice(tokens, write[None],
+                                                  (0, n))
+            if eos is not None:
+                hit = (write[:k + 1] == eos) & (jnp.arange(k + 1) <= m)
+                done = done | jnp.any(hit)
+                first_eos = jnp.argmax(hit)
+                adv = jnp.where(jnp.any(hit), first_eos + 1, m + 1)
+            else:
+                adv = m + 1
+            # re-draft bulk: rewrite the draft cache for the committed
+            # positions from the TRUE target hiddens (h_ctx) and read off
+            # the next round's d0/h_last at the accepted boundary
+            toks_in = jax.lax.dynamic_slice(tokens, (0, n), (1, k + 1))
+            pos = (n - 1) + jnp.arange(k + 1)[None, :]
+            m_logits, m_pre, m_cache = m_fn(t_params, h_ctx, toks_in, pos,
+                                            m_cache, n - 1)
+            sel = adv - 1
+            h_last = jax.lax.dynamic_slice(m_pre, (0, sel, 0),
+                                           (1, 1, hdim))
+            d0 = jnp.argmax(
+                jax.lax.dynamic_slice(m_logits, (0, sel, 0),
+                                      (1, 1, m_logits.shape[-1]))[:, 0],
+                axis=-1).astype(tokens.dtype)
+            return (tokens, t_caches, m_cache, n + adv, done, nfwd + 1,
+                    h_last, d0)
+
+        def cond(state):
+            n, done = state[3], state[4]
+            return (n < total) & ~done
+
+        state = (tokens, t_caches, m_cache, n0, done0, jnp.int32(1),
+                 h_last, d0)
+        out = jax.lax.while_loop(cond, body, state)
+        tokens, n_end, nfwd = out[0], out[3], out[5]
+        pos = jnp.arange(tokens.shape[1])[None, :]
+        tokens = jnp.where(pos < jnp.minimum(n_end, total), tokens,
+                           pad_token_id)
+        return tokens[:, :total], nfwd, n_end
+
+    if bsz == 1:
+        call = jax.jit(run)
+    else:
+        @jax.jit
+        def call(tp, ids):
+            outs, nfwd, n_end = jax.vmap(run, in_axes=(None, 0))(
+                tp, ids[:, None, :])
+            return outs[:, 0], nfwd, n_end
+
+    per_key[cache_key] = call
+    out, nfwd, n_end = call(t_params, input_ids)
     return (out, _stats(nfwd, n_end)) if return_stats else out

@@ -310,9 +310,20 @@ class DeepseekV3MTP(Layer):
         if config.dtype != jnp.float32:
             self.to(dtype=config.dtype)
 
-    def forward(self, h_prev, emb_next, positions, attn_mask=None):
+    def forward(self, h_prev, emb_next, positions, attn_mask=None,
+                kv_cache=None, cache_index=None):
+        """Training path (no cache): returns the final-normed hidden for
+        the shared lm_head. Decode path (kv_cache given — MTP-as-draft
+        speculative decoding): returns ``(normed, pre, new_cache)`` so
+        the caller can chain the PRE-norm block output as the next
+        step's ``h_prev`` (Eagle-style self-draft)."""
         x = self.eh_proj(jnp.concatenate(
             [self.hnorm(h_prev), self.enorm(emb_next)], axis=-1))
+        if kv_cache is not None:
+            x, new_cache = self.block(x, positions, kv_cache=kv_cache,
+                                      cache_index=cache_index,
+                                      attn_mask=attn_mask)
+            return self.norm(x), x, new_cache
         x = self.block(x, positions, attn_mask=attn_mask)
         return self.norm(x)
 
@@ -387,13 +398,25 @@ class DeepseekV2ForCausalLM(CausalLMBase):
                            dtype))
                 for _ in range(cfg.num_hidden_layers)]
 
+    def init_mtp_cache(self, batch_size: int, max_len: int, dtype=None):
+        """One MLA cache for the depth-0 MTP block (MTP-as-draft decode)."""
+        cfg = self.config
+        dtype = dtype or cfg.dtype
+        return (jnp.zeros((batch_size, max_len, cfg.kv_lora_rank), dtype),
+                jnp.zeros((batch_size, max_len, cfg.qk_rope_head_dim),
+                          dtype))
+
     def forward(self, input_ids, positions=None, kv_caches=None,
                 cache_index=None, attn_mask=None, attn_start=None,
-                return_mtp: bool = False):
+                return_mtp: bool = False, return_prenorm: bool = False):
         """``return_mtp`` (training-time, no cache): additionally return
         the list of MTP depth logits — depth k's logits[:, i] predict
         token i+2+k. The MTP chain consumes the pre-final-norm hidden
-        and the (k+1)-shifted token embedding; the LM head is shared."""
+        and the (k+1)-shifted token embedding; the LM head is shared.
+
+        ``return_prenorm`` (decode-time, works WITH caches): additionally
+        return the pre-final-norm hidden — the MTP-as-draft speculative
+        path feeds it to the depth modules."""
         if return_mtp:
             if kv_caches is not None:
                 raise ValueError("return_mtp is a training-time path "
@@ -430,11 +453,23 @@ class DeepseekV2ForCausalLM(CausalLMBase):
                 mtp_logits.append(self.lm_head(h).astype(jnp.float32))
             return logits, mtp_logits
         out = self.model(input_ids, positions, kv_caches, cache_index,
-                         attn_mask, attn_start=attn_start)
+                         attn_mask, attn_start=attn_start,
+                         return_prenorm=return_prenorm)
         caches = None
+        pre = None
         if kv_caches is not None:
-            out, caches = out
+            if return_prenorm:
+                out, pre, caches = out
+            else:
+                out, caches = out
+        elif return_prenorm:
+            out, pre = out
         logits = self.lm_head(out).astype(jnp.float32)
+        if return_prenorm:
+            # decode-time MTP-as-draft needs the pre-final-norm hidden
+            # alongside the logits (generation/speculative.py)
+            return (logits, pre, caches) if kv_caches is not None \
+                else (logits, pre)
         return (logits, caches) if kv_caches is not None else logits
 
 

@@ -129,11 +129,10 @@ def use_decode_kernel(q, k_cache) -> bool:
     if not (interpret_enabled()
             or (_flash_enabled() and kernels_enabled())):
         return False
-    # kv > 1 needs the [b, T, kv*d] flattened view's column block (= d) to
-    # be 128-lane aligned (a Mosaic tiling rule, so only enforced on real
-    # hardware — interpret mode keeps dispatch coverage for any d)
-    return d in (64, 128, 256) and T % 128 == 0 and (
-        d % 128 == 0 or kv == 1 or interpret_enabled())
+    # the kernel blocks K/V with FULL trailing (kv, d) dims — always legal
+    # under Mosaic's last-two-dims tiling rule, so any GQA d (incl. 64)
+    # runs on hardware; only the cache length needs a 128-multiple tile
+    return d in (64, 128, 256) and T % 128 == 0
 
 
 def decode_attention(q, k_cache, v_cache, cache_index, scale=None,

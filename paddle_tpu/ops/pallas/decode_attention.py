@@ -45,8 +45,8 @@ def pick_block_t(total: int, preferred: int = DEFAULT_BLOCK_T) -> int:
 
 
 def _decode_kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,
-                   scale, block_t, nt, gp, window=None):
-    ti = pl.program_id(2)
+                   scale, block_t, nt, kv, gp, window=None):
+    ti = pl.program_id(1)
 
     @pl.when(ti == 0)
     def _init():
@@ -61,32 +61,36 @@ def _decode_kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0, :, :]                       # [gp, d]
-        k = k_ref[0, :, :]                          # [bt, d]
-        v = v_ref[0, :, :]
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
         k_ids = lax.broadcasted_iota(jnp.int32, (gp, block_t), 1) \
             + ti * block_t
         keep = k_ids < valid
         if window is not None:  # only the trailing `window` cache slots
             keep &= k_ids >= valid - window
-        s = jnp.where(keep, s, NEG_INF)
-        m_prev = m_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_scr[:, :1] = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1,
-                                                      keepdims=True)
-        acc[:] = acc[:] * alpha + lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[:, :1] = m_new
+        # static loop over kv heads: the whole [bt, kv, d] block is in
+        # VMEM once, each head's group of gp query rows rides the MXU
+        for ki in range(kv):
+            q = q_ref[0, ki]                        # [gp, d]
+            k = k_ref[0, :, ki, :]                  # [bt, d]
+            v = v_ref[0, :, ki, :]
+            s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+            s = jnp.where(keep, s, NEG_INF)
+            m_prev = m_scr[ki, :, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_scr[ki, :, :1] = alpha * l_scr[ki, :, :1] \
+                + jnp.sum(p, axis=-1, keepdims=True)
+            acc[ki] = acc[ki] * alpha + lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[ki, :, :1] = m_new
 
     @pl.when(ti == nt - 1)
     def _finalize():
-        safe_l = jnp.maximum(l_scr[:, :1], 1e-30)
-        o_ref[0, 0, :, :] = (acc[:] / safe_l).astype(o_ref.dtype)
+        for ki in range(kv):
+            safe_l = jnp.maximum(l_scr[ki, :, :1], 1e-30)
+            o_ref[0, ki] = (acc[ki] / safe_l).astype(o_ref.dtype)
 
 
 def decode_attention_pallas(q, k_cache, v_cache, cache_index, scale,
@@ -99,7 +103,11 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_index, scale,
     _, T, kv, _ = k_cache.shape
     group = h // kv
     gp = max(8, -(-group // 8) * 8)  # round UP to 8-sublane alignment
-    bt = pick_block_t(T, block_t)
+    # each K/V block is [bt, kv, d] in VMEM: cap it at ~1 MB so MHA-sized
+    # kv (32 heads x d=128) stays well inside the ~16 MB/core budget even
+    # with Mosaic's double buffering (K + V + fp32 scratch)
+    budget_rows = max(128, (1 << 20) // (2 * kv * d) // 128 * 128)
+    bt = pick_block_t(T, min(block_t, budget_rows))
     assert bt, f"cache length {T} has no 128-multiple tile"
     nt = T // bt
 
@@ -109,32 +117,32 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_index, scale,
 
     idx = jnp.asarray(cache_index, jnp.int32).reshape(1)
     kernel = functools.partial(_decode_kernel, scale=scale, block_t=bt,
-                               nt=nt, gp=gp, window=window)
-    # Mosaic requires the last TWO block dims be (8,128)-tiled (or match the
-    # array), so a [b, T, kv, d] cache cannot take a kv-dim block of 1.
-    # View it as [b, T, kv*d] instead — contiguous, so the reshape is free —
-    # and let the column block (size d, 128-aligned) select the kv head.
-    kc = k_cache.reshape(b, T, kv * d)
-    vc = v_cache.reshape(b, T, kv * d)
+                               nt=nt, kv=kv, gp=gp, window=window)
+    # Mosaic requires the last TWO block dims be (8,128)-tiled or equal to
+    # the array's own dims. Blocking [b, T, kv, d] with FULL trailing
+    # (kv, d) dims is therefore always legal (any kv, any d — including
+    # d=64 GQA heads), and the T dim (rank -3) is unconstrained. The kv
+    # loop moves inside the kernel: every cache element still enters VMEM
+    # exactly once per step, shared across the head group.
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(b, kv, nt),
+            grid=(b, nt),
             in_specs=[
-                pl.BlockSpec((1, 1, gp, d), lambda bi, ki, ti, idx: (bi, ki, 0, 0)),
-                pl.BlockSpec((1, bt, d), lambda bi, ki, ti, idx: (bi, ti, ki)),
-                pl.BlockSpec((1, bt, d), lambda bi, ki, ti, idx: (bi, ti, ki)),
+                pl.BlockSpec((1, kv, gp, d), lambda bi, ti, idx: (bi, 0, 0, 0)),
+                pl.BlockSpec((1, bt, kv, d), lambda bi, ti, idx: (bi, ti, 0, 0)),
+                pl.BlockSpec((1, bt, kv, d), lambda bi, ti, idx: (bi, ti, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, 1, gp, d),
-                                   lambda bi, ki, ti, idx: (bi, ki, 0, 0)),
+            out_specs=pl.BlockSpec((1, kv, gp, d),
+                                   lambda bi, ti, idx: (bi, 0, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((gp, d), jnp.float32),
-                pltpu.VMEM((gp, 128), jnp.float32),
-                pltpu.VMEM((gp, 128), jnp.float32),
+                pltpu.VMEM((kv, gp, d), jnp.float32),
+                pltpu.VMEM((kv, gp, 128), jnp.float32),
+                pltpu.VMEM((kv, gp, 128), jnp.float32),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, kv, gp, d), q.dtype),
         interpret=_interpret(),
-    )(idx, qg, kc, vc)
+    )(idx, qg, k_cache, v_cache)
     return out[:, :, :group, :].reshape(b, h, d)
