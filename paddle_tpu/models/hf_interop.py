@@ -545,6 +545,218 @@ def _revert_vae(sd: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
 
 
 
+# ------------------------------------- diffusers DiT / SD3 transformers
+
+def _fuse_qkv_named(hf, src_p, names, dst_p, out):
+    """torch to_q/to_k/to_v linears -> our fused qkv ([in, 3h] layout)."""
+    ws = [np.asarray(hf[f"{src_p}.{n}.weight"]).T for n in names]
+    bs = [np.asarray(hf[f"{src_p}.{n}.bias"]) for n in names]
+    out[f"{dst_p}.weight"] = np.concatenate(ws, axis=1)
+    out[f"{dst_p}.bias"] = np.concatenate(bs)
+
+
+def _split_qkv(sd, dst_p, src_p, names, out):
+    w = np.asarray(sd[f"{dst_p}.weight"])
+    b = np.asarray(sd[f"{dst_p}.bias"])
+    h = w.shape[0]
+    for i, n in enumerate(names):
+        out[f"{src_p}.{n}.weight"] = w[:, i * h:(i + 1) * h].T
+        out[f"{src_p}.{n}.bias"] = b[i * h:(i + 1) * h]
+
+
+def _lin(hf, src, dst, out):
+    out[f"{dst}.weight"] = np.asarray(hf[f"{src}.weight"]).T
+    out[f"{dst}.bias"] = np.asarray(hf[f"{src}.bias"])
+
+
+def _lin_rev(sd, dst, src, out):
+    out[f"{src}.weight"] = np.asarray(sd[f"{dst}.weight"]).T
+    out[f"{src}.bias"] = np.asarray(sd[f"{dst}.bias"])
+
+
+def _convert_dit(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """diffusers DiTTransformer2DModel -> our DiT (models/dit.py).
+
+    The diffusers layout duplicates the timestep/label embedder inside
+    EVERY block's AdaLayerNormZero (norm1.emb.*, identical weights); we
+    read block 0's copy into the single shared embedder. The sin-cos
+    pos table is a non-persistent buffer there, so we emit ours
+    deterministically from the config. Verified by construction +
+    round-trip (diffusers is not in this image — same protocol as
+    _convert_vae)."""
+    out: Dict[str, np.ndarray] = {}
+    out["patch_embed.weight"] = hf["pos_embed.proj.weight"]
+    out["patch_embed.bias"] = hf["pos_embed.proj.bias"]
+    emb = "transformer_blocks.0.norm1.emb"
+    _lin(hf, f"{emb}.timestep_embedder.linear_1", "t_embedder.fc1", out)
+    _lin(hf, f"{emb}.timestep_embedder.linear_2", "t_embedder.fc2", out)
+    out["y_embedder.table.weight"] = \
+        hf[f"{emb}.class_embedder.embedding_table.weight"]
+    for i in range(cfg.num_hidden_layers):
+        s, d = f"transformer_blocks.{i}", f"blocks.{i}"
+        _lin(hf, f"{s}.norm1.linear", f"{d}.ada", out)
+        _fuse_qkv_named(hf, f"{s}.attn1", ("to_q", "to_k", "to_v"),
+                  f"{d}.qkv", out)
+        _lin(hf, f"{s}.attn1.to_out.0", f"{d}.proj", out)
+        _lin(hf, f"{s}.ff.net.0.proj", f"{d}.fc1", out)
+        _lin(hf, f"{s}.ff.net.2", f"{d}.fc2", out)
+    _lin(hf, "proj_out_1", "final_ada", out)
+    _lin(hf, "proj_out_2", "final_proj", out)
+    from .dit import sincos_pos_embed_2d
+    grid = cfg.input_size // cfg.patch_size
+    out["pos_embed"] = np.asarray(
+        sincos_pos_embed_2d(grid, cfg.hidden_size), np.float32)
+    return out
+
+
+def _revert_dit(sd: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """Inverse of _convert_dit: the shared embedder is written into every
+    block's norm1.emb (the diffusers layout); pos_embed is dropped
+    (non-persistent buffer there)."""
+    out: Dict[str, np.ndarray] = {}
+    out["pos_embed.proj.weight"] = np.asarray(sd["patch_embed.weight"])
+    out["pos_embed.proj.bias"] = np.asarray(sd["patch_embed.bias"])
+    for i in range(cfg.num_hidden_layers):
+        s, d = f"transformer_blocks.{i}", f"blocks.{i}"
+        emb = f"{s}.norm1.emb"
+        _lin_rev(sd, "t_embedder.fc1", f"{emb}.timestep_embedder.linear_1",
+                 out)
+        _lin_rev(sd, "t_embedder.fc2", f"{emb}.timestep_embedder.linear_2",
+                 out)
+        out[f"{emb}.class_embedder.embedding_table.weight"] = \
+            np.asarray(sd["y_embedder.table.weight"])
+        _lin_rev(sd, f"{d}.ada", f"{s}.norm1.linear", out)
+        _split_qkv(sd, f"{d}.qkv", f"{s}.attn1",
+                   ("to_q", "to_k", "to_v"), out)
+        _lin_rev(sd, f"{d}.proj", f"{s}.attn1.to_out.0", out)
+        _lin_rev(sd, f"{d}.fc1", f"{s}.ff.net.0.proj", out)
+        _lin_rev(sd, f"{d}.fc2", f"{s}.ff.net.2", out)
+    _lin_rev(sd, "final_ada", "proj_out_1", out)
+    _lin_rev(sd, "final_proj", "proj_out_2", out)
+    return out
+
+
+def _swap_halves(w_t: np.ndarray, b: np.ndarray):
+    """AdaLayerNormContinuous emits (scale, shift); our final/context
+    modulation splits (shift, scale). Swap the output halves — weights
+    here are already in our [in, out] layout, so split axis=1."""
+    h = w_t.shape[1] // 2
+    return (np.concatenate([w_t[:, h:], w_t[:, :h]], axis=1),
+            np.concatenate([b[h:], b[:h]]))
+
+
+def _convert_sd3(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """diffusers SD3Transformer2DModel -> our MMDiT (models/dit.py).
+
+    Stream mapping: attn.to_q/k/v + to_out.0 + ff.* is the image
+    stream; attn.add_*_proj + to_add_out + ff_context.* the text
+    stream. Concat order inside joint attention differs (img-first
+    there, txt-first here) but attention without positional terms is
+    permutation-equivariant in key order, so no weight change is
+    needed. AdaLayerNormContinuous (final norm_out + last block's
+    norm1_context) chunks (scale, shift) — swapped into our
+    shift-first layout. The persistent pos_embed table (max-size grid)
+    is center-cropped to our static grid, exactly what the diffusers
+    forward does per call. Verified by construction + round-trip."""
+    out: Dict[str, np.ndarray] = {}
+    out["patch_embed.weight"] = hf["pos_embed.proj.weight"]
+    out["patch_embed.bias"] = hf["pos_embed.proj.bias"]
+    table = np.asarray(hf["pos_embed.pos_embed"])  # [1, max*max, h]
+    max_g = int(round(table.shape[1] ** 0.5))
+    grid = cfg.input_size // cfg.patch_size
+    if max_g < grid:
+        raise ValueError(f"checkpoint pos_embed grid {max_g} smaller "
+                         f"than model grid {grid}")
+    top = (max_g - grid) // 2
+    out["pos_embed"] = table.reshape(1, max_g, max_g, -1)[
+        :, top:top + grid, top:top + grid].reshape(1, grid * grid, -1)
+    _lin(hf, "time_text_embed.timestep_embedder.linear_1",
+         "t_embedder.fc1", out)
+    _lin(hf, "time_text_embed.timestep_embedder.linear_2",
+         "t_embedder.fc2", out)
+    _lin(hf, "time_text_embed.text_embedder.linear_1",
+         "pooled_proj.0", out)
+    _lin(hf, "time_text_embed.text_embedder.linear_2",
+         "pooled_proj.2", out)
+    _lin(hf, "context_embedder", "context_proj", out)
+    last = cfg.num_hidden_layers - 1
+    for i in range(cfg.num_hidden_layers):
+        s, d = f"transformer_blocks.{i}", f"blocks.{i}"
+        _lin(hf, f"{s}.norm1.linear", f"{d}.img.ada", out)
+        _fuse_qkv_named(hf, f"{s}.attn", ("to_q", "to_k", "to_v"),
+                  f"{d}.img.qkv", out)
+        _lin(hf, f"{s}.attn.to_out.0", f"{d}.img.proj", out)
+        _lin(hf, f"{s}.ff.net.0.proj", f"{d}.img.fc1", out)
+        _lin(hf, f"{s}.ff.net.2", f"{d}.img.fc2", out)
+        _lin(hf, f"{s}.norm1_context.linear", f"{d}.txt.ada", out)
+        if i == last:  # AdaLayerNormContinuous: scale-first there
+            out[f"{d}.txt.ada.weight"], out[f"{d}.txt.ada.bias"] = \
+                _swap_halves(out[f"{d}.txt.ada.weight"],
+                             out[f"{d}.txt.ada.bias"])
+        _fuse_qkv_named(hf, f"{s}.attn",
+                  ("add_q_proj", "add_k_proj", "add_v_proj"),
+                  f"{d}.txt.qkv", out)
+        if i != last:
+            out[f"{d}.txt.proj.weight"] = \
+                np.asarray(hf[f"{s}.attn.to_add_out.weight"]).T
+            out[f"{d}.txt.proj.bias"] = hf[f"{s}.attn.to_add_out.bias"]
+            _lin(hf, f"{s}.ff_context.net.0.proj", f"{d}.txt.fc1", out)
+            _lin(hf, f"{s}.ff_context.net.2", f"{d}.txt.fc2", out)
+    _lin(hf, "norm_out.linear", "final_ada", out)
+    out["final_ada.weight"], out["final_ada.bias"] = \
+        _swap_halves(out["final_ada.weight"], out["final_ada.bias"])
+    _lin(hf, "proj_out", "final_proj", out)
+    return out
+
+
+def _revert_sd3(sd: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
+    """Inverse of _convert_sd3 (export + round-trip test); the exported
+    pos_embed table's max size equals our grid."""
+    out: Dict[str, np.ndarray] = {}
+    out["pos_embed.proj.weight"] = np.asarray(sd["patch_embed.weight"])
+    out["pos_embed.proj.bias"] = np.asarray(sd["patch_embed.bias"])
+    out["pos_embed.pos_embed"] = np.asarray(sd["pos_embed"])
+    _lin_rev(sd, "t_embedder.fc1",
+             "time_text_embed.timestep_embedder.linear_1", out)
+    _lin_rev(sd, "t_embedder.fc2",
+             "time_text_embed.timestep_embedder.linear_2", out)
+    _lin_rev(sd, "pooled_proj.0",
+             "time_text_embed.text_embedder.linear_1", out)
+    _lin_rev(sd, "pooled_proj.2",
+             "time_text_embed.text_embedder.linear_2", out)
+    _lin_rev(sd, "context_proj", "context_embedder", out)
+    last = cfg.num_hidden_layers - 1
+    for i in range(cfg.num_hidden_layers):
+        s, d = f"transformer_blocks.{i}", f"blocks.{i}"
+        _lin_rev(sd, f"{d}.img.ada", f"{s}.norm1.linear", out)
+        _split_qkv(sd, f"{d}.img.qkv", f"{s}.attn",
+                   ("to_q", "to_k", "to_v"), out)
+        _lin_rev(sd, f"{d}.img.proj", f"{s}.attn.to_out.0", out)
+        _lin_rev(sd, f"{d}.img.fc1", f"{s}.ff.net.0.proj", out)
+        _lin_rev(sd, f"{d}.img.fc2", f"{s}.ff.net.2", out)
+        tw = np.asarray(sd[f"{d}.txt.ada.weight"])
+        tb = np.asarray(sd[f"{d}.txt.ada.bias"])
+        if i == last:
+            tw, tb = _swap_halves(tw, tb)
+        out[f"{s}.norm1_context.linear.weight"] = tw.T
+        out[f"{s}.norm1_context.linear.bias"] = tb
+        _split_qkv(sd, f"{d}.txt.qkv", f"{s}.attn",
+                   ("add_q_proj", "add_k_proj", "add_v_proj"), out)
+        if i != last:
+            out[f"{s}.attn.to_add_out.weight"] = \
+                np.asarray(sd[f"{d}.txt.proj.weight"]).T
+            out[f"{s}.attn.to_add_out.bias"] = \
+                np.asarray(sd[f"{d}.txt.proj.bias"])
+            _lin_rev(sd, f"{d}.txt.fc1", f"{s}.ff_context.net.0.proj", out)
+            _lin_rev(sd, f"{d}.txt.fc2", f"{s}.ff_context.net.2", out)
+    w, b = _swap_halves(np.asarray(sd["final_ada.weight"]),
+                        np.asarray(sd["final_ada.bias"]))
+    out["norm_out.linear.weight"] = w.T
+    out["norm_out.linear.bias"] = b
+    _lin_rev(sd, "final_proj", "proj_out", out)
+    return out
+
+
 def _convert_resnet(hf: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
     """HF ResNetForImageClassification / ResNetModel (v1.5: stride on
     the 3x3 middle conv, first stage unstrided — exactly our "b"
@@ -590,6 +802,8 @@ _CONVERTERS: Dict[str, Callable] = {
     "vit": _convert_vit,
     "clip": _convert_clip,
     "autoencoder_kl": _convert_vae,
+    "dit": _convert_dit,
+    "sd3_transformer": _convert_sd3,
     "resnet": _convert_resnet,
 }
 
@@ -672,6 +886,49 @@ def config_from_hf(model_dir: str):
             scaling_factor=hf.get("scaling_factor", 0.18215),
         )
         return AutoencoderKL, cfg, "autoencoder_kl"
+    if not mt and hf.get("_class_name") in ("DiTTransformer2DModel",
+                                            "Transformer2DModel"):
+        from .dit import DiT, DiTConfig
+        if hf.get("norm_type", "ada_norm_zero") != "ada_norm_zero":
+            raise ValueError("only adaLN-Zero DiT transformers are "
+                             "supported")
+        nheads = hf.get("num_attention_heads", 16)
+        in_c = hf.get("in_channels", 4)
+        out_c = hf.get("out_channels") or in_c * 2
+        cfg = DiTConfig(
+            input_size=hf.get("sample_size", 32),
+            patch_size=hf.get("patch_size", 2),
+            in_channels=in_c,
+            hidden_size=nheads * hf.get("attention_head_dim", 72),
+            num_hidden_layers=hf.get("num_layers", 28),
+            num_attention_heads=nheads,
+            num_classes=hf.get("num_embeds_ada_norm", 1000),
+            learn_sigma=out_c == 2 * in_c,
+        )
+        return DiT, cfg, "dit"
+    if not mt and hf.get("_class_name") == "SD3Transformer2DModel":
+        from .dit import MMDiT, MMDiTConfig
+        if hf.get("qk_norm"):
+            raise ValueError("SD3.5-style qk_norm is not supported "
+                             "(our MMDiT matches the SD3-medium layout)")
+        if hf.get("dual_attention_layers"):
+            raise ValueError("dual_attention_layers (SD3.5-medium) not "
+                             "supported")
+        nheads = hf["num_attention_heads"]
+        h = nheads * hf.get("attention_head_dim", 64)
+        if hf.get("caption_projection_dim", h) != h:
+            raise ValueError("caption_projection_dim != hidden size")
+        cfg = MMDiTConfig(
+            input_size=hf.get("sample_size", 128),
+            patch_size=hf.get("patch_size", 2),
+            in_channels=hf.get("in_channels", 16),
+            hidden_size=h,
+            num_hidden_layers=hf["num_layers"],
+            num_attention_heads=nheads,
+            context_dim=hf.get("joint_attention_dim", 4096),
+            pooled_dim=hf.get("pooled_projection_dim", 2048),
+        )
+        return MMDiT, cfg, "sd3_transformer"
     if mt == "gpt2":
         from .gpt import GPTConfig, GPTForCausalLM
         cfg = GPTConfig(

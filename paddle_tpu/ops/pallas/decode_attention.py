@@ -8,8 +8,10 @@ GQA models because it materializes `jnp.repeat`-ed K/V; this kernel reads
 each KV block exactly once per *kv head* and shares it across the whole
 query-head group:
 
-- grid (batch, kv_head, kv_blocks); KV innermost so the fp32 accumulator
-  scratch carries the online softmax across blocks.
+- grid (batch, kv_blocks); KV innermost so the fp32 accumulator scratch
+  carries the online softmax across blocks. Each K/V block carries the
+  FULL trailing (kv, d) dims (always Mosaic-legal, any GQA d) and the kv
+  loop is unrolled inside the kernel.
 - q is pre-reshaped to [b, kv, group, d] (group = h // kv, padded to the
   8-sublane minimum) — the group dim rides the matmul's M dimension.
 - `cache_index` arrives via scalar prefetch: blocks fully past the valid
