@@ -287,6 +287,25 @@ def _child_decode():
         gen["speculative_tokens_per_sec_bs1"] = round(new_tok / dt_s, 1)
         gen["speculative_tokens_per_forward"] = round(
             stats["tokens_per_forward"], 2)
+
+        # random-init drafts accept ~nothing (tokens_per_forward ~1), so
+        # the rung above is the floor. The CEILING — what a well-trained
+        # draft buys — is draft == target: every proposal accepted.
+        out = speculative_generate(model, model, ids,
+                                   max_new_tokens=new_tok,
+                                   num_draft_tokens=4)
+        np.asarray(out)
+        t0 = time.perf_counter()
+        out, stats = speculative_generate(model, model, ids,
+                                          max_new_tokens=new_tok,
+                                          num_draft_tokens=4,
+                                          return_stats=True)
+        np.asarray(out)
+        dt_s = time.perf_counter() - t0
+        gen["speculative_ceiling_tokens_per_sec_bs1"] = round(
+            new_tok / dt_s, 1)
+        gen["speculative_ceiling_tokens_per_forward"] = round(
+            stats["tokens_per_forward"], 2)
     except Exception as e:  # keep the rung's other numbers
         gen["speculative_error"] = repr(e)[:120]
 

@@ -548,11 +548,10 @@ def _revert_vae(sd: Dict[str, np.ndarray], cfg) -> Dict[str, np.ndarray]:
 # ------------------------------------- diffusers DiT / SD3 transformers
 
 def _fuse_qkv_named(hf, src_p, names, dst_p, out):
-    """torch to_q/to_k/to_v linears -> our fused qkv ([in, 3h] layout)."""
-    ws = [np.asarray(hf[f"{src_p}.{n}.weight"]).T for n in names]
-    bs = [np.asarray(hf[f"{src_p}.{n}.bias"]) for n in names]
-    out[f"{dst_p}.weight"] = np.concatenate(ws, axis=1)
-    out[f"{dst_p}.bias"] = np.concatenate(bs)
+    """torch to_q/to_k/to_v linears -> our fused qkv, written into
+    ``out`` (thin naming wrapper over _fuse_qkv's transpose+concat)."""
+    out[f"{dst_p}.weight"], out[f"{dst_p}.bias"] = _fuse_qkv(
+        hf, *(f"{src_p}.{n}" for n in names))
 
 
 def _split_qkv(sd, dst_p, src_p, names, out):
@@ -894,7 +893,11 @@ def config_from_hf(model_dir: str):
                              "supported")
         nheads = hf.get("num_attention_heads", 16)
         in_c = hf.get("in_channels", 4)
-        out_c = hf.get("out_channels") or in_c * 2
+        # diffusers serializes out_channels: null to mean == in_channels
+        # (no learned sigma); DiT checkpoints set it to 2*in explicitly
+        out_c = hf.get("out_channels")
+        if out_c is None:
+            out_c = in_c
         cfg = DiTConfig(
             input_size=hf.get("sample_size", 32),
             patch_size=hf.get("patch_size", 2),

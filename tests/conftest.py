@@ -47,9 +47,9 @@ _HEAVY = (
     "test_hf_interop.py::test_bert_pretraining_heads_load",
     "test_hf_interop.py::test_ernie_mlm_logits_match",
     "test_hf_interop.py::test_sharded_index_checkpoint",
-    # ring flash: forward parity (matches_full_attention) stays in the
-    # default tier; the interpret-mode backward is 2x the cost for the
-    # same kernel
+    # ring flash: both composition variants are heavy since the round-5
+    # pass (see below) — the default tier keeps the plain ring exactness
+    # tests (segments/window vs dense) + the flash kernel suite
     "TestRingFlash::test_gradients_flow",
     # elastic: kill/resume (the r2 deliverable) stays; the hang path is a
     # second full subprocess cycle
@@ -124,6 +124,20 @@ _HEAVY = (
     "test_packed_fallback_for_models_without_segment_ids",  # <- packing
     "test_round3_flat_ops",              # <- per-op coverage in test_nn
     "test_mtp_module_does_not_shift_trunk_init",  # <- shapes_and_parity
+    # round-5 timing pass (suite was 540s standalone; VERDICT r4 item 9):
+    # each demotion names the default-tier representative that exercises
+    # the same machinery
+    "test_interleaved_vpp_matches_sequential[3]",  # <- composes_with_ep_moe
+    # (interleaved tables + harder ep composition in one test)
+    "TestDeepseekV2Parity::test_logits_match_torch",  # <- v3_logits_match
+    # (V3 parity is the superset: same converter/MLA plus sigmoid router)
+    "TestRingFlash::test_matches_full_attention",  # <- plain ring
+    # exactness tests (segments/window vs dense) + flash kernel suite
+    "TestMTP::test_mtp_shapes_and_main_parity",  # <- mtp_training_decreases
+    # + TestMTPSpeculative exactness (MTP modules e2e in decode)
+    "test_vae_diffusers_roundtrip",     # <- dit/sd3 roundtrips (dispatch)
+    "test_model_pass_swaps_and_generates[awq_quantize_model]",  # <- [gptq]
+    "test_fuse_attention_only",         # <- full fuse + mesh exactness
 )
 
 

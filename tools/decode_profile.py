@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""One-window decode-path profiler (round 5).
+
+BENCH_SELF_r05 raised three decode puzzles the standard queue cannot
+answer: the Pallas decode kernel timed 0.61x dense, fused projections
+timed SLOWER than unfused, and int8 weight-only decode timed slower
+than bf16. Each 'time' there was one whole generate() call over the
+tunnel; this script separates compile/dispatch from steady-state
+on-device time (long decode runs amortize the tunnel RTT) and times
+each lever in isolation. Writes DECODE_PROFILE_r05.json.
+
+Usage: timeout 1500 python tools/decode_profile.py
+"""
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "DECODE_PROFILE_r05.json")
+
+report = {"started": time.strftime("%Y-%m-%d %H:%M:%S")}
+
+
+def bank():
+    with open(OUT, "w") as f:
+        json.dump(report, f, indent=1)
+
+
+def main():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    report["device"] = str(jax.devices()[0].device_kind)
+    bank()
+    import paddle_tpu as pt
+    from paddle_tpu.models import LlamaForCausalLM
+
+    import bench
+
+    rs = np.random.RandomState(0)
+
+    # --- 1) raw decode-attention: new kv-folded kernel vs dense, several
+    # shapes (the bench shape first). np.asarray forces full execution
+    # through the tunnel; iters amortize RTT.
+    from paddle_tpu.ops.attention import dense_attention
+    from paddle_tpu.ops.pallas.decode_attention import decode_attention_pallas
+
+    def time_it(jfn, *args, iters=100):
+        np.asarray(jfn(*args))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jfn(*args)
+        np.asarray(out)
+        return round((time.perf_counter() - t0) / iters * 1e3, 4)
+
+    attn = {}
+    for (b, T, h, kv, d) in ((8, 2048, 16, 8, 128), (8, 2048, 8, 4, 64),
+                             (1, 4096, 32, 8, 128)):
+        ck = jnp.asarray(rs.randn(b, T, kv, d), jnp.bfloat16)
+        cv = jnp.asarray(rs.randn(b, T, kv, d), jnp.bfloat16)
+        q1 = jnp.asarray(rs.randn(b, h, d), jnp.bfloat16)
+        idx = jnp.int32(T - 2)
+        mask = (jnp.arange(T) <= T - 2)[None, None, None, :]
+        jd = jax.jit(lambda q, k, v: dense_attention(
+            q[:, None], k, v, attn_mask=mask)[:, 0])
+        jp = jax.jit(lambda q, k, v: decode_attention_pallas(
+            q, k, v, idx, d ** -0.5))
+        err = float(jnp.max(jnp.abs(
+            jd(q1, ck, cv).astype(jnp.float32)
+            - jp(q1, ck, cv).astype(jnp.float32))))
+        key = f"b{b}_T{T}_h{h}_kv{kv}_d{d}"
+        attn[key] = {"dense_ms": time_it(jd, q1, ck, cv),
+                     "pallas_ms": time_it(jp, q1, ck, cv),
+                     "max_err": round(err, 4)}
+        # HBM floor: read K+V once
+        attn[key]["hbm_floor_ms"] = round(
+            2 * b * T * kv * d * 2 / 819e9 * 1e3, 4)
+        report["attn"] = attn
+        bank()
+
+    # --- 2) end-to-end generate: long decode to amortize dispatch.
+    # 256 new tokens vs 64: slope = per-token cost, intercept = overhead.
+    pt.seed(0)
+    cfg = bench._bench_config("tiny")
+    model = LlamaForCausalLM(cfg)
+    gen = {}
+
+    def time_generate(m, bs, n_new):
+        ids = jnp.asarray(rs.randint(0, m.config.vocab_size, (bs, 32)))
+        out = m.generate(ids, max_new_tokens=n_new, temperature=0.0)
+        np.asarray(out)      # compile
+        t0 = time.perf_counter()
+        out = m.generate(ids, max_new_tokens=n_new, temperature=0.0)
+        np.asarray(out)
+        return time.perf_counter() - t0
+
+    for bs in (1, 8):
+        t64 = time_generate(model, bs, 64)
+        t256 = time_generate(model, bs, 256)
+        per_tok_ms = (t256 - t64) / 192 * 1e3
+        gen[f"bs{bs}"] = {
+            "t64_s": round(t64, 4), "t256_s": round(t256, 4),
+            "per_token_ms": round(per_tok_ms, 4),
+            "dispatch_overhead_ms": round(
+                (t64 * 4 - t256) / 3 * 1e3, 2),
+            "tokens_per_sec_steady": round(bs / per_tok_ms * 1e3, 1)}
+        report["generate"] = gen
+        bank()
+
+    # weight-read floor for the tiny model: all params once per token
+    n_params = sum(int(np.prod(v.shape))
+                   for v in model.state_dict().values())
+    report["weight_floor_ms_per_tok_bs1"] = round(
+        n_params * 2 / 819e9 * 1e3, 4)
+    bank()
+
+    # --- 3) fused projections, steady-state
+    from paddle_tpu.nn.fuse import fuse_projections
+    pt.seed(0)
+    fused = fuse_projections(LlamaForCausalLM(cfg))
+    for bs in (1, 8):
+        t64 = time_generate(fused, bs, 64)
+        t256 = time_generate(fused, bs, 256)
+        gen[f"fused_bs{bs}"] = {
+            "per_token_ms": round((t256 - t64) / 192 * 1e3, 4)}
+        report["generate"] = gen
+        bank()
+
+    # --- 4) int8: kernel route vs forced-XLA-dequant route
+    from paddle_tpu.quant import quantize_model
+    for tag, disable in (("int8_kernel", ""), ("int8_xla", "1")):
+        os.environ["PADDLE_TPU_DISABLE_QUANT_KERNEL"] = disable
+        pt.seed(0)
+        qm = LlamaForCausalLM(cfg)
+        quantize_model(qm, bits=8, block_size=128,
+                       skip=["lm_head", "embed"])
+        for bs in (1, 8):
+            t64 = time_generate(qm, bs, 64)
+            t256 = time_generate(qm, bs, 256)
+            gen[f"{tag}_bs{bs}"] = {
+                "per_token_ms": round((t256 - t64) / 192 * 1e3, 4)}
+            report["generate"] = gen
+            bank()
+    os.environ.pop("PADDLE_TPU_DISABLE_QUANT_KERNEL", None)
+
+    # --- 5) paged engine: per-tick decode cost with all slots busy
+    from paddle_tpu.generation.paged import PagedEngine
+    eng = PagedEngine(model, max_slots=8, num_blocks=64, block_size=32,
+                      max_blocks_per_seq=8, prefill_buckets=(32,))
+    rs2 = np.random.RandomState(1)
+    for i in range(8):
+        eng.submit(f"r{i}", rs2.randint(1, 255, (1, 8)),
+                   max_new_tokens=512)
+    for _ in range(12):   # admit everything + compile decode_step
+        eng.step()
+    t0 = time.perf_counter()
+    n_ticks = 100
+    for _ in range(n_ticks):
+        eng.step()
+    dt = time.perf_counter() - t0
+    report["paged"] = {
+        "tick_ms": round(dt / n_ticks * 1e3, 3),
+        "tokens_per_sec": round(8 * n_ticks / dt, 1)}
+    bank()
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # bank whatever we got plus the failure
+        report["error"] = repr(e)[:400]
+        bank()
+        raise
