@@ -153,8 +153,11 @@ def main():
                       max_blocks_per_seq=8, prefill_buckets=(32,))
     rs2 = np.random.RandomState(1)
     for i in range(8):
+        # 8 + 240 = 248 <= max_blocks_per_seq*block_size = 256; the 112
+        # ticks stepped below never finish a request, so all 8 slots
+        # stay busy for the whole timed window
         eng.submit(f"r{i}", rs2.randint(1, 255, (1, 8)),
-                   max_new_tokens=512)
+                   max_new_tokens=240)
     for _ in range(12):   # admit everything + compile decode_step
         eng.step()
     t0 = time.perf_counter()
