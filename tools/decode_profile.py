@@ -9,7 +9,10 @@ tunnel; this script separates compile/dispatch from steady-state
 on-device time (long decode runs amortize the tunnel RTT) and times
 each lever in isolation. Writes DECODE_PROFILE_r05.json.
 
-Usage: timeout 1500 python tools/decode_profile.py
+Usage: timeout 2100 python tools/decode_profile.py
+(budget covers ~20 cold generate compiles across base/fused/int8/int4
+plus the attention and paged sections; every subsection banks as it
+goes, so even a SIGTERM keeps what was measured)
 """
 import json
 import os
@@ -130,19 +133,28 @@ def main():
         report["generate"] = gen
         bank()
 
-    # --- 4) int8: kernel route vs forced-XLA-dequant route
+    # --- 4) int8/int4: kernel route vs forced-XLA-dequant route. Each
+    # bits-width guarded on its own so an int4-specific compile failure
+    # cannot cost the remaining rungs or section 5 (cf. bench.py).
     from paddle_tpu.quant import quantize_model
-    for tag, disable in (("int8_kernel", ""), ("int8_xla", "1")):
-        os.environ["PADDLE_TPU_DISABLE_QUANT_KERNEL"] = disable
-        pt.seed(0)
-        qm = LlamaForCausalLM(cfg)
-        quantize_model(qm, bits=8, block_size=128,
-                       skip=["lm_head", "embed"])
-        for bs in (1, 8):
-            t64 = time_generate(qm, bs, 64)
-            t256 = time_generate(qm, bs, 256)
-            gen[f"{tag}_bs{bs}"] = {
-                "per_token_ms": round((t256 - t64) / 192 * 1e3, 4)}
+    for bits in (8, 4):
+        try:
+            for tag, disable in ((f"int{bits}_kernel", ""),
+                                 (f"int{bits}_xla", "1")):
+                os.environ["PADDLE_TPU_DISABLE_QUANT_KERNEL"] = disable
+                pt.seed(0)
+                qm = LlamaForCausalLM(cfg)
+                quantize_model(qm, bits=bits, block_size=128,
+                               skip=["lm_head", "embed"])
+                for bs in (1, 8):
+                    t64 = time_generate(qm, bs, 64)
+                    t256 = time_generate(qm, bs, 256)
+                    gen[f"{tag}_bs{bs}"] = {
+                        "per_token_ms": round((t256 - t64) / 192 * 1e3, 4)}
+                    report["generate"] = gen
+                    bank()
+        except Exception as e:
+            gen[f"int{bits}_error"] = repr(e)[:200]
             report["generate"] = gen
             bank()
     os.environ.pop("PADDLE_TPU_DISABLE_QUANT_KERNEL", None)
