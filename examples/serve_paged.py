@@ -22,20 +22,27 @@ def main():
 
     eng = PagedEngine(model, max_slots=4, num_blocks=64, block_size=8,
                       max_blocks_per_seq=16,
-                      chunk_prefill_tokens=16)   # long prompts stream in
+                      chunk_prefill_tokens=16,   # long prompts stream in
+                      enable_prefix_cache=True)  # share system prompts
     rs = np.random.RandomState(0)
 
     # a mixed stream: greedy, sampled (seed-reproducible), and a long
-    # prompt that chunk-prefills without stalling the others
+    # prompt that chunk-prefills without stalling the others; the two
+    # system-prompt requests share their prefix KV blocks
+    system = rs.randint(1, 500, 32).tolist()
     eng.submit("greedy", rs.randint(1, 500, (1, 12)), max_new_tokens=24)
     eng.submit("sampled", rs.randint(1, 500, (1, 8)), max_new_tokens=24,
                temperature=0.8, top_p=0.95, seed=7)
     eng.submit("long", rs.randint(1, 500, (1, 96)), max_new_tokens=16)
+    eng.submit("sys-a", np.asarray([system + [11, 12]]), max_new_tokens=12)
+    eng.submit("sys-b", np.asarray([system + [13]]), max_new_tokens=12)
     out = eng.run()
     for rid, toks in out.items():
         lp = eng.logprobs.get(rid, [])
         print(f"{rid:8s} -> {len(toks)} tokens "
               f"(mean logprob {np.mean(lp):+.2f}): {list(toks)[:10]}...")
+    print(f"prefix cache: {eng.stats['prefix_hit_tokens']} prompt tokens "
+          f"served from shared blocks")
 
     # temp=0 rows are bit-exact vs the model's own generate()
     import jax.numpy as jnp

@@ -183,7 +183,8 @@ class PagedEngine:
     def __init__(self, model, max_slots: int = 8, num_blocks: int = 128,
                  block_size: int = 16, max_blocks_per_seq: int = 16,
                  prefill_buckets=(32, 64, 128),
-                 chunk_prefill_tokens: Optional[int] = None):
+                 chunk_prefill_tokens: Optional[int] = None,
+                 enable_prefix_cache: bool = False):
         cfg = model.config
         self.model = model
         self.fn, self.params = model.functional()
@@ -201,6 +202,26 @@ class PagedEngine:
                 block_size,
                 -(-chunk_prefill_tokens // block_size) * block_size)
         self.chunk = chunk_prefill_tokens
+        # automatic prefix caching (reference: PaddleNLP CacheKV prefix
+        # sharing / vLLM APC): requests whose prompts share a prefix
+        # point their block tables at the SAME physical blocks and skip
+        # the prefill compute for the shared part. Reuse is quantized to
+        # the CHUNK grid, so every registered span was computed by the
+        # same chunk executable at the same grid offsets as a borrower
+        # would have used — reuse is bit-exact, not just close. Blocks
+        # whose last owner finished park in an LRU pool (system prompts
+        # stay warm across requests) and are evicted only under block
+        # pressure.
+        if enable_prefix_cache and self.chunk is None:
+            raise ValueError(
+                "enable_prefix_cache requires chunk_prefill_tokens: "
+                "chunk-grid-aligned recompute is what makes reused and "
+                "freshly computed K/V bit-identical")
+        self.prefix_caching = bool(enable_prefix_cache)
+        self.prefix_cache: Dict[tuple, tuple] = {}   # key -> block ids
+        self._prefix_rev: Dict[int, set] = {}        # block -> keys
+        self.block_refs: Dict[int, int] = {}         # live owner count
+        self.cached_free: Dict[int, None] = {}       # LRU, insertion order
         L = cfg.num_hidden_layers
         kvh, d = cfg.num_key_value_heads, cfg.head_dim
         self.pools = [(jnp.zeros((self.P, self.B, kvh, d), cfg.dtype),
@@ -223,7 +244,8 @@ class PagedEngine:
         self._submit_counter = 0
         self.stats = {"decode_steps": 0, "prefills": 0, "preemptions": 0,
                       "prefill_chunks": 0, "slot_steps": 0,
-                      "active_slot_steps": 0}
+                      "active_slot_steps": 0, "prefix_hit_tokens": 0,
+                      "prefix_adopted_blocks": 0}
         # pools are donated: XLA aliases input to output so a decode
         # step costs one scatter, not a full pool copy
         self._decode_jit = jax.jit(self._decode_step, donate_argnums=(1,))
@@ -337,6 +359,113 @@ class PagedEngine:
     def _blocks_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.B - 1) // self.B
 
+    # -------------------------------------------------- prefix caching
+    def _alloc_block(self) -> Optional[int]:
+        """A fresh block: the free list first, then evict the
+        least-recently-parked cached-free block (its registrations die
+        with it)."""
+        if self.free_blocks:
+            b = self.free_blocks.pop()
+        elif self.cached_free:
+            b = next(iter(self.cached_free))
+            self._evict_registered(b)
+            # the cascade moves co-members — possibly b itself — to the
+            # free list as their registrations die; track b either way
+            if b in self.cached_free:
+                del self.cached_free[b]
+            else:
+                self.free_blocks.remove(b)
+        else:
+            return None
+        self.block_refs[b] = 1
+        return b
+
+    def _unhook(self, key, entry):
+        """Remove one (key -> entry) registration; member blocks that
+        lose their last registration while parked in cached_free fall
+        through to the plain free list."""
+        for ob in entry:
+            keys = self._prefix_rev.get(ob)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del self._prefix_rev[ob]
+                    if ob in self.cached_free:
+                        del self.cached_free[ob]
+                        self.free_blocks.append(ob)
+
+    def _evict_registered(self, b: int):
+        """Drop every prefix entry that contains block ``b``."""
+        for key in list(self._prefix_rev.get(b, ())):
+            entry = self.prefix_cache.pop(key, None)
+            if entry is not None:
+                self._unhook(key, entry)
+        self._prefix_rev.pop(b, None)
+
+    def _release_block(self, b: int):
+        rc = self.block_refs.get(b, 1) - 1
+        if rc > 0:
+            self.block_refs[b] = rc
+            return
+        self.block_refs.pop(b, None)
+        if b in self._prefix_rev:        # registered: park for reuse
+            self.cached_free[b] = None
+        else:
+            self.free_blocks.append(b)
+
+    def _chunk_digests(self, ids: List[int], max_tokens: int):
+        """SHA-256 chain digest per chunk-grid prefix span (digest_k =
+        H(digest_{k-1} || chunk_k tokens)) for every k*C <= max_tokens.
+        O(n) total — keys are 32 bytes regardless of prefix length, and
+        a digest is computable from tokens alone, so a lookup can still
+        hit a LONG span whose shorter sub-spans were evicted."""
+        import hashlib
+        C = self.chunk
+        digests = []
+        d = b""
+        k = 1
+        while k * C <= max_tokens:
+            h = hashlib.sha256(d)
+            h.update(np.asarray(ids[(k - 1) * C:k * C],
+                                np.int64).tobytes())
+            d = h.digest()
+            digests.append(d)
+            k += 1
+        return digests
+
+    def _prefix_lookup(self, ids: List[int]):
+        """Longest chunk-grid prefix of ``ids`` with a live cache entry,
+        capped so at least one live token remains to prefill (the chunk
+        that samples the first generated token). Returns
+        (cached_tokens, adopted_block_ids) WITHOUT mutating state."""
+        if not self.prefix_caching:
+            return 0, ()
+        C = self.chunk
+        cached, best = 0, ()
+        for i, d in enumerate(self._chunk_digests(ids, len(ids) - 1)):
+            entry = self.prefix_cache.get(d)
+            if entry is not None:  # keep scanning: a longer span may
+                cached = (i + 1) * C   # survive its evicted sub-spans
+                best = entry
+        return cached, best
+
+    def _register_prefix(self, req: "_Request"):
+        """Called when a prompt is fully cached: publish every
+        chunk-grid-aligned prefix span -> its physical blocks."""
+        if not self.prefix_caching:
+            return
+        C, ids = self.chunk, req.prompt
+        for i, key in enumerate(self._chunk_digests(ids, len(ids))):
+            entry = tuple(req.blocks[:(i + 1) * C // self.B])
+            old = self.prefix_cache.get(key)
+            if old == entry:
+                continue
+            if old is not None:  # last-writer-wins
+                self._unhook(key, old)
+            self.prefix_cache[key] = entry
+            for b in entry:
+                self._prefix_rev.setdefault(b, set()).add(key)
+
     def _try_admit(self) -> bool:
         """Prefill ONE queued request into a free slot if blocks allow."""
         if not self.queue:
@@ -347,13 +476,25 @@ class PagedEngine:
         except ValueError:
             return False
         ids = req.prompt
+        cached, adopted = self._prefix_lookup(ids)
         need = self._blocks_needed(len(ids) + 1)
-        if len(self.free_blocks) < need:
+        fresh = need - len(adopted)
+        evictable = sum(1 for b in self.cached_free if b not in adopted)
+        if len(self.free_blocks) + evictable < fresh:
             return False
         self.queue.pop(0)
         self._admit_counter += 1
         req.admit_seq = self._admit_counter
-        req.blocks = [self.free_blocks.pop() for _ in range(need)]
+        req.blocks = []
+        for b in adopted:            # shared prefix blocks: bump owners
+            self.cached_free.pop(b, None)
+            self.block_refs[b] = self.block_refs.get(b, 0) + 1
+            req.blocks.append(b)
+        for _ in range(fresh):
+            req.blocks.append(self._alloc_block())
+        if cached:
+            self.stats["prefix_hit_tokens"] += cached
+            self.stats["prefix_adopted_blocks"] += len(adopted)
         self.slots[slot_id] = req
         row = np.zeros((self.M,), np.int32)
         row[:need] = req.blocks
@@ -365,9 +506,10 @@ class PagedEngine:
 
         if self.chunk is not None:
             # chunked mode: admission only claims the slot + blocks; the
-            # prompt enters the cache chunk-by-chunk on later ticks
-            req.prefill_pos = 0
-            self.seq_lens[slot_id] = 0
+            # prompt enters the cache chunk-by-chunk on later ticks,
+            # starting AFTER any shared-prefix tokens already in the pool
+            req.prefill_pos = cached
+            self.seq_lens[slot_id] = cached
             return True
 
         bucket = next((b for b in self.prefill_buckets if b >= len(ids)),
@@ -418,6 +560,7 @@ class PagedEngine:
         self.seq_lens[slot_id] = req.prefill_pos
         if last:
             self.stats["prefills"] += 1
+            self._register_prefix(req)
             self.keys[slot_id] = np.array(new_key)
             req.key = self.keys[slot_id].copy()
             first = int(nxt)
@@ -433,9 +576,9 @@ class PagedEngine:
         slot = self.slots[slot_id]
         need = self._blocks_needed(int(self.seq_lens[slot_id]) + 1)
         while len(slot.blocks) < need:
-            if not self.free_blocks:
+            b = self._alloc_block()
+            if b is None:
                 return False
-            b = self.free_blocks.pop()
             slot.blocks.append(b)
             self.block_tables[slot_id, len(slot.blocks) - 1] = b
         return True
@@ -447,7 +590,8 @@ class PagedEngine:
         self._release(slot_id)
 
     def _release(self, slot_id: int):
-        self.free_blocks.extend(self.slots[slot_id].blocks)
+        for b in self.slots[slot_id].blocks:
+            self._release_block(b)
         self.block_tables[slot_id] = 0
         self.seq_lens[slot_id] = 0
         self.temps[slot_id] = 0.0

@@ -352,3 +352,129 @@ class TestChunkedPrefill:
         assert eng._preempt_youngest(exclude=gid)
         assert eng.queue and eng.queue[0].request_id == "s"
         np.testing.assert_array_equal(eng.queue[0].key, want_key)
+
+
+class TestPrefixCaching:
+    """Automatic prefix caching (round 5): shared prompt prefixes reuse
+    physical blocks and skip prefill compute, quantized to the chunk
+    grid so reuse is bit-exact; blocks outlive their owner in an LRU
+    pool and are evicted under pressure."""
+
+    def _engine(self, model, **kw):
+        base = dict(max_slots=4, num_blocks=32, block_size=8,
+                    max_blocks_per_seq=8, prefill_buckets=(16, 32),
+                    chunk_prefill_tokens=16, enable_prefix_cache=True)
+        base.update(kw)
+        return PagedEngine(model, **base)
+
+    def test_requires_chunked_prefill(self, model):
+        with pytest.raises(ValueError, match="chunk_prefill_tokens"):
+            PagedEngine(model, enable_prefix_cache=True)
+
+    def test_shared_prefix_skips_chunks_and_stays_exact(self, model):
+        """Second request with the same 32-token system prefix: fewer
+        prefill chunks, identical output to its own greedy decode."""
+        rs = np.random.RandomState(40)
+        sys_prompt = rs.randint(1, 256, 32).tolist()
+        a = np.asarray([sys_prompt + rs.randint(1, 256, 5).tolist()])
+        b = np.asarray([sys_prompt + rs.randint(1, 256, 7).tolist()])
+        eng = self._engine(model)
+        eng.submit("a", a, max_new_tokens=8)
+        eng.run()
+        chunks_a = eng.stats["prefill_chunks"]
+        eng.submit("b", b, max_new_tokens=8)
+        out = eng.run()
+        chunks_b = eng.stats["prefill_chunks"] - chunks_a
+        # 32 shared tokens = 2 chunks of 16 skipped for b
+        assert eng.stats["prefix_hit_tokens"] == 32, eng.stats
+        assert chunks_b < chunks_a, (chunks_a, chunks_b)
+        np.testing.assert_array_equal(np.asarray(out["b"]),
+                                      _greedy_new(model, b, 8))
+        np.testing.assert_array_equal(np.asarray(out["a"]),
+                                      _greedy_new(model, a, 8))
+
+    def test_blocks_survive_owner_and_accounting_drains(self, model):
+        """Donor finishes BEFORE the borrower submits: its prefix blocks
+        park in cached_free and are still adopted; at drain every
+        non-garbage block is either free or parked (no leaks)."""
+        rs = np.random.RandomState(41)
+        pref = rs.randint(1, 256, 32).tolist()
+        eng = self._engine(model)
+        eng.submit("a", np.asarray([pref + [7]]), max_new_tokens=4)
+        eng.run()
+        assert len(eng.cached_free) > 0          # parked, not freed
+        eng.submit("b", np.asarray([pref + [9, 9]]), max_new_tokens=4)
+        out = eng.run()
+        assert eng.stats["prefix_adopted_blocks"] >= 4   # 32 tok / B=8
+        np.testing.assert_array_equal(
+            np.asarray(out["b"]),
+            _greedy_new(model, np.asarray([pref + [9, 9]]), 4))
+        assert not eng.block_refs                # no live owners
+        assert len(eng.free_blocks) + len(eng.cached_free) == eng.P - 1
+
+    def test_eviction_under_pressure(self, model):
+        """A stream of DISTINCT long prompts through a small pool: parked
+        blocks must be evicted for new requests, never crashing, and
+        every output stays exact."""
+        rs = np.random.RandomState(42)
+        eng = self._engine(model, num_blocks=16, max_slots=2)
+        prompts = {f"r{i}": np.asarray([rs.randint(1, 256, 33)])
+                   for i in range(5)}
+        for rid, ids in prompts.items():
+            eng.submit(rid, ids, max_new_tokens=4)
+        out = eng.run()
+        for rid, ids in prompts.items():
+            np.testing.assert_array_equal(
+                np.asarray(out[rid]), _greedy_new(model, ids, 4),
+                err_msg=rid)
+        assert len(eng.free_blocks) + len(eng.cached_free) == eng.P - 1
+
+    def test_sampled_borrower_reproducible(self, model):
+        """Prefix sharing must not perturb a sampled request's PRNG
+        stream: same seed twice -> same tokens, with a donor's blocks
+        adopted both times."""
+        rs = np.random.RandomState(43)
+        pref = rs.randint(1, 256, 32).tolist()
+        ids = np.asarray([pref + [5, 6]])
+        outs = []
+        for _ in range(2):
+            eng = self._engine(model)
+            eng.submit("donor", np.asarray([pref + [1]]), max_new_tokens=2)
+            eng.run()
+            eng.submit("s", ids, max_new_tokens=10, temperature=0.9,
+                       top_p=0.9, seed=123)
+            outs.append(eng.run()["s"])
+            assert eng.stats["prefix_hit_tokens"] >= 32
+        np.testing.assert_array_equal(np.asarray(outs[0]),
+                                      np.asarray(outs[1]))
+
+    def test_no_false_sharing(self, model):
+        """Prompts differing in token 0 must not hit the cache."""
+        rs = np.random.RandomState(44)
+        base = rs.randint(1, 256, 33)
+        other = base.copy()
+        other[0] = base[0] % 255 + 1
+        eng = self._engine(model)
+        eng.submit("a", np.asarray([base]), max_new_tokens=4)
+        eng.run()
+        eng.submit("b", np.asarray([other]), max_new_tokens=4)
+        out = eng.run()
+        assert eng.stats["prefix_hit_tokens"] == 0
+        np.testing.assert_array_equal(
+            np.asarray(out["b"]), _greedy_new(model, np.asarray([other]), 4))
+
+    def test_preempted_request_rehits_prefix(self, model):
+        """Recompute-mode preemption becomes cheap: the victim's
+        re-prefill adopts its own still-registered prefix blocks."""
+        rs = np.random.RandomState(45)
+        eng = self._engine(model, num_blocks=14, max_slots=2,
+                           max_blocks_per_seq=8)
+        a = np.asarray([rs.randint(1, 256, 17)])
+        b = np.asarray([rs.randint(1, 256, 17)])
+        eng.submit("a", a, max_new_tokens=24)
+        eng.submit("b", b, max_new_tokens=24)
+        out = eng.run()
+        np.testing.assert_array_equal(np.asarray(out["a"]),
+                                      _greedy_new(model, a, 24))
+        np.testing.assert_array_equal(np.asarray(out["b"]),
+                                      _greedy_new(model, b, 24))
