@@ -17,7 +17,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .sampling import sample_token
+from .sampling import repetition_penalty, sample_token
 
 __all__ = ["GenerationConfig", "generate", "beam_search"]
 
@@ -39,6 +39,12 @@ class GenerationConfig:
     pad_token_id: int = 0
     num_beams: int = 1
     length_penalty: float = 1.0
+    # penalize tokens already in the running sequence (prompt +
+    # generated), HF/Paddle semantics: divide positive logits, multiply
+    # negative ones. 1.0 = off.
+    repetition_penalty: float = 1.0
+    # suppress eos until this many tokens have been generated
+    min_new_tokens: int = 0
 
 
 def generate(model, input_ids, config: Optional[GenerationConfig] = None,
@@ -60,6 +66,11 @@ def generate(model, input_ids, config: Optional[GenerationConfig] = None,
         import dataclasses
         cfg = dataclasses.replace(cfg, **kwargs)
     if cfg.num_beams > 1:
+        if cfg.repetition_penalty != 1.0 or cfg.min_new_tokens > 0:
+            raise NotImplementedError(
+                "repetition_penalty / min_new_tokens are not applied in "
+                "beam search yet; silently ignoring them would return "
+                "wrong beams")
         return beam_search(model, input_ids, cfg, params=params)
     key = key if key is not None else jax.random.key(0)
     fn, model_params = model.functional()
@@ -69,7 +80,7 @@ def generate(model, input_ids, config: Optional[GenerationConfig] = None,
 
     cache_key = (b, prompt_len, cfg.max_new_tokens, cfg.do_sample,
                  cfg.top_k, cfg.top_p, cfg.eos_token_id, cfg.pad_token_id,
-                 has_start,
+                 cfg.repetition_penalty, cfg.min_new_tokens, has_start,
                  # model surgery (e.g. quantize_model) changes the param
                  # tree; a stale compiled fn must not be reused
                  hash(tuple(model_params)))
@@ -87,6 +98,23 @@ def generate(model, input_ids, config: Optional[GenerationConfig] = None,
 def _build_generate_fn(model, fn, cfg, b, prompt_len, has_start):
     total = prompt_len + cfg.max_new_tokens
     eos = cfg.eos_token_id
+    use_rep = cfg.repetition_penalty != 1.0
+    if use_rep:  # only this path needs a vocab size off the config —
+        # the plain contract (init_kv_caches + forward) stays sufficient
+        vocab = model.config.vocab_size
+
+    def adjust(row_logits, seen, n_generated):
+        """Logits processors on one step's [b, V] row: repetition
+        penalty over the seen-token counts, eos suppression below
+        min_new_tokens. Both compile away when off (static flags)."""
+        if use_rep:
+            row_logits = repetition_penalty(row_logits, seen,
+                                            cfg.repetition_penalty)
+        if eos is not None and cfg.min_new_tokens > 0:
+            suppress = n_generated < cfg.min_new_tokens
+            is_eos = (jnp.arange(row_logits.shape[-1]) == eos)[None, :]
+            row_logits = jnp.where(is_eos & suppress, -1e30, row_logits)
+        return row_logits
 
     @jax.jit
     def run(params, input_ids, key, temperature, *start):
@@ -99,28 +127,44 @@ def _build_generate_fn(model, fn, cfg, b, prompt_len, has_start):
             [input_ids,
              jnp.full((b, cfg.max_new_tokens), cfg.pad_token_id,
                       input_ids.dtype)], axis=1)
-        next_tok = sample_token(logits[:, -1], key,
+        rows = jnp.arange(b)
+        if use_rep:
+            # bool membership mask (the penalty only tests seen-ness);
+            # left-pad prefixes excluded: not part of the real sequence
+            valid = jnp.ones((b, prompt_len), bool) if not has_start \
+                else jnp.arange(prompt_len)[None, :] >= start[0][:, None]
+            seen = jnp.zeros((b, vocab), bool) \
+                .at[rows[:, None], input_ids].max(valid)
+        else:
+            seen = jnp.zeros((b, 1), bool)        # unused placeholder
+        row0 = adjust(logits[:, -1], seen, jnp.int32(0))
+        next_tok = sample_token(row0, key,
                                 temperature=temperature, top_k=cfg.top_k,
                                 top_p=cfg.top_p, do_sample=cfg.do_sample)
         tokens = tokens.at[:, prompt_len].set(next_tok)
+        if use_rep:
+            seen = seen.at[rows, next_tok].set(True)
         done = jnp.zeros((b,), bool) if eos is None else (next_tok == eos)
 
         def step(state, cur):
-            tokens, caches, key, done = state
+            tokens, caches, key, done, seen = state
             ids = jax.lax.dynamic_slice_in_dim(tokens, cur - 1, 1, axis=1)
             logits, caches = fn(params, ids, kv_caches=caches,
                                 cache_index=cur - 1, **extra)
             key, sub = jax.random.split(key)
-            nxt = sample_token(logits[:, 0], sub, temperature=temperature,
+            row = adjust(logits[:, 0], seen, cur - prompt_len)
+            nxt = sample_token(row, sub, temperature=temperature,
                                top_k=cfg.top_k, top_p=cfg.top_p,
                                do_sample=cfg.do_sample)
             nxt = jnp.where(done, jnp.asarray(cfg.pad_token_id, nxt.dtype), nxt)
+            if use_rep:  # finished rows emit pad — don't count it
+                seen = seen.at[rows, nxt].max(~done)
             tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None], (0, cur))
             if eos is not None:
                 done = done | (nxt == eos)
-            return (tokens, caches, key, done)
+            return (tokens, caches, key, done, seen)
 
-        state = (tokens, caches, key, done)
+        state = (tokens, caches, key, done, seen)
         if eos is None:
             # static trip count: fori lowers without a dynamic predicate,
             # letting XLA pipeline iterations (while_loop can't)
@@ -128,7 +172,7 @@ def _build_generate_fn(model, fn, cfg, b, prompt_len, has_start):
                 prompt_len + 1, total, lambda c, s: step(s, c), state)
         else:
             def cond(s):
-                _, _, _, done = s[0]
+                done = s[0][3]
                 return (s[1] < total) & ~jnp.all(done)
 
             def body(s):

@@ -101,3 +101,70 @@ def test_beam_search_beats_greedy_logprob(tiny):
         return float(lp[:, -n_new:].sum())
 
     assert seq_logprob(beam) >= seq_logprob(greedy) - 1e-4
+
+
+class TestLogitsProcessors:
+    """repetition_penalty + min_new_tokens (round 5): HF-parity greedy
+    decoding through the jitted while_loop."""
+
+    def _pair(self, tmp_path):
+        import torch
+        import transformers
+        from paddle_tpu.models.hf_interop import from_pretrained
+        torch.manual_seed(0)
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            torch_dtype="float32")
+        hf = transformers.LlamaForCausalLM(cfg).eval()
+        d = str(tmp_path / "rep_llama")
+        hf.save_pretrained(d, safe_serialization=True)
+        return hf, from_pretrained(d)
+
+    def test_repetition_penalty_matches_transformers(self, tmp_path):
+        import torch
+        hf, model = self._pair(tmp_path)
+        ids = np.random.RandomState(0).randint(1, 128, (2, 10))
+        # explicit matching eos on BOTH sides (HF would otherwise use
+        # LlamaConfig's default eos=2 while ours ran eos-free — parity
+        # would then hinge on the seed never emitting token 2)
+        with torch.no_grad():
+            want = hf.generate(torch.tensor(ids), max_new_tokens=16,
+                               do_sample=False, repetition_penalty=1.4,
+                               eos_token_id=127, pad_token_id=0).numpy()
+        got = model.generate(jnp.asarray(ids), max_new_tokens=16,
+                             temperature=0.0, repetition_penalty=1.4,
+                             eos_token_id=127)
+        np.testing.assert_array_equal(np.asarray(got), want)
+        # and the penalty actually changes the output
+        base = model.generate(jnp.asarray(ids), max_new_tokens=16,
+                              temperature=0.0)
+        assert not np.array_equal(np.asarray(got), np.asarray(base))
+
+    def test_min_new_tokens_suppresses_eos(self, tmp_path):
+        import torch
+        hf, model = self._pair(tmp_path)
+        ids = np.random.RandomState(1).randint(1, 128, (1, 8))
+        # pick the model's own first greedy token as "eos" so the plain
+        # decode would stop immediately
+        first = int(np.asarray(model.generate(
+            jnp.asarray(ids), max_new_tokens=1, temperature=0.0))[0, -1])
+        assert first != 0, "greedy first token hit the pad id; the " \
+            "(tokens != 0) counting below would be meaningless"
+        short = model.generate(jnp.asarray(ids), max_new_tokens=12,
+                               temperature=0.0, eos_token_id=first)
+        long = model.generate(jnp.asarray(ids), max_new_tokens=12,
+                              temperature=0.0, eos_token_id=first,
+                              min_new_tokens=6)
+        n_short = int((np.asarray(short)[0, 8:] != 0).sum())
+        n_long = int((np.asarray(long)[0, 8:] != 0).sum())
+        assert n_short == 1                      # stopped at once
+        assert n_long >= 6, (n_short, n_long)
+        with torch.no_grad():
+            want = hf.generate(torch.tensor(ids), max_new_tokens=12,
+                               do_sample=False, eos_token_id=first,
+                               min_new_tokens=6, pad_token_id=0).numpy()
+        hf_new = want[0, 8:]
+        got_new = np.asarray(long)[0, 8:8 + len(hf_new)]
+        np.testing.assert_array_equal(got_new[:len(hf_new)], hf_new)
