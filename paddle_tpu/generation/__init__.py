@@ -10,7 +10,6 @@ where possible so changing temperature does not recompile.
 from __future__ import annotations
 
 import functools
-import weakref
 from dataclasses import dataclass
 from typing import Optional
 
@@ -21,11 +20,23 @@ from .sampling import repetition_penalty, sample_token
 
 __all__ = ["GenerationConfig", "generate", "beam_search"]
 
-# model -> {static-shape/config key -> compiled run}. Without this every
-# generate() call would build a fresh closure and jax.jit would retrace +
-# recompile the whole prefill+decode program per request — the pipeline's
-# bucket ladder only pays off if the executable is actually reused.
-_GEN_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+# Per-model executable cache {static-shape/config key -> compiled run},
+# hung off the model object itself. Without it every generate() call
+# would build a fresh closure and jax.jit would retrace + recompile the
+# whole prefill+decode program per request — the pipeline's bucket
+# ladder only pays off if the executable is actually reused. NOT a
+# module-global registry: the compiled run closes over the model, so a
+# global (even weak-keyed — its values would pin their own keys) would
+# leak every model ever generated with; model -> cache -> run -> model
+# is a plain cycle the gc collects when the caller drops the model.
+
+
+def _gen_cache_for(model):
+    cache = getattr(model, "_gen_exec_cache", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(model, "_gen_exec_cache", cache)
+    return cache
 
 
 @dataclass
@@ -84,7 +95,7 @@ def generate(model, input_ids, config: Optional[GenerationConfig] = None,
                  # model surgery (e.g. quantize_model) changes the param
                  # tree; a stale compiled fn must not be reused
                  hash(tuple(model_params)))
-    per_model = _GEN_CACHE.setdefault(model, {})
+    per_model = _gen_cache_for(model)
     run = per_model.get(cache_key)
     if run is None:
         run = _build_generate_fn(model, fn, cfg, b, prompt_len, has_start)
@@ -259,7 +270,9 @@ def beam_search(model, input_ids, config: GenerationConfig, params=None):
 from .pipeline import TextGenerationPipeline  # noqa: E402
 from .paged import PagedEngine, PagedKV  # noqa: E402
 from .speculative import (speculative_generate,  # noqa: E402
-                          mtp_speculative_generate)
+                          mtp_speculative_generate,
+                          ngram_speculative_generate)
 
 __all__ += ["TextGenerationPipeline", "speculative_generate",
-            "mtp_speculative_generate", "PagedEngine", "PagedKV"]
+            "mtp_speculative_generate", "ngram_speculative_generate",
+            "PagedEngine", "PagedKV"]

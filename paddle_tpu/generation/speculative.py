@@ -27,18 +27,75 @@ inherent cost of batched speculative decoding.
 from __future__ import annotations
 
 from typing import Optional
-import weakref
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["speculative_generate", "mtp_speculative_generate"]
+__all__ = ["speculative_generate", "mtp_speculative_generate",
+           "ngram_speculative_generate"]
 
-# target -> draft -> {static key -> compiled run}: without this every call
-# would retrace the draft-scan + verify while_loop (cf. generation's
-# _GEN_CACHE) — fatal for the serving latency this feature exists for.
-_SPEC_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+# Per-target executable cache {id(draft) -> {static key -> compiled
+# run}}: without it every call would retrace the draft-scan + verify
+# while_loop (cf. generation's executable cache) — fatal for the serving
+# latency this feature exists for. The cache hangs OFF THE TARGET
+# OBJECT, not a module-global: the cached `call` closes over the
+# model(s), so any global registry (weak-keyed or not) would pin them
+# forever, while target -> cache -> call -> target is a plain reference
+# cycle the gc collects once the caller drops the model. A dead draft
+# can't leave a stale id() entry — the cached call itself keeps the
+# draft alive exactly as long as its entry exists.
+
+
+def _spec_cache_for(target, draft):
+    caches = getattr(target, "_spec_exec_cache", None)
+    if caches is None:
+        caches = {}
+        object.__setattr__(target, "_spec_exec_cache", caches)
+    return caches.setdefault(id(draft), {})
+
+
+def _commit(tokens, g_tok, draft, n, k, eos, pad, done):
+    """The accept step shared by every drafting strategy: commit the
+    longest draft==target prefix plus the target's own correction/bonus
+    token, handle eos inside the committed span. Returns (tokens,
+    accepted_draft_count, advance, done)."""
+    match = jnp.cumprod((draft == g_tok[:k]).astype(jnp.int32))
+    m = jnp.sum(match)
+    write = jnp.where(jnp.arange(k + 1) <= m, g_tok,
+                      pad).astype(tokens.dtype)
+    tokens = jax.lax.dynamic_update_slice(tokens, write[None], (0, n))
+    if eos is not None:
+        hit = (write[:k + 1] == eos) & (jnp.arange(k + 1) <= m)
+        done = done | jnp.any(hit)
+        first_eos = jnp.argmax(hit)
+        adv = jnp.where(jnp.any(hit), first_eos + 1, m + 1)
+    else:
+        adv = m + 1
+    return tokens, m, adv, done
+
+
+def _mask_tail(tokens, n_end, total, pad):
+    """Blank the speculative tail and anything past the final cursor."""
+    pos = jnp.arange(tokens.shape[1])[None, :]
+    return jnp.where(pos < jnp.minimum(n_end, total), tokens, pad)[:, :total]
+
+
+def _jit_rows(run, bsz, n_param_args):
+    """jit `run` directly at bsz 1; otherwise vmap the per-row loop —
+    while_loop batching gives every row its own cursor/cache index and
+    freezes finished rows."""
+    if bsz == 1:
+        return jax.jit(run)
+
+    @jax.jit
+    def call(*args):
+        ps, ids = args[:n_param_args], args[n_param_args]
+        outs, nfwd, n_end = jax.vmap(
+            run, in_axes=(None,) * n_param_args + (0,))(
+                *ps, ids[:, None, :])
+        return outs[:, 0], nfwd, n_end
+    return call
 
 
 def _spec_stats(nfwd, n_end, total, prompt_len, bsz):
@@ -86,9 +143,7 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
 
     cache_key = (bsz, prompt_len, max_new_tokens, k, eos, pad_token_id,
                  hash(tuple(t_p)), hash(tuple(d_p)))
-    per_draft = _SPEC_CACHE.setdefault(
-        target, weakref.WeakKeyDictionary())
-    per_key = per_draft.setdefault(draft, {})
+    per_key = _spec_cache_for(target, draft)
 
     def _stats(nfwd, n_end):
         return _spec_stats(nfwd, n_end, total, prompt_len, bsz)
@@ -144,24 +199,10 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
             d = jax.lax.dynamic_slice(tokens, (0, n), (1, k))[0]  # drafts
             # 3) accept the longest prefix where draft == target, then the
             #    target's own token — the correction (or the bonus if all
-            #    k matched)
-            match = jnp.cumprod((d == g[:k]).astype(jnp.int32))
-            m = jnp.sum(match)                             # accepted drafts
-            # accepted drafts ARE g[:m] by definition of matching, and
-            # g[m] is the correction/bonus — so the whole commit is g[:m+1]
-            write = jnp.where(jnp.arange(k + 1) <= m, g,
-                              pad_token_id).astype(tokens.dtype)
-            tokens = jax.lax.dynamic_update_slice(tokens, write[None],
-                                                  (0, n))
-            if eos is not None:
-                hit = (write[:k + 1] == eos) & \
-                    (jnp.arange(k + 1) <= m)
-                done = done | jnp.any(hit)
-                # stop at the first eos: cap the advance there
-                first_eos = jnp.argmax(hit)
-                adv = jnp.where(jnp.any(hit), first_eos + 1, m + 1)
-            else:
-                adv = m + 1
+            #    k matched): accepted drafts ARE g[:m] by definition of
+            #    matching, so the whole commit is g[:m+1]
+            tokens, _, adv, done = _commit(tokens, g, d, n, k, eos,
+                                           pad_token_id, done)
             return (tokens, t_caches, d_caches, n + adv, done, nfwd + 1)
 
         def cond(state):
@@ -170,22 +211,9 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 64,
 
         state = (tokens, t_caches, d_caches, n0, done0, jnp.int32(1))
         tokens, _, _, n_end, _, nfwd = jax.lax.while_loop(cond, body, state)
-        # blank the speculative tail and anything past the final cursor
-        pos = jnp.arange(tokens.shape[1])[None, :]
-        tokens = jnp.where(pos < jnp.minimum(n_end, total), tokens,
-                           pad_token_id)
-        return tokens[:, :total], nfwd, n_end
+        return _mask_tail(tokens, n_end, total, pad_token_id), nfwd, n_end
 
-    if bsz == 1:
-        call = jax.jit(run)
-    else:
-        # vmap the per-row loop: lanes are [b, 1, s]; while_loop batching
-        # gives every row its own cursor/cache index and freezes done rows
-        @jax.jit
-        def call(tp, dp, ids):
-            outs, nfwd, n_end = jax.vmap(run, in_axes=(None, None, 0))(
-                tp, dp, ids[:, None, :])
-            return outs[:, 0], nfwd, n_end
+    call = _jit_rows(run, bsz, 2)
 
     per_key[cache_key] = call
     out, nfwd, n_end = call(t_params, d_params, input_ids)
@@ -248,8 +276,7 @@ def mtp_speculative_generate(model, input_ids, max_new_tokens: int = 64,
 
     cache_key = ("mtp", bsz, prompt_len, max_new_tokens, k, eos,
                  pad_token_id, hash(tuple(p0)))
-    per_draft = _SPEC_CACHE.setdefault(model, weakref.WeakKeyDictionary())
-    per_key = per_draft.setdefault(model, {})
+    per_key = _spec_cache_for(model, model)
 
     def _stats(nfwd, n_end):
         return _spec_stats(nfwd, n_end, total, prompt_len, bsz)
@@ -314,19 +341,8 @@ def mtp_speculative_generate(model, input_ids, max_new_tokens: int = 64,
             g = jnp.argmax(t_logits[0].astype(jnp.float32), axis=-1) \
                 .astype(tokens.dtype)
             d = jax.lax.dynamic_slice(tokens, (0, n), (1, k))[0]
-            match = jnp.cumprod((d == g[:k]).astype(jnp.int32))
-            m = jnp.sum(match)
-            write = jnp.where(jnp.arange(k + 1) <= m, g,
-                              pad_token_id).astype(tokens.dtype)
-            tokens = jax.lax.dynamic_update_slice(tokens, write[None],
-                                                  (0, n))
-            if eos is not None:
-                hit = (write[:k + 1] == eos) & (jnp.arange(k + 1) <= m)
-                done = done | jnp.any(hit)
-                first_eos = jnp.argmax(hit)
-                adv = jnp.where(jnp.any(hit), first_eos + 1, m + 1)
-            else:
-                adv = m + 1
+            tokens, _, adv, done = _commit(tokens, g, d, n, k, eos,
+                                           pad_token_id, done)
             # re-draft bulk: rewrite the draft cache for the committed
             # positions from the TRUE target hiddens (h_ctx) and read off
             # the next round's d0/h_last at the accepted boundary
@@ -352,19 +368,118 @@ def mtp_speculative_generate(model, input_ids, max_new_tokens: int = 64,
                  h_last, d0)
         out = jax.lax.while_loop(cond, body, state)
         tokens, n_end, nfwd = out[0], out[3], out[5]
-        pos = jnp.arange(tokens.shape[1])[None, :]
-        tokens = jnp.where(pos < jnp.minimum(n_end, total), tokens,
-                           pad_token_id)
-        return tokens[:, :total], nfwd, n_end
+        return _mask_tail(tokens, n_end, total, pad_token_id), nfwd, n_end
 
-    if bsz == 1:
-        call = jax.jit(run)
-    else:
-        @jax.jit
-        def call(tp, ids):
-            outs, nfwd, n_end = jax.vmap(run, in_axes=(None, 0))(
-                tp, ids[:, None, :])
-            return outs[:, 0], nfwd, n_end
+    call = _jit_rows(run, bsz, 1)
+
+    per_key[cache_key] = call
+    out, nfwd, n_end = call(t_params, input_ids)
+    return (out, _stats(nfwd, n_end)) if return_stats else out
+
+
+def ngram_speculative_generate(model, input_ids, max_new_tokens: int = 64,
+                               num_draft_tokens: int = 4, ngram: int = 2,
+                               eos_token_id: Optional[int] = None,
+                               pad_token_id: int = 0, params=None,
+                               return_stats: bool = False):
+    """Greedy decode accelerated by PROMPT-LOOKUP drafting (reference:
+    PaddleNLP llm "inference with reference" speculate_method; Saxena's
+    prompt-lookup decoding): no draft model at all — when the model is
+    copying spans that already appeared (summarization, code edits,
+    RAG), the continuation of the most recent matching ``ngram`` is
+    proposed as the draft and one target forward verifies it.
+
+    The match scan is a static-shape compare over the token buffer
+    (O(L*ngram) integer ops — noise next to a model forward) inside the
+    same while_loop as the verify, so the whole decode stays ONE
+    compiled program. Exactness is the verify step's as always: output
+    equals ``generate(..., temperature=0.0)`` row by row, whatever the
+    match rate.
+    """
+    bsz = input_ids.shape[0]
+    k = int(num_draft_tokens)
+    g = int(ngram)
+    if k < 1:
+        raise ValueError("num_draft_tokens must be >= 1")
+    if g < 1:
+        raise ValueError("ngram must be >= 1")
+    if input_ids.shape[1] + 1 < g:
+        raise ValueError(f"prompt too short for ngram={g}")
+    fn, p0 = model.functional()
+    t_params = params if params is not None else p0
+    prompt_len = input_ids.shape[1]
+    total = prompt_len + max_new_tokens
+    eos = eos_token_id
+
+    cache_key = ("ngram", bsz, prompt_len, max_new_tokens, k, g, eos,
+                 pad_token_id, hash(tuple(p0)))
+    per_key = _spec_cache_for(model, model)
+
+    def _stats(nfwd, n_end):
+        return _spec_stats(nfwd, n_end, total, prompt_len, bsz)
+
+    cached = per_key.get(cache_key)
+    if cached is not None:
+        out, nfwd, n_end = cached(t_params, input_ids)
+        return (out, _stats(nfwd, n_end)) if return_stats else out
+
+    L = total + k + 1
+
+    def propose(tokens, n):
+        """Continuation of the most recent earlier occurrence of the
+        last ``g`` committed tokens; pads when nothing matches. Reads
+        only committed positions (< n) for the MATCH; the copied draft
+        may run into stale tail positions — harmless, verify guards."""
+        seq = tokens[0]
+        last = jax.lax.dynamic_slice(seq, (n - g,), (g,))
+        starts = jnp.arange(L)
+        win = seq[jnp.clip(starts[:, None] + jnp.arange(g)[None, :],
+                           0, L - 1)]                       # [L, g]
+        hit = jnp.all(win == last[None, :], axis=1)
+        # strictly earlier than the suffix being matched
+        hit &= starts <= n - g - 1
+        any_hit = jnp.any(hit)
+        p = L - 1 - jnp.argmax(jnp.flip(hit))               # most recent
+        src = jnp.where(any_hit, p + g, 0)
+        draft = jax.lax.dynamic_slice(seq, (src,), (k,))
+        return jnp.where(any_hit, draft,
+                         jnp.full((k,), pad_token_id, seq.dtype))
+
+    def run(t_params, input_ids):
+        t_caches = model.init_kv_caches(1, L)
+        t_logits, t_caches = fn(t_params, input_ids, kv_caches=t_caches,
+                                cache_index=0)
+        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(input_ids.dtype)
+        tokens = jnp.concatenate(
+            [input_ids, jnp.full((1, max_new_tokens + k + 1), pad_token_id,
+                                 input_ids.dtype)], axis=1)
+        tokens = tokens.at[:, prompt_len].set(first)
+        n0 = jnp.int32(prompt_len + 1)
+        done0 = jnp.bool_(False) if eos is None else (first[0] == eos)
+
+        def body(state):
+            tokens, t_caches, n, done, nfwd = state
+            draft = propose(tokens, n)
+            tokens = jax.lax.dynamic_update_slice(tokens, draft[None],
+                                                  (0, n))
+            chunk = jax.lax.dynamic_slice(tokens, (0, n - 1), (1, k + 1))
+            t_logits, t_caches = fn(t_params, chunk, kv_caches=t_caches,
+                                    cache_index=n - 1)
+            gr = jnp.argmax(t_logits[0].astype(jnp.float32), axis=-1) \
+                .astype(tokens.dtype)
+            tokens, _, adv, done = _commit(tokens, gr, draft, n, k, eos,
+                                           pad_token_id, done)
+            return (tokens, t_caches, n + adv, done, nfwd + 1)
+
+        def cond(state):
+            _, _, n, done, _ = state
+            return (n < total) & ~done
+
+        state = (tokens, t_caches, n0, done0, jnp.int32(1))
+        tokens, _, n_end, _, nfwd = jax.lax.while_loop(cond, body, state)
+        return _mask_tail(tokens, n_end, total, pad_token_id), nfwd, n_end
+
+    call = _jit_rows(run, bsz, 1)
 
     per_key[cache_key] = call
     out, nfwd, n_end = call(t_params, input_ids)

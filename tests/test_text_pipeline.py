@@ -87,12 +87,12 @@ def test_generate_executable_reused_and_kwargs_merge(model):
     """Same shapes -> the compiled generate fn is reused (no per-call
     retrace); per-call kwargs override the base config instead of being
     dropped."""
-    from paddle_tpu.generation import _GEN_CACHE
+    from paddle_tpu.generation import _gen_cache_for
     tok = ByteTokenizer()
     cfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
     ids = jnp.asarray([tok.encode("hello wo")])
     model.generate(ids, config=cfg)
-    cache = _GEN_CACHE[model]
+    cache = _gen_cache_for(model)
     n_before = len(cache)
     model.generate(ids, config=cfg)            # same shapes: no new entry
     assert len(cache) == n_before
