@@ -148,10 +148,11 @@ class _Request:
     __slots__ = ("request_id", "prompt", "max_new", "eos", "tokens",
                  "blocks", "prefix", "prefix_lps", "admit_seq",
                  "temperature", "top_k", "top_p", "key", "lps",
-                 "prefill_pos")
+                 "prefill_pos", "stop", "trim")
 
     def __init__(self, request_id, prompt, max_new, eos, temperature,
-                 top_k, top_p, key, prefix=None, prefix_lps=None):
+                 top_k, top_p, key, prefix=None, prefix_lps=None,
+                 stop=()):
         self.request_id = request_id
         self.prompt = prompt            # ids the prefill runs over
         self.max_new = max_new          # tokens still to emit
@@ -160,6 +161,8 @@ class _Request:
         self.top_k = top_k
         self.top_p = top_p
         self.key = key                  # [2] uint32 PRNG state
+        self.stop = stop                # token-id stop sequences
+        self.trim = 0                   # matched stop length to cut
         self.prefix = prefix or []      # tokens emitted before preemption
         self.prefix_lps = prefix_lps or []
         self.admit_seq = 0              # preemption picks the youngest
@@ -329,13 +332,24 @@ class PagedEngine:
     def submit(self, request_id, input_ids, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 1.0, seed: Optional[int] = None):
+               top_p: float = 1.0, seed: Optional[int] = None,
+               stop_sequences=None):
         """temperature <= 0 keeps the bit-exact greedy path; a sampled
         request gets its own PRNG stream seeded by ``seed`` (default: a
         per-engine submission counter), so outputs are reproducible per
-        request regardless of what else shares the batch."""
+        request regardless of what else shares the batch.
+
+        ``stop_sequences``: token-id sequences that end the request the
+        moment the GENERATED stream ends with one; the matched sequence
+        is trimmed from the returned tokens (vLLM's stop semantics).
+        Matching is host-side bookkeeping — the jitted step is
+        untouched."""
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        stop = tuple(tuple(int(t) for t in s)
+                     for s in (stop_sequences or ()))
+        if any(len(s) == 0 for s in stop):
+            raise ValueError("empty stop sequence")
         ids = list(np.asarray(input_ids).reshape(-1))
         total = len(ids) + max_new_tokens
         if total > self.M * self.B:
@@ -354,7 +368,8 @@ class PagedEngine:
                          np.uint32)
         self.queue.append(_Request(request_id, ids, max_new_tokens,
                                    eos_token_id, float(temperature),
-                                   int(top_k), float(top_p), key))
+                                   int(top_k), float(top_p), key,
+                                   stop=stop))
 
     def _blocks_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.B - 1) // self.B
@@ -533,8 +548,10 @@ class PagedEngine:
         req.lps.append(float(lp))
         req.prefill_pos = len(ids)
         self.seq_lens[slot_id] = len(ids)
-        if req.max_new <= 1 or (req.eos is not None
-                                and first == req.eos):
+        # stop check FIRST: a stop completing on the final budgeted (or
+        # eos) token must still be trimmed
+        if self._stop_hit(req) or req.max_new <= 1 \
+                or (req.eos is not None and first == req.eos):
             self._finish(slot_id)
         return True
 
@@ -566,8 +583,8 @@ class PagedEngine:
             first = int(nxt)
             req.tokens.append(first)
             req.lps.append(float(lp))
-            if req.max_new <= 1 or (req.eos is not None
-                                    and first == req.eos):
+            if self._stop_hit(req) or req.max_new <= 1 \
+                    or (req.eos is not None and first == req.eos):
                 self._finish(slot_id)
 
     def _ensure_block(self, slot_id: int) -> bool:
@@ -583,10 +600,34 @@ class PagedEngine:
             self.block_tables[slot_id, len(slot.blocks) - 1] = b
         return True
 
+    @staticmethod
+    def _stop_hit(req) -> bool:
+        """True when the generated stream ends with one of the request's
+        stop sequences; records the matched length for trimming. Only
+        the last max-stop-length tokens are materialized (O(1) per tick,
+        not a prefix+tokens copy)."""
+        if not req.stop:
+            return False
+        need = max(len(s) for s in req.stop)
+        tail = req.tokens[-need:]
+        if len(tail) < need and req.prefix:  # stop spans a preemption
+            take = need - len(tail)
+            tail = req.prefix[-take:] + tail
+        for s in req.stop:
+            if len(tail) >= len(s) and tuple(tail[-len(s):]) == s:
+                req.trim = len(s)
+                return True
+        return False
+
     def _finish(self, slot_id: int):
         slot = self.slots[slot_id]
-        self.results[slot.request_id] = slot.prefix + slot.tokens
-        self.logprobs[slot.request_id] = slot.prefix_lps + slot.lps
+        toks = slot.prefix + slot.tokens
+        lps = slot.prefix_lps + slot.lps
+        if slot.trim:               # cut the matched stop sequence
+            toks = toks[:-slot.trim]
+            lps = lps[:-slot.trim]
+        self.results[slot.request_id] = toks
+        self.logprobs[slot.request_id] = lps
         self._release(slot_id)
 
     def _release(self, slot_id: int):
@@ -621,7 +662,8 @@ class PagedEngine:
                             s.temperature, s.top_k, s.top_p,
                             s.key.copy(),
                             prefix=s.prefix + s.tokens,
-                            prefix_lps=s.prefix_lps + s.lps)
+                            prefix_lps=s.prefix_lps + s.lps,
+                            stop=s.stop)
         self.queue.insert(0, requeued)
         self._release(victim)
         self.stats["preemptions"] += 1
@@ -678,7 +720,8 @@ class PagedEngine:
             slot.tokens.append(tok)
             slot.lps.append(float(lps[i]))
             slot.key = self.keys[i].copy()
-            done = len(slot.tokens) >= slot.max_new or \
+            done = self._stop_hit(slot) or \
+                len(slot.tokens) >= slot.max_new or \
                 (slot.eos is not None and tok == slot.eos)
             if done:
                 # the final token's K/V was never written - fine, it is

@@ -478,3 +478,65 @@ class TestPrefixCaching:
                                       _greedy_new(model, a, 24))
         np.testing.assert_array_equal(np.asarray(out["b"]),
                                       _greedy_new(model, b, 24))
+
+
+class TestStopSequences:
+    """Token-id stop sequences (round 5): the generated stream ends the
+    moment it ends with one; the match is trimmed (vLLM semantics)."""
+
+    def test_stop_truncates_exactly(self, model):
+        """Expected output = this request's own greedy stream cut at the
+        first occurrence of the stop sequence."""
+        eng = _engine(model)
+        rs = np.random.RandomState(50)
+        ids = rs.randint(1, 256, (1, 8))
+        full = _greedy_new(model, ids, 24).tolist()
+        # choose a 2-gram that actually occurs mid-stream as the stop
+        stop = None
+        for i in range(2, len(full) - 2):
+            stop = (full[i], full[i + 1])
+            break
+        eng.submit("s", ids, max_new_tokens=24, stop_sequences=[stop])
+        out = eng.run()["s"]
+        # reference: scan the greedy stream for the first suffix match
+        want = []
+        for t in full:
+            want.append(t)
+            if len(want) >= 2 and tuple(want[-2:]) == stop:
+                want = want[:-2]
+                break
+        assert list(out) == want, (out, want, stop)
+        assert len(eng.logprobs["s"]) == len(want)
+
+    def test_no_match_runs_to_budget(self, model):
+        eng = _engine(model)
+        rs = np.random.RandomState(51)
+        ids = rs.randint(1, 256, (1, 8))
+        eng.submit("s", ids, max_new_tokens=12,
+                   stop_sequences=[(999, 999)])  # out-of-vocab: no match
+        out = eng.run()["s"]
+        np.testing.assert_array_equal(np.asarray(out),
+                                      _greedy_new(model, ids, 12))
+
+    def test_empty_stop_sequence_rejected(self, model):
+        eng = _engine(model)
+        with pytest.raises(ValueError, match="empty stop"):
+            eng.submit("s", np.asarray([[1, 2, 3]]), max_new_tokens=4,
+                       stop_sequences=[[]])
+
+    def test_stop_on_final_budgeted_token_still_trims(self, model):
+        """Review r5: a stop completing exactly on the last budgeted
+        token must be trimmed the same as mid-stream."""
+        eng = _engine(model)
+        rs = np.random.RandomState(52)
+        ids = rs.randint(1, 256, (1, 8))
+        full = _greedy_new(model, ids, 24).tolist()
+        # pick the FIRST occurrence of some adjacent pair and set the
+        # budget so the match completes exactly on the last allowed token
+        stop = (full[2], full[3])
+        first_end = next(i + 1 for i in range(1, len(full))
+                         if (full[i - 1], full[i]) == stop)
+        eng.submit("s", ids, max_new_tokens=first_end,
+                   stop_sequences=[stop])
+        out = eng.run()["s"]
+        assert list(out) == full[:first_end - 2], (out, stop, first_end)
