@@ -16,7 +16,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .sampling import repetition_penalty, sample_token
+from .sampling import (repetition_penalty, sample_token,
+                       suffix_window_hits)
 
 __all__ = ["GenerationConfig", "generate", "beam_search"]
 
@@ -56,6 +57,9 @@ class GenerationConfig:
     repetition_penalty: float = 1.0
     # suppress eos until this many tokens have been generated
     min_new_tokens: int = 0
+    # ban any token that would complete an n-gram already present in
+    # the running sequence (HF semantics). 0 = off.
+    no_repeat_ngram_size: int = 0
 
 
 def generate(model, input_ids, config: Optional[GenerationConfig] = None,
@@ -76,12 +80,15 @@ def generate(model, input_ids, config: Optional[GenerationConfig] = None,
         # silently dropping them would be wrong-output, not an error
         import dataclasses
         cfg = dataclasses.replace(cfg, **kwargs)
+    if cfg.no_repeat_ngram_size < 0:
+        raise ValueError("no_repeat_ngram_size must be >= 0")
     if cfg.num_beams > 1:
-        if cfg.repetition_penalty != 1.0 or cfg.min_new_tokens > 0:
+        if cfg.repetition_penalty != 1.0 or cfg.min_new_tokens > 0 \
+                or cfg.no_repeat_ngram_size > 0:
             raise NotImplementedError(
-                "repetition_penalty / min_new_tokens are not applied in "
-                "beam search yet; silently ignoring them would return "
-                "wrong beams")
+                "repetition_penalty / min_new_tokens / no_repeat_ngram"
+                "_size are not applied in beam search yet; silently "
+                "ignoring them would return wrong beams")
         return beam_search(model, input_ids, cfg, params=params)
     key = key if key is not None else jax.random.key(0)
     fn, model_params = model.functional()
@@ -91,7 +98,8 @@ def generate(model, input_ids, config: Optional[GenerationConfig] = None,
 
     cache_key = (b, prompt_len, cfg.max_new_tokens, cfg.do_sample,
                  cfg.top_k, cfg.top_p, cfg.eos_token_id, cfg.pad_token_id,
-                 cfg.repetition_penalty, cfg.min_new_tokens, has_start,
+                 cfg.repetition_penalty, cfg.min_new_tokens,
+                 cfg.no_repeat_ngram_size, has_start,
                  # model surgery (e.g. quantize_model) changes the param
                  # tree; a stale compiled fn must not be reused
                  hash(tuple(model_params)))
@@ -110,17 +118,42 @@ def _build_generate_fn(model, fn, cfg, b, prompt_len, has_start):
     total = prompt_len + cfg.max_new_tokens
     eos = cfg.eos_token_id
     use_rep = cfg.repetition_penalty != 1.0
-    if use_rep:  # only this path needs a vocab size off the config —
-        # the plain contract (init_kv_caches + forward) stays sufficient
+    ngram = int(cfg.no_repeat_ngram_size)
+    if use_rep or ngram:  # only these paths need a vocab size off the
+        # config — the plain contract (init_kv_caches + forward) stays
+        # sufficient otherwise
         vocab = model.config.vocab_size
 
-    def adjust(row_logits, seen, n_generated):
+    def banned_ngram(tokens_row, cur, row_start):
+        """[V] mask of tokens that would complete an ``ngram``-gram
+        already present in the row's sequence (HF semantics): match the
+        last ngram-1 committed tokens against every earlier window
+        (shared kernel with speculative prompt-lookup) and ban each
+        window's follower."""
+        g = ngram - 1
+        L = tokens_row.shape[0]
+        starts = jnp.arange(L)
+        hit = suffix_window_hits(tokens_row, cur, g)
+        if row_start is not None:        # left-pad prefix is not content
+            hit &= starts >= row_start
+        follow = tokens_row[jnp.clip(starts + g, 0, L - 1)]
+        return jnp.zeros((vocab,), bool).at[follow].max(hit)
+
+    def adjust(row_logits, seen, n_generated, tokens=None, cur=None,
+               row_starts=None):
         """Logits processors on one step's [b, V] row: repetition
-        penalty over the seen-token counts, eos suppression below
-        min_new_tokens. Both compile away when off (static flags)."""
+        penalty over the seen-token counts, no-repeat-ngram bans, eos
+        suppression below min_new_tokens. All compile away when off
+        (static flags)."""
         if use_rep:
             row_logits = repetition_penalty(row_logits, seen,
                                             cfg.repetition_penalty)
+        if ngram:
+            ban = jax.vmap(
+                banned_ngram,
+                in_axes=(0, None, 0 if row_starts is not None else None))(
+                tokens, cur, row_starts)
+            row_logits = jnp.where(ban, -1e30, row_logits)
         if eos is not None and cfg.min_new_tokens > 0:
             suppress = n_generated < cfg.min_new_tokens
             is_eos = (jnp.arange(row_logits.shape[-1]) == eos)[None, :]
@@ -148,7 +181,9 @@ def _build_generate_fn(model, fn, cfg, b, prompt_len, has_start):
                 .at[rows[:, None], input_ids].max(valid)
         else:
             seen = jnp.zeros((b, 1), bool)        # unused placeholder
-        row0 = adjust(logits[:, -1], seen, jnp.int32(0))
+        row0 = adjust(logits[:, -1], seen, jnp.int32(0), tokens=tokens,
+                      cur=jnp.int32(prompt_len),
+                      row_starts=start[0] if has_start else None)
         next_tok = sample_token(row0, key,
                                 temperature=temperature, top_k=cfg.top_k,
                                 top_p=cfg.top_p, do_sample=cfg.do_sample)
@@ -163,7 +198,9 @@ def _build_generate_fn(model, fn, cfg, b, prompt_len, has_start):
             logits, caches = fn(params, ids, kv_caches=caches,
                                 cache_index=cur - 1, **extra)
             key, sub = jax.random.split(key)
-            row = adjust(logits[:, 0], seen, cur - prompt_len)
+            row = adjust(logits[:, 0], seen, cur - prompt_len,
+                         tokens=tokens, cur=cur,
+                         row_starts=start[0] if has_start else None)
             nxt = sample_token(row, sub, temperature=temperature,
                                top_k=cfg.top_k, top_p=cfg.top_p,
                                do_sample=cfg.do_sample)

@@ -117,3 +117,19 @@ def sample_token(logits, key, temperature=1.0, top_k=0, top_p=1.0,
     if top_p < 1.0:
         logits = top_p_filter(logits, top_p)
     return jax.random.categorical(key, logits, axis=-1)
+
+
+def suffix_window_hits(seq, cur, g):
+    """[L] bool: window ``seq[p : p+g]`` equals the last ``g`` committed
+    tokens ``seq[cur-g : cur]``, restricted to windows STRICTLY earlier
+    than that suffix. Shared match kernel for n-gram drafting
+    (speculative prompt-lookup) and no-repeat-ngram banning — O(L*g)
+    integer compares on static shapes. ``g == 0`` matches every
+    committed position (the degenerate 1-gram case)."""
+    L = seq.shape[0]
+    last = jax.lax.dynamic_slice(seq, (jnp.maximum(cur - g, 0),), (g,))
+    starts = jnp.arange(L)
+    win = seq[jnp.clip(starts[:, None] + jnp.arange(g)[None, :],
+                       0, L - 1)]                           # [L, g]
+    hit = jnp.all(win == last[None, :], axis=1)
+    return hit & (starts <= cur - g - 1) & (cur >= g)

@@ -430,14 +430,9 @@ def ngram_speculative_generate(model, input_ids, max_new_tokens: int = 64,
         last ``g`` committed tokens; pads when nothing matches. Reads
         only committed positions (< n) for the MATCH; the copied draft
         may run into stale tail positions — harmless, verify guards."""
+        from .sampling import suffix_window_hits
         seq = tokens[0]
-        last = jax.lax.dynamic_slice(seq, (n - g,), (g,))
-        starts = jnp.arange(L)
-        win = seq[jnp.clip(starts[:, None] + jnp.arange(g)[None, :],
-                           0, L - 1)]                       # [L, g]
-        hit = jnp.all(win == last[None, :], axis=1)
-        # strictly earlier than the suffix being matched
-        hit &= starts <= n - g - 1
+        hit = suffix_window_hits(seq, n, g)   # strictly-earlier matches
         any_hit = jnp.any(hit)
         p = L - 1 - jnp.argmax(jnp.flip(hit))               # most recent
         src = jnp.where(any_hit, p + g, 0)
