@@ -148,11 +148,11 @@ class _Request:
     __slots__ = ("request_id", "prompt", "max_new", "eos", "tokens",
                  "blocks", "prefix", "prefix_lps", "admit_seq",
                  "temperature", "top_k", "top_p", "key", "lps",
-                 "prefill_pos", "stop", "trim")
+                 "prefill_pos", "stop", "trim", "rep")
 
     def __init__(self, request_id, prompt, max_new, eos, temperature,
                  top_k, top_p, key, prefix=None, prefix_lps=None,
-                 stop=()):
+                 stop=(), rep=1.0):
         self.request_id = request_id
         self.prompt = prompt            # ids the prefill runs over
         self.max_new = max_new          # tokens still to emit
@@ -163,6 +163,7 @@ class _Request:
         self.key = key                  # [2] uint32 PRNG state
         self.stop = stop                # token-id stop sequences
         self.trim = 0                   # matched stop length to cut
+        self.rep = rep                  # repetition penalty (1.0 = off)
         self.prefix = prefix or []      # tokens emitted before preemption
         self.prefix_lps = prefix_lps or []
         self.admit_seq = 0              # preemption picks the youngest
@@ -238,7 +239,11 @@ class PagedEngine:
         self.temps = np.zeros((self.R,), np.float32)
         self.top_ks = np.zeros((self.R,), np.int32)
         self.top_ps = np.ones((self.R,), np.float32)
+        self.reps = np.ones((self.R,), np.float32)
         self.keys = np.zeros((self.R, 2), np.uint32)
+        # per-row seen-token masks for the repetition penalty: seeded by
+        # the prefill scatter, updated inside the jitted decode step
+        self.seen = jnp.zeros((self.R, cfg.vocab_size), bool)
         self.slots: List[Optional[_Request]] = [None] * self.R
         self.queue: List[_Request] = []
         self.results: Dict[Any, List[int]] = {}
@@ -249,11 +254,12 @@ class PagedEngine:
                       "prefill_chunks": 0, "slot_steps": 0,
                       "active_slot_steps": 0, "prefix_hit_tokens": 0,
                       "prefix_adopted_blocks": 0}
-        # pools are donated: XLA aliases input to output so a decode
-        # step costs one scatter, not a full pool copy
-        self._decode_jit = jax.jit(self._decode_step, donate_argnums=(1,))
+        # pools (and the seen masks) are donated: XLA aliases input to
+        # output so a decode step costs one scatter, not a full copy
+        self._decode_jit = jax.jit(self._decode_step,
+                                   donate_argnums=(1, 9))
         self._decode_greedy_jit = jax.jit(self._decode_step_greedy,
-                                          donate_argnums=(1,))
+                                          donate_argnums=(1, 5))
         self._prefill_jit = jax.jit(self._prefill, donate_argnums=(1,),
                                     static_argnames=("bucket",))
         self._chunk_jit = jax.jit(self._chunk_prefill, donate_argnums=(1,),
@@ -264,56 +270,76 @@ class PagedEngine:
         return [PagedKV(kp, vp, tables, lens) for kp, vp in pools]
 
     def _decode_step(self, params, pools, tables, lens, last_tokens,
-                     keys, temps, tks, tps):
-        from .sampling import sample_token_rows
+                     keys, temps, tks, tps, seen, reps, active):
+        from .sampling import repetition_penalty_rows, sample_token_rows
         caches = self._paged_caches(pools, tables, lens)
         logits, new_caches = self.fn(params, last_tokens[:, None],
                                      kv_caches=caches,
                                      positions=lens[:, None])
-        nxt, lps, new_keys = sample_token_rows(logits[:, -1], keys,
-                                               temps, tks, tps)
-        return nxt, lps, new_keys, [(c.kp, c.vp) for c in new_caches]
+        row = repetition_penalty_rows(logits[:, -1].astype(jnp.float32),
+                                      seen, reps)
+        nxt, lps, new_keys = sample_token_rows(row, keys, temps, tks, tps)
+        # active-guarded scatter: inactive rows (idle OR mid-chunk-
+        # prefill) sample garbage that must not pollute their masks —
+        # the seen analogue of the authoritative req.key protection
+        seen = seen.at[jnp.arange(self.R), nxt].max(active)
+        return (nxt, lps, new_keys, seen,
+                [(c.kp, c.vp) for c in new_caches])
 
     def _decode_step_greedy(self, params, pools, tables, lens,
-                            last_tokens):
+                            last_tokens, seen, reps, active):
         """Argmax-only tick for the common all-greedy batch: skips the
         sort/softmax/categorical machinery (and the key splits) that
-        sample_token_rows pays on the hottest serving path."""
+        sample_token_rows pays on the hottest serving path. greedy +
+        repetition_penalty is still deterministic, so the penalty rides
+        here too (a no-op where() for all-1.0 rows — bit-exact)."""
+        from .sampling import repetition_penalty_rows
         caches = self._paged_caches(pools, tables, lens)
         logits, new_caches = self.fn(params, last_tokens[:, None],
                                      kv_caches=caches,
                                      positions=lens[:, None])
-        raw = logits[:, -1].astype(jnp.float32)
+        raw = repetition_penalty_rows(logits[:, -1].astype(jnp.float32),
+                                      seen, reps)
         nxt = jnp.argmax(raw, axis=-1).astype(jnp.int32)
         lps = jnp.take_along_axis(jax.nn.log_softmax(raw, axis=-1),
                                   nxt[:, None], axis=-1)[:, 0]
-        return nxt, lps, [(c.kp, c.vp) for c in new_caches]
+        seen = seen.at[jnp.arange(self.R), nxt].max(active)
+        return nxt, lps, seen, [(c.kp, c.vp) for c in new_caches]
 
     def _prefill(self, params, pools, table_row, ids, length, key,
-                 temp, tk, tp, *, bucket: int):
-        from .sampling import sample_token_rows
+                 temp, tk, tp, rep, *, bucket: int):
+        from .sampling import repetition_penalty_rows, sample_token_rows
         tables = jnp.broadcast_to(table_row[None], (1, self.M))
         lens = jnp.asarray([length], jnp.int32)
         caches = self._paged_caches(pools, tables, lens)
         positions = jnp.arange(bucket)[None, :]
         logits, new_caches = self.fn(params, ids, kv_caches=caches,
                                      positions=positions)
-        row = logits[0, length - 1][None]          # [1, V]
+        # seen mask seeded from the live prompt region (pads excluded)
+        seen_row = jnp.zeros((logits.shape[-1],), bool) \
+            .at[ids[0]].max(jnp.arange(bucket) < length)
+        row = repetition_penalty_rows(
+            logits[0, length - 1][None].astype(jnp.float32),
+            seen_row[None], rep[None])
         nxt, lps, new_key = sample_token_rows(row, key[None],
                                               temp[None], tk[None],
                                               tp[None])
-        return (nxt[0], lps[0], new_key[0],
+        seen_row = seen_row.at[nxt[0]].set(True)
+        return (nxt[0], lps[0], new_key[0], seen_row,
                 [(c.kp, c.vp) for c in new_caches])
 
     def _chunk_prefill(self, params, pools, table_row, ids, start,
-                       total_len, key, temp, tk, tp, *, bucket: int):
+                       total_len, key, temp, tk, tp, rep, seen_row, *,
+                       bucket: int):
         """One prompt chunk at global positions [start, start+bucket):
         writes its K/V (live = positions < total_len) and attends to the
         already-cached chunks. The chosen-token sample at the last live
         position is returned EVERY chunk (one executable); the host only
         keeps it — and the advanced key — for the final chunk, so a
-        request still consumes exactly one split per emitted token."""
-        from .sampling import sample_token_rows
+        request still consumes exactly one split per emitted token. The
+        seen mask accumulates each chunk's live ids (prefix-cache-skipped
+        chunks were seeded at admission)."""
+        from .sampling import repetition_penalty_rows, sample_token_rows
         tables = jnp.broadcast_to(table_row[None], (1, self.M))
         lens = jnp.asarray([total_len], jnp.int32)
         caches = self._paged_caches(pools, tables, lens)
@@ -321,11 +347,16 @@ class PagedEngine:
         logits, new_caches = self.fn(params, ids, kv_caches=caches,
                                      positions=positions,
                                      paged_chunk=True)
-        row = logits[0, total_len - start - 1][None]
+        seen_row = seen_row.at[ids[0]].max(
+            jnp.arange(bucket) < total_len - start)
+        row = repetition_penalty_rows(
+            logits[0, total_len - start - 1][None].astype(jnp.float32),
+            seen_row[None], rep[None])
         nxt, lps, new_key = sample_token_rows(row, key[None],
                                               temp[None], tk[None],
                                               tp[None])
-        return (nxt[0], lps[0], new_key[0],
+        seen_out = seen_row.at[nxt[0]].set(True)
+        return (nxt[0], lps[0], new_key[0], seen_row, seen_out,
                 [(c.kp, c.vp) for c in new_caches])
 
     # ------------------------------------------------------------- host
@@ -333,7 +364,7 @@ class PagedEngine:
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, seed: Optional[int] = None,
-               stop_sequences=None):
+               stop_sequences=None, repetition_penalty: float = 1.0):
         """temperature <= 0 keeps the bit-exact greedy path; a sampled
         request gets its own PRNG stream seeded by ``seed`` (default: a
         per-engine submission counter), so outputs are reproducible per
@@ -350,6 +381,8 @@ class PagedEngine:
                      for s in (stop_sequences or ()))
         if any(len(s) == 0 for s in stop):
             raise ValueError("empty stop sequence")
+        if repetition_penalty <= 0:
+            raise ValueError("repetition_penalty must be > 0")
         ids = list(np.asarray(input_ids).reshape(-1))
         total = len(ids) + max_new_tokens
         if total > self.M * self.B:
@@ -369,7 +402,8 @@ class PagedEngine:
         self.queue.append(_Request(request_id, ids, max_new_tokens,
                                    eos_token_id, float(temperature),
                                    int(top_k), float(top_p), key,
-                                   stop=stop))
+                                   stop=stop,
+                                   rep=float(repetition_penalty)))
 
     def _blocks_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.B - 1) // self.B
@@ -517,6 +551,7 @@ class PagedEngine:
         self.temps[slot_id] = req.temperature
         self.top_ks[slot_id] = req.top_k
         self.top_ps[slot_id] = req.top_p
+        self.reps[slot_id] = req.rep
         self.keys[slot_id] = req.key
 
         if self.chunk is not None:
@@ -525,6 +560,12 @@ class PagedEngine:
             # starting AFTER any shared-prefix tokens already in the pool
             req.prefill_pos = cached
             self.seq_lens[slot_id] = cached
+            # seed the seen mask with prefix-cache-skipped tokens (their
+            # chunks never run); later chunks scatter their own ids
+            seen0 = jnp.zeros((self.seen.shape[1],), bool)
+            if cached:
+                seen0 = seen0.at[np.asarray(ids[:cached])].set(True)
+            self.seen = self.seen.at[slot_id].set(seen0)
             return True
 
         bucket = next((b for b in self.prefill_buckets if b >= len(ids)),
@@ -535,11 +576,13 @@ class PagedEngine:
                 bucket *= 2
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(ids)] = ids
-        nxt, lp, new_key, self.pools = self._prefill_jit(
+        nxt, lp, new_key, seen_row, self.pools = self._prefill_jit(
             self.params, self.pools, jnp.asarray(row),
             jnp.asarray(padded), np.int32(len(ids)),
             jnp.asarray(req.key), np.float32(req.temperature),
-            np.int32(req.top_k), np.float32(req.top_p), bucket=bucket)
+            np.int32(req.top_k), np.float32(req.top_p),
+            np.float32(req.rep), bucket=bucket)
+        self.seen = self.seen.at[slot_id].set(seen_row)
         self.stats["prefills"] += 1
         first = int(nxt)
         self.keys[slot_id] = np.asarray(new_key)
@@ -566,15 +609,20 @@ class PagedEngine:
         padded = np.zeros((1, self.chunk), np.int32)
         padded[0, :live] = ids[start:start + live]
         row = self.block_tables[slot_id]
-        nxt, lp, new_key, self.pools = self._chunk_jit(
+        nxt, lp, new_key, seen_mid, seen_fin, self.pools = self._chunk_jit(
             self.params, self.pools, jnp.asarray(row),
             jnp.asarray(padded), np.int32(start),
             np.int32(start + live), jnp.asarray(req.key),
             np.float32(req.temperature), np.int32(req.top_k),
-            np.float32(req.top_p), bucket=self.chunk)
+            np.float32(req.top_p), np.float32(req.rep),
+            self.seen[slot_id], bucket=self.chunk)
         self.stats["prefill_chunks"] += 1
         req.prefill_pos = start + live
         self.seq_lens[slot_id] = req.prefill_pos
+        # mid chunks keep the ids-only mask; the final chunk's committed
+        # sample rides in seen_fin (mirrors the PRNG-key protocol)
+        self.seen = self.seen.at[slot_id].set(seen_fin if last
+                                              else seen_mid)
         if last:
             self.stats["prefills"] += 1
             self._register_prefix(req)
@@ -638,6 +686,8 @@ class PagedEngine:
         self.temps[slot_id] = 0.0
         self.top_ks[slot_id] = 0
         self.top_ps[slot_id] = 1.0
+        self.reps[slot_id] = 1.0
+        self.seen = self.seen.at[slot_id].set(False)
         self.slots[slot_id] = None
 
     def _preempt_youngest(self, exclude: int) -> bool:
@@ -663,7 +713,7 @@ class PagedEngine:
                             s.key.copy(),
                             prefix=s.prefix + s.tokens,
                             prefix_lps=s.prefix_lps + s.lps,
-                            stop=s.stop)
+                            stop=s.stop, rep=s.rep)
         self.queue.insert(0, requeued)
         self._release(victim)
         self.stats["preemptions"] += 1
@@ -696,17 +746,21 @@ class PagedEngine:
         last = np.zeros((self.R,), np.int32)
         for i in active:
             last[i] = self.slots[i].tokens[-1]
+        act_mask = np.zeros((self.R,), bool)
+        act_mask[active] = True
         if np.all(self.temps[active] <= 0.0):
             # all-greedy tick: the argmax-only executable
-            nxt, lps, self.pools = self._decode_greedy_jit(
+            nxt, lps, self.seen, self.pools = self._decode_greedy_jit(
                 self.params, self.pools, jnp.asarray(self.block_tables),
-                jnp.asarray(self.seq_lens), jnp.asarray(last))
+                jnp.asarray(self.seq_lens), jnp.asarray(last),
+                self.seen, jnp.asarray(self.reps), jnp.asarray(act_mask))
         else:
-            nxt, lps, new_keys, self.pools = self._decode_jit(
+            nxt, lps, new_keys, self.seen, self.pools = self._decode_jit(
                 self.params, self.pools, jnp.asarray(self.block_tables),
                 jnp.asarray(self.seq_lens), jnp.asarray(last),
                 jnp.asarray(self.keys), jnp.asarray(self.temps),
-                jnp.asarray(self.top_ks), jnp.asarray(self.top_ps))
+                jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
+                self.seen, jnp.asarray(self.reps), jnp.asarray(act_mask))
             self.keys = np.array(new_keys)  # copy: jax views read-only
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)

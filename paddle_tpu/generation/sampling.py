@@ -133,3 +133,14 @@ def suffix_window_hits(seq, cur, g):
                        0, L - 1)]                           # [L, g]
     hit = jnp.all(win == last[None, :], axis=1)
     return hit & (starts <= cur - g - 1) & (cur >= g)
+
+
+def repetition_penalty_rows(logits, seen, penalties):
+    """Per-ROW repetition penalty for continuous batching: logits
+    [R, V], seen [R, V] bool membership of each row's running sequence,
+    penalties [R] (1.0 = off). Rows at 1.0 pass through BIT-exactly
+    (jnp.where with a false mask), preserving the engine's greedy
+    exactness guarantee."""
+    p = jnp.asarray(penalties, jnp.float32)[:, None]
+    pen = jnp.where(logits > 0, logits / p, logits * p)
+    return jnp.where(seen & (p != 1.0), pen, logits)
