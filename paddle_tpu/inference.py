@@ -125,9 +125,10 @@ class Predictor:
         request at the queue head can delay the ones behind it — size
         the pool for the large case). Greedy by default — exact per
         request vs ``generate``; ``sampling`` maps request_id -> dict
-        of per-request overrides (temperature/top_k/top_p/seed), and
-        chosen-token logprobs land in ``self.last_logprobs``. Returns
-        request_id -> generated ids.
+        of per-request overrides (temperature / top_k / top_p / seed /
+        repetition_penalty / stop_sequences), and chosen-token logprobs
+        land in ``self.last_logprobs``. Returns request_id ->
+        generated ids.
 
         The engine (pools + compiled prefill/decode executables) is
         cached per ``engine_kw`` shape, so repeated calls pay no
