@@ -789,3 +789,42 @@ class PagedEngine:
         while self.queue or any(s is not None for s in self.slots):
             self.step()
         return dict(self.results)
+
+    def stream(self):
+        """Generator over (request_id, token) pairs in emission order:
+        each tick's newly generated tokens are yielded as they land
+        (token-streaming serving APIs). Requests with stop_sequences
+        hold back the last max-stop-length tokens until they finish, so
+        the consumer sees EXACTLY the tokens that end up in ``results``
+        (a yielded token is never retracted by the stop trim). Drives
+        the engine to drain; submits made during iteration join the
+        stream."""
+        emitted: Dict[Any, int] = {}
+        # results from BEFORE this call (engines are reused across
+        # serve_stream calls) must not replay into this stream
+        flushed = set(self.results)
+        while self.queue or any(s is not None for s in self.slots):
+            self.step()
+            for s in self.slots:
+                if s is None:
+                    continue
+                rid = s.request_id
+                hold = max((len(x) for x in s.stop), default=0)
+                n_pre = len(s.prefix)
+                start = emitted.get(rid, 0)
+                # yield only the [start, upto) window — no prefix+tokens
+                # concatenation per tick (cf. _stop_hit's O(1) note)
+                upto = max(n_pre + len(s.tokens) - hold, start)
+                for i in range(start, upto):
+                    yield (rid, s.prefix[i] if i < n_pre
+                           else s.tokens[i - n_pre])
+                emitted[rid] = upto
+            if len(self.results) > len(flushed):
+                # something finished this tick: flush the rest of its
+                # (stop-trimmed) final tokens. flushed only ever grows
+                # with results, so the length compare is exact and the
+                # set difference runs only on finishing ticks.
+                for rid in set(self.results) - flushed:
+                    for t in self.results[rid][emitted.pop(rid, 0):]:
+                        yield (rid, t)
+                    flushed.add(rid)

@@ -629,3 +629,74 @@ class TestEngineRepetitionPenalty:
             np.testing.assert_array_equal(
                 np.asarray(out[rid]),
                 np.asarray(want)[0, ids.shape[1]:], err_msg=rid)
+
+
+class TestStreaming:
+    """stream(): tokens yielded as they land, exactly matching run()'s
+    results per request (stop trims never retract a yielded token)."""
+
+    def test_stream_matches_results(self, model):
+        eng = _engine(model)
+        rs = np.random.RandomState(70)
+        prompts = {f"r{i}": rs.randint(1, 256, (1, rs.randint(4, 12)))
+                   for i in range(5)}
+        for rid, ids in prompts.items():
+            eng.submit(rid, ids, max_new_tokens=10)
+        got = {}
+        order = []
+        for rid, tok in eng.stream():
+            got.setdefault(rid, []).append(tok)
+            order.append(rid)
+        for rid in prompts:
+            assert got[rid] == list(eng.results[rid]), rid
+            np.testing.assert_array_equal(
+                np.asarray(got[rid]),
+                _greedy_new(model, prompts[rid], 10), err_msg=rid)
+        # genuinely interleaved, not request-by-request
+        first_block = order[:len(prompts)]
+        assert len(set(first_block)) > 1, order[:10]
+
+    def test_stream_with_stop_never_retracts(self, model):
+        eng = _engine(model)
+        rs = np.random.RandomState(71)
+        ids = rs.randint(1, 256, (1, 8))
+        full = _greedy_new(model, ids, 24).tolist()
+        stop = (full[4], full[5])
+        eng.submit("s", ids, max_new_tokens=24, stop_sequences=[stop])
+        got = [t for rid, t in eng.stream()]
+        assert got == list(eng.results["s"]), (got, eng.results["s"])
+
+    def test_stream_mid_iteration_submit(self, model):
+        eng = _engine(model, max_slots=2)
+        rs = np.random.RandomState(72)
+        a = rs.randint(1, 256, (1, 6))
+        eng.submit("a", a, max_new_tokens=8)
+        got = {}
+        submitted_b = False
+        b = rs.randint(1, 256, (1, 7))
+        for rid, tok in eng.stream():
+            got.setdefault(rid, []).append(tok)
+            if not submitted_b and len(got.get("a", [])) >= 3:
+                eng.submit("b", b, max_new_tokens=6)
+                submitted_b = True
+        np.testing.assert_array_equal(np.asarray(got["a"]),
+                                      _greedy_new(model, a, 8))
+        np.testing.assert_array_equal(np.asarray(got["b"]),
+                                      _greedy_new(model, b, 6))
+
+    def test_stream_on_reused_engine_no_replay(self, model):
+        """Review r5: a prior run()'s results must not replay into a
+        later stream() on the same engine."""
+        eng = _engine(model)
+        rs = np.random.RandomState(73)
+        a = rs.randint(1, 256, (1, 6))
+        eng.submit("a", a, max_new_tokens=6)
+        eng.run()
+        b = rs.randint(1, 256, (1, 7))
+        eng.submit("b", b, max_new_tokens=6)
+        got = {}
+        for rid, tok in eng.stream():
+            got.setdefault(rid, []).append(tok)
+        assert set(got) == {"b"}, got.keys()
+        np.testing.assert_array_equal(np.asarray(got["b"]),
+                                      _greedy_new(model, b, 6))
