@@ -83,12 +83,13 @@ def generate(model, input_ids, config: Optional[GenerationConfig] = None,
     if cfg.no_repeat_ngram_size < 0:
         raise ValueError("no_repeat_ngram_size must be >= 0")
     if cfg.num_beams > 1:
-        if cfg.repetition_penalty != 1.0 or cfg.min_new_tokens > 0 \
-                or cfg.no_repeat_ngram_size > 0:
+        if prompt_start is not None:
+            # beam_search neither masks pad-prefix attention (attn_start)
+            # nor excludes pads from the processors' seen/ngram windows;
+            # running it on a left-padded batch would be silently wrong
             raise NotImplementedError(
-                "repetition_penalty / min_new_tokens / no_repeat_ngram"
-                "_size are not applied in beam search yet; silently "
-                "ignoring them would return wrong beams")
+                "beam search does not support left-padded prompt_start "
+                "batches; pass right-aligned prompts (per row) instead")
         return beam_search(model, input_ids, cfg, params=params)
     key = key if key is not None else jax.random.key(0)
     fn, model_params = model.functional()
@@ -114,15 +115,16 @@ def generate(model, input_ids, config: Optional[GenerationConfig] = None,
     return run(*args)
 
 
-def _build_generate_fn(model, fn, cfg, b, prompt_len, has_start):
-    total = prompt_len + cfg.max_new_tokens
+def _logits_processors(cfg, vocab):
+    """ONE implementation of the decode-time logits processors
+    (repetition penalty, no-repeat-ngram bans, min-new-tokens eos
+    suppression), shared by the greedy while_loop and beam search.
+    Returns ``process(raw, seen, n_generated, tokens, cur, row_starts)``
+    operating on [N, V] fp32 logits for N rows (batch rows or b*beams);
+    every knob compiles away when off (static flags)."""
     eos = cfg.eos_token_id
     use_rep = cfg.repetition_penalty != 1.0
     ngram = int(cfg.no_repeat_ngram_size)
-    if use_rep or ngram:  # only these paths need a vocab size off the
-        # config — the plain contract (init_kv_caches + forward) stays
-        # sufficient otherwise
-        vocab = model.config.vocab_size
 
     def banned_ngram(tokens_row, cur, row_start):
         """[V] mask of tokens that would complete an ``ngram``-gram
@@ -139,26 +141,46 @@ def _build_generate_fn(model, fn, cfg, b, prompt_len, has_start):
         follow = tokens_row[jnp.clip(starts + g, 0, L - 1)]
         return jnp.zeros((vocab,), bool).at[follow].max(hit)
 
-    def adjust(row_logits, seen, n_generated, tokens=None, cur=None,
-               row_starts=None):
-        """Logits processors on one step's [b, V] row: repetition
-        penalty over the seen-token counts, no-repeat-ngram bans, eos
-        suppression below min_new_tokens. All compile away when off
-        (static flags)."""
+    def process(raw, seen, n_generated, tokens=None, cur=None,
+                row_starts=None):
         if use_rep:
-            row_logits = repetition_penalty(row_logits, seen,
-                                            cfg.repetition_penalty)
+            raw = repetition_penalty(raw, seen, cfg.repetition_penalty)
         if ngram:
             ban = jax.vmap(
                 banned_ngram,
                 in_axes=(0, None, 0 if row_starts is not None else None))(
                 tokens, cur, row_starts)
-            row_logits = jnp.where(ban, -1e30, row_logits)
+            raw = jnp.where(ban, -1e30, raw)
         if eos is not None and cfg.min_new_tokens > 0:
             suppress = n_generated < cfg.min_new_tokens
-            is_eos = (jnp.arange(row_logits.shape[-1]) == eos)[None, :]
-            row_logits = jnp.where(is_eos & suppress, -1e30, row_logits)
-        return row_logits
+            is_eos = (jnp.arange(raw.shape[-1]) == eos)[None, :]
+            raw = jnp.where(is_eos & suppress, -1e30, raw)
+        return raw
+
+    return process
+
+
+def _build_generate_fn(model, fn, cfg, b, prompt_len, has_start):
+    total = prompt_len + cfg.max_new_tokens
+    eos = cfg.eos_token_id
+    use_rep = cfg.repetition_penalty != 1.0
+    ngram = int(cfg.no_repeat_ngram_size)
+    if use_rep or ngram:  # only these paths need a vocab size off the
+        # config — the plain contract (init_kv_caches + forward) stays
+        # sufficient otherwise
+        vocab = model.config.vocab_size
+        _process = _logits_processors(cfg, vocab)
+    elif eos is not None and cfg.min_new_tokens > 0:
+        _process = _logits_processors(cfg, None)
+    else:
+        _process = None
+
+    def adjust(row_logits, seen, n_generated, tokens=None, cur=None,
+               row_starts=None):
+        if _process is None:
+            return row_logits
+        return _process(row_logits, seen, n_generated, tokens=tokens,
+                        cur=cur, row_starts=row_starts)
 
     @jax.jit
     def run(params, input_ids, key, temperature, *start):
@@ -237,7 +259,11 @@ def _build_generate_fn(model, fn, cfg, b, prompt_len, has_start):
 def beam_search(model, input_ids, config: GenerationConfig, params=None):
     """Beam search (reference: PaddleNLP BeamSearchScorer). Beams live as an
     expanded batch [b*beams]; the KV cache is gathered per step with the
-    beam indices — static shapes throughout."""
+    beam indices — static shapes throughout. The logits processors
+    (repetition_penalty / min_new_tokens / no_repeat_ngram_size) run on
+    each beam's raw logits before log_softmax, and the final beam is
+    picked by ``score / length**length_penalty`` (HF convention; with
+    no eos all beams share one length, so the default is unchanged)."""
     cfg = config
     k = cfg.num_beams
     fn, model_params = model.functional()
@@ -246,37 +272,57 @@ def beam_search(model, input_ids, config: GenerationConfig, params=None):
     total = prompt_len + cfg.max_new_tokens
     eos = cfg.eos_token_id
     vocab = model.config.vocab_size
+    use_rep = cfg.repetition_penalty != 1.0
+    _proc = _logits_processors(cfg, vocab)
+
+    def process(raw, tokens, cur, seen):
+        """Per-beam logits processors on [b*k, V] raw fp32 logits (the
+        shared _logits_processors implementation; beams are right-
+        aligned — generate() rejects prompt_start for beams)."""
+        return _proc(raw, seen, cur - prompt_len, tokens=tokens, cur=cur)
 
     @jax.jit
     def run(params, input_ids):
         # expand prompts to beams
         ids = jnp.repeat(input_ids, k, axis=0)              # [b*k, L]
+        rows = jnp.arange(b * k)
         caches = model.init_kv_caches(b * k, total)
         logits, caches = fn(params, ids, kv_caches=caches, cache_index=0)
-        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), -1)
-        logp = logp.reshape(b, k, vocab)
+        tokens = jnp.concatenate(
+            [ids, jnp.full((b * k, cfg.max_new_tokens), cfg.pad_token_id,
+                           ids.dtype)], axis=1)
+        if use_rep:
+            seen = jnp.zeros((b * k, vocab), bool) \
+                .at[rows[:, None], ids].set(True)
+        else:
+            seen = jnp.zeros((b * k, 1), bool)    # unused placeholder
+        raw = process(logits[:, -1].astype(jnp.float32), tokens,
+                      jnp.int32(prompt_len), seen)
+        logp = jax.nn.log_softmax(raw, -1).reshape(b, k, vocab)
         # first step: only beam 0 is live (identical beams would collapse)
         first_mask = jnp.where(jnp.arange(k)[None, :, None] == 0, 0.0, -jnp.inf)
         scores, idx = jax.lax.top_k((logp + first_mask).reshape(b, -1), k)
         beam_src, next_tok = idx // vocab, idx % vocab      # [b, k]
 
-        tokens = jnp.concatenate(
-            [ids, jnp.full((b * k, cfg.max_new_tokens), cfg.pad_token_id,
-                           ids.dtype)], axis=1)
-        tokens = tokens.at[:, prompt_len].set(next_tok.reshape(-1))
-        done = jnp.zeros((b, k), bool) if eos is None else (next_tok == eos)
-
         def gather_beams(tree, src):
             flat_src = (src + jnp.arange(b)[:, None] * k).reshape(-1)
             return jax.tree.map(lambda x: x[flat_src], tree)
 
+        seen = gather_beams(seen, beam_src)
+        tokens = tokens.at[:, prompt_len].set(next_tok.reshape(-1))
+        if use_rep:
+            seen = seen.at[rows, next_tok.reshape(-1)].set(True)
+        done = jnp.zeros((b, k), bool) if eos is None else (next_tok == eos)
+        n_gen = jnp.ones((b, k), jnp.int32)   # emitted tokens incl. eos
+
         def body(cur, state):
-            tokens, caches, scores, done = state
+            tokens, caches, scores, done, seen, n_gen = state
             ids_t = jax.lax.dynamic_slice_in_dim(tokens, cur - 1, 1, axis=1)
             logits, new_caches = fn(params, ids_t, kv_caches=caches,
                                     cache_index=cur - 1)
-            logp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32), -1)
-            logp = logp.reshape(b, k, vocab)
+            raw = process(logits[:, 0].astype(jnp.float32), tokens, cur,
+                          seen)
+            logp = jax.nn.log_softmax(raw, -1).reshape(b, k, vocab)
             # finished beams: freeze score, only pad continues
             pad_only = jnp.full((vocab,), -jnp.inf).at[cfg.pad_token_id].set(0.0)
             logp = jnp.where(done[..., None], pad_only[None, None], logp)
@@ -285,20 +331,28 @@ def beam_search(model, input_ids, config: GenerationConfig, params=None):
             beam_src, next_tok = idx // vocab, idx % vocab
             tokens = gather_beams(tokens, beam_src)
             caches = gather_beams(new_caches, beam_src)
+            seen = gather_beams(seen, beam_src)
             done = jnp.take_along_axis(done, beam_src, axis=1)
+            n_gen = jnp.take_along_axis(n_gen, beam_src, axis=1)
+            n_gen = n_gen + (~done).astype(jnp.int32)
             nxt = jnp.where(done, cfg.pad_token_id, next_tok)
+            if use_rep:
+                seen = seen.at[rows, nxt.reshape(-1)] \
+                    .max(~done.reshape(-1))
             tokens = jax.lax.dynamic_update_slice(
                 tokens, nxt.reshape(-1, 1), (0, cur))
             if eos is not None:
                 done = done | (nxt == eos)
-            return (tokens, caches, scores, done)
+            return (tokens, caches, scores, done, seen, n_gen)
 
-        state = (tokens, caches, scores, done)
+        state = (tokens, caches, scores, done, seen, n_gen)
         state = jax.lax.fori_loop(prompt_len + 1, total,
                                   lambda c, s: body(c, s), state)
-        tokens, _, scores, _ = state
-        # length penalty then best beam per batch row
-        best = jnp.argmax(scores, axis=1)
+        tokens, _, scores, _, _, n_gen = state
+        # HF-convention final ranking: sum-logprob / length^penalty
+        ranked = scores / (n_gen.astype(jnp.float32)
+                           ** jnp.float32(cfg.length_penalty))
+        best = jnp.argmax(ranked, axis=1)
         return tokens.reshape(b, k, total)[jnp.arange(b), best]
 
     return run(params, input_ids)

@@ -198,3 +198,75 @@ class TestLogitsProcessors:
         # a random-init greedy decode loops quickly; banning repeated
         # bigrams must break the loop
         assert not np.array_equal(np.asarray(base), np.asarray(cons))
+
+    def test_beam1_with_processors_equals_greedy(self, tmp_path):
+        """beam_search (CALLED DIRECTLY — generate() only routes there
+        for num_beams>1) at k=1 must reduce to the HF-parity-tested
+        greedy path under every processor: log_softmax is monotonic, so
+        the selections coincide exactly."""
+        from paddle_tpu.generation import GenerationConfig, beam_search
+        _, model = self._pair(tmp_path)
+        ids = np.random.RandomState(4).randint(1, 128, (2, 9))
+        for kw in ({"repetition_penalty": 1.4},
+                   {"no_repeat_ngram_size": 2},
+                   {"min_new_tokens": 5, "eos_token_id": 11}):
+            greedy = model.generate(jnp.asarray(ids), max_new_tokens=12,
+                                    temperature=0.0, **kw)
+            beam = beam_search(model, jnp.asarray(ids),
+                               GenerationConfig(max_new_tokens=12,
+                                                num_beams=1, **kw))
+            np.testing.assert_array_equal(np.asarray(greedy),
+                                          np.asarray(beam), err_msg=str(kw))
+
+    def test_beam4_processors_constraints_hold(self, tmp_path):
+        _, model = self._pair(tmp_path)
+        ids = np.random.RandomState(5).randint(1, 128, (1, 8))
+        out = model.generate(jnp.asarray(ids), max_new_tokens=16,
+                             num_beams=4, no_repeat_ngram_size=2)
+        r = np.asarray(out)[0]
+        grams = [g for g in zip(r[:-1].tolist(), r[1:].tolist())
+                 if 0 not in g]
+        assert len(grams) == len(set(grams)), grams
+        # min_new_tokens + eos: at least that many generated tokens
+        first = int(np.asarray(model.generate(
+            jnp.asarray(ids), max_new_tokens=1, temperature=0.0))[0, -1])
+        assert first != 0
+        out = model.generate(jnp.asarray(ids), max_new_tokens=12,
+                             num_beams=4, min_new_tokens=6,
+                             eos_token_id=first)
+        n = int((np.asarray(out)[0, 8:] != 0).sum())
+        assert n >= 6, n
+
+    def test_beam_length_penalty_is_applied(self, tmp_path):
+        """length_penalty was silently unused before round 5. Ranking is
+        score/len^penalty with NEGATIVE scores, so a larger penalty
+        lifts longer beams toward zero: for the SAME prompt, the
+        selected output's length must be monotonically non-decreasing
+        in the penalty, and strictly longer somewhere across seeds
+        (beams only differ in length when eos fires mid-beam)."""
+        _, model = self._pair(tmp_path)
+        rs = np.random.RandomState(6)
+        lengths = {0.05: [], 5.0: []}
+        for seed in range(6):
+            ids = rs.randint(1, 128, (1, 7))
+            eos = int(np.asarray(model.generate(
+                jnp.asarray(ids), max_new_tokens=3,
+                temperature=0.0))[0, -1])  # a token the model will emit
+            for lp in lengths:
+                out = model.generate(jnp.asarray(ids), max_new_tokens=12,
+                                     num_beams=4, eos_token_id=eos,
+                                     length_penalty=lp)
+                lengths[lp].append(int((np.asarray(out)[0, 7:] != 0).sum()))
+        assert all(a <= b for a, b in zip(lengths[0.05], lengths[5.0])), \
+            lengths
+        assert sum(lengths[5.0]) > sum(lengths[0.05]), lengths
+
+    def test_beam_rejects_left_padded_batches(self, tmp_path):
+        """beam_search has no attn_start masking and its processors
+        would count pad prefixes as content — loud error, not silently
+        wrong beams."""
+        _, model = self._pair(tmp_path)
+        ids = np.random.RandomState(7).randint(1, 128, (2, 8))
+        with pytest.raises(NotImplementedError, match="left-padded"):
+            model.generate(jnp.asarray(ids), max_new_tokens=4,
+                           num_beams=2, prompt_start=jnp.asarray([0, 2]))
