@@ -8,18 +8,50 @@ GSPMD-sharded arrays (zarr/tensorstore under the hood), saves are async
 (training continues while the write drains), and restore applies the
 *target* shardings — so a checkpoint written on one mesh restores onto
 another (elastic resume). `latest_complete_step` only ever reports fully
-committed saves, giving crash-safe auto-resume."""
+committed saves, giving crash-safe auto-resume.
+
+Integrity layer (chaos hardening): every committed step gets a manifest
+(`<dir>/manifests/<step>.json`) with per-file sha256 content checksums.
+`verify_step` recomputes them; `latest_complete_step` and `restore` skip
+or fall back past steps whose bytes no longer match what was written
+(bit rot, torn copies, a preemption mid-gc) instead of handing corrupt
+state to the trainer or crashing auto-resume. Steps without a manifest
+(pre-integrity checkpoints, or a crash between commit and manifest
+write) are trusted as before — verification is an added guarantee, not a
+new failure mode. The `ckpt_corrupt` fault site deterministically
+corrupts a just-committed step so the fallback path stays tier-1
+tested."""
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Dict, Optional
+import sys
+import threading
+from typing import Any, Dict, List, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+from ..utils import faults
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """Every on-disk checkpoint step failed checksum verification."""
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
 
 class DistributedCheckpoint:
     """CheckpointManager facade: save(step, state) / restore(step|latest)."""
+
+    MANIFEST_DIR = "manifests"
 
     def __init__(self, directory: str, max_to_keep: int = 5,
                  async_save: bool = True):
@@ -32,22 +64,197 @@ class DistributedCheckpoint:
                 enable_async_checkpointing=async_save,
             ),
         )
+        self._pending_manifest: set = set()
+        # verification memo: step -> (manifest mtime, verdict). Hashing
+        # a big checkpoint is seconds of wall clock; latest_complete_step
+        # followed by restore must not pay it twice. Keyed on the
+        # manifest's mtime so a rewritten manifest re-verifies.
+        self._verify_memo: Dict[int, tuple] = {}
+        self._manifest_thread: Optional[threading.Thread] = None
+        self.last_restored_step: Optional[int] = None
 
+    # ------------------------------------------------------------- paths
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, self.MANIFEST_DIR,
+                            f"{step}.json")
+
+    # --------------------------------------------------------- integrity
+    def _write_manifest(self, step: int):
+        """Checksum every file of a COMMITTED step dir; write the
+        manifest atomically (tmp + rename) so a crash mid-write leaves
+        either no manifest (step trusted) or a complete one."""
+        d = self._step_dir(step)
+        files: Dict[str, Dict[str, Any]] = {}
+        for root, _, names in os.walk(d):
+            for name in sorted(names):
+                p = os.path.join(root, name)
+                rel = os.path.relpath(p, d)
+                files[rel] = {"sha256": _sha256(p),
+                              "size": os.path.getsize(p)}
+        mdir = os.path.join(self.directory, self.MANIFEST_DIR)
+        os.makedirs(mdir, exist_ok=True)
+        tmp = self._manifest_path(step) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": step, "files": files}, f)
+        os.replace(tmp, self._manifest_path(step))
+        # chaos hook: corrupt the step AFTER its manifest committed, so
+        # verification sees exactly what bit rot would produce
+        if faults.inject("ckpt_corrupt", step=step):
+            self._corrupt_step(step)
+
+    def _corrupt_step(self, step: int):
+        """Deterministically flip bytes in the step's largest file."""
+        d = self._step_dir(step)
+        largest, size = None, -1
+        for root, _, names in os.walk(d):
+            for name in names:
+                p = os.path.join(root, name)
+                s = os.path.getsize(p)
+                if s > size:
+                    largest, size = p, s
+        if largest is None:
+            return
+        with open(largest, "r+b") as f:
+            f.seek(size // 2)
+            chunk = f.read(16) or b"\0"
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+
+    def _finalize_manifests(self):
+        """Write manifests for saves that have committed since the last
+        call (async saves commit in the background; a manifest must only
+        hash final bytes). Also drops manifests whose step was evicted
+        by max_to_keep."""
+        committed = set(self._mgr.all_steps())
+        for step in sorted(self._pending_manifest & committed):
+            try:
+                self._write_manifest(step)
+            except OSError as e:  # manifest is best-effort, never fatal
+                print(f"[ckpt] manifest for step {step} failed: {e}",
+                      file=sys.stderr, flush=True)
+            self._pending_manifest.discard(step)
+        mdir = os.path.join(self.directory, self.MANIFEST_DIR)
+        if os.path.isdir(mdir):
+            for name in os.listdir(mdir):
+                stem = name.split(".")[0]
+                if stem.isdigit() and int(stem) not in committed \
+                        and int(stem) not in self._pending_manifest:
+                    try:
+                        os.remove(os.path.join(mdir, name))
+                    except OSError:
+                        pass
+
+    def verify_step(self, step: int) -> Optional[bool]:
+        """True = checksums match; False = corruption detected; None =
+        no manifest (pre-integrity checkpoint — trusted). Verdicts are
+        memoized per manifest mtime (re-hashing multi-GB steps on every
+        latest_complete_step/restore would stall the caller)."""
+        self._join_manifest_thread()
+        mpath = self._manifest_path(step)
+        if not os.path.exists(mpath):
+            self._verify_memo.pop(step, None)
+            return None
+        mtime = os.path.getmtime(mpath)
+        memo = self._verify_memo.get(step)
+        if memo is not None and memo[0] == mtime:
+            return memo[1]
+        verdict = self._verify_step_uncached(step)
+        self._verify_memo[step] = (mtime, verdict)
+        return verdict
+
+    def _verify_step_uncached(self, step: int) -> Optional[bool]:
+        mpath = self._manifest_path(step)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return None  # unreadable manifest: treat as absent
+        d = self._step_dir(step)
+        for rel, info in manifest.get("files", {}).items():
+            p = os.path.join(d, rel)
+            if not os.path.exists(p) \
+                    or os.path.getsize(p) != info["size"] \
+                    or _sha256(p) != info["sha256"]:
+                return False
+        return True
+
+    def _join_manifest_thread(self):
+        t = self._manifest_thread
+        if t is not None:
+            t.join()
+            self._manifest_thread = None
+
+    # ------------------------------------------------------------ save
     def save(self, step: int, state: Dict[str, Any], wait: bool = False):
         """Async by default: returns as soon as the device->host copy is
-        done; the write drains in the background."""
+        done; the write drains in the background. The integrity manifest
+        (which re-reads and hashes the committed files — seconds for a
+        big checkpoint) is written off-thread on the async path so the
+        training loop never stalls on it; ``wait=True`` makes both the
+        orbax write and the manifest durable before returning."""
         self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._pending_manifest.add(step)
+        self._join_manifest_thread()
         if wait:
             self._mgr.wait_until_finished()
+            self._finalize_manifests()
+        else:
+            self._manifest_thread = threading.Thread(
+                target=self._finalize_manifests, daemon=True)
+            self._manifest_thread.start()
 
+    # --------------------------------------------------------- restore
     def restore(self, step: Optional[int] = None,
-                like: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-        """Restore `step` (default: latest complete). `like` provides the
-        target structure/shardings (abstract arrays ok) — restoring onto a
-        different mesh re-shards on the fly."""
-        step = step if step is not None else self.latest_complete_step()
+                like: Optional[Dict[str, Any]] = None,
+                strict: bool = False) -> Dict[str, Any]:
+        """Restore `step` (default: latest complete+verified). `like`
+        provides the target structure/shardings (abstract arrays ok) —
+        restoring onto a different mesh re-shards on the fly.
+
+        If the requested step fails checksum verification, fall back to
+        the next older step that verifies (warning on stderr) instead of
+        handing corrupt state to the caller; the step actually loaded is
+        recorded in ``last_restored_step`` — check it whenever the exact
+        step matters. ``strict=True`` disables the fallback for an
+        explicitly requested step (eval/debug: wrong-step weights would
+        silently invalidate results) and raises
+        CheckpointCorruptionError instead; with no verified step at all
+        the same error is raised either way."""
+        self._join_manifest_thread()
+        self._finalize_manifests()
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(
+                f"no complete checkpoint in {self.directory}")
         if step is None:
-            raise FileNotFoundError(f"no complete checkpoint in {self.directory}")
+            candidates = steps
+        elif step in steps:
+            candidates = [step] + [s for s in steps if s < step]
+        else:
+            raise FileNotFoundError(
+                f"no complete checkpoint for step {step} in "
+                f"{self.directory}")
+        for s in candidates:
+            if self.verify_step(s) is False:
+                if strict and step is not None:
+                    raise CheckpointCorruptionError(
+                        f"checkpoint step {s} failed checksum "
+                        f"verification (strict restore)")
+                print(f"[ckpt] step {s} failed checksum verification; "
+                      f"falling back to an older checkpoint",
+                      file=sys.stderr, flush=True)
+                continue
+            out = self._restore_step(s, like)
+            self.last_restored_step = s
+            return out
+        raise CheckpointCorruptionError(
+            f"every checkpoint step in {self.directory} failed checksum "
+            f"verification ({candidates})")
+
+    def _restore_step(self, step: int, like):
         if like is not None:
             abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
             return self._mgr.restore(step,
@@ -55,22 +262,34 @@ class DistributedCheckpoint:
         return self._mgr.restore(step)
 
     def latest_complete_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        """Latest step that is both committed AND passes checksum
+        verification — auto-resume never lands on a corrupt latest."""
+        self._join_manifest_thread()
+        self._finalize_manifests()
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            if self.verify_step(step) is not False:
+                return step
+        return None
 
     def all_steps(self):
         return list(self._mgr.all_steps())
 
     def wait_until_finished(self):
         self._mgr.wait_until_finished()
+        self._join_manifest_thread()
+        self._finalize_manifests()
 
     def close(self):
         self._mgr.wait_until_finished()
+        self._join_manifest_thread()
+        self._finalize_manifests()
         self._mgr.close()
 
 
 def auto_resume(directory: str, state: Dict[str, Any]):
-    """(state, start_step): restore the latest complete checkpoint if one
-    exists, else return the passed-in initial state (reference: PaddleNLP
+    """(state, start_step): restore the latest complete (and verified —
+    a corrupt latest is skipped, not fatal) checkpoint if one exists,
+    else return the passed-in initial state (reference: PaddleNLP
     Trainer's resume_from_checkpoint=True behavior)."""
     ckpt = DistributedCheckpoint(directory)
     step = ckpt.latest_complete_step()

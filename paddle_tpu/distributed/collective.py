@@ -16,6 +16,7 @@ There are no process groups: a "group" is a mesh axis name.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Sequence, Union
 
 import jax
@@ -23,9 +24,23 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..utils import faults
+from ..utils.faults import retry_with_backoff
 from .env import get_mesh
 
 AxisName = Union[str, Sequence[str]]
+
+
+class CollectiveError(RuntimeError):
+    """A transient collective failure (flaky ICI/DCN link, preempted
+    peer, or the injected `collective_fail` fault). Retryable — the
+    eager wrappers re-run the collective under retry_with_backoff."""
+
+
+def _collective_retries() -> int:
+    """Total attempts per eager collective (so '3' = 2 actual retries);
+    0/negative clamps to 1 = run once, no retry."""
+    return max(1, int(os.environ.get("PADDLE_TPU_COLLECTIVE_RETRIES", "3")))
 
 
 class ReduceOp:
@@ -82,17 +97,44 @@ def axis_index(group: AxisName):
 
 
 def axis_size(group: AxisName):
-    return lax.axis_size(group)
+    from ..utils.jax_compat import axis_size as _axis_size
+    return _axis_size(group)
 
 
 # ------------------------------------------------------------ eager facades
 def _eager(fn, x, group, out_spec=None, in_spec=None):
-    from jax import shard_map
+    from ..utils.jax_compat import shard_map
     mesh = get_mesh()
     in_spec = in_spec if in_spec is not None else P(group)
     out_spec = out_spec if out_spec is not None else in_spec
-    return shard_map(fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
-                     check_vma=False)(x)
+
+    def attempt():
+        # chaos: a transient link failure surfaces BEFORE the collective
+        # runs (the XLA program either runs whole or not at all) — the
+        # retry below is the recovery contract for both the injected
+        # and the real case
+        if faults.inject("collective_fail", group=str(group)):
+            raise CollectiveError(
+                f"injected transient collective failure on axis {group!r}")
+        out = shard_map(fn, mesh=mesh, in_specs=(in_spec,),
+                        out_specs=out_spec, check_vma=False)(x)
+        # materialize INSIDE the attempt: jax dispatch is async, so an
+        # execution-time failure would otherwise surface at the caller's
+        # first read, past the retry. Eager collectives are semantically
+        # synchronous anyway.
+        return jax.block_until_ready(out)
+
+    # retry real runtime failures too, not just the injected kind: a
+    # flaky link surfaces as JaxRuntimeError. Deterministic errors
+    # (compile bugs) cost two pointless short retries, then propagate
+    # with their ORIGINAL type — retry_with_backoff re-raises as-is.
+    retryable = (CollectiveError,)
+    jax_rt = getattr(jax.errors, "JaxRuntimeError", None)
+    if jax_rt is not None:
+        retryable += (jax_rt,)
+    return retry_with_backoff(attempt, max_attempts=_collective_retries(),
+                              base_delay=0.05, max_delay=2.0,
+                              retryable=retryable)
 
 
 def eager_all_reduce(x, op: str = ReduceOp.SUM, group: str = "dp"):

@@ -14,17 +14,30 @@ from __future__ import annotations
 
 import subprocess
 import sys
-import time
 from typing import List, Optional, Sequence
 
+from ..utils.faults import retry_with_backoff
+
 __all__ = ["supervise"]
+
+
+class _RestartableExit(RuntimeError):
+    """Child exited with a relaunch-worthy code (retry_with_backoff's
+    retryable filter keys on this)."""
+
+    def __init__(self, rc: int):
+        super().__init__(f"restartable child exit rc={rc}")
+        self.rc = rc
 
 
 def supervise(argv: Sequence[str], max_restarts: int = 3,
               backoff_s: float = 1.0,
               restart_codes: Optional[Sequence[int]] = None,
               timeout_s: Optional[float] = None) -> int:
-    """Run ``argv`` as a subprocess; relaunch on failure.
+    """Run ``argv`` as a subprocess; relaunch on failure with jittered
+    exponential backoff (the shared utils.faults.retry_with_backoff —
+    ``backoff_s`` seeds the base delay, doubling per consecutive
+    failure so a crash-looping job doesn't hammer the scheduler).
 
     restart_codes: exit codes that trigger a relaunch (None = any
     non-zero, plus death-by-signal). Returns the final exit code (0 on
@@ -32,8 +45,7 @@ def supervise(argv: Sequence[str], max_restarts: int = 3,
     checkpoint via the Trainer's own auto-resume — the supervisor carries
     no training state.
     """
-    attempts = 0
-    while True:
+    def attempt() -> int:
         try:
             proc = subprocess.run(list(argv), timeout=timeout_s)
             rc = proc.returncode
@@ -45,12 +57,23 @@ def supervise(argv: Sequence[str], max_restarts: int = 3,
             return 0
         restartable = (restart_codes is None) or (rc in restart_codes) \
             or rc < 0 or rc == 124  # negative = killed by signal
-        attempts += 1
-        if not restartable or attempts > max_restarts:
-            return rc
-        print(f"[elastic] attempt {attempts}/{max_restarts}: rc={rc}; "
-              f"relaunching in {backoff_s:.1f}s", file=sys.stderr, flush=True)
-        time.sleep(backoff_s)
+        if restartable:
+            raise _RestartableExit(rc)
+        return rc
+
+    def on_retry(exc, attempt_no, delay):
+        print(f"[elastic] attempt {attempt_no}/{max_restarts + 1}: "
+              f"rc={exc.rc}; relaunching in {delay:.1f}s",
+              file=sys.stderr, flush=True)
+
+    try:
+        return retry_with_backoff(attempt, max_attempts=max_restarts + 1,
+                                  base_delay=backoff_s, factor=2.0,
+                                  max_delay=max(backoff_s, 60.0),
+                                  retryable=(_RestartableExit,),
+                                  on_retry=on_retry)
+    except _RestartableExit as e:
+        return e.rc
 
 
 def main(args: Optional[List[str]] = None) -> int:

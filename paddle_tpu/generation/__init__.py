@@ -82,6 +82,10 @@ def generate(model, input_ids, config: Optional[GenerationConfig] = None,
         cfg = dataclasses.replace(cfg, **kwargs)
     if cfg.no_repeat_ngram_size < 0:
         raise ValueError("no_repeat_ngram_size must be >= 0")
+    if cfg.repetition_penalty <= 0:
+        # mirrors PagedEngine.submit: a zero/negative penalty silently
+        # divides by zero or flips the penalty's sign semantics
+        raise ValueError("repetition_penalty must be > 0")
     if cfg.num_beams > 1:
         if prompt_start is not None:
             # beam_search neither masks pad-prefix attention (attn_start)
@@ -313,7 +317,12 @@ def beam_search(model, input_ids, config: GenerationConfig, params=None):
         if use_rep:
             seen = seen.at[rows, next_tok.reshape(-1)].set(True)
         done = jnp.zeros((b, k), bool) if eos is None else (next_tok == eos)
-        n_gen = jnp.ones((b, k), jnp.int32)   # emitted tokens incl. eos
+        # generated length per beam EXCLUDING the terminating eos — HF's
+        # BeamHypotheses.add ranks by generated_len, which does not count
+        # the eos being processed (an eos-first beam would be length 0;
+        # the final ranking clamps to 1 to keep the score finite)
+        n_gen = jnp.ones((b, k), jnp.int32) if eos is None \
+            else (~done).astype(jnp.int32)
 
         def body(cur, state):
             tokens, caches, scores, done, seen, n_gen = state
@@ -334,7 +343,10 @@ def beam_search(model, input_ids, config: GenerationConfig, params=None):
             seen = gather_beams(seen, beam_src)
             done = jnp.take_along_axis(done, beam_src, axis=1)
             n_gen = jnp.take_along_axis(n_gen, beam_src, axis=1)
-            n_gen = n_gen + (~done).astype(jnp.int32)
+            # count live continuations only; the step a beam emits eos
+            # adds nothing (HF's generated_len excludes that eos)
+            live = ~done if eos is None else ~done & (next_tok != eos)
+            n_gen = n_gen + live.astype(jnp.int32)
             nxt = jnp.where(done, cfg.pad_token_id, next_tok)
             if use_rep:
                 seen = seen.at[rows, nxt.reshape(-1)] \
@@ -349,8 +361,10 @@ def beam_search(model, input_ids, config: GenerationConfig, params=None):
         state = jax.lax.fori_loop(prompt_len + 1, total,
                                   lambda c, s: body(c, s), state)
         tokens, _, scores, _, _, n_gen = state
-        # HF-convention final ranking: sum-logprob / length^penalty
-        ranked = scores / (n_gen.astype(jnp.float32)
+        # HF-convention final ranking: sum-logprob / generated_len^penalty
+        # (eos excluded from the length; clamped to 1 for the degenerate
+        # eos-as-first-token beam)
+        ranked = scores / (jnp.maximum(n_gen, 1).astype(jnp.float32)
                            ** jnp.float32(cfg.length_penalty))
         best = jnp.argmax(ranked, axis=1)
         return tokens.reshape(b, k, total)[jnp.arange(b), best]

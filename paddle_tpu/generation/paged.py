@@ -26,11 +26,14 @@ block 0) so they can never corrupt a live block; it is never allocated.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..utils.faults import BackpressureError
 
 __all__ = ["PagedKV", "PagedEngine"]
 
@@ -148,11 +151,11 @@ class _Request:
     __slots__ = ("request_id", "prompt", "max_new", "eos", "tokens",
                  "blocks", "prefix", "prefix_lps", "admit_seq",
                  "temperature", "top_k", "top_p", "key", "lps",
-                 "prefill_pos", "stop", "trim", "rep")
+                 "prefill_pos", "stop", "trim", "rep", "deadline")
 
     def __init__(self, request_id, prompt, max_new, eos, temperature,
                  top_k, top_p, key, prefix=None, prefix_lps=None,
-                 stop=(), rep=1.0):
+                 stop=(), rep=1.0, deadline=None):
         self.request_id = request_id
         self.prompt = prompt            # ids the prefill runs over
         self.max_new = max_new          # tokens still to emit
@@ -164,6 +167,7 @@ class _Request:
         self.stop = stop                # token-id stop sequences
         self.trim = 0                   # matched stop length to cut
         self.rep = rep                  # repetition penalty (1.0 = off)
+        self.deadline = deadline        # monotonic() cutoff (None = no cap)
         self.prefix = prefix or []      # tokens emitted before preemption
         self.prefix_lps = prefix_lps or []
         self.admit_seq = 0              # preemption picks the youngest
@@ -188,7 +192,9 @@ class PagedEngine:
                  block_size: int = 16, max_blocks_per_seq: int = 16,
                  prefill_buckets=(32, 64, 128),
                  chunk_prefill_tokens: Optional[int] = None,
-                 enable_prefix_cache: bool = False):
+                 enable_prefix_cache: bool = False,
+                 max_queue: Optional[int] = None,
+                 default_timeout_s: Optional[float] = None):
         cfg = model.config
         self.model = model
         self.fn, self.params = model.functional()
@@ -248,12 +254,19 @@ class PagedEngine:
         self.queue: List[_Request] = []
         self.results: Dict[Any, List[int]] = {}
         self.logprobs: Dict[Any, List[float]] = {}
+        # overload protection (chaos hardening): bounded admission queue
+        # + per-request deadlines; aborted requests land here, keyed by
+        # request_id, with the reason ("timeout" / "cancelled")
+        self.max_queue = max_queue
+        self.default_timeout_s = default_timeout_s
+        self.cancelled: Dict[Any, str] = {}
         self._admit_counter = 0
         self._submit_counter = 0
         self.stats = {"decode_steps": 0, "prefills": 0, "preemptions": 0,
                       "prefill_chunks": 0, "slot_steps": 0,
                       "active_slot_steps": 0, "prefix_hit_tokens": 0,
-                      "prefix_adopted_blocks": 0}
+                      "prefix_adopted_blocks": 0, "timeouts": 0,
+                      "cancellations": 0, "rejected": 0}
         # pools (and the seen masks) are donated: XLA aliases input to
         # output so a decode step costs one scatter, not a full copy
         self._decode_jit = jax.jit(self._decode_step,
@@ -364,7 +377,8 @@ class PagedEngine:
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, seed: Optional[int] = None,
-               stop_sequences=None, repetition_penalty: float = 1.0):
+               stop_sequences=None, repetition_penalty: float = 1.0,
+               timeout_s: Optional[float] = None):
         """temperature <= 0 keeps the bit-exact greedy path; a sampled
         request gets its own PRNG stream seeded by ``seed`` (default: a
         per-engine submission counter), so outputs are reproducible per
@@ -374,7 +388,23 @@ class PagedEngine:
         moment the GENERATED stream ends with one; the matched sequence
         is trimmed from the returned tokens (vLLM's stop semantics).
         Matching is host-side bookkeeping — the jitted step is
-        untouched."""
+        untouched.
+
+        Admission is bounded: with ``max_queue`` set, a submit past
+        capacity raises BackpressureError instead of growing the
+        backlog. ``timeout_s`` (default: the engine's
+        ``default_timeout_s``) caps the request's wall-clock lifetime;
+        an expired request is aborted at the next tick and recorded in
+        ``self.cancelled`` with reason "timeout"."""
+        if self.max_queue is not None:
+            # reap already-dead queued requests first: capacity held by
+            # expired work must not reject a live submit
+            self._expire()
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            self.stats["rejected"] += 1
+            raise BackpressureError(
+                f"engine admission queue at capacity ({self.max_queue} "
+                f"queued); shed load or retry with backoff")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         stop = tuple(tuple(int(t) for t in s)
@@ -399,11 +429,16 @@ class PagedEngine:
             seed = self._submit_counter
         key = np.asarray(jax.random.key_data(jax.random.PRNGKey(seed)),
                          np.uint32)
+        timeout_s = timeout_s if timeout_s is not None \
+            else self.default_timeout_s
+        deadline = (time.monotonic() + timeout_s) \
+            if timeout_s is not None else None
         self.queue.append(_Request(request_id, ids, max_new_tokens,
                                    eos_token_id, float(temperature),
                                    int(top_k), float(top_p), key,
                                    stop=stop,
-                                   rep=float(repetition_penalty)))
+                                   rep=float(repetition_penalty),
+                                   deadline=deadline))
 
     def _blocks_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.B - 1) // self.B
@@ -713,16 +748,89 @@ class PagedEngine:
                             s.key.copy(),
                             prefix=s.prefix + s.tokens,
                             prefix_lps=s.prefix_lps + s.lps,
-                            stop=s.stop, rep=s.rep)
+                            stop=s.stop, rep=s.rep, deadline=s.deadline)
         self.queue.insert(0, requeued)
         self._release(victim)
         self.stats["preemptions"] += 1
         return True
 
+    # -------------------------------------------------- overload control
+    def _abort(self, req: "_Request", reason: str,
+               slot_id: Optional[int] = None):
+        self.cancelled[req.request_id] = reason
+        self.stats["timeouts" if reason == "timeout"
+                   else "cancellations"] += 1
+        if slot_id is not None:
+            self._release(slot_id)
+
+    def _expire(self):
+        """Abort queued and running requests whose deadline passed (the
+        per-request timeout contract: checked once per scheduler tick —
+        a jitted call is never interrupted mid-flight)."""
+        now = time.monotonic()
+        for req in [r for r in self.queue
+                    if r.deadline is not None and now > r.deadline]:
+            self.queue.remove(req)
+            self._abort(req, "timeout")
+        for i in range(self.R):
+            s = self.slots[i]
+            if s is not None and s.deadline is not None \
+                    and now > s.deadline:
+                self._abort(s, "timeout", slot_id=i)
+
+    def cancel(self, request_id) -> bool:
+        """Abort a queued or running request (client disconnect). Its
+        blocks/slot free immediately; no result is recorded. Returns
+        False if the request is unknown or already finished."""
+        for req in self.queue:
+            if req.request_id == request_id:
+                self.queue.remove(req)
+                self._abort(req, "cancelled")
+                return True
+        for i in range(self.R):
+            s = self.slots[i]
+            if s is not None and s.request_id == request_id:
+                self._abort(s, "cancelled", slot_id=i)
+                return True
+        return False
+
+    def health(self) -> Dict[str, Any]:
+        """Stats snapshot for load balancers / probes: scheduler
+        counters plus live occupancy (slots, blocks, queue depth)."""
+        snap = dict(self.stats)
+        snap.update(
+            queued=len(self.queue),
+            queue_capacity=self.max_queue,
+            active_slots=sum(s is not None for s in self.slots),
+            max_slots=self.R,
+            free_blocks=len(self.free_blocks),
+            cached_free_blocks=len(self.cached_free),
+            total_blocks=self.P - 1,
+            results_pending=len(self.results),
+            aborted=len(self.cancelled))
+        return snap
+
+    def close(self, drain: bool = True):
+        """``drain=True`` (default) runs the engine until every queued
+        and in-flight request completes (graceful shutdown);
+        ``drain=False`` aborts everything still pending (emergency
+        stop), recording each as "cancelled"."""
+        if drain:
+            self.run()
+            return
+        for req in list(self.queue):
+            self.queue.remove(req)
+            self._abort(req, "cancelled")
+        for i in range(self.R):
+            if self.slots[i] is not None:
+                self._abort(self.slots[i], "cancelled", slot_id=i)
+
     def step(self):
-        """One scheduler tick: admit EVERY queued request that fits
-        (slots + blocks), advance one prefill chunk per prefilling slot,
-        then one decode for all prefill-complete slots."""
+        """One scheduler tick: expire overdue requests, admit EVERY
+        queued request that fits (slots + blocks), advance one prefill
+        chunk per prefilling slot, then one decode for all
+        prefill-complete slots."""
+        self._expire()
         while self._try_admit():
             pass
         if self.chunk is not None:

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .utils.faults import BackpressureError, RequestTimeoutError
+
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
 
 
@@ -168,35 +170,110 @@ class BatchingPredictor:
     thread coalesces up to ``max_batch`` of them (waiting at most
     ``max_delay_ms`` once one is pending), stacks them into one bucketed
     engine call, and resolves each request's Future with its own row.
+
+    Overload protection (chaos hardening): ``max_queue`` bounds the
+    admission queue — past capacity ``submit()`` raises
+    BackpressureError IMMEDIATELY (shed at the door, don't buffer an
+    unbounded backlog while the engine falls behind). Per-request
+    ``timeout_s`` bounds the time a request may wait for dispatch; an
+    expired request's Future fails with RequestTimeoutError instead of
+    occupying a batch slot (the engine call itself is not interruptible
+    — the deadline governs queueing, where overload actually bites).
+    Futures support standard cancellation while queued. ``close()``
+    drains gracefully by default; ``health()`` snapshots the counters a
+    load balancer needs.
     """
 
     def __init__(self, model, config: Optional[Config] = None,
-                 max_batch: int = 8, max_delay_ms: float = 2.0):
+                 max_batch: int = 8, max_delay_ms: float = 2.0,
+                 max_queue: Optional[int] = None,
+                 default_timeout_s: Optional[float] = None):
         self.predictor = Predictor(model, config)
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1e3
+        self.max_queue = max_queue
+        self.default_timeout_s = default_timeout_s
         self._q: "queue.Queue" = queue.Queue()
         self._closed = False
+        self._aborting = False
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._stats = {"submitted": 0, "served": 0, "rejected": 0,
+                       "timeouts": 0, "cancelled": 0, "errors": 0,
+                       "batches": 0}
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
-    def submit(self, *inputs) -> Future:
+    def submit(self, *inputs, timeout_s: Optional[float] = None) -> Future:
         """One request (no batch dim on the inputs) -> Future of its
-        outputs (batch dim stripped)."""
+        outputs (batch dim stripped). Raises BackpressureError when the
+        admission queue is at ``max_queue``."""
         if self._closed:
             raise RuntimeError("BatchingPredictor is closed")
+        timeout_s = timeout_s if timeout_s is not None \
+            else self.default_timeout_s
+        deadline = (time.monotonic() + timeout_s) \
+            if timeout_s is not None else None
+        # convert BEFORE claiming queue capacity: a bad input that
+        # raises here must not leak a _pending slot forever
+        req = tuple(np.asarray(x) for x in inputs)
+        with self._lock:
+            if self.max_queue is not None and \
+                    self._pending >= self.max_queue:
+                self._stats["rejected"] += 1
+                raise BackpressureError(
+                    f"admission queue at capacity ({self.max_queue} "
+                    f"pending); shed load or retry with backoff")
+            self._pending += 1
+            self._stats["submitted"] += 1
         fut: Future = Future()
-        self._q.put((tuple(np.asarray(x) for x in inputs), fut))
+        self._q.put((req, fut, deadline))
         return fut
 
     def run(self, *inputs):
         return self.submit(*inputs).result()
+
+    def health(self) -> dict:
+        """Stats snapshot for load balancers / probes."""
+        with self._lock:
+            snap = dict(self._stats)
+            snap["queued"] = self._pending
+        snap.update(capacity=self.max_queue, max_batch=self.max_batch,
+                    closed=self._closed,
+                    worker_alive=self._worker.is_alive())
+        return snap
+
+    def _count(self, key: str):
+        with self._lock:
+            self._stats[key] += 1
+
+    def _admit(self, item) -> bool:
+        """Dequeue-side gate: False when the request must not enter a
+        batch (cancelled, expired, or the predictor is aborting)."""
+        _, fut, deadline = item
+        with self._lock:
+            self._pending -= 1
+        if self._aborting:
+            fut.cancel()  # pending -> CancelledError for the caller
+            self._count("cancelled")
+            return False
+        if not fut.set_running_or_notify_cancel():
+            self._count("cancelled")
+            return False
+        if deadline is not None and time.monotonic() > deadline:
+            fut.set_exception(RequestTimeoutError(
+                "request expired while queued for dispatch"))
+            self._count("timeouts")
+            return False
+        return True
 
     def _loop(self):
         while True:
             item = self._q.get()
             if item is None:
                 return
+            if not self._admit(item):
+                continue
             batch = [item]
             deadline = time.monotonic() + self.max_delay
             while len(batch) < self.max_batch:
@@ -210,12 +287,14 @@ class BatchingPredictor:
                 if nxt is None:
                     self._flush(batch)
                     return
-                batch.append(nxt)
+                if self._admit(nxt):
+                    batch.append(nxt)
             self._flush(batch)
 
     def _flush(self, batch):
-        reqs = [r for r, _ in batch]
-        futs = [f for _, f in batch]
+        reqs = [r for r, _, _ in batch]
+        futs = [f for _, f, _ in batch]
+        self._count("batches")
         try:
             stacked = tuple(np.stack([r[i] for r in reqs])
                             for i in range(len(reqs[0])))
@@ -224,15 +303,26 @@ class BatchingPredictor:
                 fut.set_result(jax.tree.map(
                     lambda o: o[i] if hasattr(o, "ndim") and o.ndim else o,
                     out))
+                self._count("served")
         except BaseException as e:
             for fut in futs:
                 if not fut.done():
                     fut.set_exception(e)
+                    self._count("errors")
 
-    def close(self):
+    def close(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop accepting work. ``drain=True`` (default) serves every
+        already-queued request before shutting the collector down —
+        the join is unbounded unless ``timeout`` is given, because a
+        bounded join would race the live worker for queued items and
+        nondeterministically fail requests it promised to serve;
+        ``drain=False`` fails queued requests immediately (emergency
+        stop — in-flight engine calls still finish)."""
         self._closed = True
+        if not drain:
+            self._aborting = True  # _admit fails queued items fast
         self._q.put(None)
-        self._worker.join(timeout=5)
+        self._worker.join(timeout=timeout)
         # a submit() racing past the _closed check may have enqueued
         # after the sentinel; its Future must fail, not hang forever
         while True:
@@ -240,7 +330,12 @@ class BatchingPredictor:
                 item = self._q.get_nowait()
             except queue.Empty:
                 break
-            if item is not None and not item[1].done():
+            if item is None:
+                continue
+            with self._lock:   # keep health()'s queued count honest
+                self._pending -= 1
+                self._stats["cancelled"] += 1
+            if not item[1].done():
                 item[1].set_exception(
                     RuntimeError("BatchingPredictor closed before the "
                                  "request was served"))

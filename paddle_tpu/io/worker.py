@@ -75,11 +75,19 @@ def _worker_loop(dataset, index_q, result_q, collate_fn, init_fn,
         result_q.put((-1, None, (type(e).__name__, str(e),
                                  traceback.format_exc())))
         return
+    from ..utils import faults
     while True:
         item = index_q.get()
         if item is None:
             break
         seq, indices = item
+        # chaos: OOM-kill stand-in — die hard with this batch
+        # outstanding, so the parent's dead-worker detection (not an
+        # eternal queue.get) is what ends the epoch. Spawned workers
+        # inherit os.environ, so the PADDLE_TPU_FAULTS arming channel
+        # reaches them for free.
+        if faults.inject("worker_crash", worker_id=worker_id, seq=seq):
+            os._exit(1)
         try:
             batch = collate_fn([dataset[i] for i in indices])
             result_q.put((seq, batch, None))

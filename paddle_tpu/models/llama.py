@@ -376,14 +376,15 @@ class LlamaAttention(Layer):
             spec = P(("dp", "fsdp"), "sp", "tp", None)
             ring = functools.partial(ring_attention, axis_name="sp",
                                      causal=True, window=self.window)
+            from ..utils.jax_compat import shard_map
             if segment_ids is not None:
                 sspec = P(("dp", "fsdp"), "sp")
-                out = jax.shard_map(
+                out = shard_map(
                     lambda q, k, v, seg: ring(q, k, v, segment_ids=seg),
                     mesh=get_mesh(), in_specs=(spec,) * 3 + (sspec,),
                     out_specs=spec, check_vma=False)(q, k, v, segment_ids)
             else:
-                out = jax.shard_map(
+                out = shard_map(
                     ring, mesh=get_mesh(), in_specs=(spec,) * 3,
                     out_specs=spec, check_vma=False)(q, k, v)
         elif cfg.use_flash_attention and attn_mask is None and use_flash(q, k, None, 0.0):

@@ -43,7 +43,15 @@ def pick_block_t(total: int, preferred: int = DEFAULT_BLOCK_T) -> int:
     b = min(preferred, total)
     while b > 128 and total % b:
         b //= 2
-    return b if total % b == 0 else 0
+    if total % b == 0:
+        return b
+    # halving can strand on a size that doesn't divide `total` when
+    # `preferred` is not a power of two — e.g. the VMEM budget cap's 384
+    # rows (kv*d in (1024,1365]: kv=10/d=128, kv=5/d=256, kv=20/d=64)
+    # against T=2048 walks 384->192->96 and never hits a divisor. The
+    # dispatch gate guarantees T % 128 == 0, so a 128-row tile is always
+    # legal; fall back to it instead of reporting "no tile".
+    return 128 if total % 128 == 0 else 0
 
 
 def _decode_kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,

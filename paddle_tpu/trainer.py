@@ -25,8 +25,9 @@ import numpy as np
 from .models.llama import causal_lm_loss
 from .nn.layer import Layer
 from .optimizer.optimizers import Optimizer
+from .utils import faults
 from .utils.logging import LogWriter
-from .utils.watchdog import StepWatchdog
+from .utils.watchdog import DivergenceError, StepWatchdog
 
 
 @dataclass
@@ -58,6 +59,13 @@ class TrainingArguments:
     # interleaved pipeline: virtual chunks per pp device (Megatron-style
     # virtual_pp_degree); >1 shrinks the pipeline bubble that many times
     virtual_pp_degree: int = 1
+    # divergence recovery (chaos hardening): on DivergenceError reload
+    # the latest complete checkpoint and continue — the data iterator is
+    # NOT rewound, so the poisoned window (batches between checkpoint
+    # and divergence) is skipped rather than replayed. After this many
+    # rollbacks in one train() call the error propagates (a persistent
+    # NaN is a bug or a bad lr, not a transient).
+    max_divergence_rollbacks: int = 2
 
 
 class TrainerCallback:
@@ -127,6 +135,8 @@ class Trainer:
         self._scaler_state = (self.scaler.init_state() if self.scaler
                               else None)
         self.global_step = 0
+        self._rollbacks = 0
+        self._in_recovery = False
 
     # ------------------------------------------------------------ jit step
     def _pp_degree(self) -> int:
@@ -253,8 +263,13 @@ class Trainer:
         data = iter(self.train_dataloader)
         if self.global_step and args.skip_data_on_resume:
             data = self._skip_consumed(data, self.global_step)
+        self._rollbacks = 0
         t_last = time.perf_counter()
         while self.global_step < max_steps:
+            if faults.inject("hang", step=self.global_step):
+                # chaos: simulated stuck step (preempted chip) — the
+                # StepWatchdog hang path must checkpoint and exit
+                time.sleep(faults.hang_seconds())
             try:
                 batch = next(data)
             except StopIteration:
@@ -267,10 +282,25 @@ class Trainer:
                               batch)
             self.global_step += 1
             self.watchdog.beat()
+            if faults.inject("step_nan", step=self.global_step):
+                # chaos: numeric divergence — NaN the float params (as a
+                # real NaN-grad step would) and the reported loss, then
+                # let the watchdog + rollback loop recover
+                self._params = jax.tree.map(
+                    lambda x: x * float("nan")
+                    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                    else x, self._params)
+                loss = jnp.float32(float("nan"))
             if self.global_step % args.logging_steps == 0 or \
                     self.global_step == max_steps:
                 loss_val = float(loss)
-                self.watchdog.check_loss(loss_val, self.global_step)
+                try:
+                    self.watchdog.check_loss(loss_val, self.global_step)
+                except DivergenceError:
+                    if not self._maybe_rollback():
+                        raise
+                    t_last = time.perf_counter()
+                    continue
                 now = time.perf_counter()
                 logs = {"loss": loss_val,
                         "steps_per_sec": args.logging_steps / (now - t_last)}
@@ -287,6 +317,10 @@ class Trainer:
                 self.watchdog.beat()  # ditto a long eval
         for cb in self.callbacks:
             cb.on_train_end(self.global_step)
+        if getattr(self, "_ckpt", None) is not None:
+            # drain the cached manager's async write + manifest so a
+            # finished run's last checkpoint is durable
+            self._ckpt.wait_until_finished()
         # leave the module tree holding the trained weights
         self.model.bind(self._params)
         return self
@@ -352,15 +386,28 @@ class Trainer:
     def _ckpt_dir(self):
         return os.path.join(self.args.output_dir, "checkpoints")
 
+    def _ckpt_manager(self):
+        """ONE long-lived DistributedCheckpoint across the run: per-save
+        create/close would force every periodic save to drain the async
+        write AND hash the integrity manifest synchronously in the train
+        loop — the cached manager keeps both in the background."""
+        if getattr(self, "_ckpt", None) is None:
+            from .checkpoint.distributed_ckpt import DistributedCheckpoint
+            self._ckpt = DistributedCheckpoint(self._ckpt_dir())
+        return self._ckpt
+
     def save_checkpoint(self, wait: bool = False):
-        from .checkpoint.distributed_ckpt import DistributedCheckpoint
-        ckpt = DistributedCheckpoint(self._ckpt_dir())
+        ckpt = self._ckpt_manager()
         tree = {"params": dict(self._params), "opt_state": self._opt_state}
         if self._scaler_state is not None:
             tree["scaler"] = self._scaler_state
+        if self.args.donate_state and not wait:
+            # the async write drains AFTER the next step DONATES these
+            # exact buffers — hand orbax its own device-side copy or the
+            # checkpoint bytes become whatever the reused buffers hold
+            tree = jax.tree.map(
+                lambda x: jnp.copy(x) if hasattr(x, "dtype") else x, tree)
         ckpt.save(self.global_step, tree, wait=wait)
-        ckpt.wait_until_finished() if wait else None
-        ckpt.close()
         for cb in self.callbacks:
             cb.on_save(self.global_step)
 
@@ -373,6 +420,15 @@ class Trainer:
         print(f"[watchdog] step hung > {self.args.hang_timeout_s}s at "
               f"global_step={self.global_step}; checkpointing and exiting "
               f"rc={self.args.hang_exit_code}", file=sys.stderr, flush=True)
+        if self._in_recovery:
+            # wedged INSIDE a divergence rollback: params are NaN — a
+            # snapshot now would become the latest checkpoint and poison
+            # every future auto-resume. Exit without saving; the last
+            # complete checkpoint stands and the supervisor relaunches.
+            print("[watchdog] hang during divergence recovery; exiting "
+                  "WITHOUT checkpointing (params are diverged)",
+                  file=sys.stderr, flush=True)
+            os._exit(self.args.hang_exit_code)
         # the save itself can wedge if the device is gone (device->host
         # copies blocking, not raising) — give it a bounded side thread
         # and exit regardless, or the detected hang becomes permanent
@@ -394,11 +450,53 @@ class Trainer:
                   file=sys.stderr, flush=True)
         os._exit(self.args.hang_exit_code)
 
-    def _try_resume(self):
-        from .checkpoint.distributed_ckpt import DistributedCheckpoint
+    def _maybe_rollback(self) -> bool:
+        """Bounded divergence recovery: reload the latest complete (and
+        checksum-verified) checkpoint and continue training. The data
+        iterator is deliberately NOT rewound — the poisoned window
+        (batches consumed between the checkpoint and the divergence) is
+        skipped, not replayed into the restored params. Returns False
+        (caller re-raises) when rollbacks are exhausted or there is no
+        checkpoint to return to."""
+        import sys
+        if self._rollbacks >= self.args.max_divergence_rollbacks:
+            print(f"[trainer] divergence persists after {self._rollbacks} "
+                  f"rollback(s); giving up", file=sys.stderr, flush=True)
+            return False
+        diverged_at = self.global_step
+        # a long restore must not trip the hang watchdog: params are NaN
+        # right now, and _on_hang would checkpoint them as the new
+        # latest (a permanent NaN resume loop). Flag the recovery so the
+        # hang path skips its snapshot, and beat around the restore.
+        self._in_recovery = True
+        self.watchdog.beat()
+        try:
+            restored = self._try_resume()
+        finally:
+            self._in_recovery = False
+            self.watchdog.beat()
+        if restored is None:
+            print("[trainer] divergence with no complete checkpoint to "
+                  "roll back to", file=sys.stderr, flush=True)
+            return False
+        self._rollbacks += 1
+        self.watchdog.reset_nan()
+        print(f"[trainer] divergence at step {diverged_at}: rolled back "
+              f"to checkpoint step {restored} "
+              f"(rollback {self._rollbacks}/"
+              f"{self.args.max_divergence_rollbacks}); skipping the "
+              f"poisoned data window", file=sys.stderr, flush=True)
+        return True
+
+    def _try_resume(self) -> Optional[int]:
+        """Restore the latest complete checkpoint if one exists; returns
+        the restored step (None if there was nothing to restore)."""
         if not os.path.isdir(self._ckpt_dir()):
-            return
-        ckpt = DistributedCheckpoint(self._ckpt_dir())
+            return None
+        ckpt = self._ckpt_manager()
+        # rollback can race an in-flight async save: make it durable
+        # (and its manifest written) before choosing the restore step
+        ckpt.wait_until_finished()
         step = ckpt.latest_complete_step()
         if step is not None:
             base = {"params": dict(self._params),
@@ -424,9 +522,21 @@ class Trainer:
                 # every tree shape failed: report the PRIMARY error (the
                 # fallback's mismatch error would mislead diagnosis)
                 raise first_err
+            if self.args.donate_state:
+                # defensive copy: the jitted step DONATES params/opt
+                # state, but orbax-restored arrays can share internal
+                # buffers with the restore machinery — donating those
+                # double-frees and corrupts the heap (observed on
+                # XLA:CPU). A fresh copy owns its buffers.
+                restored = jax.tree.map(
+                    lambda x: jnp.copy(x) if hasattr(x, "dtype") else x,
+                    restored)
             self._params = restored["params"]
             self._opt_state = restored["opt_state"]
             if self._scaler_state is not None and "scaler" in restored:
                 self._scaler_state = restored["scaler"]
+            # restore() may have fallen back past a corrupt latest step;
+            # global_step must track what was actually loaded
+            step = ckpt.last_restored_step
             self.global_step = step
-        ckpt.close()
+        return step

@@ -1,0 +1,59 @@
+"""jax API-drift shims.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` (<= 0.4.x,
+``check_rep=`` kwarg) to top-level ``jax.shard_map`` (>= 0.5,
+``check_vma=`` kwarg). The library targets the new spelling; this shim
+keeps it importable on older runtimes instead of dying with an
+ImportError/AttributeError at the first sharded call — a robustness
+concern in its own right (elastic relaunches may land on a different
+image than the one that wrote the checkpoint).
+"""
+from __future__ import annotations
+
+try:                                    # jax >= 0.5
+    from jax import shard_map as _shard_map
+    _NEW_API = True
+except ImportError:                     # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _NEW_API = False
+
+__all__ = ["shard_map", "axis_size", "inside_manual_region"]
+
+
+def inside_manual_region() -> bool:
+    """True when tracing inside a shard_map/pmap named-axis scope on a
+    runtime WITHOUT abstract-mesh introspection (old jax): callers that
+    would consult ``jax.sharding.get_abstract_mesh()`` can use this to
+    decide whether a sharding hint is safe to emit."""
+    try:
+        from jax._src import core as _core
+        env = _core.get_axis_env()
+        return bool(getattr(env, "axis_sizes", None))
+    except Exception:
+        return False
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` appeared after 0.4.x; the portable spelling of
+    "how many shards on this mesh axis" inside a manual region is a
+    psum of ones."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              check_vma: bool = False, axis_names=None):
+    """Version-portable ``jax.shard_map`` (replication/VMA checking off
+    by default, matching this codebase's manual-collective style).
+    ``axis_names`` selects the MANUAL mesh axes (new-API spelling); on
+    old jax it lowers to the complementary ``auto`` set."""
+    kw = {("check_vma" if _NEW_API else "check_rep"): check_vma}
+    if axis_names is not None:
+        if _NEW_API:
+            kw["axis_names"] = set(axis_names)
+        else:
+            kw["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

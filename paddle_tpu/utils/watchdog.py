@@ -55,6 +55,12 @@ class StepWatchdog:
                     f"latest checkpoint with a lower lr / loss scale")
         self.beat()
 
+    def reset_nan(self):
+        """Clear the non-finite-loss streak (divergence recovery: the
+        Trainer rolled back to a finite checkpoint, so the streak must
+        restart from zero, not re-trip on the next spike)."""
+        self._nan_streak = 0
+
     # ------------------------------------------------------------ heartbeat
     def beat(self):
         self._armed = True

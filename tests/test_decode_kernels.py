@@ -204,3 +204,36 @@ def test_paged_decode_attention_routes_to_kernel():
                                  pk.seq_lens)
     np.testing.assert_allclose(np.asarray(got[:, 0]), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+# --------------------------------------------- VMEM budget-cap regression
+def test_pick_block_t_budget_cap_falls_back_to_128():
+    """ADVICE r5 medium: halving a non-power-of-two preferred size (the
+    384-row VMEM budget cap, kv*d in (1024,1365]) strands on sizes that
+    don't divide T and used to return 0, tripping `assert bt` even
+    though T % 128 == 0 guarantees a legal tile."""
+    from paddle_tpu.ops.pallas.decode_attention import pick_block_t
+    assert pick_block_t(2048, 384) == 128      # was 0: 384->192->96
+    assert pick_block_t(640, 384) == 128       # was 0
+    # untouched behavior: power-of-two ladders and exact totals
+    assert pick_block_t(2048, 512) == 512
+    assert pick_block_t(256, 512) == 256
+    assert pick_block_t(192, 512) == 192
+    assert pick_block_t(100, 512) == 100       # exact total: full block
+
+
+@pytest.mark.parametrize("kv,d", [(10, 128), (5, 256), (20, 64)])
+def test_budget_cap_shapes_run_and_match_dense(kv, d):
+    """kv*d = 1280 puts budget_rows at exactly 384; the kernel must run
+    (128-row fallback tile) and match the dense reference."""
+    from paddle_tpu.ops.pallas.decode_attention import decode_attention_pallas
+    rs = np.random.RandomState(6)
+    b, T, h = 1, 640, 2 * kv                   # T%384 != 0, T%128 == 0
+    q = jnp.asarray(rs.randn(b, h, d), jnp.float32)
+    ck = jnp.asarray(rs.randn(b, T, kv, d), jnp.float32)
+    cv = jnp.asarray(rs.randn(b, T, kv, d), jnp.float32)
+    got = decode_attention_pallas(q, ck, cv, jnp.int32(200),
+                                  scale=1.0 / np.sqrt(d))
+    ref = _dense_reference(q[:, None], ck, cv, jnp.int32(200))[:, 0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

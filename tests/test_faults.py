@@ -1,0 +1,489 @@
+"""Chaos suite: deterministic fault injection + end-to-end recovery.
+
+Every test here kills, corrupts, or overloads ON PURPOSE (via
+paddle_tpu.utils.faults) and asserts the matching recovery path holds:
+NaN divergence rolls back to a checkpoint and still converges, a
+corrupt latest checkpoint falls back to the previous step, a killed
+DataLoader worker surfaces as an error instead of a hang, and an
+over-capacity serving engine sheds load while in-flight requests
+complete. Each test stays under ~15s on CPU so the suite rides tier-1.
+"""
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.utils import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ================================================================ registry
+class TestRegistry:
+    def test_unarmed_inject_is_false(self):
+        assert faults.inject("step_nan") is False
+
+    def test_unknown_site_rejected(self):
+        with pytest.raises(ValueError, match="unregistered"):
+            faults.inject("definitely_not_a_site")
+        with pytest.raises(ValueError, match="unknown fault site"):
+            faults.parse_spec("definitely_not_a_site")
+
+    def test_occurrence_addressing(self):
+        with faults.scoped("step_nan@2"):
+            assert [faults.inject("step_nan") for _ in range(5)] == \
+                [False, False, True, False, False]
+        with faults.scoped("step_nan@1+"):
+            assert [faults.inject("step_nan") for _ in range(4)] == \
+                [False, True, True, True]
+        with faults.scoped("step_nan@1-2"):
+            assert [faults.inject("step_nan") for _ in range(4)] == \
+                [False, True, True, False]
+        with faults.scoped("step_nan x2"):
+            assert [faults.inject("step_nan") for _ in range(4)] == \
+                [True, True, False, False]
+
+    def test_scoped_restores_and_sites_independent(self):
+        with faults.scoped("step_nan"):
+            assert faults.inject("step_nan")
+            assert not faults.inject("hang")  # other sites stay cold
+        assert not faults.inject("step_nan")  # plan popped
+
+    def test_probabilistic_is_seed_deterministic(self):
+        def draw():
+            with faults.scoped("hang~0.5", seed=7):
+                return [faults.inject("hang") for _ in range(32)]
+        a, b = draw(), draw()
+        assert a == b                      # same seed -> same schedule
+        assert any(a) and not all(a)       # actually probabilistic
+        with faults.scoped("hang~0.5", seed=8):
+            c = [faults.inject("hang") for _ in range(32)]
+        assert c != a                      # seed changes the schedule
+
+    def test_env_var_channel(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_VAR, "step_nan@1")
+        assert [faults.inject("step_nan") for _ in range(3)] == \
+            [False, True, False]
+        monkeypatch.delenv(faults.ENV_VAR)
+        assert faults.inject("step_nan") is False
+
+    def test_cli_lists_every_wired_site(self, capsys):
+        assert faults.main(["--list"]) == 0
+        out = capsys.readouterr().out
+        for site, (where, _) in faults.SITES.items():
+            assert site in out and where.split(":")[0] in out
+
+    def test_listed_sites_are_actually_wired(self):
+        """Each SITES entry names a real module: the inventory must not
+        drift from the code."""
+        import paddle_tpu  # noqa: F401
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for site, (where, _) in faults.SITES.items():
+            path = where.split(":")[0]
+            full = os.path.join(root, path)
+            assert os.path.exists(full), (site, path)
+            src = open(full).read()
+            assert f'inject("{site}"' in src, (site, path)
+
+
+# ================================================================== retry
+class TestRetryWithBackoff:
+    def test_recovers_after_transient_failures(self):
+        calls, delays = [], []
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+        out = faults.retry_with_backoff(
+            flaky, max_attempts=5, base_delay=0.01,
+            retryable=(OSError,), sleep=delays.append)
+        assert out == "ok" and len(calls) == 3 and len(delays) == 2
+        assert delays[1] > delays[0]       # exponential growth
+
+    def test_exhaustion_reraises_and_filter_passes_through(self):
+        def always():
+            raise OSError("down")
+        with pytest.raises(OSError):
+            faults.retry_with_backoff(always, max_attempts=3,
+                                      retryable=(OSError,),
+                                      sleep=lambda _: None)
+        def bug():
+            raise KeyError("bug")
+        with pytest.raises(KeyError):      # not retryable: immediate
+            faults.retry_with_backoff(bug, max_attempts=3,
+                                      retryable=(OSError,),
+                                      sleep=lambda _: None)
+
+    def test_backoff_schedule_deterministic(self):
+        def run():
+            delays = []
+            def always():
+                raise OSError("x")
+            with pytest.raises(OSError):
+                faults.retry_with_backoff(always, max_attempts=4,
+                                          base_delay=0.1, seed=3,
+                                          retryable=(OSError,),
+                                          sleep=delays.append)
+            return delays
+        assert run() == run()
+
+
+# ===================================================== checkpoint integrity
+class TestCheckpointIntegrity:
+    def _trees(self):
+        return [{"w": jnp.arange(8.0) * k, "b": jnp.full((4,), float(k))}
+                for k in (1, 2, 3)]
+
+    def test_corrupt_latest_restores_previous_step(self, tmp_path):
+        """ACCEPTANCE: a corrupted latest checkpoint restores from the
+        previous step without raising (and auto_resume skips it)."""
+        from paddle_tpu.checkpoint.distributed_ckpt import (
+            DistributedCheckpoint, auto_resume)
+        t1, t2, t3 = self._trees()
+        ck = DistributedCheckpoint(str(tmp_path), async_save=False)
+        ck.save(1, t1, wait=True)
+        ck.save(2, t2, wait=True)
+        with faults.scoped("ckpt_corrupt"):
+            ck.save(3, t3, wait=True)      # byte-flipped after manifest
+        assert ck.verify_step(2) is True
+        assert ck.verify_step(3) is False
+        # latest-complete skips the corrupt step -> auto-resume is safe
+        assert ck.latest_complete_step() == 2
+        # default restore falls back, recording what actually loaded
+        out = ck.restore(like=t1)
+        assert ck.last_restored_step == 2
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(t2["w"]))
+        # explicit restore of the corrupt step falls back too (no raise)
+        out = ck.restore(3, like=t1)
+        assert ck.last_restored_step == 2
+        # strict mode: an explicitly pinned corrupt step must raise, not
+        # silently substitute older weights (eval/debug contract)
+        from paddle_tpu.checkpoint.distributed_ckpt import \
+            CheckpointCorruptionError
+        with pytest.raises(CheckpointCorruptionError):
+            ck.restore(3, like=t1, strict=True)
+        ck.close()
+        restored, start = auto_resume(str(tmp_path), t1)
+        assert start == 3                  # resume AFTER verified step 2
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(t2["w"]))
+
+    def test_all_corrupt_raises_corruption_error(self, tmp_path):
+        from paddle_tpu.checkpoint.distributed_ckpt import (
+            CheckpointCorruptionError, DistributedCheckpoint)
+        t1, t2, _ = self._trees()
+        ck = DistributedCheckpoint(str(tmp_path), async_save=False)
+        with faults.scoped("ckpt_corrupt"):
+            ck.save(1, t1, wait=True)
+            ck.save(2, t2, wait=True)
+        assert ck.latest_complete_step() is None
+        with pytest.raises(CheckpointCorruptionError):
+            ck.restore(like=t1)
+        ck.close()
+
+    def test_unmanifested_step_stays_trusted(self, tmp_path):
+        """Pre-integrity checkpoints (no manifest) restore as before —
+        verification adds a guarantee, not a failure mode."""
+        import shutil
+        from paddle_tpu.checkpoint.distributed_ckpt import \
+            DistributedCheckpoint
+        t1, _, _ = self._trees()
+        ck = DistributedCheckpoint(str(tmp_path), async_save=False)
+        ck.save(1, t1, wait=True)
+        shutil.rmtree(tmp_path / "manifests")
+        assert ck.verify_step(1) is None
+        assert ck.latest_complete_step() == 1
+        out = ck.restore(like=t1)
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(t1["w"]))
+        ck.close()
+
+
+# ================================================== trainer NaN -> rollback
+def _tiny_trainer(tmp_path, tag, max_steps=14):
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.trainer import Trainer, TrainingArguments
+    pt.seed(0)
+    model = LlamaForCausalLM(llama_tiny())
+    args = TrainingArguments(output_dir=str(tmp_path / tag),
+                             max_steps=max_steps, logging_steps=1,
+                             save_steps=4, nan_patience=2, seed=42)
+    batch = jnp.asarray(np.random.RandomState(7).randint(0, 256, (4, 16)))
+    return Trainer(model, pt.optimizer.AdamW(learning_rate=3e-3), args,
+                   train_dataloader=[batch])
+
+
+class TestDivergenceRollback:
+    def test_nan_window_rolls_back_and_converges(self, tmp_path):
+        """ACCEPTANCE: an injected NaN window triggers
+        rollback-and-continue; the final loss matches an uninjected run
+        (bit-exact here: one repeated batch, so the post-rollback
+        trajectory replays the clean one)."""
+        from paddle_tpu.utils.watchdog import DivergenceError  # noqa: F401
+        clean = _tiny_trainer(tmp_path, "clean")
+        clean.train()
+        clean_final = clean.logger.history["loss"][-1][1]
+
+        inj = _tiny_trainer(tmp_path, "inj")
+        with faults.scoped("step_nan@8"):  # fires at global step 9
+            inj.train()                    # ckpt@8 exists; patience=2
+        inj_final = inj.logger.history["loss"][-1][1]
+        assert inj._rollbacks == 1
+        assert inj.global_step == inj.args.max_steps
+        assert np.isfinite(inj_final)
+        assert abs(inj_final - clean_final) < 1e-3, (inj_final, clean_final)
+
+    def test_rollbacks_bounded_then_reraise(self, tmp_path):
+        """A persistent NaN (fault fires on every step) exhausts
+        max_divergence_rollbacks and propagates DivergenceError."""
+        from paddle_tpu.utils.watchdog import DivergenceError
+        tr = _tiny_trainer(tmp_path, "persist")
+        with faults.scoped("step_nan@6+"):
+            with pytest.raises(DivergenceError):
+                tr.train()
+        assert tr._rollbacks == tr.args.max_divergence_rollbacks
+
+    def test_divergence_without_checkpoint_reraises(self, tmp_path):
+        from paddle_tpu.trainer import Trainer, TrainingArguments
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+        from paddle_tpu.utils.watchdog import DivergenceError
+        pt.seed(0)
+        model = LlamaForCausalLM(llama_tiny())
+        args = TrainingArguments(output_dir=str(tmp_path / "nockpt"),
+                                 max_steps=8, logging_steps=1,
+                                 save_steps=0, nan_patience=2,
+                                 resume_from_checkpoint=False)
+        batch = jnp.asarray(
+            np.random.RandomState(7).randint(0, 256, (4, 16)))
+        tr = Trainer(model, pt.optimizer.AdamW(learning_rate=3e-3), args,
+                     train_dataloader=[batch])
+        with faults.scoped("step_nan@2"):
+            with pytest.raises(DivergenceError):
+                tr.train()
+        assert tr._rollbacks == 0
+
+
+# =============================================== dataloader worker crash
+class _CrashSafeDataset:
+    def __getitem__(self, i):
+        return np.full((4,), i, np.float32)
+
+    def __len__(self):
+        return 16
+
+
+class TestWorkerCrash:
+    def test_killed_worker_does_not_hang_epoch(self, monkeypatch):
+        """ACCEPTANCE: a killed dataloader worker surfaces as
+        WorkerError within seconds — the epoch neither hangs nor
+        silently truncates."""
+        from paddle_tpu.io import DataLoader, WorkerError
+        # env channel on purpose: it must reach the SPAWNED worker
+        monkeypatch.setenv(faults.ENV_VAR, "worker_crash@1")
+        dl = DataLoader(_CrashSafeDataset(), batch_size=2, num_workers=1)
+        t0 = time.monotonic()
+        with pytest.raises(WorkerError, match="died"):
+            list(dl)
+        assert time.monotonic() - t0 < 60
+
+    def test_uninjected_pool_unaffected(self):
+        from paddle_tpu.io import DataLoader
+        dl = DataLoader(_CrashSafeDataset(), batch_size=2, num_workers=1)
+        out = list(dl)
+        assert len(out) == 8
+        np.testing.assert_array_equal(out[0][:, 0], [0, 1])
+
+
+# ================================================== serving backpressure
+def _mlp():
+    from paddle_tpu import nn
+    pt.seed(0)
+    return nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+
+
+class TestServingBackpressure:
+    def test_overload_rejects_while_inflight_completes(self):
+        """ACCEPTANCE: past-capacity submits fail fast with
+        BackpressureError; every accepted request still completes."""
+        from paddle_tpu.inference import (BackpressureError,
+                                          BatchingPredictor)
+        bp = BatchingPredictor(_mlp(), max_batch=2, max_delay_ms=1,
+                               max_queue=2)
+        try:
+            orig = bp.predictor.run
+            def slow(*a):
+                time.sleep(0.15)           # hold the engine busy
+                return orig(*a)
+            bp.predictor.run = slow
+            xs = [np.random.RandomState(i).randn(16).astype(np.float32)
+                  for i in range(10)]
+            futs, rejected = [], 0
+            for x in xs:
+                try:
+                    futs.append(bp.submit(x))
+                except BackpressureError:
+                    rejected += 1
+            assert rejected >= 1, "queue never saturated"
+            assert futs, "nothing admitted"
+            for f in futs:                 # in-flight work all completes
+                assert f.result(timeout=30).shape == (4,)
+            h = bp.health()
+            assert h["served"] == len(futs)
+            assert h["rejected"] == rejected
+            assert h["queued"] == 0 and h["worker_alive"]
+        finally:
+            bp.close()
+        h = bp.health()
+        assert h["closed"] and not h["worker_alive"]
+
+    def test_request_timeout_and_graceful_drain(self):
+        from paddle_tpu.inference import (BatchingPredictor,
+                                          RequestTimeoutError)
+        bp = BatchingPredictor(_mlp(), max_batch=1, max_delay_ms=1)
+        orig = bp.predictor.run
+        def slow(*a):
+            time.sleep(0.25)
+            return orig(*a)
+        bp.predictor.run = slow
+        x = np.zeros((16,), np.float32)
+        blocker = bp.submit(x)             # engine busy for ~0.25s
+        time.sleep(0.1)                    # collector is now inside run()
+        doomed = bp.submit(x, timeout_s=0.05)
+        tail = bp.submit(x)                # queued behind, no deadline
+        with pytest.raises(RequestTimeoutError):
+            doomed.result(timeout=30)
+        assert blocker.result(timeout=30).shape == (4,)
+        bp.close()                         # graceful drain serves `tail`
+        assert tail.result(timeout=5).shape == (4,)
+        assert bp.health()["timeouts"] == 1
+        with pytest.raises(RuntimeError):
+            bp.submit(x)                   # closed
+
+    def test_close_without_drain_fails_queued_fast(self):
+        from concurrent.futures import CancelledError
+        from paddle_tpu.inference import BatchingPredictor
+        bp = BatchingPredictor(_mlp(), max_batch=1, max_delay_ms=1)
+        orig = bp.predictor.run
+        def slow(*a):
+            time.sleep(0.3)
+            return orig(*a)
+        bp.predictor.run = slow
+        x = np.zeros((16,), np.float32)
+        blocker = bp.submit(x)
+        time.sleep(0.05)
+        queued = [bp.submit(x) for _ in range(3)]
+        bp.close(drain=False)
+        assert blocker.result(timeout=30).shape == (4,)  # in-flight OK
+        for f in queued:
+            with pytest.raises((CancelledError, RuntimeError)):
+                f.result(timeout=5)
+
+
+class TestPagedEngineResilience:
+    @pytest.fixture(scope="class")
+    def model(self):
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+        pt.seed(0)
+        return LlamaForCausalLM(llama_tiny())
+
+    def _engine(self, model, **kw):
+        from paddle_tpu.generation.paged import PagedEngine
+        base = dict(max_slots=2, num_blocks=16, block_size=8,
+                    max_blocks_per_seq=4, prefill_buckets=(16,))
+        base.update(kw)
+        return PagedEngine(model, **base)
+
+    def test_bounded_queue_rejects(self, model):
+        from paddle_tpu.utils.faults import BackpressureError
+        eng = self._engine(model, max_queue=2)
+        ids = np.arange(1, 5)[None]
+        eng.submit("a", ids, max_new_tokens=2)
+        eng.submit("b", ids, max_new_tokens=2)
+        with pytest.raises(BackpressureError):
+            eng.submit("c", ids, max_new_tokens=2)
+        out = eng.run()                    # accepted work still completes
+        assert set(out) == {"a", "b"}
+        assert eng.health()["rejected"] == 1
+        # capacity held by EXPIRED queued requests must not shed live
+        # work: dead entries are reaped at submit time
+        eng.submit("t1", ids, max_new_tokens=2, timeout_s=1e-4)
+        eng.submit("t2", ids, max_new_tokens=2, timeout_s=1e-4)
+        time.sleep(0.01)
+        eng.submit("live", ids, max_new_tokens=2)   # no BackpressureError
+        assert "live" in eng.run()
+        assert eng.cancelled.get("t1") == "timeout"
+
+    def test_timeout_cancel_and_health(self, model):
+        eng = self._engine(model)
+        ids = np.arange(1, 5)[None]
+        eng.submit("slow", ids, max_new_tokens=8, timeout_s=0.0001)
+        eng.submit("ok", ids, max_new_tokens=3)
+        time.sleep(0.01)                   # "slow" is now overdue
+        out = eng.run()
+        assert "ok" in out and "slow" not in out
+        assert eng.cancelled.get("slow") == "timeout"
+        h = eng.health()
+        assert h["timeouts"] == 1 and h["active_slots"] == 0
+        # explicit cancel of a queued request
+        eng.submit("gone", ids, max_new_tokens=3)
+        assert eng.cancel("gone") is True
+        assert eng.cancel("never-submitted") is False
+        assert eng.run() == out            # nothing new ran
+        assert eng.cancelled["gone"] == "cancelled"
+
+    def test_close_drain_and_abort(self, model):
+        eng = self._engine(model)
+        ids = np.arange(1, 5)[None]
+        eng.submit("d1", ids, max_new_tokens=2)
+        eng.close()                        # drain=True runs to completion
+        assert "d1" in eng.results
+        eng.submit("d2", ids, max_new_tokens=2)
+        eng.close(drain=False)             # abort: no decode happens
+        assert "d2" not in eng.results
+        assert eng.cancelled["d2"] == "cancelled"
+
+
+# =================================================== collective retry
+class TestCollectiveRetry:
+    def test_transient_failure_retried_then_succeeds(self):
+        from paddle_tpu.distributed import env as denv
+        from paddle_tpu.distributed.collective import (CollectiveError,
+                                                       eager_all_reduce)
+        denv.init_parallel_env()
+        x = np.arange(8.0, dtype=np.float32)
+        with faults.scoped("collective_fail x2"):
+            out = eager_all_reduce(x)      # 2 injected failures, then ok
+        assert float(np.asarray(out).reshape(-1)[0]) == float(x.sum())
+        # persistent failure exhausts the retry budget and raises
+        with faults.scoped("collective_fail"):
+            with pytest.raises(CollectiveError):
+                eager_all_reduce(x)
+
+    def test_supervise_uses_shared_backoff(self):
+        """supervise retries restartable exits with exponential backoff
+        and returns the final rc when the budget is spent."""
+        import sys
+        from paddle_tpu.distributed.elastic import supervise
+        rc = supervise([sys.executable, "-c", "raise SystemExit(7)"],
+                       max_restarts=2, backoff_s=0.01)
+        assert rc == 7
+        rc = supervise([sys.executable, "-c", "raise SystemExit(0)"],
+                       max_restarts=0)
+        assert rc == 0
+        # non-restartable code: no relaunch burned
+        rc = supervise([sys.executable, "-c", "raise SystemExit(9)"],
+                       max_restarts=5, backoff_s=0.01, restart_codes=(17,))
+        assert rc == 9

@@ -270,3 +270,76 @@ class TestLogitsProcessors:
         with pytest.raises(NotImplementedError, match="left-padded"):
             model.generate(jnp.asarray(ids), max_new_tokens=4,
                            num_beams=2, prompt_start=jnp.asarray([0, 2]))
+
+    def test_repetition_penalty_validated(self, tmp_path):
+        """generate() rejects repetition_penalty <= 0 loudly (mirrors
+        PagedEngine.submit) instead of silently dividing by zero."""
+        _, model = self._pair(tmp_path)
+        ids = jnp.asarray(np.random.RandomState(8).randint(1, 128, (1, 6)))
+        for bad in (0.0, -1.3):
+            with pytest.raises(ValueError, match="repetition_penalty"):
+                model.generate(ids, max_new_tokens=4, temperature=0.0,
+                               repetition_penalty=bad)
+        # valid value still runs (and the beam route is covered too)
+        out = model.generate(ids, max_new_tokens=4, temperature=0.0,
+                             repetition_penalty=1.2)
+        assert out.shape == (1, 10)
+
+
+class TestBeamHFParity:
+    """HF beam parity (ADVICE r5): the no-eos case is exactly
+    comparable (no hypothesis finalization on either side), and the
+    length-penalty ranking convention is pinned against transformers'
+    own BeamHypotheses (generated_len EXCLUDES the terminating eos).
+
+    Known structural deviation, by design: with eos, HF finalizes a
+    finished hypothesis out-of-band and backfills the beam slot with
+    the next-best continuation, while this implementation freezes the
+    finished beam in its slot — with eos the searches can explore
+    different candidate sets, so only the ranking convention (not
+    token-for-token output) is comparable there."""
+
+    def test_beam_search_matches_hf_token_for_token_no_eos(self, tmp_path):
+        import torch
+        import transformers
+        from paddle_tpu.models.hf_interop import from_pretrained
+        torch.manual_seed(0)
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            torch_dtype="float32")
+        hf = transformers.LlamaForCausalLM(cfg).eval()
+        d = str(tmp_path / "beam_llama")
+        hf.save_pretrained(d, safe_serialization=True)
+        model = from_pretrained(d)
+        ids = np.random.RandomState(9).randint(1, 128, (2, 8))
+        with torch.no_grad():
+            want = hf.generate(torch.tensor(ids), max_new_tokens=10,
+                               num_beams=4, do_sample=False,
+                               eos_token_id=None, pad_token_id=0).numpy()
+        got = np.asarray(model.generate(jnp.asarray(ids),
+                                        max_new_tokens=10, num_beams=4))
+        np.testing.assert_array_equal(got, want)
+
+    def test_length_penalty_ranking_matches_beamhypotheses(self):
+        """Our final ranking (score / max(generated_len, 1)^penalty,
+        eos excluded from the length) must order hypotheses exactly as
+        transformers' BeamHypotheses.add does."""
+        torch = pytest.importorskip("torch")
+        from transformers.generation.beam_search import BeamHypotheses
+        rs = np.random.RandomState(0)
+        for lp in (0.5, 1.0, 2.0):
+            for trial in range(5):
+                k = 4
+                sum_lps = -rs.uniform(0.5, 20.0, size=k)
+                gen_lens = rs.randint(1, 12, size=k)
+                bh = BeamHypotheses(num_beams=k, length_penalty=lp,
+                                    early_stopping=False)
+                for i in range(k):
+                    bh.add(torch.zeros(int(gen_lens[i]), dtype=torch.long),
+                           float(sum_lps[i]),
+                           generated_len=int(gen_lens[i]))
+                hf_best = max(range(k), key=lambda i: bh.beams[i][0])
+                ours = sum_lps / np.maximum(gen_lens, 1) ** np.float32(lp)
+                assert int(np.argmax(ours)) == hf_best, (lp, trial)

@@ -14,6 +14,7 @@ from paddle_tpu.ops.attention import dense_attention
 from paddle_tpu.parallel import (MoEMLP, pipeline_apply, ring_attention,
                                  stack_stage_params, top_k_routing,
                                  ulysses_attention)
+from paddle_tpu.utils.jax_compat import shard_map
 
 
 @pytest.fixture
@@ -32,7 +33,7 @@ def test_ring_attention_matches_dense(sp_mesh, causal):
     v = jnp.asarray(np.random.randn(b, s, kvh, d), jnp.float32)
     ref = dense_attention(q, k, v, causal=causal)
 
-    ring = jax.shard_map(
+    ring = shard_map(
         functools.partial(ring_attention, axis_name="sp", causal=causal),
         mesh=sp_mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
         check_vma=False)
@@ -47,7 +48,7 @@ def test_ring_attention_grads_match(sp_mesh):
     k = jnp.asarray(np.random.randn(b, s, h, d), jnp.float32)
     v = jnp.asarray(np.random.randn(b, s, h, d), jnp.float32)
 
-    ring = jax.shard_map(
+    ring = shard_map(
         functools.partial(ring_attention, axis_name="sp", causal=True),
         mesh=sp_mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
         check_vma=False)
@@ -67,7 +68,7 @@ def test_ulysses_matches_dense(sp_mesh, causal):
     k = jnp.asarray(np.random.randn(b, s, h, d), jnp.float32)
     v = jnp.asarray(np.random.randn(b, s, h, d), jnp.float32)
     ref = dense_attention(q, k, v, causal=causal)
-    uly = jax.shard_map(
+    uly = shard_map(
         functools.partial(ulysses_attention, axis_name="sp", causal=causal),
         mesh=sp_mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
         check_vma=False)
@@ -193,7 +194,7 @@ class TestRingFlash:
         pallas kernels in interpret mode on CPU)."""
         monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax import shard_map
+        from paddle_tpu.utils.jax_compat import shard_map
         from paddle_tpu.parallel.ring import ring_flash_attention
         from paddle_tpu.ops.attention import dense_attention
 
@@ -220,7 +221,7 @@ class TestRingFlash:
     def test_gradients_flow(self, monkeypatch):
         monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax import shard_map
+        from paddle_tpu.utils.jax_compat import shard_map
         from paddle_tpu.parallel.ring import ring_flash_attention
         from paddle_tpu.ops.attention import dense_attention
 
@@ -289,7 +290,7 @@ def test_ring_attention_segments_match_dense(sp_mesh):
     seg = jnp.asarray(_mk_segments(rng, b, s))
     ref = dense_attention(q, k, v, causal=True, attn_mask=segment_mask(seg))
 
-    ring = jax.shard_map(
+    ring = shard_map(
         lambda q, k, v, sg: ring_attention(q, k, v, axis_name="sp",
                                            causal=True, segment_ids=sg),
         mesh=sp_mesh, in_specs=(P(None, "sp"),) * 3 + (P(None, "sp"),),
@@ -310,7 +311,7 @@ def test_ring_attention_window_matches_dense(sp_mesh, window):
     v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
     ref = dense_attention(q, k, v, causal=True, window=window)
 
-    ring = jax.shard_map(
+    ring = shard_map(
         functools.partial(ring_attention, axis_name="sp", causal=True,
                           window=window),
         mesh=sp_mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
@@ -331,7 +332,7 @@ def test_ring_attention_segments_window_grads(sp_mesh):
     seg = jnp.asarray(_mk_segments(rng, b, s, n_seg=2))
     window = 6
 
-    ring = jax.shard_map(
+    ring = shard_map(
         lambda q, k, v, sg: ring_attention(q, k, v, axis_name="sp",
                                            causal=True, segment_ids=sg,
                                            window=window),
@@ -366,7 +367,7 @@ def test_ulysses_segments_window_match_dense(sp_mesh):
     ref = dense_attention(q, k, v, causal=True, window=window,
                           attn_mask=segment_mask(seg))
 
-    uly = jax.shard_map(
+    uly = shard_map(
         lambda q, k, v, sg: ulysses_attention(q, k, v, axis_name="sp",
                                               causal=True, segment_ids=sg,
                                               window=window),
@@ -386,7 +387,7 @@ def test_ring_flash_masked_delegates(sp_mesh):
     k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
     v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
     ref = dense_attention(q, k, v, causal=True, window=12)
-    ring = jax.shard_map(
+    ring = shard_map(
         functools.partial(ring_flash_attention, axis_name="sp",
                           causal=True, window=12),
         mesh=sp_mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),

@@ -1,0 +1,13 @@
+#!/usr/bin/env python
+"""Print the fault-injection site inventory (thin wrapper so ops
+tooling under tools/ has one obvious entry point; equivalent to
+``python -m paddle_tpu.utils.faults --list``)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.utils import faults  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(faults.main(["--list"]))
