@@ -52,6 +52,7 @@ class DistributedCheckpoint:
     """CheckpointManager facade: save(step, state) / restore(step|latest)."""
 
     MANIFEST_DIR = "manifests"
+    META_DIR = "meta"
 
     def __init__(self, directory: str, max_to_keep: int = 5,
                  async_save: bool = True):
@@ -80,6 +81,32 @@ class DistributedCheckpoint:
     def _manifest_path(self, step: int) -> str:
         return os.path.join(self.directory, self.MANIFEST_DIR,
                             f"{step}.json")
+
+    def _meta_path(self, step: int) -> str:
+        return os.path.join(self.directory, self.META_DIR, f"{step}.json")
+
+    # ---------------------------------------------------- meta sidecar
+    def _write_meta(self, step: int, meta: Dict[str, Any]):
+        """Host-side JSON sidecar per step (sampler position, topology
+        manifest, …) written atomically. Kept OUTSIDE the orbax tree so
+        old checkpoints (no meta) and new readers stay compatible and
+        the restore `like=` structure never has to guess whether data
+        state was saved."""
+        mdir = os.path.join(self.directory, self.META_DIR)
+        os.makedirs(mdir, exist_ok=True)
+        tmp = self._meta_path(step) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, self._meta_path(step))
+
+    def load_meta(self, step: int) -> Optional[Dict[str, Any]]:
+        """The step's meta sidecar, or None (pre-meta checkpoint /
+        unreadable sidecar — resume falls back to array state only)."""
+        try:
+            with open(self._meta_path(step)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
 
     # --------------------------------------------------------- integrity
     def _write_manifest(self, step: int):
@@ -136,16 +163,17 @@ class DistributedCheckpoint:
                 print(f"[ckpt] manifest for step {step} failed: {e}",
                       file=sys.stderr, flush=True)
             self._pending_manifest.discard(step)
-        mdir = os.path.join(self.directory, self.MANIFEST_DIR)
-        if os.path.isdir(mdir):
-            for name in os.listdir(mdir):
-                stem = name.split(".")[0]
-                if stem.isdigit() and int(stem) not in committed \
-                        and int(stem) not in self._pending_manifest:
-                    try:
-                        os.remove(os.path.join(mdir, name))
-                    except OSError:
-                        pass
+        for sub in (self.MANIFEST_DIR, self.META_DIR):
+            mdir = os.path.join(self.directory, sub)
+            if os.path.isdir(mdir):
+                for name in os.listdir(mdir):
+                    stem = name.split(".")[0]
+                    if stem.isdigit() and int(stem) not in committed \
+                            and int(stem) not in self._pending_manifest:
+                        try:
+                            os.remove(os.path.join(mdir, name))
+                        except OSError:
+                            pass
 
     def verify_step(self, step: int) -> Optional[bool]:
         """True = checksums match; False = corruption detected; None =
@@ -188,15 +216,31 @@ class DistributedCheckpoint:
             self._manifest_thread = None
 
     # ------------------------------------------------------------ save
-    def save(self, step: int, state: Dict[str, Any], wait: bool = False):
+    def save(self, step: int, state: Dict[str, Any], wait: bool = False,
+             meta: Optional[Dict[str, Any]] = None):
         """Async by default: returns as soon as the device->host copy is
         done; the write drains in the background. The integrity manifest
         (which re-reads and hashes the committed files — seconds for a
         big checkpoint) is written off-thread on the async path so the
         training loop never stalls on it; ``wait=True`` makes both the
-        orbax write and the manifest durable before returning."""
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        orbax write and the manifest durable before returning.
+
+        ``meta`` (JSON-serializable) is written eagerly to the step's
+        sidecar — it is host state (sampler cursor, topology), so there
+        is nothing to wait for; a crash before the orbax commit leaves a
+        harmless orphan sidecar that the eviction sweep collects."""
+        # register the step BEFORE writing anything: the PREVIOUS save's
+        # background _finalize_manifests sweep may still be running, and
+        # an unregistered, not-yet-committed step's fresh meta sidecar
+        # would look like an evicted orphan to it
         self._pending_manifest.add(step)
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if meta is not None:
+            try:
+                self._write_meta(step, meta)
+            except (OSError, TypeError, ValueError) as e:
+                print(f"[ckpt] meta sidecar for step {step} failed: {e}",
+                      file=sys.stderr, flush=True)
         self._join_manifest_thread()
         if wait:
             self._mgr.wait_until_finished()

@@ -12,13 +12,32 @@ end-to-end in tests/test_elastic.py.
 """
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
-from typing import List, Optional, Sequence
+import time
+from typing import Any, Callable, List, Optional, Sequence
 
 from ..utils.faults import retry_with_backoff
+from ..utils.shutdown import PREEMPTED_RC
 
-__all__ = ["supervise"]
+__all__ = ["supervise", "PREEMPTED_RC"]
+
+
+def _default_topology() -> Optional[Any]:
+    """Cheap world-size probe for the relaunch log. The supervisor must
+    not import jax (the child owns the accelerator). Prefers a FILE
+    (``$PADDLE_TPU_WORLD_SIZE_FILE``) the scheduler/launcher can rewrite
+    between relaunches — the supervisor's own env is frozen at launch,
+    so a bare env var can only describe the initial topology."""
+    path = os.environ.get("PADDLE_TPU_WORLD_SIZE_FILE")
+    if path:
+        try:
+            with open(path) as f:
+                return f.read().strip()
+        except OSError:
+            return None
+    return os.environ.get("PADDLE_TPU_WORLD_SIZE")
 
 
 class _RestartableExit(RuntimeError):
@@ -33,7 +52,11 @@ class _RestartableExit(RuntimeError):
 def supervise(argv: Sequence[str], max_restarts: int = 3,
               backoff_s: float = 1.0,
               restart_codes: Optional[Sequence[int]] = None,
-              timeout_s: Optional[float] = None) -> int:
+              timeout_s: Optional[float] = None,
+              preempt_rc: Optional[int] = PREEMPTED_RC,
+              max_preemptions: Optional[int] = None,
+              probe_topology: Optional[Callable[[], Any]]
+              = _default_topology) -> int:
     """Run ``argv`` as a subprocess; relaunch on failure with jittered
     exponential backoff (the shared utils.faults.retry_with_backoff —
     ``backoff_s`` seeds the base delay, doubling per consecutive
@@ -44,22 +67,64 @@ def supervise(argv: Sequence[str], max_restarts: int = 3,
     eventual success). Each relaunch resumes from the latest complete
     checkpoint via the Trainer's own auto-resume — the supervisor carries
     no training state.
+
+    preempt_rc: the graceful-shutdown exit code (Trainer's
+    ``preempt_exit_code``, default utils.shutdown.PREEMPTED_RC). A child
+    exiting with it was *preempted, not broken* — it already checkpointed
+    its exact step — so it is ALWAYS relaunched and never consumes a
+    ``max_restarts`` attempt (``max_preemptions`` bounds a pathological
+    preemption storm; None = unlimited, preemption is the steady state
+    on spot/preemptible pods). ``probe_topology`` is sampled before each
+    launch and changes are logged — the job may come back with a
+    different world size, which the Trainer reconciles from its
+    topology manifest on resume.
     """
+    preemptions = [0]
+    last_topo: List[Any] = [probe_topology() if probe_topology else None]
+
+    def check_topology():
+        if probe_topology is None:
+            return
+        topo = probe_topology()
+        if topo != last_topo[0]:
+            print(f"[elastic] topology changed between attempts: "
+                  f"{last_topo[0]!r} -> {topo!r} (the trainer reconciles "
+                  f"sampler shards and grad accumulation on resume)",
+                  file=sys.stderr, flush=True)
+            last_topo[0] = topo
+
     def attempt() -> int:
-        try:
-            proc = subprocess.run(list(argv), timeout=timeout_s)
-            rc = proc.returncode
-        except subprocess.TimeoutExpired:
-            # a child hung before its own watchdog could fire (e.g. stuck
-            # in startup): that IS the case this supervisor exists for
-            rc = 124
-        if rc == 0:
-            return 0
-        restartable = (restart_codes is None) or (rc in restart_codes) \
-            or rc < 0 or rc == 124  # negative = killed by signal
-        if restartable:
-            raise _RestartableExit(rc)
-        return rc
+        while True:
+            check_topology()
+            try:
+                proc = subprocess.run(list(argv), timeout=timeout_s)
+                rc = proc.returncode
+            except subprocess.TimeoutExpired:
+                # a child hung before its own watchdog could fire (e.g.
+                # stuck in startup): that IS the case this supervisor
+                # exists for
+                rc = 124
+            if rc == 0:
+                return 0
+            if preempt_rc is not None and rc == preempt_rc:
+                preemptions[0] += 1
+                if max_preemptions is not None and \
+                        preemptions[0] > max_preemptions:
+                    print(f"[elastic] preemption budget exhausted "
+                          f"({max_preemptions}); giving up",
+                          file=sys.stderr, flush=True)
+                    return rc
+                print(f"[elastic] child preempted (rc={rc}, preemption "
+                      f"{preemptions[0]}): it checkpointed before "
+                      f"exiting; relaunching WITHOUT consuming a "
+                      f"restart attempt", file=sys.stderr, flush=True)
+                time.sleep(min(backoff_s, 1.0))
+                continue
+            restartable = (restart_codes is None) or (rc in restart_codes) \
+                or rc < 0 or rc == 124  # negative = killed by signal
+            if restartable:
+                raise _RestartableExit(rc)
+            return rc
 
     def on_retry(exc, attempt_no, delay):
         print(f"[elastic] attempt {attempt_no}/{max_restarts + 1}: "

@@ -162,6 +162,29 @@ class DataLoader:
             if not self.persistent_workers:
                 pool.shutdown()
 
+    # -------------------------------------------------- resumable state
+    def state_dict(self):
+        """Sampler position for preemption-safe resume (delegated to the
+        batch sampler's (epoch, cursor) state). O(1) to capture and to
+        restore — no batch replay. Caveat: with ``num_workers > 0`` the
+        sampler runs ahead of the consumer by up to the prefetch depth,
+        so a checkpoint taken mid-epoch counts in-flight batches as
+        consumed (they are skipped on resume, never double-trained); the
+        synchronous path is exact. IterableDataset has no index space to
+        cursor — returns {} (resume falls back to the trainer's legacy
+        skip-replay)."""
+        bs = self.batch_sampler
+        if bs is None or not hasattr(bs, "state_dict"):
+            return {}
+        sd = bs.state_dict()
+        return {"batch_sampler": sd} if sd else {}
+
+    def load_state_dict(self, state):
+        inner = (state or {}).get("batch_sampler")
+        if inner is not None and self.batch_sampler is not None \
+                and hasattr(self.batch_sampler, "load_state_dict"):
+            self.batch_sampler.load_state_dict(inner)
+
     def shutdown(self):
         """Tear down persistent workers (no-op otherwise)."""
         if self._pool is not None:

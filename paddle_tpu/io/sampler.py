@@ -23,32 +23,117 @@ class Sampler:
 
 
 class SequenceSampler(Sampler):
+    """In-order indices, with a resumable in-epoch cursor (preemption
+    safety: ``state_dict``/``load_state_dict`` restore the exact
+    position in O(1) instead of replaying consumed samples)."""
+
+    def __init__(self, data_source=None):
+        super().__init__(data_source)
+        self._cursor = 0
+        self._resume_cursor = 0
+
     def __iter__(self):
-        return iter(range(len(self.data_source)))
+        start, self._resume_cursor = self._resume_cursor, 0
+        self._cursor = start
+        for i in range(start, len(self.data_source)):
+            self._cursor = i + 1
+            yield i
+        self._cursor = 0
+
+    def state_dict(self):
+        return {"cursor": self._cursor}
+
+    def load_state_dict(self, state):
+        self._resume_cursor = int(state.get("cursor", 0))
+        self._cursor = self._resume_cursor
 
 
 class RandomSampler(Sampler):
+    """Shuffled indices. The per-epoch permutation is a pure function of
+    (generator seed, epoch counter): a supplied ``generator`` seed keeps
+    the run reproducible while every epoch still gets a *different*
+    shuffle (the epoch counter is folded into the seed — a fixed seed
+    alone would replay the identical permutation each epoch), and a
+    resumed run can rebuild the exact permutation it was preempted in
+    from ``state_dict()``'s (epoch, cursor) in O(1)."""
+
     def __init__(self, data_source, replacement=False, num_samples=None,
                  generator=None):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
         self.generator = generator
-        self._epoch_seed = 0
+        self.epoch = 0
+        self._active_epoch = None
+        self._cursor = 0
+        self._resume_cursor = 0
 
     @property
     def num_samples(self):
         return self._num_samples or len(self.data_source)
 
-    def __iter__(self):
+    def _seed_base(self) -> Optional[int]:
+        """Int seed base, or None when ``generator`` is a Generator
+        OBJECT (torch/paddle-style) whose permutations cannot be
+        rebuilt from (seed, epoch)."""
+        if self.generator is None:
+            return 0
+        try:
+            return int(self.generator)
+        except (TypeError, ValueError):
+            return None
+
+    def _epoch_indices(self, epoch: int) -> np.ndarray:
         n = len(self.data_source)
-        rng = np.random.default_rng(self.generator if self.generator is not None
-                                    else self._epoch_seed)
-        self._epoch_seed += 1
+        base = self._seed_base()
+        # base None: epochs differ by advancing the generator object's
+        # state; pass an int seed instead for exact (epoch,cursor) resume
+        rng = np.random.default_rng(self.generator if base is None
+                                    else base + epoch)
         if self.replacement:
-            yield from rng.integers(0, n, size=self.num_samples).tolist()
-        else:
-            yield from rng.permutation(n)[:self.num_samples].tolist()
+            return rng.integers(0, n, size=self.num_samples)
+        return rng.permutation(n)[:self.num_samples]
+
+    def __iter__(self):
+        e = self.epoch
+        self.epoch = e + 1          # a fresh __iter__ reshuffles (legacy)
+        self._active_epoch = e
+        idx = self._epoch_indices(e)
+        start, self._resume_cursor = self._resume_cursor, 0
+        self._cursor = start
+        for i in range(start, len(idx)):
+            # advance BEFORE yielding: a state_dict() taken between
+            # batches counts the just-delivered sample as consumed
+            self._cursor = i + 1
+            yield int(idx[i])
+        # reset the cursor BEFORE leaving the active epoch: a state_dict
+        # snapshot from another thread (prefetch producer) between the
+        # two writes must never pair the next epoch with a stale cursor
+        self._cursor = 0
+        self._active_epoch = None
+
+    def state_dict(self):
+        """(epoch, in-epoch cursor) — enough to rebuild the exact
+        permutation and position after a preemption. The fallback
+        branch returns the live ``_cursor`` (not 0) so a restored-but-
+        not-yet-resumed position survives a second preemption that
+        lands before the first batch."""
+        if self._active_epoch is not None:
+            return {"epoch": self._active_epoch, "cursor": self._cursor}
+        return {"epoch": self.epoch, "cursor": self._cursor}
+
+    def load_state_dict(self, state):
+        self.epoch = int(state.get("epoch", 0))
+        cursor = int(state.get("cursor", 0))
+        if self._seed_base() is None:
+            # the checkpointed permutation is NOT reconstructible from a
+            # generator object: resuming mid-permutation would silently
+            # skip never-seen samples of a fresh shuffle — restart the
+            # epoch instead (full coverage beats exact position)
+            cursor = 0
+        self._resume_cursor = cursor
+        self._active_epoch = None
+        self._cursor = self._resume_cursor
 
     def __len__(self):
         return self.num_samples
@@ -114,6 +199,20 @@ class BatchSampler(Sampler):
             return n // self.batch_size
         return math.ceil(n / self.batch_size)
 
+    # -------------------------------------------------- resumable state
+    def state_dict(self):
+        """Delegates to the wrapped sampler (sample-level cursor; the
+        Trainer checkpoints at step == batch boundaries, so the cursor
+        is batch-aligned in practice)."""
+        if hasattr(self.sampler, "state_dict"):
+            return {"sampler": self.sampler.state_dict()}
+        return {}
+
+    def load_state_dict(self, state):
+        inner = state.get("sampler")
+        if inner is not None and hasattr(self.sampler, "load_state_dict"):
+            self.sampler.load_state_dict(inner)
+
 
 class DistributedBatchSampler(BatchSampler):
     """Index-sharded batch sampler (reference:
@@ -131,24 +230,71 @@ class DistributedBatchSampler(BatchSampler):
         self.epoch = 0
         self.num_samples = int(math.ceil(len(dataset) / self.nranks))
         self.total_size = self.num_samples * self.nranks
+        # global samples consumed in the current epoch (across ALL ranks;
+        # ranks advance in lockstep under SPMD, so local batches * nranks)
+        self._consumed = 0
+        self._resume_consumed = 0
+        self._resume_nranks = self.nranks
 
-    def __iter__(self):
+    def _epoch_indices(self, nranks: Optional[int] = None):
+        """The epoch's GLOBAL index order, padded to an even shard for
+        ``nranks`` — identical on every rank and a pure function of the
+        epoch seed, so any rank (under any topology) can rebuild the
+        stream another topology was consuming."""
         n = len(self.dataset)
         if self.shuffle:
             rng = np.random.default_rng(self.epoch)
             indices = rng.permutation(n).tolist()
         else:
             indices = list(range(n))
-        indices += indices[: (self.total_size - n)]  # pad to even shards
-        indices = indices[self.local_rank::self.nranks]
+        nranks = self.nranks if nranks is None else nranks
+        total = int(math.ceil(n / nranks)) * nranks
+        indices += indices[: (total - n)]  # pad to even shards
+        return indices
+
+    def __iter__(self):
+        consumed, self._resume_consumed = self._resume_consumed, 0
+        self._consumed = consumed
+        # Resuming mid-epoch (possibly under a DIFFERENT rank count than
+        # the checkpoint's): rebuild the stream AS THE SAVING TOPOLOGY
+        # PADDED IT, drop the globally-consumed prefix, then re-shard
+        # the REMAINING index space over the current ranks (re-padding
+        # from the remainder itself, never from consumed samples).
+        # Rank-strided sharding makes "consumed" topology-independent —
+        # after each lockstep batch the consumed set is exactly a prefix
+        # of the global order — so the new shards are non-overlapping
+        # and cover precisely the unseen remainder.
+        rest = self._epoch_indices(self._resume_nranks
+                                   if consumed else None)[consumed:]
+        self._resume_nranks = self.nranks
+        if rest and len(rest) % self.nranks:
+            # cycle the remainder until it divides evenly — the unseen
+            # rest can be SMALLER than the pad (epoch-tail resume onto
+            # many ranks), and uneven shards would break SPMD lockstep
+            pad = self.nranks - len(rest) % self.nranks
+            rest = rest + (rest * (-(-pad // len(rest))))[:pad]
+        local = rest[self.local_rank::self.nranks]
         batch = []
-        for idx in indices:
+        for idx in local:
             batch.append(idx)
             if len(batch) == self.batch_size:
+                # advance BEFORE yielding: a state_dict() taken between
+                # batches counts the delivered batch as consumed
+                consumed += self.batch_size * self.nranks
+                self._consumed = consumed
                 yield batch
                 batch = []
         if batch and not self.drop_last:
+            consumed += len(batch) * self.nranks
+            self._consumed = consumed
             yield batch
+        # epoch completed: advance so the next wrap reshuffles (same
+        # identical-shuffle-per-epoch fix as RandomSampler — nothing in
+        # the Trainer calls set_epoch, which still overrides explicitly).
+        # Reset consumed FIRST: a state_dict snapshot between the two
+        # writes must never pair the next epoch with a full-epoch count.
+        self._consumed = 0
+        self.epoch += 1
 
     def __len__(self):
         if self.drop_last:
@@ -157,3 +303,27 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+        self._consumed = 0
+        self._resume_consumed = 0
+
+    # -------------------------------------------------- resumable state
+    def state_dict(self):
+        """Topology-portable position: (epoch, globally consumed
+        samples, saving rank count). ``nranks`` is LOAD-BEARING: the
+        saving topology's padding defined the stream the consumed
+        counter was measured against, and load_state_dict rebuilds
+        exactly that stream before re-sharding the remainder. While a
+        restored position is still pending (no __iter__ yet), the
+        counter is still measured against the ORIGINAL saving
+        topology's stream — report that nranks, not the live one."""
+        return {"epoch": self.epoch, "consumed": self._consumed,
+                "nranks": self._resume_nranks if self._resume_consumed
+                else self.nranks}
+
+    def load_state_dict(self, state):
+        self.epoch = int(state.get("epoch", 0))
+        self._resume_consumed = int(state.get("consumed", 0))
+        self._consumed = self._resume_consumed
+        # the SAVING topology's rank count: its padding defined the
+        # stream the consumed counter was measured against
+        self._resume_nranks = int(state.get("nranks", self.nranks))

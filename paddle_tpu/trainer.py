@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional
@@ -27,6 +28,7 @@ from .nn.layer import Layer
 from .optimizer.optimizers import Optimizer
 from .utils import faults
 from .utils.logging import LogWriter
+from .utils.shutdown import PREEMPTED_RC, GracefulShutdown
 from .utils.watchdog import DivergenceError, StepWatchdog
 
 
@@ -66,6 +68,15 @@ class TrainingArguments:
     # rollbacks in one train() call the error propagates (a persistent
     # NaN is a bug or a bad lr, not a transient).
     max_divergence_rollbacks: int = 2
+    # preemption safety: install a SIGTERM/SIGINT GracefulShutdown
+    # handler for the duration of train(); the loop polls it at step
+    # boundaries and, when tripped (scheduler preemption notice, ^C, or
+    # the seeded `preempt` fault site), checkpoints the exact current
+    # step, drains the async writer, and exits preempt_exit_code — which
+    # distributed.elastic.supervise restarts for free (a preemption is
+    # not a failure and never consumes a max_restarts attempt).
+    graceful_shutdown: bool = True
+    preempt_exit_code: int = PREEMPTED_RC
 
 
 class TrainerCallback:
@@ -137,6 +148,8 @@ class Trainer:
         self.global_step = 0
         self._rollbacks = 0
         self._in_recovery = False
+        self._shutdown: Optional[GracefulShutdown] = None
+        self._sampler_restored = False
 
     # ------------------------------------------------------------ jit step
     def _pp_degree(self) -> int:
@@ -261,11 +274,40 @@ class Trainer:
 
         assert self.train_dataloader is not None, "pass train_dataloader"
         data = iter(self.train_dataloader)
-        if self.global_step and args.skip_data_on_resume:
+        if self.global_step and args.skip_data_on_resume \
+                and not self._sampler_restored:
+            # legacy fallback: no sampler state in the checkpoint (plain
+            # iterables, pre-meta checkpoints) — blind O(global_step)
+            # replay of the stream. Loaders with state_dict support are
+            # restored in O(1) by _try_resume instead.
             data = self._skip_consumed(data, self.global_step)
         self._rollbacks = 0
+        if self._shutdown is not None:
+            # a latch tripped in a PREVIOUS train() call must not make
+            # this one exit before its first step
+            self._shutdown.clear()
+        if args.graceful_shutdown:
+            if self._shutdown is None:
+                self._shutdown = GracefulShutdown()
+            self._shutdown.install()
+        try:
+            return self._train_loop(data, max_steps)
+        finally:
+            if self._shutdown is not None:
+                self._shutdown.uninstall()
+
+    def _train_loop(self, data, max_steps: int):
+        args = self.args
         t_last = time.perf_counter()
         while self.global_step < max_steps:
+            if faults.inject("preempt", step=self.global_step):
+                # chaos: deterministic stand-in for a scheduler
+                # preemption notice (SIGTERM) landing between steps
+                sd = self._shutdown or GracefulShutdown()
+                self._shutdown = sd
+                sd.request("injected preempt")
+            if self._shutdown is not None and self._shutdown.requested():
+                self._preempt_exit()
             if faults.inject("hang", step=self.global_step):
                 # chaos: simulated stuck step (preempted chip) — the
                 # StepWatchdog hang path must checkpoint and exit
@@ -274,7 +316,12 @@ class Trainer:
                 batch = next(data)
             except StopIteration:
                 data = iter(self.train_dataloader)
-                batch = next(data)
+                try:
+                    batch = next(data)
+                except StopIteration:
+                    # a bare StopIteration from the second next() would
+                    # leak out of the loop as a silent early return
+                    raise ValueError("train_dataloader is empty") from None
             batch = self._prep_batch(batch)
             self._params, self._opt_state, self._scaler_state, loss = \
                 self._step_fn(self._params, self._opt_state,
@@ -407,9 +454,64 @@ class Trainer:
             # checkpoint bytes become whatever the reused buffers hold
             tree = jax.tree.map(
                 lambda x: jnp.copy(x) if hasattr(x, "dtype") else x, tree)
-        ckpt.save(self.global_step, tree, wait=wait)
+        ckpt.save(self.global_step, tree, wait=wait,
+                  meta=self._checkpoint_meta())
         for cb in self.callbacks:
             cb.on_save(self.global_step)
+
+    def _dp_degree(self) -> int:
+        """Batch-sharding degree of the live mesh (dp and fsdp both
+        split the batch; 1 with no mesh installed)."""
+        from .distributed import env
+        if not env.has_mesh():
+            return 1
+        shape = env.get_mesh().shape
+        return int(shape.get("dp", 1)) * int(shape.get("fsdp", 1))
+
+    def _checkpoint_meta(self) -> Dict[str, Any]:
+        """Host-side sidecar for the step: sampler position (O(1)
+        resume) + the topology manifest (cross-topology reconcile)."""
+        topo: Dict[str, Any] = {
+            "device_count": jax.device_count(),
+            "dp": self._dp_degree(),
+            "accum": self.args.gradient_accumulation_steps,
+        }
+        mesh_shape = self._live_mesh_shape()
+        if mesh_shape is not None:
+            topo["mesh"] = mesh_shape
+        meta: Dict[str, Any] = {"step": self.global_step,
+                                "topology": topo}
+        dl = self.train_dataloader
+        if dl is not None and hasattr(dl, "state_dict"):
+            try:
+                sd = dl.state_dict()
+                if sd:
+                    meta["sampler"] = sd
+            except Exception as e:  # sampler state is best-effort
+                print(f"[trainer] sampler state_dict failed: {e}",
+                      file=sys.stderr, flush=True)
+        return meta
+
+    def _preempt_exit(self):
+        """Graceful-shutdown path: checkpoint the EXACT current step
+        (sampler cursor included), drain the async writer so the save is
+        durable, and exit with the preemption code the elastic
+        supervisor restarts for free. SystemExit (not os._exit): the
+        main thread is healthy here and should unwind cleanly."""
+        reason = (self._shutdown.reason if self._shutdown else None) \
+            or "requested"
+        print(f"[trainer] preemption ({reason}) at global_step="
+              f"{self.global_step}: checkpointing and exiting "
+              f"rc={self.args.preempt_exit_code}",
+              file=sys.stderr, flush=True)
+        try:
+            self.save_checkpoint(wait=True)
+        except Exception as e:
+            # the grace window beats a perfect save: the latest periodic
+            # checkpoint stands and the relaunch resumes from it
+            print(f"[trainer] checkpoint during preemption failed: {e}; "
+                  f"exiting anyway", file=sys.stderr, flush=True)
+        raise SystemExit(self.args.preempt_exit_code)
 
     def _on_hang(self):
         """Monitor-thread path for a hung step (preempted chip, stuck
@@ -471,7 +573,11 @@ class Trainer:
         self._in_recovery = True
         self.watchdog.beat()
         try:
-            restored = self._try_resume()
+            # restore_data=False: the live iterator is deliberately NOT
+            # rewound (poisoned-window skip) — restoring the sampler
+            # cursor here would replay checkpointed-epoch data at the
+            # next epoch wrap
+            restored = self._try_resume(restore_data=False)
         finally:
             self._in_recovery = False
             self.watchdog.beat()
@@ -488,9 +594,11 @@ class Trainer:
               f"poisoned data window", file=sys.stderr, flush=True)
         return True
 
-    def _try_resume(self) -> Optional[int]:
+    def _try_resume(self, restore_data: bool = True) -> Optional[int]:
         """Restore the latest complete checkpoint if one exists; returns
-        the restored step (None if there was nothing to restore)."""
+        the restored step (None if there was nothing to restore).
+        ``restore_data=False`` (divergence rollback) restores arrays
+        only, leaving the live data iterator's position untouched."""
         if not os.path.isdir(self._ckpt_dir()):
             return None
         ckpt = self._ckpt_manager()
@@ -522,15 +630,36 @@ class Trainer:
                 # every tree shape failed: report the PRIMARY error (the
                 # fallback's mismatch error would mislead diagnosis)
                 raise first_err
-            if self.args.donate_state:
-                # defensive copy: the jitted step DONATES params/opt
-                # state, but orbax-restored arrays can share internal
-                # buffers with the restore machinery — donating those
-                # double-frees and corrupts the heap (observed on
-                # XLA:CPU). A fresh copy owns its buffers.
-                restored = jax.tree.map(
-                    lambda x: jnp.copy(x) if hasattr(x, "dtype") else x,
-                    restored)
+            # Two placement fixups in one pass:
+            # - defensive copy (donate_state): the jitted step DONATES
+            #   params/opt state, but orbax-restored arrays can share
+            #   internal buffers with the restore machinery — donating
+            #   those double-frees and corrupts the heap (observed on
+            #   XLA:CPU). A fresh copy owns its buffers.
+            # - mesh re-placement (cross-topology resume): orbax commits
+            #   restored arrays to the devices of the restore target; if
+            #   that target was not laid out on the LIVE mesh (plain
+            #   host params as `like`, or a checkpoint from a different
+            #   topology), the committed placement conflicts with the
+            #   step's mesh sharding constraints — replicate such arrays
+            #   onto the current mesh (arrays already spanning the mesh
+            #   keep their sharding).
+            from .distributed import env as denv
+            mesh = denv.get_mesh() if denv.has_mesh() else None
+            mesh_devs = set(mesh.devices.flat) if mesh is not None else None
+
+            def _fix(x):
+                if not hasattr(x, "dtype"):
+                    return x
+                sh = getattr(x, "sharding", None)
+                if mesh is not None and (
+                        sh is None or set(sh.device_set) != mesh_devs):
+                    from jax.sharding import NamedSharding, PartitionSpec
+                    return jax.device_put(
+                        x, NamedSharding(mesh, PartitionSpec()))
+                return jnp.copy(x) if self.args.donate_state else x
+
+            restored = jax.tree.map(_fix, restored)
             self._params = restored["params"]
             self._opt_state = restored["opt_state"]
             if self._scaler_state is not None and "scaler" in restored:
@@ -539,4 +668,84 @@ class Trainer:
             # global_step must track what was actually loaded
             step = ckpt.last_restored_step
             self.global_step = step
+            if restore_data:
+                self._restore_meta(ckpt, step)
         return step
+
+    def _restore_meta(self, ckpt, step: int):
+        """Apply the step's meta sidecar: O(1) sampler-position restore
+        (replacing _skip_consumed's blind replay) and cross-topology
+        reconciliation when the checkpoint was written under a different
+        mesh."""
+        self._sampler_restored = False
+        meta = ckpt.load_meta(step)
+        if not meta:
+            return
+        self._reconcile_topology(meta.get("topology"))
+        sd = meta.get("sampler")
+        dl = self.train_dataloader
+        if sd and dl is not None and hasattr(dl, "load_state_dict"):
+            try:
+                dl.load_state_dict(sd)
+                self._sampler_restored = True
+            except Exception as e:
+                print(f"[trainer] sampler state restore failed ({e}); "
+                      f"falling back to data replay",
+                      file=sys.stderr, flush=True)
+
+    def _reconcile_topology(self, saved: Optional[Dict[str, Any]]):
+        """The job may come back with a different world size (preemptible
+        pods): keep the EFFECTIVE global batch constant by recomputing
+        gradient accumulation from the saved dp degree, and log the
+        change. The per-rank index space re-shards inside
+        DistributedBatchSampler.load_state_dict (its consumed counter is
+        topology-independent), and orbax re-shards the arrays onto the
+        live mesh via the restore target shardings."""
+        if not saved:
+            return
+        cur_dp = self._dp_degree()
+        old_dp = int(saved.get("dp", cur_dp) or cur_dp)
+        if old_dp == cur_dp:
+            return
+        old_accum = int(saved.get("accum",
+                                  self.args.gradient_accumulation_steps))
+        effective = old_dp * old_accum
+        new_accum = max(1, effective // cur_dp)
+        # the accum factor must divide the loader batch (the jitted step
+        # folds the batch into accum microbatches) — clamp down to the
+        # nearest divisor rather than crashing the first resumed step
+        batch = self._loader_batch_size()
+        if batch:
+            while batch % new_accum:
+                new_accum -= 1
+        if new_accum * cur_dp != effective:
+            print(f"[trainer] effective global batch not exactly "
+                  f"preservable: dp {old_dp}->{cur_dp} with accum "
+                  f"{old_accum}, loader batch {batch} "
+                  f"(using accum={new_accum})",
+                  file=sys.stderr, flush=True)
+        print(f"[trainer] topology change on resume: dp {old_dp} -> "
+              f"{cur_dp} (mesh {saved.get('mesh')} -> now "
+              f"{self._live_mesh_shape()}); gradient accumulation "
+              f"{old_accum} -> {new_accum} to preserve the effective "
+              f"global batch", file=sys.stderr, flush=True)
+        if new_accum != self.args.gradient_accumulation_steps:
+            self.args.gradient_accumulation_steps = new_accum
+            self._step_fn = None   # rebuilt with the new accum factor
+
+    def _live_mesh_shape(self) -> Optional[Dict[str, int]]:
+        from .distributed import env
+        if not env.has_mesh():
+            return None
+        return {a: int(d) for a, d in env.get_mesh().shape.items()}
+
+    def _loader_batch_size(self) -> Optional[int]:
+        """The per-step batch the dataloader feeds, when discoverable
+        (None for plain iterables)."""
+        dl = self.train_dataloader
+        bs = getattr(getattr(dl, "batch_sampler", None), "batch_size",
+                     None) or getattr(dl, "batch_size", None)
+        try:
+            return int(bs) if bs else None
+        except (TypeError, ValueError):
+            return None

@@ -88,6 +88,13 @@ SITES: Dict[str, Tuple[str, str]] = {
         "raise CollectiveError before an eager collective runs "
         "(transient ICI/DCN failure; exercises retry_with_backoff "
         "around the collective wrappers)"),
+    "preempt": (
+        "paddle_tpu/trainer.py:Trainer.train",
+        "request graceful shutdown at the next step boundary (SIGTERM "
+        "stand-in for a scheduler preemption notice): the Trainer "
+        "checkpoints its exact step, drains the async writer, and exits "
+        "PREEMPTED_RC — which elastic.supervise restarts without "
+        "consuming a max_restarts attempt"),
 }
 
 

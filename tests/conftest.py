@@ -141,10 +141,29 @@ _HEAVY = (
 )
 
 
+# The slow tier: tier-1 verify runs `-m 'not slow'` (which, unlike the
+# default addopts, INCLUDES heavy) against a hard wall-clock cap — these
+# multi-subprocess e2e tests are its biggest line items (~80s combined)
+# and each keeps a faster default-tier representative of the same
+# machinery:
+#   kill/resume e2e        <- test_preemption.py in-process preempt e2e
+#                             (sampler-exact resume, a strict superset)
+#   hang+supervisor e2e    <- test_supervise_uses_shared_backoff +
+#                             preempt free-restart supervisor test
+#   nan rollback converges <- test_rollbacks_bounded_then_reraise
+_SLOW = (
+    "test_kill_mid_run_then_resume_continues_trajectory",
+    "test_hang_checkpoints_exits_and_supervisor_finishes",
+    "test_nan_window_rolls_back_and_converges",
+)
+
+
 def pytest_collection_modifyitems(items):
     for item in items:
         if any(key in item.nodeid for key in _HEAVY):
             item.add_marker(pytest.mark.heavy)
+        if any(key in item.nodeid for key in _SLOW):
+            item.add_marker(pytest.mark.slow)
 
 
 @pytest.fixture(autouse=True)

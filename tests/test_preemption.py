@@ -1,0 +1,617 @@
+"""Preemption-safe elastic training (ISSUE 3 tentpole).
+
+Four-layer contract, pinned end-to-end: (1) graceful shutdown — an
+injected ``preempt`` (SIGTERM stand-in) checkpoints the exact step and
+exits ``PREEMPTED_RC``; (2) resumable data pipeline — sampler/loader
+``state_dict`` restores the exact shuffle position in O(1), no replay;
+(3) cross-topology resume — a dp=4 checkpoint restores under dp=2 with
+identical numerics, recomputed grad accumulation, and a re-sharded,
+non-overlapping sampler index space; (4) supervisor awareness —
+``PREEMPTED_RC`` relaunches never consume a ``max_restarts`` attempt.
+Every test stays in-process (or spawns only jax-free children) to ride
+the tier-1 budget: each is well under 15s on CPU.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.io import (DataLoader, DistributedBatchSampler,
+                           RandomSampler, SequenceSampler)
+from paddle_tpu.utils import faults
+from paddle_tpu.utils.shutdown import PREEMPTED_RC, GracefulShutdown
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# =========================================================== sampler state
+class TestSamplerState:
+    def test_seeded_sampler_reshuffles_per_epoch(self):
+        """Regression (ISSUE 3 satellite): a supplied generator seed
+        must not pin every epoch to the identical permutation — the
+        epoch counter folds into the seed."""
+        s = RandomSampler(list(range(32)), generator=123)
+        e0, e1, e2 = list(s), list(s), list(s)
+        assert sorted(e0) == sorted(e1) == sorted(e2) == list(range(32))
+        assert e0 != e1 and e1 != e2          # epochs differ...
+        s2 = RandomSampler(list(range(32)), generator=123)
+        assert [list(s2) for _ in range(3)] == [e0, e1, e2]  # ...reproducibly
+        # and an unseeded sampler still reshuffles per epoch
+        u = RandomSampler(list(range(32)))
+        assert list(u) != list(u)
+
+    def test_random_sampler_state_roundtrip_mid_epoch(self):
+        src = list(range(20))
+        ref = RandomSampler(src, generator=7)
+        epoch0, epoch1 = list(ref), list(ref)
+        live = RandomSampler(src, generator=7)
+        it = iter(live)
+        head = [next(it) for _ in range(7)]
+        state = live.state_dict()
+        assert state == {"epoch": 0, "cursor": 7}
+        fresh = RandomSampler(src, generator=7)
+        fresh.load_state_dict(state)
+        assert head + list(fresh) == epoch0    # exact remaining order
+        # the restored sampler's NEXT epoch is epoch 1, not a replay
+        assert list(fresh) == epoch1
+        # a state taken at an epoch boundary resumes at the next epoch
+        boundary = RandomSampler(src, generator=7)
+        list(boundary)
+        fresh2 = RandomSampler(src, generator=7)
+        fresh2.load_state_dict(boundary.state_dict())
+        assert list(fresh2) == epoch1
+
+    def test_sequence_sampler_cursor(self):
+        s = SequenceSampler(list(range(10)))
+        it = iter(s)
+        assert [next(it) for _ in range(4)] == [0, 1, 2, 3]
+        fresh = SequenceSampler(list(range(10)))
+        fresh.load_state_dict(s.state_dict())
+        assert list(fresh) == [4, 5, 6, 7, 8, 9]
+
+    def test_dataloader_state_roundtrip(self):
+        data = np.arange(24, dtype=np.float32).reshape(12, 2)
+        mk = lambda: DataLoader(list(data), batch_size=3,
+                                sampler=RandomSampler(data, generator=5))
+        ref = [b.copy() for b in mk()]
+        live = mk()
+        it = iter(live)
+        head = [next(it) for _ in range(2)]
+        resumed = mk()
+        resumed.load_state_dict(live.state_dict())
+        tail = list(resumed)
+        got = head + tail
+        assert len(got) == len(ref)
+        for a, b in zip(got, ref):
+            np.testing.assert_array_equal(a, b)
+
+    def test_distributed_sampler_reshard_disjoint_and_complete(self):
+        """A dp=4 mid-epoch state restores under dp=2 (and dp=3, the
+        non-dividing case): the new shards cover exactly the unseen
+        remainder of the epoch's global order, with no overlap between
+        ranks and no sample double-consumed."""
+        N, BS = 64, 2
+        mk = lambda nr, r: DistributedBatchSampler(
+            list(range(N)), BS, num_replicas=nr, rank=r, shuffle=True)
+        olds = [mk(4, r) for r in range(4)]
+        its = [iter(s) for s in olds]
+        consumed = []
+        for _ in range(3):                     # 3 lockstep batches
+            for it in its:
+                consumed += next(it)
+        state = olds[0].state_dict()
+        assert state["consumed"] == 3 * BS * 4 == len(consumed)
+        global_order = olds[0]._epoch_indices()
+        # prefix property: rank-strided sharding makes the lockstep-
+        # consumed SET exactly the head of the global order — the
+        # invariant that lets a new topology resume from one counter
+        assert set(consumed) == set(global_order[:len(consumed)])
+        for new_ranks in (2, 3):
+            news = [mk(new_ranks, r) for r in range(new_ranks)]
+            for s in news:
+                s.load_state_dict(state)
+            shards = [[i for b in s for i in b] for s in news]
+            remainder = set(global_order[len(consumed):])
+            seen = [i for sh in shards for i in sh]
+            assert set(seen) == remainder      # exactly the unseen rest
+            assert set(consumed).isdisjoint(remainder)
+            # non-overlapping across ranks up to the even-shard pad
+            pad = (-len(remainder)) % new_ranks
+            assert len(seen) - len(set(seen)) <= pad
+            # every rank got the same number of batches (lockstep safety)
+            assert len({len(sh) for sh in shards}) == 1
+
+    def test_generator_object_still_accepted(self):
+        """Passing a np.random.Generator OBJECT (torch/paddle-style)
+        keeps working: epochs differ via its advancing state; exact
+        (epoch, cursor) resume needs an int seed."""
+        s = RandomSampler(list(range(16)), generator=np.random.default_rng(0))
+        e0, e1 = list(s), list(s)
+        assert sorted(e0) == sorted(e1) == list(range(16))
+        assert e0 != e1
+        s.load_state_dict(s.state_dict())      # degrades, never crashes
+        assert sorted(list(s)) == list(range(16))
+        # a mid-epoch cursor is NOT reconstructible from a generator
+        # object: resume restarts the epoch (full coverage) instead of
+        # skipping never-seen samples of a fresh permutation
+        s2 = RandomSampler(list(range(16)), generator=np.random.default_rng(0))
+        s2.load_state_dict({"epoch": 0, "cursor": 5})
+        assert sorted(list(s2)) == list(range(16))
+
+    def test_distributed_sampler_reshuffles_per_epoch(self):
+        """Epoch wrap without set_epoch must reshuffle (same bug class
+        as the seeded RandomSampler fix); explicit set_epoch still
+        pins the order."""
+        d = DistributedBatchSampler(list(range(32)), 4, num_replicas=1,
+                                    rank=0, shuffle=True)
+        e0, e1 = list(d), list(d)
+        assert e0 != e1
+        d.set_epoch(0)
+        assert list(d) == e0
+
+    def test_epoch_tail_resume_onto_more_ranks_keeps_lockstep(self):
+        """Resuming with only 2 unseen samples onto 8 ranks: the pad
+        must CYCLE the remainder so every rank still gets the same
+        batch count (uneven shards would hang SPMD lockstep)."""
+        N = 64
+        olds = [DistributedBatchSampler(list(range(N)), 1, num_replicas=2,
+                                        rank=r, shuffle=True)
+                for r in range(2)]
+        its = [iter(s) for s in olds]
+        for _ in range(31):                    # 62 of 64 consumed
+            for it in its:
+                next(it)
+        state = olds[0].state_dict()
+        assert state["consumed"] == 62
+        news = [DistributedBatchSampler(list(range(N)), 1, num_replicas=8,
+                                        rank=r, shuffle=True)
+                for r in range(8)]
+        for s in news:
+            s.load_state_dict(state)
+        shards = [[i for b in s for i in b] for s in news]
+        assert len({len(sh) for sh in shards}) == 1   # lockstep preserved
+        assert all(len(sh) == 1 for sh in shards)
+        remainder = set(olds[0]._epoch_indices()[62:])
+        assert {i for sh in shards for i in sh} == remainder
+
+    def test_state_survives_restore_without_iteration(self):
+        """Double preemption: a restored-but-never-iterated sampler's
+        state_dict must re-report the held position (epoch, cursor, and
+        for DBS the ORIGINAL saving nranks), not a zeroed one."""
+        s = RandomSampler(list(range(16)), generator=3)
+        it = iter(s)
+        [next(it) for _ in range(5)]
+        state = s.state_dict()
+        fresh = RandomSampler(list(range(16)), generator=3)
+        fresh.load_state_dict(state)
+        assert fresh.state_dict() == state     # no iteration in between
+        d = DistributedBatchSampler(list(range(10)), 1, num_replicas=4,
+                                    rank=0, shuffle=True)
+        dit = iter(d)
+        next(dit), next(dit)
+        dstate = d.state_dict()
+        assert dstate["nranks"] == 4
+        d2 = DistributedBatchSampler(list(range(10)), 1, num_replicas=5,
+                                     rank=0, shuffle=True)
+        d2.load_state_dict(dstate)
+        assert d2.state_dict() == dstate       # still the saving topology
+
+    def test_distributed_sampler_same_topology_resume(self):
+        N, BS = 32, 4
+        ref = [b for b in DistributedBatchSampler(
+            list(range(N)), BS, num_replicas=2, rank=0, shuffle=True)]
+        live = DistributedBatchSampler(list(range(N)), BS, num_replicas=2,
+                                       rank=0, shuffle=True)
+        it = iter(live)
+        head = [next(it), next(it)]
+        fresh = DistributedBatchSampler(list(range(N)), BS, num_replicas=2,
+                                        rank=0, shuffle=True)
+        fresh.load_state_dict(live.state_dict())
+        assert head + list(fresh) == ref
+
+
+# ======================================================= empty dataloader
+def test_empty_train_dataloader_raises_value_error(tmp_path):
+    """Regression (ISSUE 3 satellite): the epoch-wrap ``next`` must not
+    leak a bare StopIteration out of the training loop."""
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.trainer import Trainer, TrainingArguments
+    pt.seed(0)
+    tr = Trainer(LlamaForCausalLM(llama_tiny()),
+                 pt.optimizer.AdamW(learning_rate=1e-3),
+                 TrainingArguments(output_dir=str(tmp_path), max_steps=2,
+                                   resume_from_checkpoint=False),
+                 train_dataloader=[])
+    with pytest.raises(ValueError, match="train_dataloader is empty"):
+        tr.train()
+
+
+# ========================================================== preempt e2e
+class _RecordingDataset:
+    """Token dataset that logs every __getitem__ — replay-based resume
+    would re-fetch consumed samples; O(1) sampler restore must not."""
+
+    def __init__(self, n=16, s=16, vocab=256):
+        self.data = np.random.RandomState(7).randint(0, vocab, (n, s))
+        self.fetches = []
+
+    def __getitem__(self, i):
+        self.fetches.append(i)
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+def _preempt_trainer(out_dir, max_steps=10):
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.trainer import Trainer, TrainingArguments
+    pt.seed(0)
+    ds = _RecordingDataset()
+    dl = DataLoader(ds, batch_size=4, sampler=RandomSampler(ds, generator=11))
+    args = TrainingArguments(output_dir=str(out_dir), max_steps=max_steps,
+                             logging_steps=1, save_steps=4, seed=42)
+    tr = Trainer(LlamaForCausalLM(llama_tiny()),
+                 pt.optimizer.AdamW(learning_rate=1e-3), args,
+                 train_dataloader=dl)
+    return tr, ds
+
+
+class TestPreemptionE2E:
+    def test_preempt_checkpoints_exits_and_resumes_exactly(self, tmp_path):
+        """ACCEPTANCE: injected preempt mid-run -> checkpoint at the
+        exact step + PREEMPTED_RC; relaunch resumes to the SAME final
+        loss as an uninterrupted run on the identical data order, via
+        sampler-state restore (O(1)), not replay."""
+        ref, _ = _preempt_trainer(tmp_path / "ref")
+        ref.train()
+        ref_final = ref.logger.history["loss"][-1][1]
+
+        tr, _ = _preempt_trainer(tmp_path / "run")
+        with faults.scoped("preempt@6"):
+            with pytest.raises(SystemExit) as ei:
+                tr.train()
+        assert ei.value.code == PREEMPTED_RC == tr.args.preempt_exit_code
+        assert tr.global_step == 6             # exact step, not save_steps
+        ckdir = tmp_path / "run" / "checkpoints"
+        steps = sorted(int(d) for d in os.listdir(ckdir) if d.isdigit())
+        assert 6 in steps
+        meta = json.load(open(ckdir / "meta" / "6.json"))
+        assert meta["step"] == 6
+        assert meta["sampler"]["batch_sampler"]["sampler"]["cursor"] == 8
+        assert meta["topology"]["dp"] == 1
+
+        # relaunch: O(1) sampler restore, identical trajectory
+        tr2, ds2 = _preempt_trainer(tmp_path / "run")
+        tr2.train()
+        assert tr2._sampler_restored
+        assert tr2.global_step == 10
+        # no replay: exactly the 4 remaining steps' samples were fetched
+        assert len(ds2.fetches) == 4 * 4
+        final = tr2.logger.history["loss"][-1][1]
+        assert abs(final - ref_final) < 1e-6, (final, ref_final)
+
+    def test_latch_cleared_on_next_train_call(self, tmp_path):
+        """In-process retry after a preemption exit: a latch tripped in
+        the previous train() must not make the next call exit before
+        its first step."""
+        tr, _ = _preempt_trainer(tmp_path / "again", max_steps=4)
+        with faults.scoped("preempt@1"):
+            with pytest.raises(SystemExit):
+                tr.train()
+        assert tr.global_step == 1
+        tr.train()                             # latch cleared: runs
+        assert tr.global_step == 4
+
+    def test_sigterm_latch_requests_graceful_stop(self, tmp_path):
+        """The signal channel latches identically to the fault channel
+        (handler installed by train(); request observed at the next
+        step boundary)."""
+        import signal as _signal
+        tr, _ = _preempt_trainer(tmp_path / "sig", max_steps=6)
+
+        class Kick:
+            def __init__(self):
+                self.sent = False
+
+            def on_step_end(self, step, logs):
+                if step >= 2 and not self.sent:
+                    self.sent = True
+                    os.kill(os.getpid(), _signal.SIGTERM)
+
+            def on_save(self, step):
+                pass
+
+            def on_train_end(self, step):
+                pass
+
+        tr.callbacks.append(Kick())
+        before = _signal.getsignal(_signal.SIGTERM)
+        with pytest.raises(SystemExit) as ei:
+            tr.train()
+        assert ei.value.code == PREEMPTED_RC
+        assert 2 <= tr.global_step < 6
+        # handler uninstalled on the way out (previous handler restored)
+        assert _signal.getsignal(_signal.SIGTERM) is before
+        assert isinstance(tr._shutdown, GracefulShutdown)
+        assert tr._shutdown.requested()
+
+
+# ================================================= supervisor awareness
+_COUNTER_CHILD = r"""
+import os, sys
+p = sys.argv[1]
+n = int(open(p).read()) if os.path.exists(p) else 0
+open(p, "w").write(str(n + 1))
+codes = [int(c) for c in sys.argv[2].split(",")]
+sys.exit(codes[min(n, len(codes) - 1)])
+"""
+
+
+class TestSupervisorPreemption:
+    def _run(self, counter, codes, **kw):
+        from paddle_tpu.distributed.elastic import supervise
+        rc = supervise([sys.executable, "-c", _COUNTER_CHILD, str(counter),
+                        ",".join(map(str, codes))], backoff_s=0.01, **kw)
+        n = int(open(counter).read()) if os.path.exists(counter) else 0
+        return rc, n
+
+    def test_preempted_rc_restarts_without_consuming_attempts(self, tmp_path):
+        # two preemptions, then success — with ZERO crash restarts
+        # allowed; only works if preemption is a free restart
+        rc, n = self._run(tmp_path / "a", [PREEMPTED_RC, PREEMPTED_RC, 0],
+                          max_restarts=0)
+        assert rc == 0 and n == 3
+        # a real crash after a preemption still consumes the budget
+        rc, n = self._run(tmp_path / "b", [PREEMPTED_RC, 7, 7],
+                          max_restarts=1)
+        assert rc == 7 and n == 3              # preempt + crash + retry
+
+    def test_preemption_storm_bounded(self, tmp_path):
+        rc, n = self._run(tmp_path / "c", [PREEMPTED_RC], max_restarts=0,
+                          max_preemptions=2)
+        assert rc == PREEMPTED_RC and n == 3   # initial + 2 free restarts
+
+    def test_topology_change_logged(self, tmp_path, capfd):
+        topos = iter(["v4-8", "v4-8", "v4-4", "v4-4"])
+        rc, n = self._run(tmp_path / "d", [PREEMPTED_RC, 0], max_restarts=0,
+                          probe_topology=lambda: next(topos))
+        assert rc == 0 and n == 2
+        err = capfd.readouterr().err
+        assert "topology changed" in err and "v4-4" in err
+
+    def test_default_probe_reads_mutable_file(self, tmp_path, monkeypatch):
+        """The default topology probe must see changes made AFTER the
+        supervisor launched — env is frozen, the file channel is not."""
+        from paddle_tpu.distributed.elastic import _default_topology
+        f = tmp_path / "ws"
+        monkeypatch.setenv("PADDLE_TPU_WORLD_SIZE_FILE", str(f))
+        assert _default_topology() is None   # not written yet
+        f.write_text("8\n")
+        assert _default_topology() == "8"
+        f.write_text("4")
+        assert _default_topology() == "4"    # mutable between relaunches
+        monkeypatch.delenv("PADDLE_TPU_WORLD_SIZE_FILE")
+        monkeypatch.setenv("PADDLE_TPU_WORLD_SIZE", "16")
+        assert _default_topology() == "16"   # static fallback
+
+    def test_fault_sites_tool_check(self):
+        """tools/fault_sites.py --check: the inventory (incl. the new
+        `preempt` site) matches the wired code."""
+        import importlib.util
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "fault_sites", os.path.join(root, "tools", "fault_sites.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.check_wired() == 0
+        assert "preempt" in faults.SITES
+
+
+# ================================================== cross-topology resume
+class TestCrossTopologyResume:
+    def test_ckpt_dp4_restores_under_dp2_identical_numerics(self, tmp_path):
+        """ACCEPTANCE (checkpoint layer): arrays saved sharded over a
+        dp=4 mesh restore onto a dp=2 mesh via orbax target shardings —
+        deliberate resharding, identical numerics."""
+        from paddle_tpu.checkpoint.distributed_ckpt import \
+            DistributedCheckpoint
+        from paddle_tpu.distributed import env
+        mesh4 = env.init_parallel_env({"dp": 4}, devices=jax.devices()[:4])
+        w = np.arange(64, dtype=np.float32).reshape(8, 8)
+        m = np.linspace(-1, 1, 32, dtype=np.float32).reshape(8, 4)
+        tree4 = {
+            "params": {"w": jax.device_put(
+                w, NamedSharding(mesh4, P("dp", None)))},
+            "opt_state": {"m": jax.device_put(
+                m, NamedSharding(mesh4, P("dp", None)))},
+        }
+        ck = DistributedCheckpoint(str(tmp_path), async_save=False)
+        ck.save(1, tree4, wait=True, meta={"topology": {"dp": 4}})
+        env.clear_mesh()
+
+        mesh2 = env.init_parallel_env({"dp": 2}, devices=jax.devices()[:2])
+        sh2 = NamedSharding(mesh2, P("dp", None))
+        like = {"params": {"w": jax.device_put(np.zeros_like(w), sh2)},
+                "opt_state": {"m": jax.device_put(np.zeros_like(m), sh2)}}
+        out = ck.restore(1, like=like)
+        assert out["params"]["w"].sharding.is_equivalent_to(sh2, 2)
+        np.testing.assert_array_equal(np.asarray(out["params"]["w"]), w)
+        np.testing.assert_array_equal(np.asarray(out["opt_state"]["m"]), m)
+        assert ck.load_meta(1) == {"topology": {"dp": 4}}
+        ck.close()
+
+    def test_trainer_reconciles_dp4_to_dp2(self, tmp_path):
+        """ACCEPTANCE (trainer layer): resume under a halved dp degree
+        restores identical params/opt-state, recomputes grad
+        accumulation to preserve the effective global batch, and
+        re-shards the sampler's remaining index space disjointly."""
+        from paddle_tpu.distributed import env
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+        from paddle_tpu.trainer import Trainer, TrainingArguments
+
+        # 128 samples / (4 per batch * 4 ranks) = 8 lockstep steps per
+        # epoch: the step-4 checkpoint lands MID-epoch, so resharding
+        # has a real remainder to redistribute
+        data = np.random.RandomState(7).randint(0, 256, (128, 16))
+
+        def mk(nranks, rank, max_steps):
+            pt.seed(0)
+            dl = DataLoader(
+                list(data),
+                batch_sampler=DistributedBatchSampler(
+                    list(data), 4, num_replicas=nranks, rank=rank,
+                    shuffle=True))
+            args = TrainingArguments(output_dir=str(tmp_path),
+                                     max_steps=max_steps, logging_steps=1,
+                                     save_steps=4, seed=42)
+            return Trainer(LlamaForCausalLM(llama_tiny()),
+                           pt.optimizer.AdamW(learning_rate=1e-3), args,
+                           train_dataloader=dl)
+
+        env.init_parallel_env({"dp": 4}, devices=jax.devices()[:4])
+        tr = mk(4, 0, max_steps=4)
+        tr.train()                              # saves at step 4
+        tr._ckpt.wait_until_finished()
+        saved = {k: np.asarray(v) for k, v in tr._params.items()}
+        meta = tr._ckpt.load_meta(4)
+        assert meta["topology"]["dp"] == 4
+        assert meta["topology"]["mesh"]["dp"] == 4
+        consumed_n = 4 * 4 * 4                  # steps * batch * ranks
+        assert meta["sampler"]["batch_sampler"]["consumed"] == consumed_n
+        env.clear_mesh()
+
+        env.init_parallel_env({"dp": 2}, devices=jax.devices()[:2])
+        tr2 = mk(2, 0, max_steps=6)
+        tr2._opt_state = tr2.optimizer.init(tr2._params)
+        assert tr2._try_resume() == 4
+        # identical numerics across the topology change
+        for k in saved:
+            np.testing.assert_array_equal(saved[k],
+                                          np.asarray(tr2._params[k]))
+        # per-device share preserved: dp 4->2 doubles accumulation
+        assert tr2.args.gradient_accumulation_steps == 2
+        assert tr2._step_fn is None             # rebuilt for the new accum
+        assert tr2._sampler_restored
+
+        # the two new ranks shard the REMAINING index space disjointly
+        def resharded(rank):
+            s = DistributedBatchSampler(list(data), 4, num_replicas=2,
+                                        rank=rank, shuffle=True)
+            s.load_state_dict(meta["sampler"]["batch_sampler"])
+            return [i for b in s for i in b]
+
+        shard0, shard1 = resharded(0), resharded(1)
+        global_order = DistributedBatchSampler(
+            list(data), 4, num_replicas=2, rank=0,
+            shuffle=True)._epoch_indices()
+        assert set(shard0).isdisjoint(shard1)
+        assert set(shard0) | set(shard1) == set(global_order[consumed_n:])
+        assert set(shard0 + shard1).isdisjoint(global_order[:consumed_n])
+
+        # and training continues to completion under the new topology
+        tr2.train()
+        assert tr2.global_step == 6
+        assert np.isfinite(tr2.logger.history["loss"][-1][1])
+
+
+def test_reconcile_clamps_accum_to_loader_batch(tmp_path):
+    """dp 4->3 with accum 3 and loader batch 6 would naively pick
+    accum=4, which cannot fold a batch of 6 — the reconcile clamps to
+    the nearest divisor instead of crashing the first resumed step."""
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.trainer import Trainer, TrainingArguments
+    pt.seed(0)
+    ds = list(np.random.RandomState(7).randint(0, 256, (24, 16)))
+    dl = DataLoader(ds, batch_size=6)
+    args = TrainingArguments(output_dir=str(tmp_path), max_steps=1,
+                             gradient_accumulation_steps=3)
+    tr = Trainer(LlamaForCausalLM(llama_tiny()),
+                 pt.optimizer.AdamW(learning_rate=1e-3), args,
+                 train_dataloader=dl)
+    tr._dp_degree = lambda: 3
+    tr._reconcile_topology({"dp": 4, "accum": 3})
+    assert tr.args.gradient_accumulation_steps == 3   # 4 -> clamp to 3
+    tr._dp_degree = lambda: 2
+    tr._reconcile_topology({"dp": 4, "accum": 3})
+    assert tr.args.gradient_accumulation_steps == 6   # exact: divides 6
+
+
+# ====================================== rollback keeps poisoned-window skip
+def test_divergence_rollback_does_not_rewind_sampler(tmp_path):
+    """A divergence rollback restores ARRAYS only: the sampler cursor
+    must keep its live position (poisoned-window skip), not rewind to
+    the checkpoint's — only a process relaunch restores data state."""
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.trainer import Trainer, TrainingArguments
+    pt.seed(0)
+    ds = _RecordingDataset()
+    dl = DataLoader(ds, batch_size=4, sampler=RandomSampler(ds, generator=11))
+    args = TrainingArguments(output_dir=str(tmp_path), max_steps=6,
+                             logging_steps=1, save_steps=2, nan_patience=1,
+                             seed=42)
+    tr = Trainer(LlamaForCausalLM(llama_tiny()),
+                 pt.optimizer.AdamW(learning_rate=1e-3), args,
+                 train_dataloader=dl)
+    with faults.scoped("step_nan@2"):      # fires at global step 3
+        tr.train()
+    assert tr._rollbacks == 1
+    assert tr.global_step == 6
+    assert not tr._sampler_restored        # rollback didn't touch data
+    # steps 1-3 fetched 3 batches, rollback to ckpt@2, steps 3-6 fetch 4
+    # more — NO batch re-fetched by a rewind
+    assert len(ds.fetches) == 7 * 4
+
+
+# ============================================== concurrent resume safety
+def test_resume_waits_for_inflight_async_save(tmp_path):
+    """ISSUE 3 satellite: auto-resume racing a still-in-flight async
+    save must drain it (wait_until_finished BEFORE latest_complete_step)
+    and restore the finalized step — never a torn one."""
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.trainer import Trainer, TrainingArguments
+    pt.seed(0)
+    batch = jnp.asarray(np.random.RandomState(7).randint(0, 256, (4, 16)))
+    args = TrainingArguments(output_dir=str(tmp_path), max_steps=3,
+                             logging_steps=1, save_steps=0, seed=42,
+                             donate_state=False)
+    tr = Trainer(LlamaForCausalLM(llama_tiny()),
+                 pt.optimizer.AdamW(learning_rate=1e-3), args,
+                 train_dataloader=[batch])
+    tr.train()
+    tr.save_checkpoint(wait=False)             # async save in flight
+    params_at_save = {k: np.asarray(v) for k, v in tr._params.items()}
+
+    ckpt = tr._ckpt_manager()
+    calls = []
+    orig_wait = ckpt.wait_until_finished
+    orig_latest = ckpt.latest_complete_step
+    ckpt.wait_until_finished = lambda: (calls.append("wait"),
+                                        orig_wait())[1]
+    ckpt.latest_complete_step = lambda: (calls.append("latest"),
+                                         orig_latest())[1]
+    try:
+        restored = tr._try_resume()
+    finally:
+        ckpt.wait_until_finished = orig_wait
+        ckpt.latest_complete_step = orig_latest
+    assert restored == 3
+    assert "wait" in calls and "latest" in calls
+    assert calls.index("wait") < calls.index("latest")
+    for k in params_at_save:
+        np.testing.assert_array_equal(params_at_save[k],
+                                      np.asarray(tr._params[k]))

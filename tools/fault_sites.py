@@ -1,13 +1,40 @@
 #!/usr/bin/env python
-"""Print the fault-injection site inventory (thin wrapper so ops
-tooling under tools/ has one obvious entry point; equivalent to
-``python -m paddle_tpu.utils.faults --list``)."""
+"""Fault-injection site inventory (thin ops wrapper over
+``python -m paddle_tpu.utils.faults --list``).
+
+``--check`` additionally verifies the inventory has not drifted from the
+code: every registered site (including the elastic-training ``preempt``
+site) must have a live ``faults.inject("<site>")`` call at the module it
+claims to be wired into."""
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
 
 from paddle_tpu.utils import faults  # noqa: E402
 
+
+def check_wired() -> int:
+    bad = []
+    for site, (where, _) in sorted(faults.SITES.items()):
+        path = os.path.join(ROOT, where.split(":")[0])
+        if not os.path.exists(path):
+            bad.append(f"{site}: {where} (file missing)")
+        elif f'inject("{site}"' not in open(path).read():
+            bad.append(f"{site}: no inject(\"{site}\") call in {where}")
+    if bad:
+        print("fault-site inventory drifted from the code:",
+              file=sys.stderr)
+        for line in bad:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    print(f"all {len(faults.SITES)} fault sites wired: "
+          + ", ".join(sorted(faults.SITES)))
+    return 0
+
+
 if __name__ == "__main__":
+    if "--check" in sys.argv[1:]:
+        sys.exit(check_wired())
     sys.exit(faults.main(["--list"]))
