@@ -18,6 +18,7 @@ import sys
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
+from ..utils import compile_cache
 from ..utils.faults import retry_with_backoff
 from ..utils.shutdown import PREEMPTED_RC
 
@@ -56,7 +57,8 @@ def supervise(argv: Sequence[str], max_restarts: int = 3,
               preempt_rc: Optional[int] = PREEMPTED_RC,
               max_preemptions: Optional[int] = None,
               probe_topology: Optional[Callable[[], Any]]
-              = _default_topology) -> int:
+              = _default_topology,
+              compile_cache_dir: Optional[str] = None) -> int:
     """Run ``argv`` as a subprocess; relaunch on failure with jittered
     exponential backoff (the shared utils.faults.retry_with_backoff —
     ``backoff_s`` seeds the base delay, doubling per consecutive
@@ -78,7 +80,18 @@ def supervise(argv: Sequence[str], max_restarts: int = 3,
     launch and changes are logged — the job may come back with a
     different world size, which the Trainer reconciles from its
     topology manifest on resume.
+
+    compile_cache_dir: persistent XLA compilation cache shared by every
+    (re)launch — injected into children as
+    ``$PADDLE_TPU_COMPILE_CACHE_DIR`` (the child's ``Trainer.train``
+    resolves it via ``utils.compile_cache.enable``), so a
+    preempted-and-relaunched worker restores its step executable from
+    disk instead of paying full recompilation. None inherits the
+    supervisor's env (which may itself carry the var); the supervisor
+    never imports jax — the child owns the accelerator.
     """
+    child_environ = compile_cache.child_env(compile_cache_dir) \
+        if compile_cache.resolve_dir(compile_cache_dir) else None
     preemptions = [0]
     last_topo: List[Any] = [probe_topology() if probe_topology else None]
 
@@ -97,7 +110,8 @@ def supervise(argv: Sequence[str], max_restarts: int = 3,
         while True:
             check_topology()
             try:
-                proc = subprocess.run(list(argv), timeout=timeout_s)
+                proc = subprocess.run(list(argv), timeout=timeout_s,
+                                      env=child_environ)
                 rc = proc.returncode
             except subprocess.TimeoutExpired:
                 # a child hung before its own watchdog could fire (e.g.
@@ -146,16 +160,27 @@ def main(args: Optional[List[str]] = None) -> int:
     -- cmd args...``"""
     args = list(sys.argv[1:] if args is None else args)
     max_restarts = 3
-    if args and args[0] == "--max-restarts":
-        max_restarts = int(args[1])
+    cache_dir = None
+    while args and args[0] in ("--max-restarts", "--compile-cache-dir"):
+        if len(args) < 2 or args[1] == "--":
+            # flag without a value: fall through to the usage message
+            # instead of an IndexError (or eating the -- separator)
+            args = []
+            break
+        if args[0] == "--max-restarts":
+            max_restarts = int(args[1])
+        else:
+            cache_dir = args[1]
         args = args[2:]
     if args and args[0] == "--":
         args = args[1:]
     if not args:
         print("usage: python -m paddle_tpu.distributed.elastic "
-              "[--max-restarts N] -- cmd ...", file=sys.stderr)
+              "[--max-restarts N] [--compile-cache-dir DIR] -- cmd ...",
+              file=sys.stderr)
         return 2
-    return supervise(args, max_restarts=max_restarts)
+    return supervise(args, max_restarts=max_restarts,
+                     compile_cache_dir=cache_dir)
 
 
 if __name__ == "__main__":

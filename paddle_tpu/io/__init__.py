@@ -2,6 +2,7 @@
 from .dataset import (ChainDataset, ConcatDataset, Dataset, IterableDataset,
                       Subset, TensorDataset, random_split)
 from .dataloader import DataLoader, default_collate_fn
+from .device_prefetch import DevicePrefetcher, default_device_put
 from .worker import WorkerError, WorkerInfo, get_worker_info
 from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
                       Sampler, SequenceSampler, SubsetRandomSampler,

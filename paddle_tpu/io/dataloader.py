@@ -21,6 +21,22 @@ from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
 
+def bounded_put(q: "queue.Queue", item, stop: threading.Event,
+                poll_s: float = 0.05) -> bool:
+    """Bounded producer put that re-checks ``stop`` while the queue is
+    full, so an abandoned consumer (early break, preemption exit) can't
+    leave the producer thread parked forever. Returns False when stopped
+    before the item fit. Shared by the DataLoader prefetch threads and
+    io.device_prefetch's producer — one copy of the shutdown race."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=poll_s)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
 def default_collate_fn(batch):
     """Stack samples into batch arrays (mirrors paddle's default_collate_fn)."""
     sample = batch[0]
@@ -105,15 +121,7 @@ class DataLoader:
                 self.exc = exc
 
         def put(item):
-            """Bounded put that re-checks stop so an abandoned consumer
-            (early break) can't leave this thread parked on a full queue."""
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.05)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+            return bounded_put(q, item, stop)
 
         def producer():
             try:

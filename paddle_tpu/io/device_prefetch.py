@@ -1,0 +1,312 @@
+"""Asynchronous device-prefetch input pipeline (ISSUE 4 tentpole).
+
+The trainer used to dispatch each jitted step against a host-resident
+numpy batch, so the H2D transfer serialized with the previous step's
+compute. ``DevicePrefetcher`` wraps any dataloader/iterable and runs
+batch prep (``Trainer._prep_batch``-style reshaping) plus
+``jax.device_put`` — replicated onto the live global mesh when one is
+installed — in a background thread with a BOUNDED double/triple buffer,
+so the next batch's host assembly and device copy overlap the current
+step's compute (the "keep the SPMD program fed" half of GSPMD's MFU
+story; see PAPERS.md).
+
+Preemption safety (composes with the PR 3 graceful-shutdown latch and
+sampler-state checkpointing): the wrapped sampler runs AHEAD of the
+consumer by up to the buffer depth, so exposing the producer's live
+cursor would make a checkpoint skip buffered-but-untrained batches on
+resume. Instead every buffered batch carries the loader's
+``state_dict()`` snapshot taken right after it was drawn, and
+``state_dict()`` reports the snapshot of the last batch actually
+YIELDED — exactly the consumer position. Nothing is double-trained or
+silently skipped, and the bit-identical-trajectory preemption tests
+hold with prefetch enabled.
+
+Robustness: a wedged producer (the seeded ``prefetch_stall`` fault, or
+a genuinely hung host input pipeline) must degrade, not deadlock — when
+the buffer stays empty past ``stall_timeout_s`` the consumer takes the
+fetch lock and feeds itself synchronously from the wrapped iterator
+(``sync_fallbacks`` counts these). The lock serializes every access to
+the inner iterator, so producer and degraded consumer never interleave
+a fetch.
+"""
+from __future__ import annotations
+
+import queue
+import sys
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+import jax
+
+from ..utils import faults
+
+__all__ = ["DevicePrefetcher", "default_device_put"]
+
+_BATCH, _ERROR, _END = "batch", "error", "end"
+
+
+def default_device_put(batch):
+    """Place a host batch (array or pytree) onto the accelerator:
+    replicated onto the live global mesh when one is installed (the
+    jitted step's sharding constraints re-shard it on-device), plain
+    ``device_put`` on a single local device, and a host pass-through
+    when placement is ambiguous (several devices, no mesh — jit's own
+    placement logic wins, as before)."""
+    from ..distributed import env as denv
+    if denv.has_mesh():
+        return jax.device_put(batch, denv.replicated())
+    if len(jax.local_devices()) == 1:
+        return jax.device_put(batch)
+    return batch
+
+
+class _PrefetchIterator:
+    """One epoch's background feed; created by ``iter(DevicePrefetcher)``."""
+
+    def __init__(self, loader, prep, place, depth, stall_timeout_s,
+                 inner=None):
+        self._prep = prep
+        self._place = place
+        self._stall_timeout_s = stall_timeout_s
+        self._inner = iter(loader) if inner is None else inner
+        self._snapshot = getattr(loader, "state_dict", None)
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._lock = threading.Lock()          # serializes self._inner
+        self._stop = threading.Event()
+        self._exhausted = False                # inner raised StopIteration
+        self._finished = False                 # consumer saw the end
+        self._degraded = False                 # stall latch: sync feeding
+        self.state = self._snap()              # last-YIELDED position
+        self.sync_fallbacks = 0
+        self._warned_stall = False
+        self._thread = threading.Thread(
+            target=self._produce, name="device-prefetch", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ producer
+    def _snap(self) -> dict:
+        if self._snapshot is None:
+            return {}
+        try:
+            return self._snapshot() or {}
+        except Exception as e:     # state is best-effort; feeding is not
+            print(f"[prefetch] loader state_dict failed: {e}",
+                  file=sys.stderr, flush=True)
+            return {}
+
+    def _fetch_locked(self):
+        """next(inner) + state snapshot + prep + device_put. Caller must
+        hold the lock: the snapshot only means "position after this
+        batch" if no other fetch is in flight."""
+        batch = next(self._inner)              # may raise StopIteration
+        snap = self._snap()
+        if self._prep is not None:
+            batch = self._prep(batch)
+        return self._place(batch), snap        # device_put dispatch is async
+
+    def _put(self, item) -> bool:
+        from .dataloader import bounded_put
+        return bounded_put(self._q, item, self._stop)
+
+    def _produce(self):
+        try:
+            while not self._stop.is_set():
+                if faults.inject("prefetch_stall"):
+                    # OUTSIDE the lock: the consumer's degraded
+                    # synchronous path must be able to feed itself while
+                    # this thread is wedged
+                    time.sleep(faults.prefetch_stall_seconds())
+                with self._lock:
+                    if self._stop.is_set() or self._exhausted:
+                        break
+                    try:
+                        item = self._fetch_locked()
+                    except StopIteration:
+                        self._exhausted = True
+                        break
+                    # still under the lock: a bypassing consumer must
+                    # find either this batch already queued or a free
+                    # lock and an empty queue — never a batch in limbo
+                    if not self._put((_BATCH, item)):
+                        return
+        except BaseException as e:             # propagate into the consumer
+            self._put((_ERROR, e))
+            return
+        self._put((_END, None))
+
+    # ------------------------------------------------------------ consumer
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        while True:
+            if self._degraded:
+                # latched: don't pay the full stall timeout on the empty
+                # queue every batch — go straight to the sync path, which
+                # drains (and un-latches on) a recovered producer's
+                # deliveries before falling back to the fetch lock
+                kind, payload = self._degraded_fetch()
+                if kind is None:
+                    continue                   # producer holds the lock
+            else:
+                try:
+                    kind, payload = self._q.get(
+                        timeout=self._stall_timeout_s)
+                except queue.Empty:
+                    kind, payload = self._degraded_fetch()
+                    if kind is None:
+                        continue               # producer mid-cycle: wait on
+            if kind == _BATCH:
+                batch, snap = payload
+                if snap:
+                    self.state = snap
+                return batch
+            if kind == _ERROR:
+                self._finished = True
+                self.close()
+                raise payload
+            self._finished = True              # _END
+            self.close()
+            raise StopIteration
+
+    def _degraded_fetch(self):
+        """Stall path: the producer delivered nothing for a full
+        timeout. Take the fetch lock and feed synchronously — training
+        degrades to the old serial feed instead of deadlocking."""
+        try:
+            # Drain the buffer BEFORE taking the lock. Queue order is
+            # fetch order, so a lock-free get is always consistent — and
+            # it is what unwedges a RECOVERED producer that filled the
+            # bounded queue and is now blocked in its put while holding
+            # the fetch lock (which this path would otherwise wait on
+            # forever: latched consumer needs the lock, producer needs a
+            # queue slot).
+            item = self._q.get_nowait()
+            self._degraded = False             # producer is feeding again
+            return item
+        except queue.Empty:
+            pass
+        if not self._lock.acquire(timeout=self._stall_timeout_s):
+            return None, None                  # producer holds the lock
+        try:
+            try:
+                item = self._q.get_nowait()    # raced a late delivery
+                self._degraded = False         # producer is feeding again
+                return item
+            except queue.Empty:
+                pass
+            if self._exhausted:
+                return _END, None
+            if not self._warned_stall:
+                self._warned_stall = True
+                print(f"[prefetch] no batch for {self._stall_timeout_s:.1f}s "
+                      f"(stalled prefetch thread); degrading to synchronous "
+                      f"feeding", file=sys.stderr, flush=True)
+            try:
+                item = self._fetch_locked()
+            except StopIteration:
+                self._exhausted = True
+                return _END, None
+            self.sync_fallbacks += 1
+            self._degraded = True              # stay synchronous until the
+            return _BATCH, item                # producer delivers again
+        finally:
+            self._lock.release()
+
+    def close(self, join_timeout_s: float = 5.0):
+        """Idempotent teardown: stop the producer, discard buffered
+        batches (the consumer-position ``state`` is unaffected — that is
+        the whole point), and join the thread."""
+        self._stop.set()
+        try:
+            while True:                        # unblock a producer in put()
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread.is_alive() and \
+                threading.current_thread() is not self._thread:
+            self._thread.join(timeout=join_timeout_s)
+
+
+class DevicePrefetcher:
+    """Iterable wrapper: each ``iter()`` starts a fresh background-fed
+    epoch (tearing down the previous epoch's thread first), matching the
+    trainer's epoch-wrap contract.
+
+    ``state_dict()`` reports the CONSUMER position (module docstring) in
+    exactly the wrapped loader's schema, so checkpoint meta sidecars are
+    byte-compatible with the synchronous path; ``load_state_dict``
+    delegates to the wrapped loader (call it before iterating, as the
+    trainer's resume path does)."""
+
+    def __init__(self, loader: Iterable, prep: Optional[Callable] = None,
+                 depth: int = 2, place: Optional[Callable] = None,
+                 stall_timeout_s: float = 5.0,
+                 initial_iter: Optional[Iterable] = None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.loader = loader
+        self.prep = prep
+        self.depth = depth
+        self.place = place if place is not None else default_device_put
+        self.stall_timeout_s = stall_timeout_s
+        # a partially-consumed iterator for the FIRST epoch only (the
+        # trainer's legacy resume skip advances the raw loader before
+        # wrapping, so discarded batches never pay prep + H2D); later
+        # epochs re-iterate the loader as usual
+        self._initial_iter = initial_iter
+        self._it: Optional[_PrefetchIterator] = None
+        self._last_state: Optional[dict] = None
+        self._closed_fallbacks = 0
+
+    def __iter__(self):
+        self.close()
+        inner, self._initial_iter = self._initial_iter, None
+        self._it = _PrefetchIterator(self.loader, self.prep, self.place,
+                                     self.depth, self.stall_timeout_s,
+                                     inner=inner)
+        return self._it
+
+    def __len__(self):
+        return len(self.loader)  # type: ignore[arg-type]
+
+    # ---------------------------------------------------- resumable state
+    def state_dict(self) -> dict:
+        if self._it is not None:
+            return dict(self._it.state)
+        if self._last_state is not None:
+            # closed epoch: the wrapped loader ran AHEAD by the buffer
+            # depth, so its live state_dict would over-report; the
+            # retained consumer position is the truthful one
+            return dict(self._last_state)
+        sd = getattr(self.loader, "state_dict", None)
+        return sd() if sd is not None else {}
+
+    def load_state_dict(self, state):
+        self._last_state = None
+        lsd = getattr(self.loader, "load_state_dict", None)
+        if lsd is not None:
+            lsd(state)
+
+    @property
+    def sync_fallbacks(self) -> int:
+        """Degraded synchronous fetches taken (stall fallback), summed
+        across closed epochs so the trainer can report it post-train."""
+        live = self._it.sync_fallbacks if self._it is not None else 0
+        return self._closed_fallbacks + live
+
+    def close(self):
+        if self._it is not None:
+            self._last_state = dict(self._it.state)
+            self._closed_fallbacks += self._it.sync_fallbacks
+            self._it.close()
+            self._it = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -26,8 +26,9 @@ import numpy as np
 from .models.llama import causal_lm_loss
 from .nn.layer import Layer
 from .optimizer.optimizers import Optimizer
-from .utils import faults
+from .utils import compile_cache, faults
 from .utils.logging import LogWriter
+from .utils.profiler import StepTimer, llama_flops_per_token
 from .utils.shutdown import PREEMPTED_RC, GracefulShutdown
 from .utils.watchdog import DivergenceError, StepWatchdog
 
@@ -77,6 +78,30 @@ class TrainingArguments:
     # not a failure and never consumes a max_restarts attempt).
     graceful_shutdown: bool = True
     preempt_exit_code: int = PREEMPTED_RC
+    # async input pipeline (perf): wrap the dataloader in a
+    # DevicePrefetcher so batch prep + the H2D copy of step N+1 overlap
+    # step N's compute instead of serializing with it. 0 disables
+    # (synchronous feeding, the pre-ISSUE-4 behavior). Checkpoint meta
+    # always records the CONSUMER position, so preemption/resume is
+    # bit-identical with or without prefetch.
+    prefetch_depth: int = 2
+    # a prefetch producer that delivers nothing for this long (wedged
+    # host pipeline, the seeded `prefetch_stall` fault) degrades the
+    # loop to synchronous feeding instead of deadlocking it
+    prefetch_stall_timeout_s: float = 5.0
+    # persistent XLA compilation cache: a preempted-and-relaunched
+    # worker restores the step executable from disk instead of paying
+    # full recompilation. None falls back to
+    # $PADDLE_TPU_COMPILE_CACHE_DIR (which elastic.supervise propagates
+    # to relaunched children); unset entirely = no-op.
+    compile_cache_dir: Optional[str] = None
+    # compile the train step ahead-of-time on the first batch (before
+    # step 0 "runs"), so compile time never counts against the first
+    # checkpoint/logging interval
+    aot_warmup: bool = False
+    # per-token model FLOPs for the in-loop MFU log; 0 derives it from
+    # the model config (llama-family) on the first batch
+    flops_per_token: float = 0.0
 
 
 class TrainerCallback:
@@ -127,7 +152,13 @@ class Trainer:
             nan_patience=self.args.nan_patience,
             hang_timeout_s=self.args.hang_timeout_s,
             on_hang=self._on_hang if self.args.hang_timeout_s else None)
-        self._pure_fn, self._params = model.functional()
+        # plain dict, NOT the OrderedDict functional() hands back: the
+        # jitted step returns plain-dict params, and dict/OrderedDict are
+        # DIFFERENT pytree node types — an OrderedDict here means step 2
+        # silently retraces+recompiles the whole step (and permanently
+        # invalidates the AOT-warmed executable)
+        pure_fn, params = model.functional()
+        self._pure_fn, self._params = pure_fn, dict(params)
         # PEFT/LoRA: parameters whose ParamMeta says trainable=False are
         # frozen — grads are taken only w.r.t. the trainable subset and
         # the optimizer holds state only for it (frozen weights never get
@@ -150,6 +181,14 @@ class Trainer:
         self._in_recovery = False
         self._shutdown: Optional[GracefulShutdown] = None
         self._sampler_restored = False
+        # live feed for the current/most-recent train(): the raw
+        # dataloader, or the DevicePrefetcher wrapping it — checkpoint
+        # meta must read sampler state from HERE (consumer position),
+        # never from a loader the prefetcher has run ahead on
+        self._data_feed = None
+        self.step_timer: Optional[StepTimer] = None
+        self._aot_done = False
+        self._derived_flops: Optional[float] = None
 
     # ------------------------------------------------------------ jit step
     def _pp_degree(self) -> int:
@@ -263,6 +302,11 @@ class Trainer:
     def train(self, max_steps: Optional[int] = None):
         args = self.args
         max_steps = max_steps or args.max_steps
+        # persistent compilation cache BEFORE anything traces: a
+        # relaunched (e.g. preempted) worker restores the byte-identical
+        # step executable from disk instead of recompiling. No-op when
+        # neither args nor $PADDLE_TPU_COMPILE_CACHE_DIR is set.
+        compile_cache.enable(args.compile_cache_dir)
         if self._opt_state is None:
             self._opt_state = self.optimizer.init(
                 {k: self._params[k] for k in self._trainable_keys}
@@ -273,13 +317,32 @@ class Trainer:
             self._step_fn = self._build_step()
 
         assert self.train_dataloader is not None, "pass train_dataloader"
-        data = iter(self.train_dataloader)
-        if self.global_step and args.skip_data_on_resume \
-                and not self._sampler_restored:
-            # legacy fallback: no sampler state in the checkpoint (plain
-            # iterables, pre-meta checkpoints) — blind O(global_step)
-            # replay of the stream. Loaders with state_dict support are
-            # restored in O(1) by _try_resume instead.
+        # async feed (AFTER _try_resume restored the sampler position):
+        # prep + device placement of batch N+1 overlap step N's compute
+        feed = self.train_dataloader
+        # legacy fallback: no sampler state in the checkpoint (plain
+        # iterables, pre-meta checkpoints) — blind O(global_step) replay
+        # of the stream. Loaders with state_dict support are restored in
+        # O(1) by _try_resume instead.
+        legacy_skip = bool(self.global_step and args.skip_data_on_resume
+                           and not self._sampler_restored)
+        if args.prefetch_depth > 0:
+            initial_iter = None
+            if legacy_skip:
+                # skip on the RAW loader: discarded batches must not pay
+                # accum-fold prep + an H2D copy in the producer thread
+                initial_iter = self._skip_consumed(
+                    iter(self.train_dataloader), self.global_step,
+                    source=self.train_dataloader)
+            from .io.device_prefetch import DevicePrefetcher
+            feed = DevicePrefetcher(
+                self.train_dataloader, prep=self._prep_batch,
+                depth=args.prefetch_depth,
+                stall_timeout_s=args.prefetch_stall_timeout_s,
+                initial_iter=initial_iter)
+        self._data_feed = feed
+        data = iter(feed)
+        if legacy_skip and feed is self.train_dataloader:
             data = self._skip_consumed(data, self.global_step)
         self._rollbacks = 0
         if self._shutdown is not None:
@@ -293,12 +356,27 @@ class Trainer:
         try:
             return self._train_loop(data, max_steps)
         finally:
+            if feed is not self.train_dataloader:
+                # tears the producer thread down; the prefetcher retains
+                # the consumer position so a post-train save_checkpoint
+                # still records truthful sampler state
+                feed.close()
             if self._shutdown is not None:
                 self._shutdown.uninstall()
 
     def _train_loop(self, data, max_steps: int):
         args = self.args
+        prefetching = self._data_feed is not self.train_dataloader
+        # windowed throughput meter: totals accumulate only while the
+        # loop is actually stepping — save/eval wall time is stopped out
+        # of the window, so tokens_per_sec/mfu measure the step loop,
+        # not checkpoint I/O
+        timer = self.step_timer = StepTimer(
+            flops_per_token=args.flops_per_token)
+        win_tokens = 0
+        win_steps = 0
         t_last = time.perf_counter()
+        timer.start()
         while self.global_step < max_steps:
             if faults.inject("preempt", step=self.global_step):
                 # chaos: deterministic stand-in for a scheduler
@@ -315,19 +393,33 @@ class Trainer:
             try:
                 batch = next(data)
             except StopIteration:
-                data = iter(self.train_dataloader)
+                data = iter(self._data_feed)
                 try:
                     batch = next(data)
                 except StopIteration:
                     # a bare StopIteration from the second next() would
                     # leak out of the loop as a silent early return
                     raise ValueError("train_dataloader is empty") from None
-            batch = self._prep_batch(batch)
+            if not prefetching:
+                # the prefetcher already prepped + placed in its thread
+                batch = self._prep_batch(batch)
+            if timer.flops_per_token == 0.0:
+                if self._derived_flops is None:
+                    self._derived_flops = self._derive_flops_per_token(batch)
+                timer.flops_per_token = self._derived_flops
+            if args.aot_warmup and not self._aot_done:
+                self._aot_warmup(batch)
+                # compile happened before "step 0"; don't bill it to the
+                # first throughput window
+                timer.start()
+                t_last = time.perf_counter()
             self._params, self._opt_state, self._scaler_state, loss = \
                 self._step_fn(self._params, self._opt_state,
                               self._scaler_state, jnp.int32(self.global_step),
                               batch)
             self.global_step += 1
+            win_tokens += self._batch_tokens(batch)
+            win_steps += 1
             self.watchdog.beat()
             if faults.inject("step_nan", step=self.global_step):
                 # chaos: numeric divergence — NaN the float params (as a
@@ -340,28 +432,63 @@ class Trainer:
                 loss = jnp.float32(float("nan"))
             if self.global_step % args.logging_steps == 0 or \
                     self.global_step == max_steps:
-                loss_val = float(loss)
+                loss_val = float(loss)   # host sync: closes the window
                 try:
                     self.watchdog.check_loss(loss_val, self.global_step)
                 except DivergenceError:
                     if not self._maybe_rollback():
                         raise
+                    # rollback time (restore I/O) is not step time
                     t_last = time.perf_counter()
+                    timer.start()
+                    win_tokens = 0
+                    win_steps = 0
                     continue
                 now = time.perf_counter()
+                dt = timer.stop(win_tokens, win_steps)
+                tps = win_tokens / max(dt, 1e-9)
                 logs = {"loss": loss_val,
-                        "steps_per_sec": args.logging_steps / (now - t_last)}
+                        # win_steps, not args.logging_steps: a save/eval
+                        # (or resume) landing mid-window resets t_last,
+                        # so the denominator only spans the steps since —
+                        # the numerator must match
+                        "steps_per_sec": win_steps / (now - t_last),
+                        "tokens_per_sec": tps,
+                        "mfu": timer.flops_per_token * tps /
+                        timer.peak_flops if timer.flops_per_token else 0.0}
+                win_tokens = 0
+                win_steps = 0
                 t_last = now
+                timer.start()
                 self.logger.add_scalars(logs, self.global_step)
                 for cb in self.callbacks:
                     cb.on_step_end(self.global_step, logs)
-            if args.save_steps and self.global_step % args.save_steps == 0:
-                self.save_checkpoint()
-                self.watchdog.beat()  # a long save is not a hung step
-            if args.eval_steps and self.eval_dataloader is not None and \
-                    self.global_step % args.eval_steps == 0:
-                self.evaluate()
-                self.watchdog.beat()  # ditto a long eval
+            due_save = args.save_steps and \
+                self.global_step % args.save_steps == 0
+            due_eval = args.eval_steps and self.eval_dataloader is not None \
+                and self.global_step % args.eval_steps == 0
+            if due_save or due_eval:
+                # close the throughput window BEFORE the save/eval (and
+                # drain in-flight compute so it isn't silently credited
+                # to the excluded span); the timer restart + t_last
+                # reset below keep save/eval wall time out of both the
+                # StepTimer totals and the next steps_per_sec window.
+                # Skipped when the logging branch just closed it —
+                # stopping an empty window would pad StepTimer.steps
+                # with a zero-length entry and skew avg_step_s.
+                if win_steps:
+                    jax.block_until_ready(loss)
+                    timer.stop(win_tokens, win_steps)
+                    win_tokens = 0
+                    win_steps = 0
+                if due_save:
+                    self.save_checkpoint()
+                    self.watchdog.beat()  # a long save is not a hung step
+                if due_eval:
+                    self.evaluate()
+                    self.watchdog.beat()  # ditto a long eval
+                timer.start()
+                t_last = time.perf_counter()
         for cb in self.callbacks:
             cb.on_train_end(self.global_step)
         if getattr(self, "_ckpt", None) is not None:
@@ -372,9 +499,12 @@ class Trainer:
         self.model.bind(self._params)
         return self
 
-    def _skip_consumed(self, data, n: int):
+    def _skip_consumed(self, data, n: int, source=None):
         """Advance the data iterator past ``n`` already-trained batches,
-        re-iterating at epoch boundaries."""
+        re-iterating ``source`` (default: the live feed) at epoch
+        boundaries."""
+        if source is None:
+            source = self._data_feed
         skip = n
         while skip > 0:
             got_any = False
@@ -383,7 +513,7 @@ class Trainer:
                 got_any = True
                 skip -= 1
             except StopIteration:
-                data = iter(self.train_dataloader)
+                data = iter(source)
                 try:
                     next(data)
                     skip -= 1
@@ -406,6 +536,86 @@ class Trainer:
                 batch = {k: fold(v) for k, v in batch.items()}
         return batch
 
+    # ------------------------------------------------------- perf meters
+    @staticmethod
+    def _token_array(batch):
+        """The token-id array of a batch ([b, s] or the accum-folded
+        [accum, b, s]): dict batches by ``input_ids``, tuple batches by
+        first element. None when the batch carries no shaped array —
+        the one unwrap heuristic shared by token counting and FLOPs
+        derivation, so the mfu ratio can't silently diverge."""
+        x = batch
+        if isinstance(x, dict):
+            x = x.get("input_ids", next(iter(x.values())))
+        elif isinstance(x, (list, tuple)) and x:
+            x = x[0]
+        return x if getattr(x, "shape", None) else None
+
+    @classmethod
+    def _batch_tokens(cls, batch) -> int:
+        """Token count of a step's batch for the throughput log."""
+        x = cls._token_array(batch)
+        return int(np.prod(x.shape)) if x is not None else 0
+
+    def _derive_flops_per_token(self, batch) -> float:
+        """Per-token train FLOPs for the MFU log when args.flops_per_token
+        is unset: the 6N + attention estimate from the model config
+        (llama-family shape); 0.0 when the config doesn't expose the
+        needed fields (mfu then logs as 0)."""
+        cfg = getattr(self.model, "config", None)
+        layers = getattr(cfg, "num_hidden_layers", None)
+        hidden = getattr(cfg, "hidden_size", None)
+        if not layers or not hidden:
+            return 0.0
+        x = self._token_array(batch)
+        if x is None:
+            return 0.0
+        seq = int(x.shape[-1])
+        n_params = sum(int(np.prod(v.shape)) for v in self._params.values()
+                       if hasattr(v, "shape"))
+        # honest 6N, matching bench.py's headline formula: the input
+        # embedding is a gather, not a matmul, so its params don't
+        # belong in 6N (lm_head does — it IS a matmul)
+        vocab = getattr(cfg, "vocab_size", None)
+        if vocab:
+            n_params -= vocab * hidden
+        return llama_flops_per_token(n_params, layers, seq, hidden)
+
+    def _aot_warmup(self, batch):
+        """Compile the train step ahead of the first dispatch
+        (jit(...).lower().compile()), so XLA compile time lands before
+        step 0 instead of inside the first checkpoint interval. The
+        compiled executable is shape-pinned; if a later batch drifts
+        (e.g. a ragged epoch tail) the wrapper falls back to the
+        original jit, which recompiles for the new shape as before."""
+        self._aot_done = True
+        jitted = self._step_fn
+        if not hasattr(jitted, "lower"):   # already warmed/wrapped
+            return
+        t0 = time.perf_counter()
+        try:
+            compiled = jitted.lower(
+                self._params, self._opt_state, self._scaler_state,
+                jnp.int32(self.global_step), batch).compile()
+        except Exception as e:
+            print(f"[trainer] AOT warmup failed ({e}); falling back to "
+                  f"on-demand jit", file=sys.stderr, flush=True)
+            return
+        print(f"[trainer] AOT warmup: train step compiled in "
+              f"{time.perf_counter() - t0:.1f}s before step 0",
+              file=sys.stderr, flush=True)
+        self.watchdog.beat()               # a long compile is not a hang
+
+        def stepper(*a):
+            try:
+                return compiled(*a)
+            except (TypeError, ValueError):
+                # shape drift: the AOT executable rejects BEFORE running
+                # (donated buffers untouched); jit handles it
+                return jitted(*a)
+
+        self._step_fn = stepper
+
     # ------------------------------------------------------------- eval
     def evaluate(self) -> float:
         assert self.eval_dataloader is not None
@@ -421,11 +631,15 @@ class Trainer:
             if self._eval_fn is None:  # build once; jit caches per shape
                 self._eval_fn = jax.jit(lambda p, b: self.loss_fn(fn, p, b))
             for batch in self.eval_dataloader:
-                losses.append(float(self._eval_fn(self._params, batch)))
+                # collect DEVICE scalars: each float() here would block
+                # the host once per batch, serializing dispatch with
+                # compute — one device_get at the end syncs once
+                losses.append(self._eval_fn(self._params, batch))
         finally:
             if was_training:
                 self.model.train()
-        mean = float(np.mean(losses)) if losses else float("nan")
+        losses = jax.device_get(losses) if losses else []
+        mean = float(np.mean(losses)) if len(losses) else float("nan")
         self.logger.add_scalar("eval_loss", mean, self.global_step)
         return mean
 
@@ -481,7 +695,12 @@ class Trainer:
             topo["mesh"] = mesh_shape
         meta: Dict[str, Any] = {"step": self.global_step,
                                 "topology": topo}
-        dl = self.train_dataloader
+        # read sampler state from the live feed: with prefetch active
+        # the raw loader has run AHEAD by the buffer depth, and saving
+        # its cursor would skip buffered-but-untrained batches on
+        # resume; the DevicePrefetcher reports the consumer position
+        dl = self._data_feed if self._data_feed is not None \
+            else self.train_dataloader
         if dl is not None and hasattr(dl, "state_dict"):
             try:
                 sd = dl.state_dict()

@@ -50,11 +50,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 ENV_VAR = "PADDLE_TPU_FAULTS"
 SEED_ENV_VAR = "PADDLE_TPU_FAULT_SEED"
 HANG_ENV_VAR = "PADDLE_TPU_FAULT_HANG_S"
+PREFETCH_STALL_ENV_VAR = "PADDLE_TPU_FAULT_PREFETCH_STALL_S"
 
 __all__ = [
     "SITES", "inject", "scoped", "configure", "reset", "parse_spec",
     "retry_with_backoff", "BackpressureError", "RequestTimeoutError",
-    "main",
+    "hang_seconds", "prefetch_stall_seconds", "main",
 ]
 
 # ------------------------------------------------------------- inventory
@@ -95,6 +96,13 @@ SITES: Dict[str, Tuple[str, str]] = {
         "checkpoints its exact step, drains the async writer, and exits "
         "PREEMPTED_RC — which elastic.supervise restarts without "
         "consuming a max_restarts attempt"),
+    "prefetch_stall": (
+        "paddle_tpu/io/device_prefetch.py:_PrefetchIterator._produce",
+        "sleep PADDLE_TPU_FAULT_PREFETCH_STALL_S (default 30) in the "
+        "device-prefetch producer thread before its next fetch (slow or "
+        "wedged host input pipeline stand-in; the consumer's stall "
+        "timeout degrades the trainer to synchronous feeding instead of "
+        "deadlocking the step loop)"),
 }
 
 
@@ -275,6 +283,11 @@ def reset() -> None:
 def hang_seconds() -> float:
     """How long a fired ``hang`` site should sleep."""
     return float(os.environ.get(HANG_ENV_VAR, "3600"))
+
+
+def prefetch_stall_seconds() -> float:
+    """How long a fired ``prefetch_stall`` site wedges the producer."""
+    return float(os.environ.get(PREFETCH_STALL_ENV_VAR, "30"))
 
 
 # ---------------------------------------------------------------- retry

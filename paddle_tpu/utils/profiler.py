@@ -27,6 +27,14 @@ def device_peak_flops(device=None) -> float:
     return PEAK_BF16_FLOPS.get(getattr(device, "device_kind", ""), 197e12)
 
 
+def local_peak_flops() -> float:
+    """Aggregate peak of every local chip. The trainer's token counts
+    span the whole per-process batch (all local mesh devices), so MFU
+    must divide by the matching aggregate peak — a single chip's peak
+    would overstate it by the local device count."""
+    return sum(device_peak_flops(d) for d in jax.local_devices())
+
+
 class Profiler:
     """paddle.profiler.Profiler-shaped facade over jax.profiler."""
 
@@ -65,7 +73,7 @@ def annotate(name: str):
 class StepTimer:
     """Running step-time / throughput / MFU meter."""
     flops_per_token: float = 0.0
-    peak_flops: float = field(default_factory=device_peak_flops)
+    peak_flops: float = field(default_factory=local_peak_flops)
     _t0: Optional[float] = None
     steps: int = 0
     total_s: float = 0.0
@@ -74,10 +82,13 @@ class StepTimer:
     def start(self):
         self._t0 = time.perf_counter()
 
-    def stop(self, tokens: int = 0):
+    def stop(self, tokens: int = 0, steps: int = 1):
+        """Close a timing window covering ``steps`` training steps (the
+        trainer logs once per ``logging_steps`` window, so per-step
+        averages need the real step count, not the window count)."""
         assert self._t0 is not None
         dt = time.perf_counter() - self._t0
-        self.steps += 1
+        self.steps += steps
         self.total_s += dt
         self.total_tokens += tokens
         return dt

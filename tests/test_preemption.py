@@ -300,8 +300,12 @@ class TestPreemptionE2E:
         tr2.train()
         assert tr2._sampler_restored
         assert tr2.global_step == 10
-        # no replay: exactly the 4 remaining steps' samples were fetched
-        assert len(ds2.fetches) == 4 * 4
+        # no replay: the 4 remaining steps' samples, plus at most the
+        # device-prefetcher's bounded read-ahead (depth+1 batches drawn
+        # but never trained) — a replay-based resume would re-fetch the
+        # 6 consumed batches first and blow well past this bound
+        depth = tr2.args.prefetch_depth
+        assert 4 * 4 <= len(ds2.fetches) <= (4 + depth + 1) * 4
         final = tr2.logger.history["loss"][-1][1]
         assert abs(final - ref_final) < 1e-6, (final, ref_final)
 
@@ -573,8 +577,10 @@ def test_divergence_rollback_does_not_rewind_sampler(tmp_path):
     assert tr.global_step == 6
     assert not tr._sampler_restored        # rollback didn't touch data
     # steps 1-3 fetched 3 batches, rollback to ckpt@2, steps 3-6 fetch 4
-    # more — NO batch re-fetched by a rewind
-    assert len(ds.fetches) == 7 * 4
+    # more — NO batch re-fetched by a rewind; the device-prefetcher may
+    # add its bounded read-ahead (never-trained) on top
+    depth = tr.args.prefetch_depth
+    assert 7 * 4 <= len(ds.fetches) <= (7 + depth + 1) * 4
 
 
 # ============================================== concurrent resume safety
