@@ -28,12 +28,14 @@ import json
 import os
 import sys
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
 from ..utils import faults
+from ..utils import observability as obs
 
 
 class CheckpointCorruptionError(RuntimeError):
@@ -121,6 +123,11 @@ class DistributedCheckpoint:
                 rel = os.path.relpath(p, d)
                 files[rel] = {"sha256": _sha256(p),
                               "size": os.path.getsize(p)}
+        total_bytes = sum(f["size"] for f in files.values())
+        obs.histogram("ckpt_bytes",
+                      buckets=obs.BYTES_BUCKETS).observe(total_bytes)
+        obs.record_event("ckpt_committed", step=step, bytes=total_bytes,
+                         files=len(files))
         mdir = os.path.join(self.directory, self.MANIFEST_DIR)
         os.makedirs(mdir, exist_ok=True)
         tmp = self._manifest_path(step) + ".tmp"
@@ -233,6 +240,7 @@ class DistributedCheckpoint:
         # background _finalize_manifests sweep may still be running, and
         # an unregistered, not-yet-committed step's fresh meta sidecar
         # would look like an evicted orphan to it
+        t0 = time.perf_counter()
         self._pending_manifest.add(step)
         self._mgr.save(step, args=ocp.args.StandardSave(state))
         if meta is not None:
@@ -249,6 +257,12 @@ class DistributedCheckpoint:
             self._manifest_thread = threading.Thread(
                 target=self._finalize_manifests, daemon=True)
             self._manifest_thread.start()
+        # async saves observe the dispatch cost (what the train loop
+        # actually pays); wait=True observes the full durable write
+        save_ms = (time.perf_counter() - t0) * 1e3
+        obs.histogram("ckpt_save_ms").observe(save_ms)
+        obs.record_event("ckpt_save", step=step, wait=wait,
+                         ms=round(save_ms, 3))
 
     # --------------------------------------------------------- restore
     def restore(self, step: Optional[int] = None,
@@ -291,8 +305,13 @@ class DistributedCheckpoint:
                       f"falling back to an older checkpoint",
                       file=sys.stderr, flush=True)
                 continue
+            t0 = time.perf_counter()
             out = self._restore_step(s, like)
             self.last_restored_step = s
+            restore_ms = (time.perf_counter() - t0) * 1e3
+            obs.histogram("ckpt_restore_ms").observe(restore_ms)
+            obs.record_event("ckpt_restore", step=s,
+                             ms=round(restore_ms, 3))
             return out
         raise CheckpointCorruptionError(
             f"every checkpoint step in {self.directory} failed checksum "

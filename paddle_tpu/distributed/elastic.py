@@ -19,6 +19,7 @@ import time
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..utils import compile_cache
+from ..utils import observability as obs
 from ..utils.faults import retry_with_backoff
 from ..utils.shutdown import PREEMPTED_RC
 
@@ -58,7 +59,8 @@ def supervise(argv: Sequence[str], max_restarts: int = 3,
               max_preemptions: Optional[int] = None,
               probe_topology: Optional[Callable[[], Any]]
               = _default_topology,
-              compile_cache_dir: Optional[str] = None) -> int:
+              compile_cache_dir: Optional[str] = None,
+              run_dir: Optional[str] = None) -> int:
     """Run ``argv`` as a subprocess; relaunch on failure with jittered
     exponential backoff (the shared utils.faults.retry_with_backoff —
     ``backoff_s`` seeds the base delay, doubling per consecutive
@@ -89,10 +91,35 @@ def supervise(argv: Sequence[str], max_restarts: int = 3,
     disk instead of paying full recompilation. None inherits the
     supervisor's env (which may itself carry the var); the supervisor
     never imports jax — the child owns the accelerator.
+
+    run_dir: where to land the SUPERVISOR'S OWN telemetry on exit —
+    ``flight_supervisor.json`` (child launch/exit events with rcs) and
+    ``metrics_supervisor.prom`` (restart/preemption counters). Children
+    write their attempt-numbered ``flight_<k>``/``trace_<k>`` files
+    themselves; without this the supervisor's view — the only place the
+    cross-attempt launch/exit/rc story lives — is write-only and dies
+    with the process. Pass the child's ``<output_dir>/runs`` so one dir
+    holds both sides. None (default) keeps the old behavior.
     """
-    child_environ = compile_cache.child_env(compile_cache_dir) \
-        if compile_cache.resolve_dir(compile_cache_dir) else None
+    # every (re)launch gets an explicit environment: the compile-cache
+    # dir (when configured), the shared run id, and a per-launch attempt
+    # number — the child's observability names its artifacts
+    # flight_<attempt>.json / trace_<attempt>.json, so an elastic run's
+    # attempts sit side by side in one run dir and stitch into one
+    # timeline (epoch-microsecond trace timestamps).
+    base_env = compile_cache.child_env(compile_cache_dir) \
+        if compile_cache.resolve_dir(compile_cache_dir) \
+        else dict(os.environ)
+    base_env[obs.ENV_RUN_ID] = obs.run_id()
+    launches = [0]
     preemptions = [0]
+    # PER-CALL recorder/registry, not the process globals: a driver
+    # supervising two jobs back-to-back must not report job A's
+    # preemption counters and launch events in job B's artifacts
+    recorder = obs.FlightRecorder()
+    registry = obs.MetricsRegistry()
+    c_restarts = registry.counter("elastic_restarts_total")
+    c_preempts = registry.counter("elastic_preemptions_total")
     last_topo: List[Any] = [probe_topology() if probe_topology else None]
 
     def check_topology():
@@ -109,19 +136,27 @@ def supervise(argv: Sequence[str], max_restarts: int = 3,
     def attempt() -> int:
         while True:
             check_topology()
+            env = dict(base_env)
+            env[obs.ENV_ATTEMPT] = str(launches[0])
+            recorder.record("elastic_child_launch", attempt=launches[0],
+                            argv0=argv[0])
+            launches[0] += 1
             try:
                 proc = subprocess.run(list(argv), timeout=timeout_s,
-                                      env=child_environ)
+                                      env=env)
                 rc = proc.returncode
             except subprocess.TimeoutExpired:
                 # a child hung before its own watchdog could fire (e.g.
                 # stuck in startup): that IS the case this supervisor
                 # exists for
                 rc = 124
+            recorder.record("elastic_child_exit",
+                            attempt=launches[0] - 1, rc=rc)
             if rc == 0:
                 return 0
             if preempt_rc is not None and rc == preempt_rc:
                 preemptions[0] += 1
+                c_preempts.inc()
                 if max_preemptions is not None and \
                         preemptions[0] > max_preemptions:
                     print(f"[elastic] preemption budget exhausted "
@@ -141,9 +176,25 @@ def supervise(argv: Sequence[str], max_restarts: int = 3,
             return rc
 
     def on_retry(exc, attempt_no, delay):
+        c_restarts.inc()
         print(f"[elastic] attempt {attempt_no}/{max_restarts + 1}: "
               f"rc={exc.rc}; relaunching in {delay:.1f}s",
               file=sys.stderr, flush=True)
+
+    def flush_supervisor_telemetry():
+        if run_dir is None:
+            return
+        try:
+            os.makedirs(run_dir, exist_ok=True)
+            recorder.dump(
+                os.path.join(run_dir, "flight_supervisor.json"),
+                "supervise_exit")
+            prom = os.path.join(run_dir, "metrics_supervisor.prom")
+            with open(prom + ".tmp", "w") as f:
+                f.write(registry.prometheus_text())
+            os.replace(prom + ".tmp", prom)
+        except OSError:
+            pass   # telemetry must never mask the child's exit code
 
     try:
         return retry_with_backoff(attempt, max_attempts=max_restarts + 1,
@@ -153,6 +204,8 @@ def supervise(argv: Sequence[str], max_restarts: int = 3,
                                   on_retry=on_retry)
     except _RestartableExit as e:
         return e.rc
+    finally:
+        flush_supervisor_telemetry()
 
 
 def main(args: Optional[List[str]] = None) -> int:
@@ -161,7 +214,9 @@ def main(args: Optional[List[str]] = None) -> int:
     args = list(sys.argv[1:] if args is None else args)
     max_restarts = 3
     cache_dir = None
-    while args and args[0] in ("--max-restarts", "--compile-cache-dir"):
+    run_dir = None
+    while args and args[0] in ("--max-restarts", "--compile-cache-dir",
+                               "--run-dir"):
         if len(args) < 2 or args[1] == "--":
             # flag without a value: fall through to the usage message
             # instead of an IndexError (or eating the -- separator)
@@ -169,18 +224,21 @@ def main(args: Optional[List[str]] = None) -> int:
             break
         if args[0] == "--max-restarts":
             max_restarts = int(args[1])
-        else:
+        elif args[0] == "--compile-cache-dir":
             cache_dir = args[1]
+        else:
+            run_dir = args[1]
         args = args[2:]
     if args and args[0] == "--":
         args = args[1:]
     if not args:
         print("usage: python -m paddle_tpu.distributed.elastic "
-              "[--max-restarts N] [--compile-cache-dir DIR] -- cmd ...",
+              "[--max-restarts N] [--compile-cache-dir DIR] "
+              "[--run-dir DIR] -- cmd ...",
               file=sys.stderr)
         return 2
     return supervise(args, max_restarts=max_restarts,
-                     compile_cache_dir=cache_dir)
+                     compile_cache_dir=cache_dir, run_dir=run_dir)
 
 
 if __name__ == "__main__":

@@ -26,6 +26,7 @@ block 0) so they can never corrupt a live block; it is never allocated.
 """
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Any, Dict, List, NamedTuple, Optional
 
@@ -33,9 +34,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import observability as obs
 from ..utils.faults import BackpressureError
 
 __all__ = ["PagedKV", "PagedEngine"]
+
+# unique per-process engine label: every engine's counters live in the
+# global observability registry (scrapeable), while `stats`/`health()`
+# keep their per-instance semantics
+_engine_ids = itertools.count()
 
 
 class PagedKV(NamedTuple):
@@ -151,7 +158,8 @@ class _Request:
     __slots__ = ("request_id", "prompt", "max_new", "eos", "tokens",
                  "blocks", "prefix", "prefix_lps", "admit_seq",
                  "temperature", "top_k", "top_p", "key", "lps",
-                 "prefill_pos", "stop", "trim", "rep", "deadline")
+                 "prefill_pos", "stop", "trim", "rep", "deadline",
+                 "t_submit")
 
     def __init__(self, request_id, prompt, max_new, eos, temperature,
                  top_k, top_p, key, prefix=None, prefix_lps=None,
@@ -175,6 +183,7 @@ class _Request:
         self.lps: List[float] = []      # chosen-token logprobs
         self.blocks: List[int] = []
         self.prefill_pos = 0            # prompt tokens already cached
+        self.t_submit = time.monotonic()   # queue-wait histogram anchor
 
 
 class PagedEngine:
@@ -262,11 +271,23 @@ class PagedEngine:
         self.cancelled: Dict[Any, str] = {}
         self._admit_counter = 0
         self._submit_counter = 0
-        self.stats = {"decode_steps": 0, "prefills": 0, "preemptions": 0,
-                      "prefill_chunks": 0, "slot_steps": 0,
-                      "active_slot_steps": 0, "prefix_hit_tokens": 0,
-                      "prefix_adopted_blocks": 0, "timeouts": 0,
-                      "cancellations": 0, "rejected": 0}
+        # registry-backed scheduler counters (ISSUE 5): one source of
+        # truth for `stats`, `health()`, and a /metrics scrape. The
+        # per-instance engine label keeps pre-migration dict semantics —
+        # a fresh engine starts every counter at 0.
+        self._obs_labels = {"engine": f"paged{next(_engine_ids)}"}
+        reg = obs.registry()
+        self._counters = {
+            k: reg.counter(f"paged_{k}_total", **self._obs_labels)
+            for k in ("decode_steps", "prefills", "preemptions",
+                      "prefill_chunks", "slot_steps",
+                      "active_slot_steps", "prefix_hit_tokens",
+                      "prefix_adopted_blocks", "timeouts",
+                      "cancellations", "rejected")}
+        self._h_decode = reg.histogram("paged_decode_step_ms",
+                                       **self._obs_labels)
+        self._h_wait = reg.histogram("paged_queue_wait_ms",
+                                     **self._obs_labels)
         # pools (and the seen masks) are donated: XLA aliases input to
         # output so a decode step costs one scatter, not a full copy
         self._decode_jit = jax.jit(self._decode_step,
@@ -277,6 +298,15 @@ class PagedEngine:
                                     static_argnames=("bucket",))
         self._chunk_jit = jax.jit(self._chunk_prefill, donate_argnums=(1,),
                                   static_argnames=("bucket",))
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """Scheduler-counter snapshot (pre-migration dict shape; the
+        values now come from the observability registry)."""
+        return {k: int(c.value) for k, c in self._counters.items()}
+
+    def _count(self, key: str, n: int = 1):
+        self._counters[key].inc(n)
 
     # ------------------------------------------------------------ jitted
     def _paged_caches(self, pools, tables, lens):
@@ -401,7 +431,11 @@ class PagedEngine:
             # expired work must not reject a live submit
             self._expire()
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
-            self.stats["rejected"] += 1
+            self._count("rejected")
+            obs.record_event("serve_reject",
+                             engine=self._obs_labels["engine"],
+                             request_id=request_id,
+                             queued=len(self.queue))
             raise BackpressureError(
                 f"engine admission queue at capacity ({self.max_queue} "
                 f"queued); shed load or retry with backoff")
@@ -577,8 +611,12 @@ class PagedEngine:
         for _ in range(fresh):
             req.blocks.append(self._alloc_block())
         if cached:
-            self.stats["prefix_hit_tokens"] += cached
-            self.stats["prefix_adopted_blocks"] += len(adopted)
+            self._count("prefix_hit_tokens", cached)
+            self._count("prefix_adopted_blocks", len(adopted))
+        self._h_wait.observe((time.monotonic() - req.t_submit) * 1e3)
+        obs.record_event("serve_admit",
+                         engine=self._obs_labels["engine"],
+                         request_id=req.request_id, slot=slot_id)
         self.slots[slot_id] = req
         row = np.zeros((self.M,), np.int32)
         row[:need] = req.blocks
@@ -618,7 +656,7 @@ class PagedEngine:
             np.int32(req.top_k), np.float32(req.top_p),
             np.float32(req.rep), bucket=bucket)
         self.seen = self.seen.at[slot_id].set(seen_row)
-        self.stats["prefills"] += 1
+        self._count("prefills")
         first = int(nxt)
         self.keys[slot_id] = np.asarray(new_key)
         req.key = self.keys[slot_id].copy()
@@ -651,7 +689,7 @@ class PagedEngine:
             np.float32(req.temperature), np.int32(req.top_k),
             np.float32(req.top_p), np.float32(req.rep),
             self.seen[slot_id], bucket=self.chunk)
-        self.stats["prefill_chunks"] += 1
+        self._count("prefill_chunks")
         req.prefill_pos = start + live
         self.seq_lens[slot_id] = req.prefill_pos
         # mid chunks keep the ids-only mask; the final chunk's committed
@@ -659,7 +697,7 @@ class PagedEngine:
         self.seen = self.seen.at[slot_id].set(seen_fin if last
                                               else seen_mid)
         if last:
-            self.stats["prefills"] += 1
+            self._count("prefills")
             self._register_prefix(req)
             self.keys[slot_id] = np.array(new_key)
             req.key = self.keys[slot_id].copy()
@@ -751,15 +789,19 @@ class PagedEngine:
                             stop=s.stop, rep=s.rep, deadline=s.deadline)
         self.queue.insert(0, requeued)
         self._release(victim)
-        self.stats["preemptions"] += 1
+        self._count("preemptions")
+        obs.record_event("serve_preempt",
+                         engine=self._obs_labels["engine"],
+                         request_id=s.request_id,
+                         emitted=len(s.tokens))
         return True
 
     # -------------------------------------------------- overload control
     def _abort(self, req: "_Request", reason: str,
                slot_id: Optional[int] = None):
         self.cancelled[req.request_id] = reason
-        self.stats["timeouts" if reason == "timeout"
-                   else "cancellations"] += 1
+        self._count("timeouts" if reason == "timeout"
+                    else "cancellations")
         if slot_id is not None:
             self._release(slot_id)
 
@@ -851,6 +893,7 @@ class PagedEngine:
                   if s is not None and s.tokens]
         if not active:
             return
+        t_decode = time.perf_counter()
         last = np.zeros((self.R,), np.int32)
         for i in active:
             last[i] = self.slots[i].tokens[-1]
@@ -872,9 +915,12 @@ class PagedEngine:
             self.keys = np.array(new_keys)  # copy: jax views read-only
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)
-        self.stats["decode_steps"] += 1
-        self.stats["slot_steps"] += self.R
-        self.stats["active_slot_steps"] += len(active)
+        # the np.asarray above synced the device, so this is the REAL
+        # per-tick latency (dispatch + compute), not just dispatch
+        self._h_decode.observe((time.perf_counter() - t_decode) * 1e3)
+        self._count("decode_steps")
+        self._count("slot_steps", self.R)
+        self._count("active_slot_steps", len(active))
         for i in active:
             slot = self.slots[i]
             self.seq_lens[i] += 1   # the decode wrote last token's K/V

@@ -17,6 +17,7 @@ serving discipline is SHAPE discipline:
 """
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -27,9 +28,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .utils import observability as obs
 from .utils.faults import BackpressureError, RequestTimeoutError
 
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+# per-process instance ids: every BatchingPredictor's counters live in
+# the GLOBAL metrics registry under a unique engine label, so health()
+# and a /metrics scrape read the same numbers
+_batcher_ids = itertools.count()
 
 
 class Config:
@@ -182,7 +189,17 @@ class BatchingPredictor:
     Futures support standard cancellation while queued. ``close()``
     drains gracefully by default; ``health()`` snapshots the counters a
     load balancer needs.
+
+    Observability (ISSUE 5): the counters live in the global
+    ``utils.observability`` MetricsRegistry under a unique
+    ``engine=batcherN`` label — ``health()`` reads the SAME objects a
+    Prometheus scrape exports, so the two can never disagree. A
+    queue-wait histogram (``serving_queue_wait_ms``) tracks dispatch
+    latency per admitted request.
     """
+
+    _STAT_KEYS = ("submitted", "served", "rejected", "timeouts",
+                  "cancelled", "errors", "batches")
 
     def __init__(self, model, config: Optional[Config] = None,
                  max_batch: int = 8, max_delay_ms: float = 2.0,
@@ -198,9 +215,13 @@ class BatchingPredictor:
         self._aborting = False
         self._lock = threading.Lock()
         self._pending = 0
-        self._stats = {"submitted": 0, "served": 0, "rejected": 0,
-                       "timeouts": 0, "cancelled": 0, "errors": 0,
-                       "batches": 0}
+        labels = {"engine": f"batcher{next(_batcher_ids)}"}
+        self._obs_labels = labels
+        reg = obs.registry()
+        self._stats = {k: reg.counter(f"serving_{k}_total", **labels)
+                       for k in self._STAT_KEYS}
+        self._g_queued = reg.gauge("serving_queue_depth", **labels)
+        self._h_wait = reg.histogram("serving_queue_wait_ms", **labels)
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
@@ -220,23 +241,29 @@ class BatchingPredictor:
         with self._lock:
             if self.max_queue is not None and \
                     self._pending >= self.max_queue:
-                self._stats["rejected"] += 1
+                self._stats["rejected"].inc()
+                obs.record_event("serve_reject",
+                                 engine=self._obs_labels["engine"],
+                                 pending=self._pending)
                 raise BackpressureError(
                     f"admission queue at capacity ({self.max_queue} "
                     f"pending); shed load or retry with backoff")
             self._pending += 1
-            self._stats["submitted"] += 1
+            self._g_queued.set(self._pending)
+            self._stats["submitted"].inc()
         fut: Future = Future()
-        self._q.put((req, fut, deadline))
+        self._q.put((req, fut, deadline, time.monotonic()))
         return fut
 
     def run(self, *inputs):
         return self.submit(*inputs).result()
 
     def health(self) -> dict:
-        """Stats snapshot for load balancers / probes."""
+        """Stats snapshot for load balancers / probes — read straight
+        off the registry counters, so it matches a concurrent
+        ``MetricsRegistry.snapshot()`` / Prometheus scrape exactly."""
         with self._lock:
-            snap = dict(self._stats)
+            snap = {k: int(c.value) for k, c in self._stats.items()}
             snap["queued"] = self._pending
         snap.update(capacity=self.max_queue, max_batch=self.max_batch,
                     closed=self._closed,
@@ -244,15 +271,15 @@ class BatchingPredictor:
         return snap
 
     def _count(self, key: str):
-        with self._lock:
-            self._stats[key] += 1
+        self._stats[key].inc()
 
     def _admit(self, item) -> bool:
         """Dequeue-side gate: False when the request must not enter a
         batch (cancelled, expired, or the predictor is aborting)."""
-        _, fut, deadline = item
+        _, fut, deadline, t_enq = item
         with self._lock:
             self._pending -= 1
+            self._g_queued.set(self._pending)
         if self._aborting:
             fut.cancel()  # pending -> CancelledError for the caller
             self._count("cancelled")
@@ -265,6 +292,10 @@ class BatchingPredictor:
                 "request expired while queued for dispatch"))
             self._count("timeouts")
             return False
+        # observed only for ADMITTED requests: expired/cancelled items
+        # would pollute the dispatch-latency histogram with the (often
+        # maximal) wait of work that was never served
+        self._h_wait.observe((time.monotonic() - t_enq) * 1e3)
         return True
 
     def _loop(self):
@@ -292,8 +323,8 @@ class BatchingPredictor:
             self._flush(batch)
 
     def _flush(self, batch):
-        reqs = [r for r, _, _ in batch]
-        futs = [f for _, f, _ in batch]
+        reqs = [r for r, _, _, _ in batch]
+        futs = [f for _, f, _, _ in batch]
         self._count("batches")
         try:
             stacked = tuple(np.stack([r[i] for r in reqs])
@@ -334,7 +365,8 @@ class BatchingPredictor:
                 continue
             with self._lock:   # keep health()'s queued count honest
                 self._pending -= 1
-                self._stats["cancelled"] += 1
+                self._g_queued.set(self._pending)
+            self._stats["cancelled"].inc()
             if not item[1].done():
                 item[1].set_exception(
                     RuntimeError("BatchingPredictor closed before the "

@@ -40,6 +40,7 @@ from typing import Callable, Iterable, Optional
 import jax
 
 from ..utils import faults
+from ..utils import observability as obs
 
 __all__ = ["DevicePrefetcher", "default_device_put"]
 
@@ -80,6 +81,11 @@ class _PrefetchIterator:
         self.state = self._snap()              # last-YIELDED position
         self.sync_fallbacks = 0
         self._warned_stall = False
+        # observability: live buffer depth + stall accounting (the
+        # "why was the feed slow" half of the step-time postmortem)
+        self._g_depth = obs.gauge("prefetch_buffer_depth")
+        self._c_sync = obs.counter("prefetch_sync_fallbacks_total")
+        self._c_stall = obs.counter("prefetch_stall_degradations_total")
         self._thread = threading.Thread(
             target=self._produce, name="device-prefetch", daemon=True)
         self._thread.start()
@@ -107,7 +113,9 @@ class _PrefetchIterator:
 
     def _put(self, item) -> bool:
         from .dataloader import bounded_put
-        return bounded_put(self._q, item, self._stop)
+        ok = bounded_put(self._q, item, self._stop)
+        self._g_depth.set(self._q.qsize())
+        return ok
 
     def _produce(self):
         try:
@@ -160,6 +168,7 @@ class _PrefetchIterator:
                     if kind is None:
                         continue               # producer mid-cycle: wait on
             if kind == _BATCH:
+                self._g_depth.set(self._q.qsize())
                 batch, snap = payload
                 if snap:
                     self.state = snap
@@ -205,12 +214,16 @@ class _PrefetchIterator:
                 print(f"[prefetch] no batch for {self._stall_timeout_s:.1f}s "
                       f"(stalled prefetch thread); degrading to synchronous "
                       f"feeding", file=sys.stderr, flush=True)
+                self._c_stall.inc()
+                obs.record_event("prefetch_stall",
+                                 timeout_s=self._stall_timeout_s)
             try:
                 item = self._fetch_locked()
             except StopIteration:
                 self._exhausted = True
                 return _END, None
             self.sync_fallbacks += 1
+            self._c_sync.inc()
             self._degraded = True              # stay synchronous until the
             return _BATCH, item                # producer delivers again
         finally:

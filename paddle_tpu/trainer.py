@@ -27,6 +27,7 @@ from .models.llama import causal_lm_loss
 from .nn.layer import Layer
 from .optimizer.optimizers import Optimizer
 from .utils import compile_cache, faults
+from .utils import observability as obs
 from .utils.logging import LogWriter
 from .utils.profiler import StepTimer, llama_flops_per_token
 from .utils.shutdown import PREEMPTED_RC, GracefulShutdown
@@ -307,6 +308,13 @@ class Trainer:
         # step executable from disk instead of recompiling. No-op when
         # neither args nor $PADDLE_TPU_COMPILE_CACHE_DIR is set.
         compile_cache.enable(args.compile_cache_dir)
+        # observability artifacts (trace_<attempt>.json,
+        # flight_<attempt>.json, metrics.prom) land in the SAME run dir
+        # as the JSONL metrics — one dir answers "what happened"
+        obs.configure(os.path.join(args.output_dir, "runs"))
+        obs.record_event("train_start", step=self.global_step,
+                         max_steps=max_steps, run_id=obs.run_id(),
+                         attempt=obs.attempt_id())
         if self._opt_state is None:
             self._opt_state = self.optimizer.init(
                 {k: self._params[k] for k in self._trainable_keys}
@@ -355,7 +363,20 @@ class Trainer:
             self._shutdown.install()
         try:
             return self._train_loop(data, max_steps)
+        except SystemExit:
+            raise      # preempt/hang exits dump their own flight record
+        except BaseException as e:
+            # crash postmortem: the last ring-buffer window (recent
+            # steps, fault fires, rollbacks, ckpt events) hits disk
+            # BEFORE the exception unwinds out of the trainer
+            obs.record_event("crash", step=self.global_step,
+                             error=repr(e))
+            obs.dump_flight(f"crash:{type(e).__name__}")
+            raise
         finally:
+            # the trace + Prometheus snapshot are written on EVERY exit
+            # path (normal completion included)
+            obs.flush()
             if feed is not self.train_dataloader:
                 # tears the producer thread down; the prefetcher retains
                 # the consumer position so a post-train save_checkpoint
@@ -373,11 +394,16 @@ class Trainer:
         # not checkpoint I/O
         timer = self.step_timer = StepTimer(
             flops_per_token=args.flops_per_token)
+        # registry handles cached outside the loop: the per-step cost is
+        # an inc/observe (one small lock), not a registry lookup
+        m_steps = obs.counter("train_steps_total")
+        h_step = obs.histogram("train_step_wall_ms")
         win_tokens = 0
         win_steps = 0
         t_last = time.perf_counter()
         timer.start()
         while self.global_step < max_steps:
+            t_step = time.perf_counter()
             if faults.inject("preempt", step=self.global_step):
                 # chaos: deterministic stand-in for a scheduler
                 # preemption notice (SIGTERM) landing between steps
@@ -413,11 +439,24 @@ class Trainer:
                 # first throughput window
                 timer.start()
                 t_last = time.perf_counter()
-            self._params, self._opt_state, self._scaler_state, loss = \
-                self._step_fn(self._params, self._opt_state,
-                              self._scaler_state, jnp.int32(self.global_step),
-                              batch)
+            stepno = self.global_step
+            with obs.span("train_step", step=stepno):
+                self._params, self._opt_state, self._scaler_state, loss = \
+                    self._step_fn(self._params, self._opt_state,
+                                  self._scaler_state,
+                                  jnp.int32(stepno), batch)
             self.global_step += 1
+            # host-side step wall (data wait + dispatch; device compute
+            # overlaps asynchronously and is amortized into the window
+            # by the logging-step sync) — the per-step series behind
+            # obs_report's p50/p99 and the flight record's recent
+            # window. step= matches the train_step span's number (the
+            # step just executed), so trace and flight cross-reference.
+            step_ms = (time.perf_counter() - t_step) * 1e3
+            h_step.observe(step_ms)
+            m_steps.inc()
+            obs.record_event("step_end", step=stepno,
+                             ms=round(step_ms, 3))
             win_tokens += self._batch_tokens(batch)
             win_steps += 1
             self.watchdog.beat()
@@ -436,6 +475,8 @@ class Trainer:
                 try:
                     self.watchdog.check_loss(loss_val, self.global_step)
                 except DivergenceError:
+                    obs.record_event("divergence", step=self.global_step,
+                                     loss=loss_val)
                     if not self._maybe_rollback():
                         raise
                     # rollback time (restore I/O) is not step time
@@ -461,6 +502,17 @@ class Trainer:
                 t_last = now
                 timer.start()
                 self.logger.add_scalars(logs, self.global_step)
+                # mirror the window metrics into registry gauges and
+                # merge the WHOLE registry (serving counters, prefetch
+                # gauges, ckpt histograms included) into the same JSONL
+                # stream the dashboards already tail
+                for k, v in logs.items():
+                    obs.gauge(f"train_{k}").set(v)
+                try:
+                    obs.gauge("train_lr").set(self.optimizer.get_lr())
+                except Exception:
+                    pass       # exotic schedules: lr gauge is optional
+                obs.publish(self.logger, self.global_step)
                 for cb in self.callbacks:
                     cb.on_step_end(self.global_step, logs)
             due_save = args.save_steps and \
@@ -628,19 +680,22 @@ class Trainer:
         was_training = self.model.training
         self.model.eval()
         try:
-            if self._eval_fn is None:  # build once; jit caches per shape
-                self._eval_fn = jax.jit(lambda p, b: self.loss_fn(fn, p, b))
-            for batch in self.eval_dataloader:
-                # collect DEVICE scalars: each float() here would block
-                # the host once per batch, serializing dispatch with
-                # compute — one device_get at the end syncs once
-                losses.append(self._eval_fn(self._params, batch))
+            with obs.span("evaluate", step=self.global_step):
+                if self._eval_fn is None:  # built once; jit caches/shape
+                    self._eval_fn = jax.jit(
+                        lambda p, b: self.loss_fn(fn, p, b))
+                for batch in self.eval_dataloader:
+                    # collect DEVICE scalars: each float() here would
+                    # block the host once per batch, serializing dispatch
+                    # with compute — one device_get at the end syncs once
+                    losses.append(self._eval_fn(self._params, batch))
+                losses = jax.device_get(losses) if losses else []
         finally:
             if was_training:
                 self.model.train()
-        losses = jax.device_get(losses) if losses else []
         mean = float(np.mean(losses)) if len(losses) else float("nan")
         self.logger.add_scalar("eval_loss", mean, self.global_step)
+        obs.record_event("eval", step=self.global_step, loss=mean)
         return mean
 
     # --------------------------------------------------------- checkpoint
@@ -668,8 +723,10 @@ class Trainer:
             # checkpoint bytes become whatever the reused buffers hold
             tree = jax.tree.map(
                 lambda x: jnp.copy(x) if hasattr(x, "dtype") else x, tree)
-        ckpt.save(self.global_step, tree, wait=wait,
-                  meta=self._checkpoint_meta())
+        with obs.span("checkpoint_save", step=self.global_step,
+                      wait=wait):
+            ckpt.save(self.global_step, tree, wait=wait,
+                      meta=self._checkpoint_meta())
         for cb in self.callbacks:
             cb.on_save(self.global_step)
 
@@ -723,6 +780,8 @@ class Trainer:
               f"{self.global_step}: checkpointing and exiting "
               f"rc={self.args.preempt_exit_code}",
               file=sys.stderr, flush=True)
+        obs.record_event("preempt_exit", step=self.global_step,
+                         reason=reason, rc=self.args.preempt_exit_code)
         try:
             self.save_checkpoint(wait=True)
         except Exception as e:
@@ -730,6 +789,11 @@ class Trainer:
             # checkpoint stands and the relaunch resumes from it
             print(f"[trainer] checkpoint during preemption failed: {e}; "
                   f"exiting anyway", file=sys.stderr, flush=True)
+            obs.record_event("preempt_ckpt_failed", error=repr(e))
+        # the flight dump happens AFTER the shutdown checkpoint so the
+        # record's tail shows the fault/latch AND the save that answered
+        # it — the acceptance shape of a clean preemption postmortem
+        obs.dump_flight("preempt")
         raise SystemExit(self.args.preempt_exit_code)
 
     def _on_hang(self):
@@ -741,6 +805,9 @@ class Trainer:
         print(f"[watchdog] step hung > {self.args.hang_timeout_s}s at "
               f"global_step={self.global_step}; checkpointing and exiting "
               f"rc={self.args.hang_exit_code}", file=sys.stderr, flush=True)
+        obs.record_event("hang", step=self.global_step,
+                         timeout_s=self.args.hang_timeout_s)
+        obs.dump_flight("hang")
         if self._in_recovery:
             # wedged INSIDE a divergence rollback: params are NaN — a
             # snapshot now would become the latest checkpoint and poison
@@ -811,6 +878,11 @@ class Trainer:
               f"(rollback {self._rollbacks}/"
               f"{self.args.max_divergence_rollbacks}); skipping the "
               f"poisoned data window", file=sys.stderr, flush=True)
+        obs.counter("train_rollbacks_total").inc()
+        obs.record_event("rollback", diverged_at=diverged_at,
+                         restored_step=restored,
+                         rollback=self._rollbacks)
+        obs.dump_flight("divergence_rollback")
         return True
 
     def _try_resume(self, restore_data: bool = True) -> Optional[int]:

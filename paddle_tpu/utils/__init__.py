@@ -1,4 +1,4 @@
 """paddle_tpu.utils."""
-from . import compile_cache, faults, rng
+from . import compile_cache, faults, observability, rng
 from .faults import retry_with_backoff
 from .rng import fold_axis, next_key, rng_state, seed

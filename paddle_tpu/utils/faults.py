@@ -243,6 +243,17 @@ def inject(site: str, **ctx) -> bool:
         print(f"[faults] firing {site} (occurrence {occ})"
               + (f" {info}" if info else ""),
               file=sys.stderr, flush=True)
+        # observability: every fire lands in the flight recorder (the
+        # postmortem window must show WHICH chaos preceded the crash)
+        # and in a per-site counter. Imported lazily on the rare fired
+        # path; the unarmed hot path stays a dict lookup + env read.
+        try:
+            from . import observability as obs
+            obs.record_event("fault_fire", site=site, occurrence=occ,
+                             **ctx)
+            obs.counter("fault_fires_total", site=site).inc()
+        except Exception:
+            pass      # telemetry must never break the chaos experiment
     return fired
 
 

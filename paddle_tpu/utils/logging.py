@@ -10,7 +10,7 @@ import json
 import os
 import time
 from collections import defaultdict
-from typing import Dict, Optional
+from typing import Dict
 
 
 class LogWriter:
@@ -41,11 +41,15 @@ class LogWriter:
         self.close()
 
 
-_default: Optional[LogWriter] = None
+_writers: Dict[str, LogWriter] = {}
 
 
 def get_logger(logdir: str = "runs") -> LogWriter:
-    global _default
-    if _default is None:
-        _default = LogWriter(logdir)
-    return _default
+    """Shared writer PER LOGDIR. The old singleton was keyed on nothing,
+    so every call after the first silently ignored ``logdir`` and wrote
+    into whichever directory happened to be asked for first."""
+    key = os.path.abspath(logdir)
+    writer = _writers.get(key)
+    if writer is None or writer._fh.closed:
+        writer = _writers[key] = LogWriter(logdir)
+    return writer

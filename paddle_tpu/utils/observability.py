@@ -1,0 +1,579 @@
+"""Unified observability layer (ISSUE 5 tentpole): metrics registry,
+span tracing, and a crash flight recorder shared by train / serve /
+elastic.
+
+The repo's telemetry used to be fragmented — JSONL scalars in
+``utils.logging``, a ``StepTimer`` in the trainer, and hand-rolled
+``stats`` dicts in the serving engines — none of which could answer
+"why was step 4317 slow" or "what happened in the 30 s before the
+worker died". This module is the one substrate they all feed:
+
+- **MetricsRegistry** — thread-safe labeled counters / gauges /
+  histograms with ``snapshot()``, Prometheus text-format export
+  (``prometheus_text()``), and a JSONL sink (``publish(writer, step)``)
+  that merges registry values into the existing ``LogWriter`` stream.
+- **Span tracing** — ``span("train_step", step=n)`` context manager
+  emitting chrome://tracing-format events (load the flushed file in
+  Perfetto / ``chrome://tracing``) and forwarding to
+  ``jax.profiler.TraceAnnotation`` so spans also land in xplane
+  profiles. A run id + attempt id propagate to elastic children via
+  env (``$PADDLE_TPU_RUN_ID`` / ``$PADDLE_TPU_ATTEMPT``), and every
+  event timestamps in epoch microseconds, so per-attempt trace files
+  from a preempted-and-relaunched job stitch into ONE timeline.
+- **Flight recorder** — a bounded ring buffer of recent structured
+  events (step end, fault fires, rollbacks, prefetch stalls,
+  checkpoint save/restore, preemption latch, serving
+  admits/rejects/preemptions) dumped to ``<run_dir>/flight_<attempt>.json``
+  on crash, SIGTERM/preemption, or divergence rollback — the 30-second
+  postmortem a print log can't give.
+
+Deliberately dependency-free at import time (no jax): the elastic
+supervisor — which must never own the accelerator — imports this to
+stamp run/attempt ids into child environments. ``span`` imports jax
+lazily and degrades to wall-clock-only events when it is unavailable.
+
+``tools/obs_report.py`` renders a run dir's artifacts (p50/p99 step
+time, MFU, stall/fault/rollback timeline) and can serve the Prometheus
+snapshot over stdlib HTTP.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "ENV_RUN_ID", "ENV_ATTEMPT", "run_id", "attempt_id",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "SpanTracer", "FlightRecorder",
+    "registry", "tracer", "recorder",
+    "counter", "gauge", "histogram", "span", "record_event",
+    "configure", "run_dir", "flight_path", "trace_path", "metrics_path",
+    "dump_flight", "flush", "publish", "reset",
+]
+
+ENV_RUN_ID = "PADDLE_TPU_RUN_ID"
+ENV_ATTEMPT = "PADDLE_TPU_ATTEMPT"
+
+# default latency buckets (milliseconds): sub-ms serving ticks up to
+# multi-minute checkpoint restores
+DEFAULT_MS_BUCKETS = (0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500,
+                      1000, 2000, 5000, 10000, 30000, 60000)
+# byte-sized things (checkpoint step dirs)
+BYTES_BUCKETS = (1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10, 1e11)
+
+
+def run_id() -> str:
+    """Stable id for this run, minted once and published to the
+    environment so spawned children (elastic relaunches, DataLoader
+    workers) inherit it and their telemetry stitches into one run."""
+    rid = os.environ.get(ENV_RUN_ID)
+    if not rid:
+        rid = uuid.uuid4().hex[:12]
+        os.environ[ENV_RUN_ID] = rid
+    return rid
+
+
+def attempt_id() -> int:
+    """Elastic attempt number: 0 for a directly-launched process,
+    incremented by ``distributed.elastic.supervise`` per relaunch."""
+    try:
+        return int(os.environ.get(ENV_ATTEMPT, "0") or 0)
+    except ValueError:
+        return 0
+
+
+# ---------------------------------------------------------------- metrics
+def _label_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _full_name(name: str, lkey: Tuple[Tuple[str, str], ...]) -> str:
+    if not lkey:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in lkey)
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotone float counter. ``inc`` only — a counter that can go
+    down is a gauge."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0):
+        if n < 0:
+            raise ValueError("counters only go up; use a gauge")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float):
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0):
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0):
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus semantics: cumulative
+    ``le``-bounded buckets + sum + count). Quantiles are estimated by
+    linear interpolation inside the covering bucket, clamped to the
+    observed min/max so a lone sample reports itself, not a bucket
+    edge."""
+
+    __slots__ = ("buckets", "_counts", "_sum", "_count", "_min", "_max",
+                 "_lock")
+
+    def __init__(self, buckets=DEFAULT_MS_BUCKETS):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.buckets) + 1)   # +1: +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        v = float(v)
+        with self._lock:
+            i = 0
+            while i < len(self.buckets) and v > self.buckets[i]:
+                i += 1
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-quantile (q in [0, 1])."""
+        with self._lock:
+            if self._count == 0:
+                return 0.0
+            target = q * self._count
+            cum = 0
+            lo = self._min
+            for i, c in enumerate(self._counts):
+                hi = self.buckets[i] if i < len(self.buckets) else self._max
+                hi = min(hi, self._max)
+                if c:
+                    if cum + c >= target:
+                        frac = (target - cum) / c
+                        return max(self._min, min(self._max,
+                                                  lo + frac * (hi - lo)))
+                    cum += c
+                # lo advances past EMPTY buckets too: the covering
+                # bucket's interpolation must start at its own lower
+                # edge, not several bucket-widths below it
+                lo = max(lo, hi)
+            return self._max
+
+    def export(self) -> Tuple[Tuple[int, ...], float, int]:
+        """One-lock consistent ``(bucket_counts, sum, count)`` view for
+        exposition — piecemeal reads under concurrent ``observe()``
+        would publish a sum that includes samples missing from the
+        buckets."""
+        with self._lock:
+            return tuple(self._counts), self._sum, self._count
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            count, total = self._count, self._sum
+        return {
+            "count": count,
+            "sum": total,
+            "mean": total / count if count else 0.0,
+            "min": self._min if count else 0.0,
+            "max": self._max if count else 0.0,
+            "p50": self.percentile(0.5),
+            "p99": self.percentile(0.99),
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe named+labeled metric store. One metric NAME has one
+    kind (counter|gauge|histogram) — re-registering it as another kind
+    raises, so a dashboard can trust ``# TYPE`` lines."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: Dict[Tuple[str, tuple], Any] = {}
+        self._kinds: Dict[str, str] = {}
+
+    def _get(self, kind: str, name: str, factory, labels: Dict[str, Any]):
+        lkey = _label_key(labels)
+        with self._lock:
+            prev = self._kinds.get(name)
+            if prev is not None and prev != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {prev}, "
+                    f"requested {kind}")
+            self._kinds[name] = kind
+            m = self._metrics.get((name, lkey))
+            if m is None:
+                m = factory()
+                self._metrics[(name, lkey)] = m
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", name, Counter, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", name, Gauge, labels)
+
+    def histogram(self, name: str, buckets=None, **labels) -> Histogram:
+        return self._get("histogram", name,
+                         lambda: Histogram(buckets or DEFAULT_MS_BUCKETS),
+                         labels)
+
+    def _items(self) -> List[Tuple[str, tuple, str, Any]]:
+        with self._lock:
+            return [(name, lkey, self._kinds[name], m)
+                    for (name, lkey), m in sorted(self._metrics.items())]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """{full_name: value} for scalars; histograms report their
+        stats dict. This is the "one source of truth" the serving
+        ``health()`` endpoints read from."""
+        out: Dict[str, Any] = {}
+        for name, lkey, kind, m in self._items():
+            full = _full_name(name, lkey)
+            out[full] = m.stats() if kind == "histogram" else m.value
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (scrape-ready; served by
+        ``tools/obs_report.py --serve``)."""
+        lines: List[str] = []
+        typed: set = set()
+        for name, lkey, kind, m in self._items():
+            if name not in typed:
+                lines.append(f"# TYPE {name} {kind}")
+                typed.add(name)
+            if kind == "histogram":
+                counts, total, _ = m.export()
+                cum = 0
+                for i, b in enumerate(m.buckets):
+                    cum += counts[i]
+                    lk = lkey + (("le", f"{b:g}"),)
+                    lines.append(f"{_full_name(name + '_bucket', lk)} {cum}")
+                cum += counts[-1]
+                lk = lkey + (("le", "+Inf"),)
+                lines.append(f"{_full_name(name + '_bucket', lk)} {cum}")
+                lines.append(f"{_full_name(name + '_sum', lkey)} "
+                             f"{total:g}")
+                lines.append(f"{_full_name(name + '_count', lkey)} {cum}")
+            else:
+                lines.append(f"{_full_name(name, lkey)} {m.value:g}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def publish(self, writer, step: int):
+        """Merge the registry into a ``LogWriter``-compatible JSONL
+        stream (same ``{"step","tag","value","wall"}`` records the
+        dashboards already tail): scalars as-is, histograms as
+        ``name:p50`` / ``name:p99`` / ``name:count``."""
+        for name, lkey, kind, m in self._items():
+            full = _full_name(name, lkey)
+            if kind == "histogram":
+                s = m.stats()
+                if not s["count"]:
+                    continue
+                for suffix in ("p50", "p99", "count"):
+                    writer.add_scalar(f"{full}:{suffix}", s[suffix], step)
+            else:
+                writer.add_scalar(full, m.value, step)
+
+
+# ------------------------------------------------------------------ spans
+_TRACE_ANNOTATION: Any = None   # cached class; False = jax unavailable
+
+
+def _trace_annotation(name: str):
+    global _TRACE_ANNOTATION
+    if _TRACE_ANNOTATION is None:
+        try:
+            import jax
+            _TRACE_ANNOTATION = jax.profiler.TraceAnnotation
+        except Exception:
+            _TRACE_ANNOTATION = False
+    if _TRACE_ANNOTATION is False:
+        return None
+    try:
+        return _TRACE_ANNOTATION(name)
+    except Exception:
+        return None
+
+
+class SpanTracer:
+    """Chrome-trace ("Trace Event Format") span collector. Events
+    buffer in a bounded RING (a run longer than the buffer keeps the
+    most RECENT window — the one a crash-time flush needs — not the
+    first N steps) and ``flush()`` writes a Perfetto /
+    chrome://tracing loadable JSON object. Timestamps are EPOCH
+    microseconds, so traces from separate attempts of one elastic run
+    line up on a shared axis when opened together."""
+
+    def __init__(self, max_events: int = 200_000):
+        self._events: deque = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self.total_events = 0
+        self._pid = os.getpid()
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self.total_events - len(self._events))
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[None]:
+        ann = _trace_annotation(name)
+        if ann is not None:
+            ann.__enter__()
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            dur = time.time() - t0
+            if ann is not None:
+                try:
+                    ann.__exit__(None, None, None)
+                except Exception:
+                    pass
+            ev = {"name": name, "cat": "paddle_tpu", "ph": "X",
+                  "ts": t0 * 1e6, "dur": dur * 1e6, "pid": self._pid,
+                  "tid": threading.get_ident() & 0x7FFFFFFF,
+                  "args": attrs}
+            with self._lock:
+                self._events.append(ev)     # ring: oldest falls out
+                self.total_events += 1
+
+    def instant(self, name: str, **attrs):
+        """Zero-duration marker event (fault fires, latches)."""
+        ev = {"name": name, "cat": "paddle_tpu", "ph": "i", "s": "p",
+              "ts": time.time() * 1e6, "pid": self._pid,
+              "tid": threading.get_ident() & 0x7FFFFFFF, "args": attrs}
+        with self._lock:
+            self._events.append(ev)
+            self.total_events += 1
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def flush(self, path: str):
+        """Write (atomically) the chrome-trace JSON object."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self.dropped
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"run_id": run_id(),
+                             "attempt": attempt_id(),
+                             "dropped_events": dropped}}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+
+# --------------------------------------------------------- flight recorder
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    try:
+        return float(v)          # numpy / jax scalars
+    except (TypeError, ValueError):
+        return str(v)
+
+
+class FlightRecorder:
+    """Bounded ring buffer of recent structured events. Cheap enough to
+    record per training step; ``dump()`` writes the whole window
+    atomically for the post-crash "what just happened" read.
+
+    Deliberately LOCK-FREE on the record path: ``record`` runs inside
+    signal handlers (the preemption latch) — a handler blocking on a
+    lock its own thread holds would deadlock the process. ``deque``
+    append/iteration are atomic at the C level, which is exactly the
+    guarantee needed here."""
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = capacity
+        self._events: deque = deque(maxlen=capacity)
+        self.total_events = 0
+
+    def record(self, kind: str, **fields):
+        ev = {"wall": time.time(), "kind": kind}
+        for k, v in fields.items():
+            ev[k] = _jsonable(v)
+        self._events.append(ev)
+        self.total_events += 1    # approximate under races; fine
+
+    def snapshot(self) -> List[dict]:
+        return list(self._events)
+
+    def dump(self, path: str, reason: str) -> str:
+        events = list(self._events)
+        total = self.total_events
+        doc = {"run_id": run_id(), "attempt": attempt_id(),
+               "reason": reason, "dumped_wall": time.time(),
+               "capacity": self.capacity, "total_events": total,
+               "events": events}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+
+# --------------------------------------------------------- process default
+_registry = MetricsRegistry()
+_tracer = SpanTracer()
+_recorder = FlightRecorder()
+_run_dir: Optional[str] = None
+_state_lock = threading.Lock()
+
+
+def registry() -> MetricsRegistry:
+    return _registry
+
+
+def tracer() -> SpanTracer:
+    return _tracer
+
+
+def recorder() -> FlightRecorder:
+    return _recorder
+
+
+def counter(name: str, **labels) -> Counter:
+    return _registry.counter(name, **labels)
+
+
+def gauge(name: str, **labels) -> Gauge:
+    return _registry.gauge(name, **labels)
+
+
+def histogram(name: str, buckets=None, **labels) -> Histogram:
+    return _registry.histogram(name, buckets=buckets, **labels)
+
+
+def span(name: str, **attrs):
+    return _tracer.span(name, **attrs)
+
+
+def record_event(kind: str, **fields):
+    _recorder.record(kind, **fields)
+
+
+def configure(directory: str) -> str:
+    """Point the process-default observability at a run dir (the
+    Trainer passes ``<output_dir>/runs`` — the same dir its JSONL
+    metrics land in, so every artifact of a run lives in one place)."""
+    global _run_dir
+    with _state_lock:
+        os.makedirs(directory, exist_ok=True)
+        _run_dir = directory
+    return directory
+
+
+def run_dir() -> Optional[str]:
+    return _run_dir
+
+
+def flight_path() -> Optional[str]:
+    return None if _run_dir is None else os.path.join(
+        _run_dir, f"flight_{attempt_id()}.json")
+
+
+def trace_path() -> Optional[str]:
+    return None if _run_dir is None else os.path.join(
+        _run_dir, f"trace_{attempt_id()}.json")
+
+
+def metrics_path() -> Optional[str]:
+    return None if _run_dir is None else os.path.join(
+        _run_dir, "metrics.prom")
+
+
+def dump_flight(reason: str) -> Optional[str]:
+    """Dump the flight window (and the trace + metrics snapshot — a
+    postmortem wants all three together). No-op without a configured
+    run dir; never raises (a broken dump must not mask the original
+    crash)."""
+    path = flight_path()
+    if path is None:
+        return None
+    try:
+        out = _recorder.dump(path, reason)
+        flush()
+        return out
+    except Exception:
+        return None
+
+
+def flush() -> None:
+    """Write the Perfetto trace and the Prometheus text snapshot for
+    the configured run dir (atomic, idempotent, safe to call often)."""
+    if _run_dir is None:
+        return
+    try:
+        _tracer.flush(trace_path())
+    except Exception:
+        pass
+    try:
+        tmp = metrics_path() + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(_registry.prometheus_text())
+        os.replace(tmp, metrics_path())
+    except Exception:
+        pass
+
+
+def publish(writer, step: int) -> None:
+    """Merge registry values into a LogWriter JSONL stream."""
+    _registry.publish(writer, step)
+
+
+def reset() -> None:
+    """Fresh registry / tracer / recorder and no run dir (tests)."""
+    global _registry, _tracer, _recorder, _run_dir
+    with _state_lock:
+        _registry = MetricsRegistry()
+        _tracer = SpanTracer()
+        _recorder = FlightRecorder()
+        _run_dir = None

@@ -7,6 +7,7 @@ training loops: step time, tokens/sec, and MFU against the chip's peak."""
 from __future__ import annotations
 
 import contextlib
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -35,6 +36,11 @@ def local_peak_flops() -> float:
     return sum(device_peak_flops(d) for d in jax.local_devices())
 
 
+# jax.profiler supports ONE live trace per process; the owner lets
+# stop() know whether this instance actually holds it
+_trace_owner: Optional["Profiler"] = None
+
+
 class Profiler:
     """paddle.profiler.Profiler-shaped facade over jax.profiler."""
 
@@ -44,13 +50,37 @@ class Profiler:
         self._active = False
 
     def start(self):
+        """Idempotent: a second ``start()`` on a live profiler — or a
+        ``start()`` while ANOTHER profiler's trace is still open — warns
+        and returns instead of surfacing jax.profiler's raw "trace
+        already started" error mid-run."""
+        global _trace_owner
+        if self._active:
+            print("[profiler] start() called on an already-active "
+                  "profiler; ignoring", file=sys.stderr, flush=True)
+            return
         if not self.timer_only:
-            jax.profiler.start_trace(self.logdir)
+            if _trace_owner is not None:
+                print(f"[profiler] a trace is already running "
+                      f"(logdir={_trace_owner.logdir}); start() falls "
+                      f"back to timer-only for this profiler",
+                      file=sys.stderr, flush=True)
+            else:
+                jax.profiler.start_trace(self.logdir)
+                _trace_owner = self
         self._active = True
 
     def stop(self):
-        if self._active and not self.timer_only:
-            jax.profiler.stop_trace()
+        global _trace_owner
+        if self._active and _trace_owner is self:
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                # release the latch even when stop_trace() raises: the
+                # jax trace is in an unknown state either way, but a
+                # held latch would wedge every future profiler in this
+                # process into timer-only fallback
+                _trace_owner = None
         self._active = False
 
     def __enter__(self):
@@ -86,8 +116,12 @@ class StepTimer:
         """Close a timing window covering ``steps`` training steps (the
         trainer logs once per ``logging_steps`` window, so per-step
         averages need the real step count, not the window count)."""
-        assert self._t0 is not None
+        if self._t0 is None:
+            raise RuntimeError(
+                "StepTimer.stop() called with no open window; call "
+                "start() first")
         dt = time.perf_counter() - self._t0
+        self._t0 = None          # window closed; a second stop() raises
         self.steps += steps
         self.total_s += dt
         self.total_tokens += tokens

@@ -81,6 +81,11 @@ class GracefulShutdown:
             print(f"[shutdown] graceful shutdown requested ({reason}); "
                   f"will checkpoint and exit at the next step boundary",
                   file=sys.stderr, flush=True)
+            try:   # flight recorder: the latch is the postmortem anchor
+                from . import observability as obs
+                obs.record_event("preempt_latch", reason=reason)
+            except Exception:
+                pass
         self._event.set()
 
     def requested(self) -> bool:

@@ -1,0 +1,402 @@
+#!/usr/bin/env python
+"""Render a run dir's observability artifacts (ISSUE 5): step-time
+p50/p99, MFU/throughput, stall counters, and the fault/rollback/
+checkpoint event timeline from the flight recorder — the "what
+happened to this run" one-pager.
+
+    python tools/obs_report.py runs/                 # human summary
+    python tools/obs_report.py runs/ --json          # machine-readable
+    python tools/obs_report.py runs/ --serve 9090    # /metrics scrape
+    python tools/obs_report.py --check               # CI self-test
+
+A run dir (``<output_dir>/runs`` for the Trainer) holds:
+
+- ``metrics.jsonl``  — LogWriter scalars + merged registry publishes
+- ``metrics.prom``   — Prometheus text snapshot (what ``--serve`` serves)
+- ``trace_<k>.json`` — chrome-trace spans per elastic attempt k
+                       (load in Perfetto / chrome://tracing)
+- ``flight_<k>.json``— flight-recorder dump per attempt (crash /
+                       preemption / rollback postmortems)
+- ``flight_supervisor.json`` / ``metrics_supervisor.prom`` — the
+  elastic supervisor's own child-launch/exit events and
+  restart/preemption counters (``supervise(run_dir=…)`` / ``--run-dir``)
+
+``--check`` builds a synthetic run dir with the observability library
+itself, re-parses it, and exits nonzero if the schema drifted —
+runnable in CI with no devices.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# event kinds that belong on the human timeline (per-step step_end
+# records feed the latency stats instead — hundreds of them would
+# drown the signal)
+TIMELINE_KINDS = (
+    "train_start", "fault_fire", "divergence", "rollback",
+    "preempt_latch", "preempt_exit", "preempt_ckpt_failed", "hang",
+    "crash", "prefetch_stall", "ckpt_save", "ckpt_restore",
+    "ckpt_committed", "eval", "elastic_child_launch",
+    "elastic_child_exit", "serve_reject", "serve_preempt",
+)
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    idx = q * (len(xs) - 1)
+    lo, hi = int(idx), min(int(idx) + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * (idx - lo)
+
+
+def _load_jsonl(path: str) -> Dict[str, List]:
+    """tag -> [(step, value)] series from a LogWriter stream."""
+    series: Dict[str, List] = {}
+    if not os.path.exists(path):
+        return series
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                series.setdefault(rec["tag"], []).append(
+                    (rec["step"], rec["value"]))
+            except (ValueError, KeyError):
+                continue   # torn tail line from a crash: skip, don't die
+    return series
+
+
+def _load_flights(run_dir: str) -> List[dict]:
+    flights = []
+    for path in sorted(glob.glob(os.path.join(run_dir, "flight_*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            doc["_file"] = os.path.basename(path)
+            flights.append(doc)
+        except (OSError, ValueError):
+            continue
+    return flights
+
+
+def _load_prom(path: str) -> Dict[str, float]:
+    prom: Dict[str, float] = {}
+    if not os.path.exists(path):
+        return prom
+    for line in open(path):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name, value = line.rsplit(" ", 1)
+            prom[name] = float(value)
+        except ValueError:
+            continue
+    return prom
+
+
+def _load_traces(run_dir: str) -> List[dict]:
+    traces = []
+    for path in sorted(glob.glob(os.path.join(run_dir, "trace_*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            doc["_file"] = os.path.basename(path)
+            traces.append(doc)
+        except (OSError, ValueError):
+            continue
+    return traces
+
+
+def summarize(run_dir: str) -> Dict[str, Any]:
+    """Parse every artifact in ``run_dir`` into one summary dict (the
+    schema ``--check`` pins)."""
+    series = _load_jsonl(os.path.join(run_dir, "metrics.jsonl"))
+    flights = _load_flights(run_dir)
+    traces = _load_traces(run_dir)
+
+    # step latency: flight step_end events are the primary series (they
+    # survive crashes); train_step trace spans are the fallback
+    step_ms = [ev["ms"] for fl in flights for ev in fl.get("events", ())
+               if ev.get("kind") == "step_end" and "ms" in ev]
+    span_ms = [ev["dur"] / 1e3 for tr in traces
+               for ev in tr.get("traceEvents", ())
+               if ev.get("name") == "train_step" and "dur" in ev]
+    lat = step_ms or span_ms
+
+    def last(tag: str) -> Optional[float]:
+        return series[tag][-1][1] if series.get(tag) else None
+
+    timeline = sorted(
+        (ev for fl in flights for ev in fl.get("events", ())
+         if ev.get("kind") in TIMELINE_KINDS),
+        key=lambda ev: ev.get("wall", 0.0))
+
+    prom = _load_prom(os.path.join(run_dir, "metrics.prom"))
+    # the supervisor process keeps its own registry (children can't
+    # count their own relaunches): a separate snapshot, merged here
+    sup_prom = _load_prom(os.path.join(run_dir,
+                                       "metrics_supervisor.prom"))
+
+    def prom_sum(prefix: str, src: Optional[Dict[str, float]] = None
+                 ) -> float:
+        return sum(v for k, v in (prom if src is None else src).items()
+                   if k.split("{")[0] == prefix)
+
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        # the supervisor's flight doc is not a child attempt
+        "attempts": sorted({fl.get("attempt", 0) for fl in flights
+                            if fl["_file"] != "flight_supervisor.json"}),
+        "flight_reasons": [(fl["_file"], fl.get("reason"))
+                           for fl in flights],
+        "steps_recorded": len(lat),
+        "step_ms": {
+            "p50": round(_percentile(lat, 0.5), 3),
+            "p99": round(_percentile(lat, 0.99), 3),
+            "mean": round(sum(lat) / len(lat), 3) if lat else 0.0,
+            "max": round(max(lat), 3) if lat else 0.0,
+        },
+        "train": {
+            "loss": last("loss") if last("loss") is not None
+            else last("train_loss"),
+            "mfu": last("mfu") if last("mfu") is not None
+            else last("train_mfu"),
+            "tokens_per_sec": last("tokens_per_sec")
+            if last("tokens_per_sec") is not None
+            else last("train_tokens_per_sec"),
+        },
+        "counters": {
+            "prefetch_sync_fallbacks":
+                prom_sum("prefetch_sync_fallbacks_total"),
+            "prefetch_stall_degradations":
+                prom_sum("prefetch_stall_degradations_total"),
+            "fault_fires": prom_sum("fault_fires_total"),
+            "rollbacks": prom_sum("train_rollbacks_total"),
+            "train_steps": prom_sum("train_steps_total"),
+            "elastic_restarts":
+                prom_sum("elastic_restarts_total", sup_prom),
+            "elastic_preemptions":
+                prom_sum("elastic_preemptions_total", sup_prom),
+        },
+        "trace_spans": sum(len(tr.get("traceEvents", ()))
+                           for tr in traces),
+        "timeline": timeline,
+        "jsonl_tags": sorted(series),
+    }
+
+
+def render(s: Dict[str, Any]) -> str:
+    import datetime
+    lines = [f"run dir: {s['run_dir']}",
+             f"attempts: {s['attempts'] or [0]}   "
+             f"trace spans: {s['trace_spans']}   "
+             f"steps recorded: {s['steps_recorded']}"]
+    st = s["step_ms"]
+    lines.append(f"step time  p50 {st['p50']:.1f} ms   "
+                 f"p99 {st['p99']:.1f} ms   mean {st['mean']:.1f} ms   "
+                 f"max {st['max']:.1f} ms")
+    tr = s["train"]
+    if tr["loss"] is not None:
+        mfu = tr["mfu"] or 0.0
+        tps = tr["tokens_per_sec"] or 0.0
+        lines.append(f"train      loss {tr['loss']:.4f}   "
+                     f"mfu {mfu:.2%}   tokens/s {tps:,.0f}")
+    c = s["counters"]
+    # metrics.prom is a per-process snapshot: after an elastic run it
+    # holds the LAST attempt's registry (the timeline spans them all)
+    lines.append(f"counters (last attempt)   "
+                 f"steps {c['train_steps']:.0f}   "
+                 f"fault fires {c['fault_fires']:.0f}   "
+                 f"rollbacks {c['rollbacks']:.0f}   "
+                 f"prefetch stalls "
+                 f"{c['prefetch_stall_degradations']:.0f} "
+                 f"(sync fallbacks {c['prefetch_sync_fallbacks']:.0f})")
+    if c["elastic_restarts"] or c["elastic_preemptions"]:
+        lines.append(f"supervisor restarts {c['elastic_restarts']:.0f}   "
+                     f"preemptions {c['elastic_preemptions']:.0f}")
+    for fname, reason in s["flight_reasons"]:
+        lines.append(f"flight     {fname}: {reason}")
+    if s["timeline"]:
+        lines.append("timeline:")
+        for ev in s["timeline"][-40:]:
+            wall = datetime.datetime.fromtimestamp(
+                ev.get("wall", 0.0)).strftime("%H:%M:%S.%f")[:-3]
+            extra = " ".join(f"{k}={v}" for k, v in ev.items()
+                             if k not in ("wall", "kind"))
+            lines.append(f"  {wall}  {ev['kind']:<22s} {extra}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ serve
+def serve(run_dir: str, port: int) -> int:
+    """Serve ``/metrics`` (Prometheus text, re-read per scrape) and
+    ``/`` (the JSON summary) with the stdlib http server — a sidecar
+    scrape endpoint with zero dependencies."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.rstrip("/") == "/metrics":
+                path = os.path.join(run_dir, "metrics.prom")
+                try:
+                    body = open(path, "rb").read()
+                except OSError:
+                    self.send_error(404, "no metrics.prom yet")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                body = json.dumps(summarize(run_dir)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+        def log_message(self, *a):   # quiet: scrapes every few seconds
+            pass
+
+    httpd = http.server.HTTPServer(("", port), Handler)
+    print(f"serving {run_dir} on :{port} (/metrics for Prometheus, "
+          f"/ for the JSON summary)", file=sys.stderr, flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+# ------------------------------------------------------------------ check
+def self_check() -> int:
+    """CI mode: synthesize a run dir with the observability library,
+    re-parse it, and verify the summary schema — no devices, no model.
+    Nonzero exit = the reader and the writer drifted apart."""
+    import tempfile
+
+    from paddle_tpu.utils import observability as obs
+    from paddle_tpu.utils.logging import LogWriter
+
+    failures: List[str] = []
+
+    def expect(cond: bool, what: str):
+        if not cond:
+            failures.append(what)
+
+    obs.reset()
+    with tempfile.TemporaryDirectory() as tmp:
+        run = os.path.join(tmp, "runs")
+        obs.configure(run)
+        # a fake 5-step run with one fault fire and a checkpoint
+        writer = LogWriter(run)
+        for step in range(1, 6):
+            with obs.span("train_step", step=step):
+                pass
+            obs.counter("train_steps_total").inc()
+            obs.histogram("train_step_wall_ms").observe(10.0 + step)
+            obs.record_event("step_end", step=step, ms=10.0 + step)
+        obs.gauge("train_mfu").set(0.41)
+        obs.record_event("fault_fire", site="preempt", occurrence=0)
+        obs.record_event("ckpt_save", step=5, wait=True, ms=12.5)
+        writer.add_scalar("loss", 2.5, 5)
+        writer.add_scalar("mfu", 0.41, 5)
+        writer.add_scalar("tokens_per_sec", 123456.0, 5)
+        obs.publish(writer, 5)
+        writer.close()
+        obs.dump_flight("preempt")
+        # a fake supervisor view (separate recorder/registry — in real
+        # runs it's a separate PROCESS writing these two files)
+        sup = obs.FlightRecorder()
+        sup.record("elastic_child_launch", attempt=0, argv0="python")
+        sup.record("elastic_child_exit", attempt=0, rc=76)
+        sup.dump(os.path.join(run, "flight_supervisor.json"),
+                 "supervise_exit")
+        sreg = obs.MetricsRegistry()
+        sreg.counter("elastic_preemptions_total").inc()
+        with open(os.path.join(run, "metrics_supervisor.prom"), "w") as f:
+            f.write(sreg.prometheus_text())
+
+        s = summarize(run)
+        expect(s["steps_recorded"] == 5, "step_end events lost")
+        expect(s["step_ms"]["p50"] > 0, "p50 not computed")
+        expect(s["step_ms"]["p99"] >= s["step_ms"]["p50"],
+               "p99 < p50")
+        expect(s["train"]["loss"] == 2.5, "loss not read from jsonl")
+        expect(s["train"]["mfu"] == 0.41, "mfu not read from jsonl")
+        expect(s["counters"]["train_steps"] == 5,
+               "train_steps_total not in metrics.prom")
+        kinds = [ev["kind"] for ev in s["timeline"]]
+        expect("fault_fire" in kinds, "fault_fire missing from timeline")
+        expect("ckpt_save" in kinds, "ckpt_save missing from timeline")
+        expect("elastic_child_exit" in kinds,
+               "supervisor flight events missing from timeline")
+        expect(s["counters"]["elastic_preemptions"] == 1,
+               "supervisor counters not read from "
+               "metrics_supervisor.prom")
+        expect(len(s["attempts"]) == 1,
+               "flight_supervisor.json polluted the attempts set")
+        expect(s["flight_reasons"] and
+               s["flight_reasons"][0][1] == "preempt",
+               "flight reason lost")
+        expect(s["trace_spans"] >= 5, "train_step spans missing")
+        expect(any(t.startswith("train_step_wall_ms")
+                   for t in s["jsonl_tags"]),
+               "registry publish missing from jsonl")
+        # the trace must be chrome-trace shaped (Perfetto-loadable)
+        tr = _load_traces(run)[0]
+        ev = next(e for e in tr["traceEvents"]
+                  if e["name"] == "train_step")
+        expect(ev["ph"] == "X" and "ts" in ev and "dur" in ev
+               and ev["args"]["step"] in range(1, 6),
+               "trace events not chrome-trace shaped")
+        expect("run_id" in tr.get("otherData", {}),
+               "trace missing run_id metadata")
+        render(s)   # rendering must not throw on a well-formed summary
+    obs.reset()
+    if failures:
+        print("obs_report schema drift:", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print("obs_report --check: schema OK "
+          "(writer and reader agree on all artifacts)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", nargs="?", help="run dir (e.g. out/runs)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable summary")
+    ap.add_argument("--serve", type=int, metavar="PORT",
+                    help="serve /metrics + the JSON summary over HTTP")
+    ap.add_argument("--check", action="store_true",
+                    help="synthetic self-test (CI; no devices)")
+    ns = ap.parse_args(argv)
+    if ns.check:
+        return self_check()
+    if not ns.run_dir:
+        ap.error("run_dir required (or --check)")
+    if not os.path.isdir(ns.run_dir):
+        print(f"not a directory: {ns.run_dir}", file=sys.stderr)
+        return 2
+    if ns.serve:
+        return serve(ns.run_dir, ns.serve)
+    s = summarize(ns.run_dir)
+    print(json.dumps(s) if ns.json else render(s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
