@@ -519,6 +519,39 @@ def main():
             failures.append({"stage": "decode", "rc": rc,
                              "stderr_tail": err[-300:]})
 
+    # tools/decode_profile.py rung ingestion (ISSUE 6): when the same
+    # window already ran the profiler, fold its per-architecture paged
+    # numbers in so the banked bench captures the tick-fusion
+    # before/after even if this process' own paged rung was skipped.
+    if result is not None:
+        prof = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "DECODE_PROFILE_r06.json")
+        try:
+            with open(prof) as f:
+                pj = json.load(f)
+            paged = pj.get("paged")
+            if paged and "paged_tokens_per_sec" in paged:
+                result.setdefault("decode", {})
+                result["decode"]["paged_profile"] = dict(
+                    paged, profile_device=pj.get("device"),
+                    profile_started=pj.get("started"))
+                # promote the rung only when the profile came from THIS
+                # window: same device kind AND started within the last
+                # 6h — a stale CPU-run file (or a week-old hardware
+                # window's) must not masquerade as this run's number
+                try:
+                    age_s = time.time() - time.mktime(time.strptime(
+                        pj["started"], "%Y-%m-%d %H:%M:%S"))
+                except (KeyError, ValueError):
+                    age_s = float("inf")
+                if pj.get("device") == probe.get("device_kind") \
+                        and age_s < 6 * 3600:
+                    result["decode"].setdefault(
+                        "paged_tokens_per_sec",
+                        paged["paged_tokens_per_sec"])
+        except (OSError, ValueError):
+            pass
+
     # (c) always emit exactly one JSON line.
     if result is not None:
         result["probe"] = {k: probe[k] for k in

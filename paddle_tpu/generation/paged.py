@@ -20,6 +20,17 @@ TPU-native design — everything the XLA program sees is STATIC:
   bookkeeping between jitted calls — numpy lists, no recompiles. New
   requests are admitted mid-decode the moment a slot and blocks free
   up: the bucketed Predictor's whole-batch barrier is gone.
+- The decode tick itself is DEVICE-RESIDENT (ISSUE 6): block tables,
+  seq lens, per-row sampling params, PRNG keys, token budgets and the
+  active mask live on device as engine state advanced INSIDE the one
+  compiled tick program (attention → repetition penalty → sampling →
+  eos/budget done flags); the host reads back only (next_token,
+  logprob, done) per tick and re-uploads its numpy mirrors only on
+  slot transitions. Steady-state decode is therefore exactly one
+  dispatch + one small D2H per token — none of the per-tick
+  ``jnp.asarray`` uploads and Python stop/eos bookkeeping that left
+  the r05 bench at 49 tok/s. ``fused_tick=False`` restores the
+  per-tick host path (the bit-exactness reference).
 
 Padded prompt positions scatter into a reserved GARBAGE block (physical
 block 0) so they can never corrupt a live block; it is never allocated.
@@ -119,21 +130,29 @@ def paged_decode_attention(q, pk: PagedKV, scale: Optional[float] = None,
     """q [R, 1, h, d] against each row's blocks, masked to the row's
     length (inclusive of the token written this step).
 
-    Fast path: the Pallas paged kernel streams only each row's LIVE
-    blocks (scalar-prefetched block table, HBM bytes ∝ actual context).
-    Fallback (CPU tests / odd shapes): dense whole-table gather — the
-    math is dense_attention's, only the gather and per-row length mask
-    live here."""
+    Fast path (default "ragged"): the schedule-driven ragged kernel —
+    one grid over the batch's ACTUAL live blocks, packed live-first, no
+    per-request padding (ISSUE 6). ``PADDLE_TPU_PAGED_ATTN=grid`` keeps
+    the r05-hardware-validated grid-per-row kernel; ``=dense`` forces
+    the fallback. Fallback (CPU tests / odd shapes): dense whole-table
+    gather — the math is dense_attention's, only the gather and per-row
+    length mask live here."""
+    import os
+
     from ..ops.attention import dense_attention
     from ..ops.pallas.paged_attention import (paged_attention_pallas,
                                               use_paged_kernel)
+    from ..ops.pallas.ragged_paged_attention import \
+        ragged_paged_attention_pallas
     R = q.shape[0]
     kvh, d = pk.kp.shape[2], pk.kp.shape[3]
-    if use_paged_kernel(q, pk.kp):
+    mode = os.environ.get("PADDLE_TPU_PAGED_ATTN", "ragged")
+    if mode != "dense" and use_paged_kernel(q, pk.kp):
         sc = scale if scale is not None else d ** -0.5
-        out = paged_attention_pallas(q[:, 0], pk.kp, pk.vp,
-                                     pk.block_tables, pk.seq_lens, sc,
-                                     window=window)
+        fn = (paged_attention_pallas if mode == "grid"
+              else ragged_paged_attention_pallas)
+        out = fn(q[:, 0], pk.kp, pk.vp, pk.block_tables, pk.seq_lens,
+                 sc, window=window)
         return out[:, None]
     ks = pk.kp[pk.block_tables]                  # [R, M, B, kvh, d]
     vs = pk.vp[pk.block_tables]
@@ -203,7 +222,9 @@ class PagedEngine:
                  chunk_prefill_tokens: Optional[int] = None,
                  enable_prefix_cache: bool = False,
                  max_queue: Optional[int] = None,
-                 default_timeout_s: Optional[float] = None):
+                 default_timeout_s: Optional[float] = None,
+                 fused_tick: bool = True,
+                 ticks_per_dispatch: int = 1):
         cfg = model.config
         self.model = model
         self.fn, self.params = model.functional()
@@ -298,6 +319,51 @@ class PagedEngine:
                                     static_argnames=("bucket",))
         self._chunk_jit = jax.jit(self._chunk_prefill, donate_argnums=(1,),
                                   static_argnames=("bucket",))
+        # --- device-resident fused tick (ISSUE 6 tentpole) ------------
+        # fused_tick=True keeps block tables / seq lens / sampling params
+        # / PRNG keys / done-bookkeeping ON DEVICE as engine state
+        # mutated by one compiled program per tick; the host reads back
+        # only (next_token, logprob, done) and re-uploads mirrors on
+        # SLOT TRANSITIONS (admit / finish / chunk / preempt / new
+        # block). fused_tick=False keeps the per-tick host path — the
+        # parity reference the fused stream must match bit-exactly.
+        self._fused = bool(fused_tick)
+        self._dev: Optional[Dict[str, Any]] = None   # device state dict
+        self._dev_dirty = True          # host mirrors changed since build
+        self._dev_keys_dirty = False    # device keys advanced since sync
+        self._key_overrides: set = set()  # rows host re-keyed (authoritative)
+        # instrumentation for the one-dispatch-per-tick contract: jitted
+        # engine-program launches and host->device mirror uploads (the
+        # transition scatters on `seen` are not counted — they are slot-
+        # transition work, not steady-state ticks)
+        self.dispatch_count = 0
+        self.h2d_uploads = 0
+        # NOTE: the small state dict is NOT donated — donating leaves
+        # that pass through unchanged (tables, temps, ...) makes XLA
+        # emit input->output aliases for them, and executables
+        # round-tripped through the persistent compile cache mis-assign
+        # those aliased buffers on jax 0.4.37 CPU (cold-compile exact,
+        # cache-hit garbage). The arrays are a few hundred bytes; the
+        # copies are free. Pools and seen masks keep their donation.
+        self._tick_jit = jax.jit(self._fused_tick,
+                                 donate_argnums=(1, 2))
+        self._tick_greedy_jit = jax.jit(self._fused_tick_greedy,
+                                        donate_argnums=(1, 2))
+        # MPK-style multi-tick fusion: lax.scan K device-resident ticks
+        # inside ONE compiled program, amortizing the per-dispatch floor
+        # over K tokens. Only taken when provably stream-exact (see
+        # _scan_ticks); K=1 (default) keeps strict per-tick scheduling.
+        self._ticks_per_dispatch = max(1, int(ticks_per_dispatch))
+        if self._ticks_per_dispatch > 1:
+            import functools
+            self._scan_greedy_jit = jax.jit(
+                functools.partial(self._fused_scan, greedy=True,
+                                  K=self._ticks_per_dispatch),
+                donate_argnums=(1, 2))
+            self._scan_jit = jax.jit(
+                functools.partial(self._fused_scan, greedy=False,
+                                  K=self._ticks_per_dispatch),
+                donate_argnums=(1, 2))
 
     @property
     def stats(self) -> Dict[str, int]:
@@ -348,6 +414,138 @@ class PagedEngine:
                                   nxt[:, None], axis=-1)[:, 0]
         seen = seen.at[jnp.arange(self.R), nxt].max(active)
         return nxt, lps, seen, [(c.kp, c.vp) for c in new_caches]
+
+    # ------------------------------------------- fused device-resident tick
+    def _fused_epilogue(self, st, new_caches, seen, nxt, lps, new_keys):
+        """Device-side tick bookkeeping: advance active rows' lengths /
+        last tokens / budgets, fold the emitted token into the seen
+        mask, and derive the done flag (eos hit or budget exhausted —
+        the same predicate the host evaluates after appending). The
+        active mask deactivates done rows so an unserviced row can never
+        advance twice; stop-sequence matching stays host-side and is
+        reconciled at the finish transition."""
+        act = st["active"]
+        acti = act.astype(jnp.int32)
+        seen = seen.at[jnp.arange(self.R), nxt].max(act)
+        rem = st["rem"] - acti
+        done = act & (((st["eos"] >= 0) & (nxt == st["eos"]))
+                      | (rem <= 0))
+        new_st = dict(st)
+        new_st.update(lens=st["lens"] + acti,
+                      last=jnp.where(act, nxt, st["last"]),
+                      keys=new_keys, rem=rem, active=act & ~done)
+        return (nxt, lps, done, seen,
+                [(c.kp, c.vp) for c in new_caches], new_st)
+
+    def _fused_tick(self, params, pools, seen, st):
+        """ONE compiled program for a mixed greedy/sampled tick:
+        attention (ragged paged kernel when gated) → repetition penalty
+        → per-row sampling → done flags + device-state advance. Key
+        splits follow `_decode_step` exactly (all rows split), so
+        sampled streams are bit-identical to the host-tick path."""
+        from .sampling import repetition_penalty_rows, sample_token_rows
+        caches = self._paged_caches(pools, st["tables"], st["lens"])
+        logits, new_caches = self.fn(params, st["last"][:, None],
+                                     kv_caches=caches,
+                                     positions=st["lens"][:, None])
+        raw = repetition_penalty_rows(logits[:, -1].astype(jnp.float32),
+                                      seen, st["reps"])
+        nxt, lps, new_keys = sample_token_rows(raw, st["keys"],
+                                               st["temps"], st["tks"],
+                                               st["tps"])
+        return self._fused_epilogue(st, new_caches, seen, nxt, lps,
+                                    new_keys)
+
+    def _fused_tick_greedy(self, params, pools, seen, st):
+        """Argmax-only fused tick (same specialization contract as
+        `_decode_step_greedy`: chosen when every ACTIVE row is greedy;
+        keys pass through untouched, exactly like the host path's
+        no-split greedy executable)."""
+        from .sampling import repetition_penalty_rows
+        caches = self._paged_caches(pools, st["tables"], st["lens"])
+        logits, new_caches = self.fn(params, st["last"][:, None],
+                                     kv_caches=caches,
+                                     positions=st["lens"][:, None])
+        raw = repetition_penalty_rows(logits[:, -1].astype(jnp.float32),
+                                      seen, st["reps"])
+        nxt = jnp.argmax(raw, axis=-1).astype(jnp.int32)
+        lps = jnp.take_along_axis(jax.nn.log_softmax(raw, axis=-1),
+                                  nxt[:, None], axis=-1)[:, 0]
+        return self._fused_epilogue(st, new_caches, seen, nxt, lps,
+                                    st["keys"])
+
+    def _fused_scan(self, params, pools, seen, st, *, greedy: bool,
+                    K: int):
+        """K fused ticks inside ONE compiled program (``lax.scan`` over
+        the single-tick core — the MPK "as few programs as possible"
+        endpoint). Each iteration is the SAME traced computation as the
+        K=1 executable, so the emitted stream is bit-identical to K
+        single dispatches; the per-dispatch floor is amortized over K
+        tokens. Rows that finish (eos/budget) mid-scan deactivate via
+        the device active mask and stop advancing; their later (nxt,
+        lps) slots are garbage the host never reads past the first done
+        flag. Returns (nxt[K,R], lps[K,R], done[K,R], seen, pools, st)."""
+        tick = self._fused_tick_greedy if greedy else self._fused_tick
+
+        def body(carry, _):
+            pools, seen, st = carry
+            nxt, lps, done, seen, pools, st = tick(params, pools, seen,
+                                                   st)
+            return (pools, seen, st), (nxt, lps, done)
+
+        (pools, seen, st), (nxt, lps, done) = jax.lax.scan(
+            body, (pools, seen, st), None, length=K)
+        return nxt, lps, done, seen, pools, st
+
+    def _sync_keys_from_dev(self):
+        """Fold the device PRNG keys back into the host mirror. Rows the
+        host re-keyed since the last upload (`_key_overrides`: fresh
+        admissions, chunk-final authoritative keys) keep their host
+        value — the device copy is stale for them until the next
+        refresh uploads it."""
+        if self._dev is None or not self._dev_keys_dirty:
+            return
+        dk = np.asarray(self._dev["keys"])
+        for r in range(self.R):
+            if r not in self._key_overrides:
+                self.keys[r] = dk[r]
+        self._dev_keys_dirty = False
+
+    def _refresh_dev(self):
+        """Rebuild the device-resident tick state from the host mirrors
+        (runs only on slot transitions — admissions, finishes, chunk
+        advances, preemptions, block growth — never on a steady-state
+        tick)."""
+        self._sync_keys_from_dev()
+        self._key_overrides.clear()
+        eos = np.full((self.R,), -1, np.int32)
+        rem = np.zeros((self.R,), np.int32)
+        last = np.zeros((self.R,), np.int32)
+        act = np.zeros((self.R,), bool)
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            if s.eos is not None:
+                eos[i] = s.eos
+            rem[i] = max(s.max_new - len(s.tokens), 0)
+            if s.tokens and s.prefill_pos >= len(s.prompt):
+                act[i] = True
+                last[i] = s.tokens[-1]
+        self.h2d_uploads += 1
+        self._dev = dict(
+            tables=jnp.asarray(self.block_tables),
+            lens=jnp.asarray(self.seq_lens),
+            last=jnp.asarray(last),
+            keys=jnp.asarray(self.keys),
+            temps=jnp.asarray(self.temps),
+            tks=jnp.asarray(self.top_ks),
+            tps=jnp.asarray(self.top_ps),
+            reps=jnp.asarray(self.reps),
+            eos=jnp.asarray(eos),
+            rem=jnp.asarray(rem),
+            active=jnp.asarray(act),
+        )
+        self._dev_dirty = False
 
     def _prefill(self, params, pools, table_row, ids, length, key,
                  temp, tk, tp, rep, *, bucket: int):
@@ -626,6 +824,8 @@ class PagedEngine:
         self.top_ps[slot_id] = req.top_p
         self.reps[slot_id] = req.rep
         self.keys[slot_id] = req.key
+        self._key_overrides.add(slot_id)
+        self._dev_dirty = True
 
         if self.chunk is not None:
             # chunked mode: admission only claims the slot + blocks; the
@@ -649,6 +849,7 @@ class PagedEngine:
                 bucket *= 2
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(ids)] = ids
+        self.dispatch_count += 1
         nxt, lp, new_key, seen_row, self.pools = self._prefill_jit(
             self.params, self.pools, jnp.asarray(row),
             jnp.asarray(padded), np.int32(len(ids)),
@@ -659,6 +860,7 @@ class PagedEngine:
         self._count("prefills")
         first = int(nxt)
         self.keys[slot_id] = np.asarray(new_key)
+        self._key_overrides.add(slot_id)
         req.key = self.keys[slot_id].copy()
         req.tokens.append(first)
         req.lps.append(float(lp))
@@ -682,6 +884,8 @@ class PagedEngine:
         padded = np.zeros((1, self.chunk), np.int32)
         padded[0, :live] = ids[start:start + live]
         row = self.block_tables[slot_id]
+        self._dev_dirty = True       # lens/activation change this tick
+        self.dispatch_count += 1
         nxt, lp, new_key, seen_mid, seen_fin, self.pools = self._chunk_jit(
             self.params, self.pools, jnp.asarray(row),
             jnp.asarray(padded), np.int32(start),
@@ -700,6 +904,7 @@ class PagedEngine:
             self._count("prefills")
             self._register_prefix(req)
             self.keys[slot_id] = np.array(new_key)
+            self._key_overrides.add(slot_id)
             req.key = self.keys[slot_id].copy()
             first = int(nxt)
             req.tokens.append(first)
@@ -719,6 +924,7 @@ class PagedEngine:
                 return False
             slot.blocks.append(b)
             self.block_tables[slot_id, len(slot.blocks) - 1] = b
+            self._dev_dirty = True   # table row grew: re-upload mirrors
         return True
 
     @staticmethod
@@ -762,6 +968,8 @@ class PagedEngine:
         self.reps[slot_id] = 1.0
         self.seen = self.seen.at[slot_id].set(False)
         self.slots[slot_id] = None
+        self._key_overrides.discard(slot_id)
+        self._dev_dirty = True
 
     def _preempt_youngest(self, exclude: int) -> bool:
         """Memory pressure: requeue the most recently admitted OTHER
@@ -780,6 +988,15 @@ class PagedEngine:
         # after every decode tick / final chunk, and NOT perturbed by the
         # all-rows key split that garbage-advances self.keys for rows
         # still mid-chunk-prefill
+        if self._fused and s.tokens and victim not in self._key_overrides:
+            # fused mode never syncs s.key per tick; for a DECODE-active
+            # victim the truth is the device key stream (or the mirror
+            # refreshed from it). Mid-prefill victims (no tokens) keep
+            # their untouched authoritative s.key exactly as before.
+            if self._dev is not None and self._dev_keys_dirty:
+                s.key = np.asarray(self._dev["keys"])[victim].copy()
+            else:
+                s.key = self.keys[victim].copy()
         requeued = _Request(s.request_id, s.prompt + s.tokens,
                             s.max_new - len(s.tokens), s.eos,
                             s.temperature, s.top_k, s.top_p,
@@ -893,25 +1110,43 @@ class PagedEngine:
                   if s is not None and s.tokens]
         if not active:
             return
+        if self._fused:
+            scan = self._ticks_per_dispatch > 1 \
+                and self._scan_ticks(active)
+            return self._decode_fused(active, scan=scan)
+        return self._decode_host(active)
+
+    def _up(self, x):
+        """Host-mirror upload on the per-tick host path (counted so the
+        fused path's zero-upload steady state is testable)."""
+        self.h2d_uploads += 1
+        return jnp.asarray(x)
+
+    def _decode_host(self, active):
+        """The pre-fusion per-tick path: re-uploads every mirror and
+        runs all stop/eos/budget bookkeeping in Python. Kept as the
+        bit-exactness reference for the fused tick (and as a fallback
+        while the ragged kernel awaits its hardware window)."""
         t_decode = time.perf_counter()
         last = np.zeros((self.R,), np.int32)
         for i in active:
             last[i] = self.slots[i].tokens[-1]
         act_mask = np.zeros((self.R,), bool)
         act_mask[active] = True
+        self.dispatch_count += 1
         if np.all(self.temps[active] <= 0.0):
             # all-greedy tick: the argmax-only executable
             nxt, lps, self.seen, self.pools = self._decode_greedy_jit(
-                self.params, self.pools, jnp.asarray(self.block_tables),
-                jnp.asarray(self.seq_lens), jnp.asarray(last),
-                self.seen, jnp.asarray(self.reps), jnp.asarray(act_mask))
+                self.params, self.pools, self._up(self.block_tables),
+                self._up(self.seq_lens), self._up(last),
+                self.seen, self._up(self.reps), self._up(act_mask))
         else:
             nxt, lps, new_keys, self.seen, self.pools = self._decode_jit(
-                self.params, self.pools, jnp.asarray(self.block_tables),
-                jnp.asarray(self.seq_lens), jnp.asarray(last),
-                jnp.asarray(self.keys), jnp.asarray(self.temps),
-                jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
-                self.seen, jnp.asarray(self.reps), jnp.asarray(act_mask))
+                self.params, self.pools, self._up(self.block_tables),
+                self._up(self.seq_lens), self._up(last),
+                self._up(self.keys), self._up(self.temps),
+                self._up(self.top_ks), self._up(self.top_ps),
+                self.seen, self._up(self.reps), self._up(act_mask))
             self.keys = np.array(new_keys)  # copy: jax views read-only
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)
@@ -935,6 +1170,103 @@ class PagedEngine:
                 # the final token's K/V was never written - fine, it is
                 # never attended to
                 self._finish(i)
+        return True
+
+    def _decode_fused(self, active, scan: bool = False):
+        """Steady-state fused tick: ONE compiled dispatch advancing every
+        active slot (attention → penalty → sampling → done flags, all
+        device-state mutations inside the program) and one small D2H
+        readback of (next_token, logprob, done). Mirrors re-upload only
+        when a slot transition dirtied them. With ``scan=True`` (caller
+        proved eligibility via _scan_ticks) the one dispatch is the
+        K-tick lax.scan program — same host bookkeeping, a [K, R]
+        readback, and the decode-step histogram then records the whole
+        dispatch wall (divide by ticks_per_dispatch for per-token)."""
+        K = self._ticks_per_dispatch if scan else 1
+        if self._dev is None or self._dev_dirty:
+            self._refresh_dev()
+        t_decode = time.perf_counter()
+        self.dispatch_count += 1
+        greedy = np.all(self.temps[active] <= 0.0)
+        if scan:
+            fn = self._scan_greedy_jit if greedy else self._scan_jit
+        else:
+            fn = self._tick_greedy_jit if greedy else self._tick_jit
+        nxt, lps, done, self.seen, self.pools, self._dev = fn(
+            self.params, self.pools, self.seen, self._dev)
+        if not greedy:
+            self._dev_keys_dirty = True
+        nxt, lps, done = jax.device_get((nxt, lps, done))
+        if not scan:                     # [R] -> [1, R]: one tick loop
+            nxt, lps, done = nxt[None], lps[None], done[None]
+        self._h_decode.observe((time.perf_counter() - t_decode) * 1e3)
+        self._count("decode_steps", K)
+        self._count("slot_steps", self.R * K)
+        for i in active:
+            slot = self.slots[i]
+            for k in range(K):
+                self._count("active_slot_steps")
+                self.seq_lens[i] += 1   # device advanced its copy too
+                slot.tokens.append(int(nxt[k, i]))
+                slot.lps.append(float(lps[k, i]))
+                # stop check FIRST so a stop completing on the final
+                # budgeted (or eos) token still records its trim length;
+                # scan ticks past a row's done flag are garbage the
+                # break never reads (the device active mask froze them)
+                if self._stop_hit(slot) or bool(done[k, i]):
+                    self._finish(i)
+                    break
+        return True
+
+    def _scan_ticks(self, active) -> bool:
+        """True when the next ``ticks_per_dispatch`` ticks may run inside
+        one compiled program with NO observable difference from K
+        single ticks. Conservative by construction — any condition a
+        single tick would re-evaluate between tokens falls back to K=1:
+
+        - an empty queue (a scan must not delay an admission a
+          single-tick schedule would have made after token 1);
+        - every occupied slot decode-active (no mid-chunk prefill
+          interleaving, which runs between ticks);
+        - no stop sequences or deadlines on active rows (both are
+          HOST-side per-tick checks; eos/budget termination lives on
+          device and scans fine);
+        - block headroom for each row's next min(K, remaining-budget)
+          writes, preallocated here. Preallocation failure falls back
+          to the single-tick path and its preemption logic rather than
+          preempting for speculative capacity."""
+        K = self._ticks_per_dispatch
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            if i not in active:
+                return False          # occupied but not decode-active
+            if s.stop or s.deadline is not None:
+                return False
+        if self.queue:
+            return False
+        # pre-check the WHOLE speculative demand against what
+        # _alloc_block could actually serve (free list + evictable
+        # parked blocks) BEFORE allocating anything: a partial grab that
+        # fails on a later row would leave earlier rows holding
+        # speculative blocks, and the single-tick fallback would then
+        # preempt under pressure this method itself created
+        needs = []
+        for i in active:
+            s = self.slots[i]
+            a = min(K, max(s.max_new - len(s.tokens), 1))
+            need = self._blocks_needed(int(self.seq_lens[i]) + a)
+            needs.append((i, need))
+        fresh = sum(max(n - len(self.slots[i].blocks), 0)
+                    for i, n in needs)
+        if fresh > len(self.free_blocks) + len(self.cached_free):
+            return False              # pressure: single-tick handles it
+        for i, need in needs:
+            s = self.slots[i]
+            while len(s.blocks) < need:
+                s.blocks.append(self._alloc_block())
+                self.block_tables[i, len(s.blocks) - 1] = s.blocks[-1]
+                self._dev_dirty = True
         return True
 
     def run(self) -> Dict[Any, List[int]]:

@@ -129,10 +129,18 @@ def use_decode_kernel(q, k_cache) -> bool:
     if not (interpret_enabled()
             or (_flash_enabled() and kernels_enabled())):
         return False
-    # the kernel blocks K/V with FULL trailing (kv, d) dims — always legal
-    # under Mosaic's last-two-dims tiling rule, so any GQA d (incl. 64)
-    # runs on hardware; only the cache length needs a 128-multiple tile
-    return d in (64, 128, 256) and T % 128 == 0
+    if interpret_enabled():
+        # interpret mode skips Mosaic's tiling checks; any shape the
+        # python emulation can run keeps CI coverage of the dispatch glue
+        return d in (64, 128, 256) and T % 128 == 0
+    # hardware: the kernel's K/V column blocks are [bt, cw] over the
+    # folded [b, T, kv*d] view and must be STRICTLY (8, 128)-tiled (the
+    # r05 window refused the equal-to-array-dims escape hatch for
+    # (kv, d) = (4, 64)). cw is d when d % 128 == 0 and a head PAIR
+    # (128) when d == 64 with an even kv; d=64 with odd kv has no
+    # 128-multiple column block and takes the grouped-einsum fallback.
+    return T % 128 == 0 and (d in (128, 256)
+                             or (d == 64 and kv % 2 == 0))
 
 
 def decode_attention(q, k_cache, v_cache, cache_index, scale=None,

@@ -244,3 +244,51 @@ class TestFlashPrefillBranch:
         a = mf.generate(ids, max_new_tokens=8, temperature=0.0)
         b = md.generate(ids, max_new_tokens=8, temperature=0.0)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_bf16_prefill_divergence_is_accumulation_order(self,
+                                                           monkeypatch):
+        """Pins the BENCH_SELF_r05 `prefill_flash_vs_dense`
+        AssertionError(0.0664) triage (ISSUE 6 satellite): at the
+        validator's exact shape (hidden 256, 4 heads, 256-token prompt,
+        end-to-end bf16) flash-vs-dense logits differ by ~0.065 ABSOLUTE
+        — but the same comparison in fp32 is exact to ~5e-6, so the gap
+        is bf16 accumulation ORDER (flash's online-softmax block sums vs
+        dense's full-row reductions), not kernel math. Decision: judge
+        bf16 prefill RELATIVE to logit magnitude (rel ~1.3% on
+        |logits|~5), as tools/tpu_validate.py now does; the fp32 bound
+        here is the tripwire that would catch a REAL kernel regression
+        hiding behind the widened bf16 gate."""
+        monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+        import paddle_tpu as pt
+        from paddle_tpu.models import LlamaForCausalLM
+        from paddle_tpu.models.llama import llama_tiny
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, 256, (2, 256)))
+
+        def logits_pair(dtype):
+            pt.seed(0)
+            mf = LlamaForCausalLM(llama_tiny(
+                hidden_size=256, num_attention_heads=4,
+                max_position_embeddings=512, dtype=dtype))
+            pt.seed(0)
+            md = LlamaForCausalLM(llama_tiny(
+                hidden_size=256, num_attention_heads=4,
+                max_position_embeddings=512, dtype=dtype,
+                use_flash_attention=False))
+            lf, _ = mf(ids, kv_caches=mf.init_kv_caches(2, 384),
+                       cache_index=0)
+            ld, _ = md(ids, kv_caches=md.init_kv_caches(2, 384),
+                       cache_index=0)
+            return (np.asarray(lf, np.float32),
+                    np.asarray(ld, np.float32))
+
+        lf32, ld32 = logits_pair(jnp.float32)
+        err32 = np.max(np.abs(lf32 - ld32))
+        assert err32 < 1e-4, \
+            f"fp32 flash diverged ({err32}): REAL kernel bug, not noise"
+        lf16, ld16 = logits_pair(jnp.bfloat16)
+        err16 = np.max(np.abs(lf16 - ld16))
+        rel16 = err16 / max(np.max(np.abs(ld16)), 1e-6)
+        # the r05 absolute-5e-2 gate tripped exactly here; the relative
+        # gate (tpu_validate.py uses 2.5e-2) must hold
+        assert rel16 < 2.5e-2, (err16, rel16)

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Tier-budget marker audit (ISSUE 6 satellite; sibling of
+``fault_sites.py --check``).
+
+The tier-1 verify runs ``pytest -m 'not slow'`` against a hard 870s
+wall clock that currently has only ~duration-of-one-sweep headroom, so
+a single dropped ``@pytest.mark.slow`` on a bench or sweep test can
+blow the whole budget. ``--check`` collects the suite twice with
+``pytest --collect-only`` (once ``-m slow``, once ``-m 'not slow'``)
+and fails if:
+
+- any MUST_BE_SLOW pattern (wall-clock benches, sweep-style parity
+  matrices, multi-subprocess e2e) matches a test in the tier-1
+  collection, or
+- a pattern matches nothing at all (stale policy entry — the test was
+  renamed or deleted and the guard is no longer guarding anything).
+
+Run without flags for the marker census only.
+"""
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Patterns (regex, matched against pytest node ids) that must stay OUT
+# of the tier-1 run. Keep in sync with tests/conftest.py's _SLOW list
+# and per-test @pytest.mark.slow decorations.
+MUST_BE_SLOW = (
+    # ISSUE 6: wall-clock micro-bench + sweep matrices + the 14s
+    # full-batch interpret parity (each keeps a tier-1 representative)
+    r"test_fused_tick\.py.*microbench",
+    r"test_fused_tick\.py.*parity_sweep",
+    r"test_fused_tick\.py.*full_batch",
+    # PR 2: multi-subprocess preemption/elastic e2e (conftest _SLOW)
+    r"test_kill_mid_run_then_resume_continues_trajectory",
+    r"test_hang_checkpoints_exits_and_supervisor_finishes",
+    r"test_nan_window_rolls_back_and_converges",
+)
+
+
+def _collect(marker_expr):
+    cmd = [sys.executable, "-m", "pytest", "tests/", "--collect-only",
+           "-q", "-m", marker_expr, "-p", "no:cacheprovider",
+           "--continue-on-collection-errors"]
+    out = subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True,
+                         timeout=300,
+                         env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    nodes = [ln.strip() for ln in out.stdout.splitlines()
+             if "::" in ln and not ln.startswith(("=", "<", " "))]
+    return nodes
+
+
+def check() -> int:
+    slow = _collect("slow")
+    tier1 = _collect("not slow")
+    bad, stale = [], []
+    for pat in MUST_BE_SLOW:
+        rx = re.compile(pat)
+        leaked = [n for n in tier1 if rx.search(n)]
+        if leaked:
+            bad.extend(f"{pat}: IN TIER-1 -> {n}" for n in leaked[:3])
+        elif not any(rx.search(n) for n in slow):
+            stale.append(pat)
+    census = (f"tier-1 {len(tier1)} tests, slow {len(slow)} "
+              f"(cap 870s; see ROADMAP 'Tier-1 verify')")
+    if bad or stale:
+        print("marker audit FAILED:", file=sys.stderr)
+        for line in bad:
+            print(f"  budget leak  {line}", file=sys.stderr)
+        for pat in stale:
+            print(f"  stale policy {pat}: matches no collected test",
+                  file=sys.stderr)
+        print(census, file=sys.stderr)
+        return 1
+    print(f"marker audit OK: {census}; "
+          f"{len(MUST_BE_SLOW)} slow-policy patterns enforced")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(check())

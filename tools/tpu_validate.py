@@ -121,6 +121,32 @@ def paged_kernel():
     assert err < 3e-2, err
 check("paged_attention_kernel", paged_kernel)
 
+def ragged_paged_kernel():
+    # ISSUE 6: the schedule-driven ragged kernel (the serving default)
+    # must compile and match the dense gather on hardware, same ragged
+    # rows as the grid kernel check above
+    from paddle_tpu.ops.pallas.ragged_paged_attention import \
+        ragged_paged_attention_pallas
+    from paddle_tpu.ops.attention import dense_attention as da
+    R, P, B, M, kvh2, h2, d2 = 4, 64, 16, 16, 4, 8, 128
+    qq = jnp.asarray(rs.randn(R, h2, d2), jnp.bfloat16)
+    kp = jnp.asarray(rs.randn(P, B, kvh2, d2), jnp.bfloat16)
+    vp = jnp.asarray(rs.randn(P, B, kvh2, d2), jnp.bfloat16)
+    tables = jnp.asarray(rs.permutation(np.arange(P))[:R * M]
+                         .reshape(R, M), jnp.int32)
+    lens = jnp.asarray([0, 31, 100, 255], jnp.int32)
+    out = ragged_paged_attention_pallas(qq, kp, vp, tables, lens,
+                                        d2 ** -0.5)
+    ks = kp[tables].reshape(R, -1, kvh2, d2)
+    vs = vp[tables].reshape(R, -1, kvh2, d2)
+    kpos = jnp.arange(ks.shape[1])[None, :]
+    ref = da(qq[:, None], ks, vs,
+             attn_mask=(kpos <= lens[:, None])[:, None, None, :])[:, 0]
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    assert err < 3e-2, err
+check("ragged_paged_attention_kernel", ragged_paged_kernel)
+
 def prefill_flash():
     # the generate() prefill branch: flash at cache_index==0 must match
     # the masked-dense-over-cache path it replaced (llama.py)
