@@ -549,6 +549,12 @@ def main():
                     result["decode"].setdefault(
                         "paged_tokens_per_sec",
                         paged["paged_tokens_per_sec"])
+                    # ISSUE 7: the speculative-tick rung rides along
+                    # whenever the profiler's spec section completed
+                    if "paged_spec_tokens_per_sec" in paged:
+                        result["decode"].setdefault(
+                            "paged_spec_tokens_per_sec",
+                            paged["paged_spec_tokens_per_sec"])
         except (OSError, ValueError):
             pass
 

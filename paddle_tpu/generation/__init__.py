@@ -374,10 +374,13 @@ def beam_search(model, input_ids, config: GenerationConfig, params=None):
 
 from .pipeline import TextGenerationPipeline  # noqa: E402
 from .paged import PagedEngine, PagedKV  # noqa: E402
+from .prompt_lookup import (propose_ngram,  # noqa: E402
+                            propose_ngram_rows)
 from .speculative import (speculative_generate,  # noqa: E402
                           mtp_speculative_generate,
                           ngram_speculative_generate)
 
 __all__ += ["TextGenerationPipeline", "speculative_generate",
             "mtp_speculative_generate", "ngram_speculative_generate",
-            "PagedEngine", "PagedKV"]
+            "PagedEngine", "PagedKV", "propose_ngram",
+            "propose_ngram_rows"]

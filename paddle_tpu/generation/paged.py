@@ -31,6 +31,16 @@ TPU-native design — everything the XLA program sees is STATIC:
   ``jnp.asarray`` uploads and Python stop/eos bookkeeping that left
   the r05 bench at 49 tok/s. ``fused_tick=False`` restores the
   per-tick host path (the bit-exactness reference).
+- ``spec_tokens=k`` (ISSUE 7) turns each fused tick into a speculative
+  MULTI-token tick: a device-resident prompt-lookup proposer (shared
+  with ``ngram_speculative_generate``) drafts up to k tokens per slot
+  from that request's own committed stream, one forward verifies all
+  k+1 positions through the multi-query paged attention, and the
+  accepted length commits in-program — still one dispatch and one
+  small D2H per tick, with eos/stop/budget honored inside the accepted
+  window. Per-request adaptive k (device-resident accept-rate EMA) and
+  per-row headroom checks fall individual rows back to the 1-token
+  tick without leaving the program.
 
 Padded prompt positions scatter into a reserved GARBAGE block (physical
 block 0) so they can never corrupt a live block; it is never allocated.
@@ -55,6 +65,17 @@ __all__ = ["PagedKV", "PagedEngine"]
 # keep their per-instance semantics
 _engine_ids = itertools.count()
 
+# --- adaptive-k policy for the fused speculative tick (ISSUE 7). Per
+# request, an EMA of the accepted-draft fraction decides how hard to
+# speculate; it lives ON DEVICE (advanced inside the tick program) with
+# a host mirror carried on the request, so adapting k costs zero
+# steady-state uploads. Below the floor a row falls back to the 1-token
+# tick, re-probing with a single draft every PROBE-th active tick so a
+# stream that turns repetitive mid-request can recover.
+_SPEC_EMA_ALPHA = 0.3      # EMA step toward this tick's accept fraction
+_SPEC_EMA_FLOOR = 0.25     # below: stop drafting (probes only)
+_SPEC_PROBE_EVERY = 16     # collapsed rows re-probe with k=1 this often
+
 
 class PagedKV(NamedTuple):
     """Per-layer paged cache view handed to the attention modules.
@@ -75,14 +96,33 @@ class PagedKV(NamedTuple):
 
 
 def paged_decode_write(pk: PagedKV, k, v):
-    """Scatter each row's single new K/V (k [R, 1, kvh, d]) into its
-    current block at (seq_len // B, seq_len % B)."""
+    """Scatter each row's new K/V (k [R, T, kvh, d]) into its blocks at
+    positions seq_len .. seq_len+T-1. T == 1 is the plain decode tick;
+    T > 1 is the speculative verify (ISSUE 7) writing the probe token
+    plus T-1 drafts in one scatter. Positions past a row's ALLOCATED
+    blocks divert to the garbage block automatically (unallocated table
+    entries are 0 — the garbage block id — and logical blocks past M
+    are clamped there explicitly), so a row without speculative
+    headroom can ride the multi-token program unharmed: its surplus
+    writes are garbage-block noise the attention mask never reads."""
     B = pk.block_size
-    r = jnp.arange(k.shape[0])
-    bidx = pk.block_tables[r, pk.seq_lens // B]          # [R]
-    boff = pk.seq_lens % B
-    kp = pk.kp.at[bidx, boff].set(k[:, 0].astype(pk.kp.dtype))
-    vp = pk.vp.at[bidx, boff].set(v[:, 0].astype(pk.vp.dtype))
+    R, T = k.shape[0], k.shape[1]
+    if T == 1:
+        r = jnp.arange(R)
+        bidx = pk.block_tables[r, pk.seq_lens // B]      # [R]
+        boff = pk.seq_lens % B
+        kp = pk.kp.at[bidx, boff].set(k[:, 0].astype(pk.kp.dtype))
+        vp = pk.vp.at[bidx, boff].set(v[:, 0].astype(pk.vp.dtype))
+        return pk._replace(kp=kp, vp=vp)
+    M = pk.block_tables.shape[1]
+    r = jnp.arange(R)[:, None]                           # [R, 1]
+    pos = pk.seq_lens[:, None] + jnp.arange(T)[None, :]  # [R, T]
+    lb = pos // B
+    bidx = jnp.where(lb < M,
+                     pk.block_tables[r, jnp.clip(lb, 0, M - 1)], 0)
+    boff = pos % B
+    kp = pk.kp.at[bidx, boff].set(k.astype(pk.kp.dtype))
+    vp = pk.vp.at[bidx, boff].set(v.astype(pk.vp.dtype))
     return pk._replace(kp=kp, vp=vp)
 
 
@@ -127,16 +167,21 @@ def paged_chunk_attention(q, pk: PagedKV, positions,
 
 def paged_decode_attention(q, pk: PagedKV, scale: Optional[float] = None,
                            window: Optional[int] = None):
-    """q [R, 1, h, d] against each row's blocks, masked to the row's
-    length (inclusive of the token written this step).
+    """q [R, T, h, d] against each row's blocks: query t of row r sits
+    at position seq_lens[r] + t and attends tokens 0..seq_lens[r]+t
+    (inclusive of the tokens written this step). T == 1 is the plain
+    decode tick; T > 1 is the speculative verify's multi-query rows
+    (ISSUE 7) — per-position causal masking inside the row.
 
     Fast path (default "ragged"): the schedule-driven ragged kernel —
     one grid over the batch's ACTUAL live blocks, packed live-first, no
-    per-request padding (ISSUE 6). ``PADDLE_TPU_PAGED_ATTN=grid`` keeps
-    the r05-hardware-validated grid-per-row kernel; ``=dense`` forces
-    the fallback. Fallback (CPU tests / odd shapes): dense whole-table
-    gather — the math is dense_attention's, only the gather and per-row
-    length mask live here."""
+    per-request padding (ISSUE 6); it serves both T == 1 and the
+    multi-query rows. ``PADDLE_TPU_PAGED_ATTN=grid`` keeps the
+    r05-hardware-validated grid-per-row kernel (single-query only —
+    multi-query falls through to dense under it); ``=dense`` forces the
+    fallback. Fallback (CPU tests / odd shapes): dense whole-table
+    gather — the math is dense_attention's, only the gather and the
+    per-(row, position) mask live here."""
     import os
 
     from ..ops.attention import dense_attention
@@ -144,26 +189,33 @@ def paged_decode_attention(q, pk: PagedKV, scale: Optional[float] = None,
                                               use_paged_kernel)
     from ..ops.pallas.ragged_paged_attention import \
         ragged_paged_attention_pallas
-    R = q.shape[0]
+    R, T = q.shape[0], q.shape[1]
     kvh, d = pk.kp.shape[2], pk.kp.shape[3]
     mode = os.environ.get("PADDLE_TPU_PAGED_ATTN", "ragged")
     if mode != "dense" and use_paged_kernel(q, pk.kp):
         sc = scale if scale is not None else d ** -0.5
-        fn = (paged_attention_pallas if mode == "grid"
-              else ragged_paged_attention_pallas)
-        out = fn(q[:, 0], pk.kp, pk.vp, pk.block_tables, pk.seq_lens,
-                 sc, window=window)
-        return out[:, None]
+        if T == 1:
+            fn = (paged_attention_pallas if mode == "grid"
+                  else ragged_paged_attention_pallas)
+            out = fn(q[:, 0], pk.kp, pk.vp, pk.block_tables,
+                     pk.seq_lens, sc, window=window)
+            return out[:, None]
+        if mode != "grid":
+            return ragged_paged_attention_pallas(
+                q, pk.kp, pk.vp, pk.block_tables, pk.seq_lens, sc,
+                window=window)
     ks = pk.kp[pk.block_tables]                  # [R, M, B, kvh, d]
     vs = pk.vp[pk.block_tables]
-    T = ks.shape[1] * ks.shape[2]
-    ks = ks.reshape(R, T, kvh, d)
-    vs = vs.reshape(R, T, kvh, d)
-    kpos = jnp.arange(T)[None, :]
-    keep = kpos <= pk.seq_lens[:, None]
+    Tk = ks.shape[1] * ks.shape[2]
+    ks = ks.reshape(R, Tk, kvh, d)
+    vs = vs.reshape(R, Tk, kvh, d)
+    kpos = jnp.arange(Tk)[None, None, :]                  # [1, 1, Tk]
+    qpos = pk.seq_lens[:, None, None] + \
+        jnp.arange(T)[None, :, None]                      # [R, T, 1]
+    keep = kpos <= qpos                                   # [R, T, Tk]
     if window is not None:
-        keep &= kpos > pk.seq_lens[:, None] - window
-    return dense_attention(q, ks, vs, attn_mask=keep[:, None, None, :],
+        keep &= kpos > qpos - window
+    return dense_attention(q, ks, vs, attn_mask=keep[:, None],
                            scale=scale)
 
 
@@ -178,7 +230,7 @@ class _Request:
                  "blocks", "prefix", "prefix_lps", "admit_seq",
                  "temperature", "top_k", "top_p", "key", "lps",
                  "prefill_pos", "stop", "trim", "rep", "deadline",
-                 "t_submit")
+                 "t_submit", "spec_ema")
 
     def __init__(self, request_id, prompt, max_new, eos, temperature,
                  top_k, top_p, key, prefix=None, prefix_lps=None,
@@ -203,6 +255,10 @@ class _Request:
         self.blocks: List[int] = []
         self.prefill_pos = 0            # prompt tokens already cached
         self.t_submit = time.monotonic()   # queue-wait histogram anchor
+        # accept-rate EMA for the speculative tick's adaptive k (host
+        # mirror of the device copy; optimistic start so new requests
+        # draft immediately). Carried across preemptions.
+        self.spec_ema = 1.0
 
 
 class PagedEngine:
@@ -224,7 +280,9 @@ class PagedEngine:
                  max_queue: Optional[int] = None,
                  default_timeout_s: Optional[float] = None,
                  fused_tick: bool = True,
-                 ticks_per_dispatch: int = 1):
+                 ticks_per_dispatch: int = 1,
+                 spec_tokens: int = 0,
+                 spec_ngram: int = 2):
         cfg = model.config
         self.model = model
         self.fn, self.params = model.functional()
@@ -298,17 +356,23 @@ class PagedEngine:
         # a fresh engine starts every counter at 0.
         self._obs_labels = {"engine": f"paged{next(_engine_ids)}"}
         reg = obs.registry()
+        # spec_proposed/spec_accepted (ISSUE 7): drafted vs accepted
+        # draft tokens — `health()` derives the accept rate from the
+        # SAME registry objects a /metrics scrape exports
         self._counters = {
             k: reg.counter(f"paged_{k}_total", **self._obs_labels)
             for k in ("decode_steps", "prefills", "preemptions",
                       "prefill_chunks", "slot_steps",
                       "active_slot_steps", "prefix_hit_tokens",
                       "prefix_adopted_blocks", "timeouts",
-                      "cancellations", "rejected")}
+                      "cancellations", "rejected",
+                      "spec_proposed", "spec_accepted")}
         self._h_decode = reg.histogram("paged_decode_step_ms",
                                        **self._obs_labels)
         self._h_wait = reg.histogram("paged_queue_wait_ms",
                                      **self._obs_labels)
+        self._h_tpf = reg.histogram("paged_tokens_per_forward",
+                                    **self._obs_labels)
         # pools (and the seen masks) are donated: XLA aliases input to
         # output so a decode step costs one scatter, not a full copy
         self._decode_jit = jax.jit(self._decode_step,
@@ -363,6 +427,38 @@ class PagedEngine:
             self._scan_jit = jax.jit(
                 functools.partial(self._fused_scan, greedy=False,
                                   K=self._ticks_per_dispatch),
+                donate_argnums=(1, 2))
+        # --- prompt-lookup speculative ticks (ISSUE 7 tentpole) -------
+        # spec_tokens=k > 0: every fused tick drafts up to k tokens per
+        # eligible slot from that request's OWN committed stream (no
+        # draft model — the n-gram proposer shared with the batch
+        # path's ngram_speculative_generate), verifies all k+1
+        # positions in ONE forward through the multi-query paged
+        # attention, and commits the per-row accepted length in-program
+        # — still one dispatch and one small D2H per tick. Rows fall
+        # back to the 1-token tick per-request (inside the same
+        # program) when greedy-ineligible (sampled / penalized), when
+        # block headroom is missing, or when their accept-rate EMA
+        # collapses. Takes precedence over ticks_per_dispatch scanning:
+        # a spec tick is already a multi-token dispatch.
+        self._spec_k = int(spec_tokens)
+        self._spec_ngram = int(spec_ngram)
+        if self._spec_k:
+            if self._spec_k < 1:
+                raise ValueError("spec_tokens must be >= 0")
+            if self._spec_ngram < 1:
+                raise ValueError("spec_ngram must be >= 1")
+            if not self._fused:
+                raise ValueError(
+                    "spec_tokens requires fused_tick=True: the "
+                    "proposer/verify/commit live inside the fused "
+                    "device program")
+            import functools
+            self._tick_spec_jit = jax.jit(
+                functools.partial(self._fused_tick_spec, greedy=False),
+                donate_argnums=(1, 2))
+            self._tick_spec_greedy_jit = jax.jit(
+                functools.partial(self._fused_tick_spec, greedy=True),
                 donate_argnums=(1, 2))
 
     @property
@@ -497,6 +593,116 @@ class PagedEngine:
             body, (pools, seen, st), None, length=K)
         return nxt, lps, done, seen, pools, st
 
+    def _fused_tick_spec(self, params, pools, seen, st, *, greedy: bool):
+        """ONE compiled program for a speculative multi-token tick
+        (ISSUE 7): per-row prompt-lookup drafts -> one k+1-position
+        verify forward through the multi-query paged attention -> the
+        shared longest-matched-prefix accept -> in-program commit of
+        the per-row accepted length (seq lens, committed-stream buffer,
+        budgets, done flags, adaptive-k EMA all advance on device).
+
+        Per-row fallback, not per-batch: a row drafts 0..k tokens
+        (``kprop``) depending on greedy eligibility, its write headroom
+        (allocated blocks, read off the table — unallocated entries are
+        the garbage block id 0), its remaining budget, and its accept
+        EMA; kprop=0 rows ARE the plain 1-token tick inside the same
+        program, so mixed spec/non-spec batches stay one dispatch.
+
+        Exactness: position 0 reproduces the plain tick bit-for-bit
+        (same penalty + sampler/argmax on the same logits; mixed ticks
+        split every row's key once, exactly like `_fused_tick`). Drafts
+        only ever land when they EQUAL the verify argmax, and spec
+        eligibility requires repetition_penalty == 1.0 — the penalty is
+        a per-row no-op then, so the vectorized verify needs no
+        in-window seen evolution. Rejected drafts' K/V and buffer
+        writes sit beyond the committed cursor and are overwritten
+        before they become readable (the batch path's rewind-free
+        trick)."""
+        from .prompt_lookup import accept_length, propose_ngram_rows
+        from .sampling import repetition_penalty_rows, sample_token_rows
+        k = self._spec_k
+        T = k + 1
+        lens, active, temps = st["lens"], st["active"], st["temps"]
+        rem, tables = st["rem"], st["tables"]
+        C = lens + 1                  # committed tokens (active rows)
+        # per-row draft cap: adaptive want ∧ write headroom ∧ budget
+        alloc = jnp.sum(tables > 0, axis=1).astype(jnp.int32)
+        capw = alloc * self.B - lens          # writable slots from lens
+        probe = (st["tickc"] % _SPEC_PROBE_EVERY) == 0
+        want = jnp.where(st["ema"] >= _SPEC_EMA_FLOOR, k,
+                         jnp.where(probe, 1, 0))
+        eligible = active & (temps <= 0.0) & (st["reps"] == 1.0)
+        kprop = jnp.where(
+            eligible,
+            jnp.clip(jnp.minimum(jnp.minimum(want, capw - 1), rem - 1),
+                     0, k), 0)
+        drafts = propose_ngram_rows(st["toks"], C, k, self._spec_ngram,
+                                    fill=-1)
+        drafts = jnp.where(jnp.arange(k)[None, :] < kprop[:, None],
+                           drafts, -1)        # -1 never matches/commits
+        ids = jnp.concatenate([st["last"][:, None],
+                               jnp.maximum(drafts, 0)], axis=1)
+        positions = lens[:, None] + jnp.arange(T)[None, :]
+        caches = self._paged_caches(pools, tables, lens)
+        logits, new_caches = self.fn(params, ids, kv_caches=caches,
+                                     positions=positions,
+                                     paged_decode=True)
+        logits = logits.astype(jnp.float32)
+        # position 0 == the plain tick, bit-for-bit (penalty + sampler)
+        raw0 = repetition_penalty_rows(logits[:, 0], seen, st["reps"])
+        if greedy:
+            g0 = jnp.argmax(raw0, axis=-1).astype(jnp.int32)
+            lp0 = jnp.take_along_axis(jax.nn.log_softmax(raw0, axis=-1),
+                                      g0[:, None], axis=-1)[:, 0]
+            new_keys = st["keys"]
+        else:
+            g0, lp0, new_keys = sample_token_rows(raw0, st["keys"],
+                                                  temps, st["tks"],
+                                                  st["tps"])
+        # verify positions 1..k: pure argmax (spec rows are penalty-free)
+        g_rest = jnp.argmax(logits[:, 1:], axis=-1).astype(jnp.int32)
+        lp_rest = jnp.take_along_axis(
+            jax.nn.log_softmax(logits[:, 1:], axis=-1),
+            g_rest[..., None], axis=-1)[..., 0]
+        G = jnp.concatenate([g0[:, None], g_rest], axis=1)    # [R, T]
+        LP = jnp.concatenate([lp0[:, None], lp_rest], axis=1)
+        # accept: longest draft==target prefix + the correction/bonus,
+        # truncated by budget and a window-interior eos
+        m = accept_length(drafts, G)
+        n_acc = jnp.minimum(m + 1, jnp.maximum(rem, 1))
+        is_eos = (st["eos"][:, None] >= 0) & (G == st["eos"][:, None])
+        hit = is_eos & (jnp.arange(T)[None, :] < n_acc[:, None])
+        eos_hit = jnp.any(hit, axis=1)
+        n_acc = jnp.where(eos_hit, jnp.argmax(hit, axis=1) + 1, n_acc)
+        n_eff = jnp.where(active, n_acc, 0)
+        done = active & (eos_hit | (rem - n_eff <= 0))
+        # commit: seen mask (emitted tokens only), committed-stream
+        # buffer (all T candidates — positions past n_acc sit beyond
+        # the committed cursor, never matched, overwritten next tick),
+        # cursor/budget/last/EMA advance
+        r_idx = jnp.arange(self.R)
+        acc_win = jnp.arange(T)[None, :] < n_eff[:, None]
+        seen = seen.at[r_idx[:, None], G].max(acc_win)
+        toks = st["toks"].at[r_idx[:, None],
+                             C[:, None] + jnp.arange(T)[None, :]].set(G)
+        last = jnp.where(
+            active,
+            jnp.take_along_axis(G, (n_acc - 1)[:, None], axis=1)[:, 0],
+            st["last"])
+        ema = jnp.where(
+            kprop > 0,
+            (1.0 - _SPEC_EMA_ALPHA) * st["ema"] + _SPEC_EMA_ALPHA
+            * (m.astype(jnp.float32)
+               / jnp.maximum(kprop.astype(jnp.float32), 1.0)),
+            st["ema"])
+        new_st = dict(st)
+        new_st.update(lens=lens + n_eff, last=last, keys=new_keys,
+                      rem=rem - n_eff, active=active & ~done,
+                      toks=toks, ema=ema,
+                      tickc=st["tickc"] + active.astype(jnp.int32))
+        return (G, LP, n_eff, kprop, m, done, seen,
+                [(c.kp, c.vp) for c in new_caches], new_st)
+
     def _sync_keys_from_dev(self):
         """Fold the device PRNG keys back into the host mirror. Rows the
         host re-keyed since the last upload (`_key_overrides`: fresh
@@ -545,6 +751,22 @@ class PagedEngine:
             rem=jnp.asarray(rem),
             active=jnp.asarray(act),
         )
+        if self._spec_k:
+            # committed-stream buffer the n-gram proposer matches over
+            # (prompt + emitted tokens per slot; the +k+1 tail slack
+            # absorbs the tick's unconditional candidate writes), plus
+            # the per-request accept EMA and the probe tick counter
+            Lbuf = self.M * self.B + self._spec_k + 1
+            tk = np.zeros((self.R, Lbuf), np.int32)
+            ema = np.ones((self.R,), np.float32)
+            for i, s in enumerate(self.slots):
+                if s is None:
+                    continue
+                seq = s.prompt + s.tokens
+                tk[i, :len(seq)] = seq
+                ema[i] = s.spec_ema
+            self._dev.update(toks=jnp.asarray(tk), ema=jnp.asarray(ema),
+                             tickc=jnp.zeros((self.R,), jnp.int32))
         self._dev_dirty = False
 
     def _prefill(self, params, pools, table_row, ids, length, key,
@@ -913,12 +1135,19 @@ class PagedEngine:
                     or (req.eos is not None and first == req.eos):
                 self._finish(slot_id)
 
-    def _ensure_block(self, slot_id: int) -> bool:
-        """The next decode writes at seq_lens[slot_id]; allocate the
-        covering block if the row hasn't got it yet."""
+    def _grow_blocks(self, slot_id: int, need: int,
+                     reserve: int = 0) -> bool:
+        """Grow a slot's table to ``need`` blocks from the allocator
+        (one shared implementation for decode growth, scan and spec
+        headroom). ``reserve`` refuses to dip the allocatable pool
+        (free + parked) at or below that count — speculative callers
+        use it so their grabs can never starve `_ensure_block`.
+        Returns False when the pool cannot serve."""
         slot = self.slots[slot_id]
-        need = self._blocks_needed(int(self.seq_lens[slot_id]) + 1)
         while len(slot.blocks) < need:
+            if reserve and len(self.free_blocks) + \
+                    len(self.cached_free) <= reserve:
+                return False
             b = self._alloc_block()
             if b is None:
                 return False
@@ -926,6 +1155,12 @@ class PagedEngine:
             self.block_tables[slot_id, len(slot.blocks) - 1] = b
             self._dev_dirty = True   # table row grew: re-upload mirrors
         return True
+
+    def _ensure_block(self, slot_id: int) -> bool:
+        """The next decode writes at seq_lens[slot_id]; allocate the
+        covering block if the row hasn't got it yet."""
+        need = self._blocks_needed(int(self.seq_lens[slot_id]) + 1)
+        return self._grow_blocks(slot_id, need)
 
     @staticmethod
     def _stop_hit(req) -> bool:
@@ -1004,6 +1239,7 @@ class PagedEngine:
                             prefix=s.prefix + s.tokens,
                             prefix_lps=s.prefix_lps + s.lps,
                             stop=s.stop, rep=s.rep, deadline=s.deadline)
+        requeued.spec_ema = s.spec_ema   # adaptive k survives preemption
         self.queue.insert(0, requeued)
         self._release(victim)
         self._count("preemptions")
@@ -1057,6 +1293,9 @@ class PagedEngine:
         """Stats snapshot for load balancers / probes: scheduler
         counters plus live occupancy (slots, blocks, queue depth)."""
         snap = dict(self.stats)
+        prop = snap.get("spec_proposed", 0)
+        snap["spec_accept_rate"] = round(
+            snap.get("spec_accepted", 0) / prop, 4) if prop else 0.0
         snap.update(
             queued=len(self.queue),
             queue_capacity=self.max_queue,
@@ -1111,6 +1350,11 @@ class PagedEngine:
         if not active:
             return
         if self._fused:
+            if self._spec_k:
+                # speculative ticks ARE multi-token dispatches: they
+                # replace the scan fusion (see __init__)
+                self._spec_headroom(active)
+                return self._decode_fused_spec(active)
             scan = self._ticks_per_dispatch > 1 \
                 and self._scan_ticks(active)
             return self._decode_fused(active, scan=scan)
@@ -1218,6 +1462,86 @@ class PagedEngine:
                     break
         return True
 
+    def _spec_headroom(self, active):
+        """Best-effort block preallocation so spec-eligible rows can
+        write k+1 tokens this tick. Never preempts and keeps a
+        one-block-per-active-row reserve — a row that cannot get
+        headroom simply drafts less (or nothing): the device caps its
+        kprop by the write capacity read off the block table, which IS
+        the clean per-row 1-token fallback. Collapsed-EMA rows only
+        reserve probe headroom (one draft) instead of k."""
+        for i in active:
+            s = self.slots[i]
+            if self.temps[i] > 0.0 or s.rep != 1.0:
+                continue
+            if s.max_new - len(s.tokens) < 2:
+                continue
+            k_want = self._spec_k if s.spec_ema >= _SPEC_EMA_FLOOR else 1
+            # a table holds at most M blocks: near the capacity edge the
+            # device write-capacity clamp shrinks kprop instead
+            need = min(
+                self._blocks_needed(int(self.seq_lens[i]) + k_want + 1),
+                self.M)
+            if not self._grow_blocks(i, need, reserve=len(active)):
+                return
+
+    def _decode_fused_spec(self, active):
+        """The speculative fused tick's host half: ONE dispatch, one
+        small D2H of (candidates [R, k+1], logprobs, accepted length,
+        proposed/accepted counts, done), then per-row bookkeeping over
+        each row's ACCEPTED window — appending tokens, checking stop
+        sequences inside the window (a stop mid-window finishes the
+        request; the tokens the device committed past it die with the
+        slot's release), and honoring the device done flag. Mirrors
+        re-upload only on slot transitions, exactly like the plain
+        fused tick."""
+        if self._dev is None or self._dev_dirty:
+            self._refresh_dev()
+        t_decode = time.perf_counter()
+        self.dispatch_count += 1
+        greedy = np.all(self.temps[active] <= 0.0)
+        fn = self._tick_spec_greedy_jit if greedy else self._tick_spec_jit
+        (nxt, lps, nacc, kprop, macc, done, self.seen, self.pools,
+         self._dev) = fn(self.params, self.pools, self.seen, self._dev)
+        if not greedy:
+            self._dev_keys_dirty = True
+        nxt, lps, nacc, kprop, macc, done = jax.device_get(
+            (nxt, lps, nacc, kprop, macc, done))
+        self._h_decode.observe((time.perf_counter() - t_decode) * 1e3)
+        self._count("decode_steps")
+        self._count("slot_steps", self.R)
+        prop = int(kprop[active].sum())
+        if prop:
+            self._count("spec_proposed", prop)
+            acc = int(macc[active].sum())
+            if acc:
+                self._count("spec_accepted", acc)
+        for i in active:
+            slot = self.slots[i]
+            n = int(nacc[i])
+            self._h_tpf.observe(n)
+            if kprop[i]:
+                # host mirror of the device EMA (same update; the
+                # authority switch happens at the next refresh upload)
+                slot.spec_ema = ((1.0 - _SPEC_EMA_ALPHA) * slot.spec_ema
+                                 + _SPEC_EMA_ALPHA
+                                 * (float(macc[i]) / float(kprop[i])))
+            finished = False
+            for j in range(n):
+                self._count("active_slot_steps")
+                self.seq_lens[i] += 1   # device advanced its copy too
+                slot.tokens.append(int(nxt[i, j]))
+                slot.lps.append(float(lps[i, j]))
+                # stop check FIRST: a stop completing on the final
+                # budgeted (or eos) token must still record its trim
+                if self._stop_hit(slot):
+                    self._finish(i)
+                    finished = True
+                    break
+            if not finished and bool(done[i]):
+                self._finish(i)
+        return True
+
     def _scan_ticks(self, active) -> bool:
         """True when the next ``ticks_per_dispatch`` ticks may run inside
         one compiled program with NO observable difference from K
@@ -1262,11 +1586,7 @@ class PagedEngine:
         if fresh > len(self.free_blocks) + len(self.cached_free):
             return False              # pressure: single-tick handles it
         for i, need in needs:
-            s = self.slots[i]
-            while len(s.blocks) < need:
-                s.blocks.append(self._alloc_block())
-                self.block_tables[i, len(s.blocks) - 1] = s.blocks[-1]
-                self._dev_dirty = True
+            self._grow_blocks(i, need)   # pre-checked: cannot fail
         return True
 
     def run(self) -> Dict[Any, List[int]]:

@@ -1,0 +1,66 @@
+"""Prompt-lookup drafting shared by the batch and paged speculative
+paths (ISSUE 7; reference: Saxena's prompt-lookup decoding, PaddleNLP
+"inference with reference" speculate_method).
+
+One jit-able proposer, two consumers:
+
+- ``ngram_speculative_generate`` (generation/speculative.py) calls
+  :func:`propose_ngram` on its single-row token buffer inside the
+  decode while_loop;
+- the PagedEngine's fused speculative tick (generation/paged.py) calls
+  :func:`propose_ngram_rows` on its device-resident [R, L] committed-
+  stream buffer — one vmap, all rows drafted in the same compiled tick
+  program.
+
+The proposer is DRAFT-ONLY: it reads committed positions (< ``n``) for
+the n-gram MATCH, and the copied continuation may run into stale tail
+positions — harmless, the verify forward guards every proposal. The
+accept step is :func:`accept_length`, the longest-matched-prefix count
+shared by every speculative strategy (the rest of ``_commit`` — the
+token write-back and eos handling — is buffer-layout-specific and stays
+with its caller).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["propose_ngram", "propose_ngram_rows", "accept_length"]
+
+
+def propose_ngram(seq, n, num_draft: int, ngram: int, fill):
+    """Continuation of the most recent earlier occurrence of the last
+    ``ngram`` committed tokens of ``seq`` [L]; ``fill`` where nothing
+    matches. ``n`` is the committed-token count — only windows strictly
+    inside ``seq[:n]`` can match. All static shapes; jit/vmap-able."""
+    from .sampling import suffix_window_hits
+    L = seq.shape[0]
+    hit = suffix_window_hits(seq, n, ngram)       # strictly-earlier matches
+    any_hit = jnp.any(hit)
+    p = L - 1 - jnp.argmax(jnp.flip(hit))         # most recent
+    src = jnp.where(any_hit, p + ngram, 0)
+    draft = jax.lax.dynamic_slice(seq, (src,), (num_draft,))
+    return jnp.where(any_hit, draft,
+                     jnp.full((num_draft,), fill, seq.dtype))
+
+
+def propose_ngram_rows(seqs, ns, num_draft: int, ngram: int, fill=-1):
+    """Per-row drafts for continuous batching: ``seqs`` [R, L] committed
+    streams, ``ns`` [R] committed counts -> [R, num_draft] drafts. The
+    default ``fill=-1`` can never equal a real token id, so a no-match
+    row's draft is rejected by the verify instead of accidentally
+    accepted (the batch path keeps pad fill for bit-compat with its
+    pinned streams)."""
+    return jax.vmap(
+        lambda s, n: propose_ngram(s, n, num_draft, ngram, fill))(seqs, ns)
+
+
+def accept_length(draft, target):
+    """Longest matched-prefix count between ``draft`` [..., k] and the
+    verify targets ``target`` [..., >=k]: the number of drafted tokens
+    the target would have emitted itself. Works row-batched ([R, k] vs
+    [R, k+1]) and single-row."""
+    k = draft.shape[-1]
+    match = jnp.cumprod(
+        (draft == target[..., :k]).astype(jnp.int32), axis=-1)
+    return jnp.sum(match, axis=-1)

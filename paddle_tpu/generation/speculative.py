@@ -59,9 +59,11 @@ def _commit(tokens, g_tok, draft, n, k, eos, pad, done):
     """The accept step shared by every drafting strategy: commit the
     longest draft==target prefix plus the target's own correction/bonus
     token, handle eos inside the committed span. Returns (tokens,
-    accepted_draft_count, advance, done)."""
-    match = jnp.cumprod((draft == g_tok[:k]).astype(jnp.int32))
-    m = jnp.sum(match)
+    accepted_draft_count, advance, done). The match count itself is
+    prompt_lookup.accept_length — the same helper the PagedEngine's
+    fused speculative tick commits with."""
+    from .prompt_lookup import accept_length
+    m = accept_length(draft, g_tok)
     write = jnp.where(jnp.arange(k + 1) <= m, g_tok,
                       pad).astype(tokens.dtype)
     tokens = jax.lax.dynamic_update_slice(tokens, write[None], (0, n))
@@ -426,19 +428,11 @@ def ngram_speculative_generate(model, input_ids, max_new_tokens: int = 64,
     L = total + k + 1
 
     def propose(tokens, n):
-        """Continuation of the most recent earlier occurrence of the
-        last ``g`` committed tokens; pads when nothing matches. Reads
-        only committed positions (< n) for the MATCH; the copied draft
-        may run into stale tail positions — harmless, verify guards."""
-        from .sampling import suffix_window_hits
-        seq = tokens[0]
-        hit = suffix_window_hits(seq, n, g)   # strictly-earlier matches
-        any_hit = jnp.any(hit)
-        p = L - 1 - jnp.argmax(jnp.flip(hit))               # most recent
-        src = jnp.where(any_hit, p + g, 0)
-        draft = jax.lax.dynamic_slice(seq, (src,), (k,))
-        return jnp.where(any_hit, draft,
-                         jnp.full((k,), pad_token_id, seq.dtype))
+        """The shared prompt-lookup proposer (prompt_lookup.py — the
+        same helper the PagedEngine's fused speculative tick vmaps over
+        its slots), pad-filled when nothing matches."""
+        from .prompt_lookup import propose_ngram
+        return propose_ngram(tokens[0], n, k, g, pad_token_id)
 
     def run(t_params, input_ids):
         t_caches = model.init_kv_caches(1, L)

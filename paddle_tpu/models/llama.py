@@ -258,7 +258,8 @@ class LlamaAttention(Layer):
 
     def forward(self, x, positions, kv_cache: Optional[Tuple] = None,
                 cache_index=None, attn_mask=None, attn_start=None,
-                segment_ids=None, paged_chunk: bool = False):
+                segment_ids=None, paged_chunk: bool = False,
+                paged_decode: bool = False):
         cfg = self.config
         b, s, _ = x.shape
         nh, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
@@ -296,15 +297,17 @@ class LlamaAttention(Layer):
                                             paged_prefill_write)
         if kv_cache is not None and isinstance(kv_cache, PagedKV):
             # paged serving (generation/paged.py): block-table cache.
-            # s == 1: scatter-write this token, attend over the row's
-            # gathered blocks up to its length. s > 1: prefill — write
-            # the prompt's K/V into its blocks; whole-prompt prefill is
-            # plain causal attention over the prompt itself (pad tail
-            # lands in the garbage block and produces discarded rows),
-            # while a CHUNK (paged_chunk=True, positions carry the
-            # global offset) must also attend to the earlier chunks
-            # already in the row's blocks.
-            if s == 1:
+            # s == 1 (or paged_decode=True at any s — the speculative
+            # verify's multi-query rows, ISSUE 7): scatter-write the
+            # tokens at each row's cursor, attend over the row's
+            # gathered blocks with per-position causal masking. Other
+            # s > 1: prefill — write the prompt's K/V into its blocks;
+            # whole-prompt prefill is plain causal attention over the
+            # prompt itself (pad tail lands in the garbage block and
+            # produces discarded rows), while a CHUNK (paged_chunk=
+            # True, positions carry the global offset) must also attend
+            # to the earlier chunks already in the row's blocks.
+            if s == 1 or paged_decode:
                 new_cache = paged_decode_write(kv_cache, k, v)
                 out = paged_decode_attention(q, new_cache,
                                              window=self.window)
@@ -453,12 +456,13 @@ class LlamaDecoderLayer(Layer):
 
     def forward(self, x, positions, kv_cache=None, cache_index=None,
                 attn_mask=None, attn_start=None, segment_ids=None,
-                paged_chunk: bool = False):
+                paged_chunk: bool = False, paged_decode: bool = False):
         attn_out = self.self_attn(self.input_layernorm(x), positions,
                                   kv_cache=kv_cache, cache_index=cache_index,
                                   attn_mask=attn_mask, attn_start=attn_start,
                                   segment_ids=segment_ids,
-                                  paged_chunk=paged_chunk)
+                                  paged_chunk=paged_chunk,
+                                  paged_decode=paged_decode)
         new_cache = None
         if kv_cache is not None:
             attn_out, new_cache = attn_out
@@ -486,7 +490,8 @@ class LlamaModel(Layer):
 
     def forward(self, input_ids, positions=None, kv_caches=None,
                 cache_index=None, attn_mask=None, attn_start=None,
-                segment_ids=None, paged_chunk: bool = False):
+                segment_ids=None, paged_chunk: bool = False,
+                paged_decode: bool = False):
         b, s = input_ids.shape
         if positions is None:
             start = cache_index if cache_index is not None else 0
@@ -510,7 +515,8 @@ class LlamaModel(Layer):
                 out = layer(x, positions, kv_cache=cache_i,
                             cache_index=cache_index, attn_mask=attn_mask,
                             attn_start=attn_start, segment_ids=segment_ids,
-                            paged_chunk=paged_chunk)
+                            paged_chunk=paged_chunk,
+                            paged_decode=paged_decode)
             if kv_caches is not None:
                 x, nc = out
                 new_caches.append(nc)
@@ -544,10 +550,12 @@ class LlamaForCausalLM(CausalLMBase):
 
     def forward(self, input_ids, positions=None, kv_caches=None,
                 cache_index=None, attn_mask=None, attn_start=None,
-                segment_ids=None, paged_chunk: bool = False):
+                segment_ids=None, paged_chunk: bool = False,
+                paged_decode: bool = False):
         out = self.model(input_ids, positions, kv_caches, cache_index,
                          attn_mask, attn_start, segment_ids=segment_ids,
-                         paged_chunk=paged_chunk)
+                         paged_chunk=paged_chunk,
+                         paged_decode=paged_decode)
         caches = None
         if kv_caches is not None:
             out, caches = out

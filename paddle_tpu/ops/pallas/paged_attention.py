@@ -149,11 +149,14 @@ def paged_attention_pallas(q, kp, vp, block_tables, seq_lens, scale,
 def use_paged_kernel(q, kp) -> bool:
     """Same gating policy as the other kernels: TPU backend (or interpret
     mode so CI drives the dispatch glue), MXU-friendly head_dim, whole
-    query-head groups, 8-sublane-aligned block_size."""
+    query-head groups, 8-sublane-aligned block_size. ``s > 1`` (the
+    speculative verify's multi-query rows, ISSUE 7) is gated the same
+    way — only the ragged kernel serves it; the grid-per-row kernel
+    stays single-query (its caller falls back to dense)."""
     from . import interpret_enabled, kernels_enabled
     R, s, h, d = q.shape
     B, kvh = kp.shape[1], kp.shape[2]
-    if s != 1 or h % kvh:
+    if h % kvh:
         return False
     if not kernels_enabled():
         return False

@@ -68,21 +68,26 @@ def schedule_capacity(R: int, M: int, P: int) -> int:
 
 
 def build_schedule(block_tables, seq_lens, S: int, block_size: int,
-                   window=None):
+                   window=None, q_len: int = 1):
     """Flattened live-first schedule. Returns int32 arrays
     (row[S], blk[S], live[S]) where (row, blk) index ``block_tables``
     and live flags steps < total. Dead steps repeat the LAST live step's
     (row, blk) so their block indices never change (copy-free). All jnp
-    — traceable inside the decode tick's jit."""
+    — traceable inside the decode tick's jit.
+
+    ``q_len`` > 1 (ISSUE 7 multi-query verify rows): each row carries
+    q_len query positions seq_len .. seq_len+q_len-1, so live blocks
+    must cover the LAST query's window (lens + q_len attendable tokens)
+    while a sliding window's front clamp follows the FIRST query."""
     R, M = block_tables.shape
     B = block_size
     lens = jnp.asarray(seq_lens, jnp.int32)
-    valid = lens + 1                                  # attendable tokens
+    valid = lens + q_len                              # attendable tokens
     nb = jnp.clip((valid + B - 1) // B, 1, M)         # last live block + 1
     if window is None:
         lo = jnp.zeros((R,), jnp.int32)
     else:
-        lo = jnp.maximum(valid - window, 0) // B      # first in-band block
+        lo = jnp.maximum(lens + 1 - window, 0) // B   # first in-band block
     cnt = nb - lo                                     # >= 1 per row
     cum = jnp.cumsum(cnt)
     total = cum[-1]
@@ -100,7 +105,7 @@ def build_schedule(block_tables, seq_lens, S: int, block_size: int,
 
 def _ragged_kernel(tbl_ref, len_ref, row_ref, blk_ref, live_ref,
                    q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,
-                   scale, bs, S, window):
+                   scale, bs, S, window, group):
     si = pl.program_id(1)
     r = row_ref[si]
     b = blk_ref[si]
@@ -128,9 +133,16 @@ def _ragged_kernel(tbl_ref, len_ref, row_ref, blk_ref, live_ref,
                             preferred_element_type=jnp.float32) * scale
         gp = q.shape[0]
         k_ids = lax.broadcasted_iota(jnp.int32, (gp, bs), 1) + b * bs
-        keep = k_ids < valid
+        # multi-query rows (ISSUE 7): the q tile packs q_len positions x
+        # `group` query heads, so sublane j belongs to verify position
+        # t = j // group and attends causally up to seq_len + t. Single-
+        # query calls have every real sublane at t == 0 — the original
+        # mask; padded sublanes see a wider mask but their rows are
+        # sliced off by the caller.
+        t_of = lax.broadcasted_iota(jnp.int32, (gp, bs), 0) // group
+        keep = k_ids < valid + t_of
         if window is not None:
-            keep &= k_ids >= valid - window
+            keep &= k_ids >= valid + t_of - window
         s = jnp.where(keep, s, NEG_INF)
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -151,23 +163,43 @@ def _ragged_kernel(tbl_ref, len_ref, row_ref, blk_ref, live_ref,
 
 def ragged_paged_attention_pallas(q, kp, vp, block_tables, seq_lens,
                                   scale, window=None):
-    """q [R, h, d]; kp/vp [P, B, kvh, d] physical pools; block_tables
-    [R, M]; seq_lens [R] (position written this step — tokens
-    0..seq_lens[r] attend). Returns [R, h, d]."""
-    R, h, d = q.shape
+    """q [R, h, d] (single-query decode) OR [R, T, h, d] (multi-query
+    speculative verify rows, ISSUE 7: query t of row r sits at position
+    seq_lens[r] + t and attends tokens 0..seq_lens[r]+t); kp/vp
+    [P, B, kvh, d] physical pools; block_tables [R, M]; seq_lens [R].
+    Returns q's shape.
+
+    Multi-query rides the SAME (kvh, S) schedule grid: the q tile packs
+    T positions x `group` heads into the sublane dim (padded to 8), so
+    each KV block is still read once per kv head per row — the verify's
+    extra queries are matmul rows, not extra HBM traffic."""
+    multi = q.ndim == 4
+    if multi:
+        R, T, h, d = q.shape
+    else:
+        R, h, d = q.shape
+        T = 1
     P, B, kvh, _ = kp.shape
     M = block_tables.shape[1]
     group = h // kvh
-    gp = max(8, -(-group // 8) * 8)
+    rows = T * group
+    gp = max(8, -(-rows // 8) * 8)
     S = schedule_capacity(R, M, P)
 
-    qg = q.reshape(R, kvh, group, d)
-    if gp != group:
-        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    if multi:
+        # [R, T, kvh, group, d] -> [R, kvh, T*group, d]: position-major
+        # sublanes so the kernel's t = sublane // group mapping holds
+        qg = q.reshape(R, T, kvh, group, d).transpose(0, 2, 1, 3, 4) \
+             .reshape(R, kvh, rows, d)
+    else:
+        qg = q.reshape(R, kvh, group, d)
+    if gp != rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - rows), (0, 0)))
 
     tbl = jnp.asarray(block_tables, jnp.int32)
     lens = jnp.asarray(seq_lens, jnp.int32)
-    row_s, blk_s, live = build_schedule(tbl, lens, S, B, window=window)
+    row_s, blk_s, live = build_schedule(tbl, lens, S, B, window=window,
+                                        q_len=T)
 
     def q_index(ki, si, tbl, lens, row, blk, live):
         return (row[si], ki, 0, 0)
@@ -178,7 +210,7 @@ def ragged_paged_attention_pallas(q, kp, vp, block_tables, seq_lens,
         return (tbl[row[si], blk[si]], 0, ki)
 
     kernel = functools.partial(_ragged_kernel, scale=scale, bs=B, S=S,
-                               window=window)
+                               window=window, group=group)
     kc = kp.reshape(P, B, kvh * d)
     vc = vp.reshape(P, B, kvh * d)
     out = pl.pallas_call(
@@ -201,4 +233,8 @@ def ragged_paged_attention_pallas(q, kp, vp, block_tables, seq_lens,
         out_shape=jax.ShapeDtypeStruct((R, kvh, gp, d), q.dtype),
         interpret=_interpret(),
     )(tbl, lens, row_s, blk_s, live, qg, kc, vc)
-    return out[:, :, :group, :].reshape(R, h, d)
+    out = out[:, :, :rows, :]
+    if not multi:
+        return out.reshape(R, h, d)
+    return out.reshape(R, kvh, T, group, d).transpose(0, 2, 1, 3, 4) \
+              .reshape(R, T, h, d)

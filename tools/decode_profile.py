@@ -269,6 +269,88 @@ def main():
         paged[t]["tokens_per_sec"] for t in ("fused", "fused_scan8"))
     report["paged"] = paged
     bank()
+
+    # --- 6) speculative paged tick (ISSUE 7): accept-rate sweep + the
+    # paged_spec_tokens_per_sec rung. A zeroed lm_head makes the tiny
+    # model's greedy stream perfectly repetitive (token 0 forever), so
+    # prompt-lookup accepts ~every draft — the BEST case; the same
+    # spec engine on the random-weight model is the collapse case (the
+    # adaptive-k EMA shuts drafting off). Each row carries the same
+    # program-vs-host split as section 5 so multi-token commits'
+    # dispatch amortization is a number.
+    spec = {}
+    try:
+        pt.seed(0)
+        rep_model = LlamaForCausalLM(cfg)
+        rep_model.lm_head.weight = rep_model.lm_head.weight * 0.0
+
+        def run_spec(m, new_tok=48, **kw):
+            eng = PagedEngine(m, max_slots=8, num_blocks=64,
+                              block_size=32, max_blocks_per_seq=8,
+                              prefill_buckets=(32,), **kw)
+            rs4 = np.random.RandomState(3)
+            eng.submit("warm", rs4.randint(1, 255, (1, 8)),
+                       max_new_tokens=2)
+            eng.run()          # compile untimed
+            for i in range(8):
+                eng.submit(i, rs4.randint(1, 255, (1, 8)),
+                           max_new_tokens=new_tok)
+            # every counter is DELTA'd past the warm-up request, like
+            # the _h_decode window — cumulative reads would bias the
+            # short spec runs (~6 dispatches) far more than spec-off
+            st0 = eng.stats
+            _, sum0, cnt0 = eng._h_decode.export()
+            _, tpf_sum0, tpf_cnt0 = eng._h_tpf.export()
+            t0 = time.perf_counter()
+            res = eng.run()
+            dt = time.perf_counter() - t0
+            _, sum1, cnt1 = eng._h_decode.export()
+            _, tpf_sum1, tpf_cnt1 = eng._h_tpf.export()
+            n_tok = sum(len(v) for key, v in res.items()
+                        if key != "warm")
+            st = eng.stats
+            nd = max(st["decode_steps"] - st0["decode_steps"], 1)
+            prop = st["spec_proposed"] - st0["spec_proposed"]
+            # per-slot tokens-per-forward straight from the histogram:
+            # one observe per (tick, active slot), value = accepted len
+            tpf_sum = tpf_sum1 - tpf_sum0
+            tpf_cnt = tpf_cnt1 - tpf_cnt0
+            return {
+                "tokens_per_sec": round(n_tok / dt, 1),
+                "decode_dispatches": nd,
+                "tokens_per_forward_per_slot": round(
+                    tpf_sum / tpf_cnt, 2) if tpf_cnt else 1.0,
+                "tokens_per_dispatch": round(n_tok / nd, 2),
+                "program_ms_per_dispatch": round(
+                    (sum1 - sum0) / max(cnt1 - cnt0, 1), 3),
+                "accept_rate": round(
+                    (st["spec_accepted"] - st0["spec_accepted"])
+                    / prop, 4) if prop else 0.0,
+            }
+
+        spec["spec_off_repetitive"] = run_spec(rep_model)
+        for k in (2, 4, 8):
+            spec[f"spec_k{k}_repetitive"] = run_spec(rep_model,
+                                                     spec_tokens=k)
+            report["spec"] = spec
+            bank()
+        spec["spec_k4_random"] = run_spec(model, spec_tokens=4)
+        b0 = spec["spec_off_repetitive"]["tokens_per_sec"]
+        for key in spec:
+            if key != "spec_off_repetitive":
+                spec[key]["speedup_vs_spec_off"] = round(
+                    spec[key]["tokens_per_sec"] / max(b0, 1e-9), 2)
+        # the rung bench.py ingests alongside paged_tokens_per_sec
+        paged["paged_spec_tokens_per_sec"] = max(
+            spec[f"spec_k{k}_repetitive"]["tokens_per_sec"]
+            for k in (2, 4, 8))
+        report["spec"] = spec
+        report["paged"] = paged
+        bank()
+    except Exception as e:
+        spec["error"] = repr(e)[:300]
+        report["spec"] = spec
+        bank()
     # machine-ingestible line (bench.py merges DECODE_PROFILE_r06.json's
     # paged section into its decode rung when the file is present)
     print("PAGED_JSON " + json.dumps(paged), flush=True)

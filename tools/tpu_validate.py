@@ -147,6 +147,33 @@ def ragged_paged_kernel():
     assert err < 3e-2, err
 check("ragged_paged_attention_kernel", ragged_paged_kernel)
 
+def ragged_paged_multiquery_kernel():
+    # ISSUE 7: the speculative verify's multi-query rows (q [R, T, h, d];
+    # query t of row r attends 0..len+t) must compile and match the
+    # dense per-position reference on hardware — the serving spec tick
+    # routes through this shape
+    from paddle_tpu.ops.pallas.ragged_paged_attention import \
+        ragged_paged_attention_pallas
+    from paddle_tpu.ops.attention import dense_attention as da
+    R, P, B, M, kvh2, h2, d2, T = 4, 64, 16, 16, 4, 8, 128, 5
+    qq = jnp.asarray(rs.randn(R, T, h2, d2), jnp.bfloat16)
+    kp = jnp.asarray(rs.randn(P, B, kvh2, d2), jnp.bfloat16)
+    vp = jnp.asarray(rs.randn(P, B, kvh2, d2), jnp.bfloat16)
+    tables = jnp.asarray(rs.permutation(np.arange(P))[:R * M]
+                         .reshape(R, M), jnp.int32)
+    lens = jnp.asarray([0, 31, 100, 250], jnp.int32)
+    out = ragged_paged_attention_pallas(qq, kp, vp, tables, lens,
+                                        d2 ** -0.5)
+    ks = kp[tables].reshape(R, -1, kvh2, d2)
+    vs = vp[tables].reshape(R, -1, kvh2, d2)
+    kpos = jnp.arange(ks.shape[1])[None, None, :]
+    qpos = lens[:, None, None] + jnp.arange(T)[None, :, None]
+    ref = da(qq, ks, vs, attn_mask=(kpos <= qpos)[:, None])
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    assert err < 3e-2, err
+check("ragged_paged_multiquery_kernel", ragged_paged_multiquery_kernel)
+
 def prefill_flash():
     # the generate() prefill branch: flash at cache_index==0 must match
     # the masked-dense-over-cache path it replaced (llama.py)
