@@ -435,6 +435,40 @@ def _last_known_good():
                if k in best[1]}}
 
 
+def _ingest_rung(result, probe, filename, section_key, profile_field,
+                 promote):
+    """Fold one rung file (written by tools/decode_profile.py or
+    tools/serve_loadgen.py next to this script) into the bench result:
+    always annotate ``result["decode"][profile_field]`` with the full
+    section + provenance; promote the keys in ``promote`` (first one
+    required for the file to count at all) only under the same-device
+    + <6h freshness gate. Missing/corrupt files are ignored."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        filename)
+    try:
+        with open(path) as f:
+            pj = json.load(f)
+        section = pj.get(section_key)
+        if not section or promote[0] not in section:
+            return
+        result.setdefault("decode", {})
+        result["decode"][profile_field] = dict(
+            section, profile_device=pj.get("device"),
+            profile_started=pj.get("started"))
+        try:
+            age_s = time.time() - time.mktime(time.strptime(
+                pj["started"], "%Y-%m-%d %H:%M:%S"))
+        except (KeyError, ValueError):
+            age_s = float("inf")
+        if pj.get("device") == probe.get("device_kind") \
+                and age_s < 6 * 3600:
+            for key in promote:
+                if key in section:
+                    result["decode"].setdefault(key, section[key])
+    except (OSError, ValueError):
+        pass
+
+
 def main():
     budget = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET", 450))
     t0 = time.monotonic()
@@ -519,44 +553,20 @@ def main():
             failures.append({"stage": "decode", "rc": rc,
                              "stderr_tail": err[-300:]})
 
-    # tools/decode_profile.py rung ingestion (ISSUE 6): when the same
-    # window already ran the profiler, fold its per-architecture paged
-    # numbers in so the banked bench captures the tick-fusion
-    # before/after even if this process' own paged rung was skipped.
+    # Profiler/loadgen rung ingestion — decode_profile (ISSUE 6) and
+    # serve_loadgen (ISSUE 9) share one contract: annotate the banked
+    # bench with the profile either way, but promote the headline keys
+    # only when the file came from THIS window (same device kind,
+    # started < 6h ago — a stale CPU-run file, or a week-old hardware
+    # window's, must not masquerade as this run's number).
     if result is not None:
-        prof = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "DECODE_PROFILE_r06.json")
-        try:
-            with open(prof) as f:
-                pj = json.load(f)
-            paged = pj.get("paged")
-            if paged and "paged_tokens_per_sec" in paged:
-                result.setdefault("decode", {})
-                result["decode"]["paged_profile"] = dict(
-                    paged, profile_device=pj.get("device"),
-                    profile_started=pj.get("started"))
-                # promote the rung only when the profile came from THIS
-                # window: same device kind AND started within the last
-                # 6h — a stale CPU-run file (or a week-old hardware
-                # window's) must not masquerade as this run's number
-                try:
-                    age_s = time.time() - time.mktime(time.strptime(
-                        pj["started"], "%Y-%m-%d %H:%M:%S"))
-                except (KeyError, ValueError):
-                    age_s = float("inf")
-                if pj.get("device") == probe.get("device_kind") \
-                        and age_s < 6 * 3600:
-                    result["decode"].setdefault(
-                        "paged_tokens_per_sec",
-                        paged["paged_tokens_per_sec"])
-                    # ISSUE 7: the speculative-tick rung rides along
-                    # whenever the profiler's spec section completed
-                    if "paged_spec_tokens_per_sec" in paged:
-                        result["decode"].setdefault(
-                            "paged_spec_tokens_per_sec",
-                            paged["paged_spec_tokens_per_sec"])
-        except (OSError, ValueError):
-            pass
+        _ingest_rung(result, probe, "DECODE_PROFILE_r06.json", "paged",
+                     "paged_profile",
+                     ("paged_tokens_per_sec",
+                      "paged_spec_tokens_per_sec"))
+        _ingest_rung(result, probe, "SERVE_LOADGEN_r07.json", "gateway",
+                     "gateway_profile",
+                     ("gateway_tokens_per_sec", "gateway_p99_ttft_ms"))
 
     # (c) always emit exactly one JSON line.
     if result is not None:

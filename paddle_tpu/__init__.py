@@ -59,6 +59,7 @@ from . import audio  # noqa: E402
 from . import incubate  # noqa: E402
 from . import vision  # noqa: E402
 from . import quant  # noqa: E402
+from . import serving  # noqa: E402
 from .checkpoint import load, save  # noqa: E402
 from .hapi import Model, summary  # noqa: E402
 from . import callbacks  # noqa: E402

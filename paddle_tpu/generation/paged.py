@@ -971,6 +971,52 @@ class PagedEngine:
             k += 1
         return digests
 
+    def prefix_digests(self, input_ids,
+                       max_tokens: Optional[int] = None) -> List[str]:
+        """Public prompt-digest helper (ISSUE 9 satellite): the hex
+        SHA-256 chain digests of EVERY chunk-grid prefix span of
+        ``input_ids`` (shortest first) — each byte-for-byte a key
+        ``prefix_cache`` files that span under, so a multi-replica
+        router can probe "who holds this warm" against the exact keys
+        the blocks are registered by (router-key == cache-key, pinned
+        by test). The whole chain matters: a request whose unique tail
+        crosses a chunk boundary shares only its SHORTER spans with
+        its siblings, and affinity that probed just the longest digest
+        would silently miss the warm replica. ``max_tokens`` overrides
+        the default span cap of ``len(ids) - 1`` (the same cap
+        ``_prefix_lookup`` uses: at least one live token must remain
+        to prefill). Empty when no grid-aligned span exists.
+        Deterministic across engines with the same
+        ``chunk_prefill_tokens``, which is what makes it a routing
+        key."""
+        if self.chunk is None:
+            raise ValueError(
+                "prefix_digest requires chunk_prefill_tokens: digests "
+                "are keyed to the chunk grid the prefix cache reuses "
+                "on")
+        ids = [int(t) for t in np.asarray(input_ids).reshape(-1)]
+        cap = len(ids) - 1 if max_tokens is None \
+            else min(int(max_tokens), len(ids))
+        return [d.hex() for d in self._chunk_digests(ids, cap)]
+
+    def prefix_digest(self, input_ids,
+                      max_tokens: Optional[int] = None) -> str:
+        """The LONGEST span's digest (see ``prefix_digests``);
+        ``""`` when no grid-aligned span exists (short prompt)."""
+        digests = self.prefix_digests(input_ids, max_tokens)
+        return digests[-1] if digests else ""
+
+    def has_prefix(self, digest: str) -> bool:
+        """True when ``digest`` (hex, as returned by
+        ``prefix_digest``) currently has live blocks in the prefix
+        cache — the router's "is this replica warm" probe."""
+        if not self.prefix_caching or not digest:
+            return False
+        try:
+            return bytes.fromhex(digest) in self.prefix_cache
+        except ValueError:
+            return False
+
     def _prefix_lookup(self, ids: List[int]):
         """Longest chunk-grid prefix of ``ids`` with a live cache entry,
         capped so at least one live token remains to prefill (the chunk

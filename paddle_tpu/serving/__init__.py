@@ -1,0 +1,20 @@
+"""Serving gateway subsystem (ISSUE 9): the async HTTP/SSE front door
+over :class:`~paddle_tpu.generation.paged.PagedEngine` — SLO-aware
+continuous-batching admission (:mod:`.scheduler`), prefix-cache-aware
+multi-replica routing (:mod:`.router`), and the stdlib-only gateway
+server with graceful SIGTERM drain (:mod:`.gateway`).
+
+See ``docs/SERVING.md`` for the API schema, SLO classes, drain
+semantics and the load-generator reading guide.
+"""
+from .gateway import Gateway
+from .router import EngineReplica, NoReplicaError, PrefixAffinityRouter
+from .scheduler import (SLO_BATCH, SLO_INTERACTIVE, ServeRequest,
+                        ShedError, SLOScheduler)
+
+__all__ = [
+    "Gateway",
+    "EngineReplica", "NoReplicaError", "PrefixAffinityRouter",
+    "SLO_BATCH", "SLO_INTERACTIVE", "ServeRequest", "ShedError",
+    "SLOScheduler",
+]

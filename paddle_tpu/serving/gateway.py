@@ -1,0 +1,714 @@
+"""Async HTTP/SSE serving gateway over PagedEngine (ISSUE 9 tentpole;
+reference: vLLM's OpenAI front end + continuous-batching engine loop,
+restated stdlib-only).
+
+This is the front door ROADMAP item 2 asks for: the piece that turns
+"an engine" into "a service". Dependency policy matches
+``tools/obs_report.py --serve`` — stdlib only (``asyncio`` +
+hand-parsed HTTP/1.1 over ``asyncio.start_server``), so the gateway
+runs anywhere the engine does.
+
+Architecture (one process, N replicas):
+
+- **HTTP layer (asyncio)** — ``POST /v1/generate`` takes a JSON body
+  (token-id prompt + sampling params + SLO class/tenant/priority) and
+  answers either a JSON completion or an SSE token stream
+  (``text/event-stream``, one ``data:`` event per token, a final
+  ``done`` event carrying the full stop-trimmed token list).
+  ``GET /healthz`` is the aggregated health snapshot; ``GET /metrics``
+  serves the live observability registry in Prometheus text format —
+  the same objects ``health()`` reads, pinned equal by test.
+- **Replica workers (one thread per engine)** — ``PagedEngine`` is
+  single-threaded by design, so ALL engine access (submit / step /
+  cancel) happens on that replica's tick thread. The thread loop:
+  drain posted control ops (cancels), reap scheduler-expired requests,
+  admit from the :class:`SLOScheduler` exactly while the engine has a
+  free slot and an empty queue (iteration-level continuous batching —
+  the policy queue stays in the scheduler where it can still be
+  reordered or shed), then one ``engine.step()`` and a token dispatch
+  that mirrors ``PagedEngine.stream()``'s hold-back semantics, so a
+  gateway SSE stream is BIT-IDENTICAL to a direct engine stream (a
+  yielded token is never retracted by a stop trim).
+- **Router** — :class:`PrefixAffinityRouter` keyed by
+  ``PagedEngine.prefix_digest()`` picks the replica whose prefix cache
+  already holds the prompt's shared span (least-loaded fallback,
+  health eviction).
+- **Drain** — SIGTERM (via ``utils.shutdown.GracefulShutdown``) latches
+  draining: new requests get 503 + Retry-After, in-flight requests
+  finish, workers exit once their engines are empty, metrics flush
+  (``observability.flush()``), the listener closes. Rolling restarts
+  lose nothing that already got a slot.
+
+Token events cross from tick threads to the asyncio loop via
+``loop.call_soon_threadsafe`` onto per-request queues; a client that
+disconnects mid-stream is detected at the SSE writer (EOF watch or a
+failed ``drain()``) and its request is cancelled ON THE TICK THREAD
+(``engine.cancel`` frees the slot and blocks immediately — a dropped
+stream never strands a slot).
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils import observability as obs
+from ..utils.faults import BackpressureError
+from ..utils.shutdown import GracefulShutdown
+from .router import EngineReplica, NoReplicaError, PrefixAffinityRouter
+from .scheduler import (SLO_BATCH, SLO_INTERACTIVE, ServeRequest,
+                        ShedError, SLOScheduler)
+
+__all__ = ["Gateway"]
+
+_gateway_ids = itertools.count()
+
+_SSE_HEAD = (b"HTTP/1.1 200 OK\r\n"
+             b"Content-Type: text/event-stream\r\n"
+             b"Cache-Control: no-cache\r\n"
+             b"Connection: close\r\n\r\n")
+
+
+def _http_response(status: int, body: bytes,
+                   ctype: str = "application/json",
+                   extra: Dict[str, str] = None) -> bytes:
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              429: "Too Many Requests", 500: "Internal Server Error",
+              503: "Service Unavailable", 504: "Gateway Timeout"}.get(
+                  status, "OK")
+    head = [f"HTTP/1.1 {status} {reason}",
+            f"Content-Type: {ctype}",
+            f"Content-Length: {len(body)}",
+            "Connection: close"]
+    for k, v in (extra or {}).items():
+        head.append(f"{k}: {v}")
+    return ("\r\n".join(head) + "\r\n\r\n").encode() + body
+
+
+def _json_response(status: int, payload: Dict[str, Any],
+                   extra: Dict[str, str] = None) -> bytes:
+    return _http_response(status, json.dumps(payload).encode(),
+                          extra=extra)
+
+
+class _ReplicaWorker(threading.Thread):
+    """Owns ONE PagedEngine: the only thread that ever touches it.
+
+    ``tick_lock`` serializes ``engine.step()`` across replicas that
+    share one underlying MODEL object: ``Layer.functional()``'s pure
+    fn binds params onto the shared layer tree for the duration of a
+    call, so two threads tracing/running through the same model
+    concurrently corrupt each other (UnexpectedTracerError at best).
+    Replicas built over distinct model instances get distinct locks
+    and tick freely."""
+
+    def __init__(self, gw: "Gateway", replica: EngineReplica,
+                 sched: SLOScheduler, tick_lock: threading.Lock):
+        super().__init__(daemon=True,
+                         name=f"gateway-{gw.name}-{replica.name}")
+        self.gw = gw
+        self.replica = replica
+        self.engine = replica.engine
+        self.sched = sched
+        self._tick_lock = tick_lock
+        self._ops: deque = deque()
+        self._wake = threading.Event()
+        self._live: Dict[Any, ServeRequest] = {}
+        self.draining = False
+
+    # ------------------------------------------------------- cross-thread
+    def post(self, fn):
+        """Run ``fn`` on the tick thread before the next step."""
+        self._ops.append(fn)
+        self._wake.set()
+
+    def wake(self):
+        self._wake.set()
+
+    def cancel_request(self, request_id):
+        """Client gone: drop it from wherever it currently lives —
+        scheduler queue (never reached the engine) or the engine
+        itself (slot + blocks free immediately). The engine-side
+        record dicts are consumed here too (runs on the tick thread):
+        nobody will ever read this request's result, and `_dispatch`
+        only reaps rids still in `_live`, so leaving them would leak
+        one entry per disconnect in a long-running gateway."""
+        if not self.sched.cancel(request_id):
+            self.engine.cancel(request_id)
+            self.engine.cancelled.pop(request_id, None)
+            self.engine.results.pop(request_id, None)
+            self.engine.logprobs.pop(request_id, None)
+        self._live.pop(request_id, None)
+
+    def _emit(self, req: ServeRequest, ev):
+        if req.sink is None:
+            return
+        try:
+            self.gw._loop.call_soon_threadsafe(req.sink.put_nowait, ev)
+        except RuntimeError:   # loop already closed (teardown)
+            pass
+
+    # ------------------------------------------------------------ tick loop
+    def run(self):
+        eng = self.engine
+        while True:
+            while self._ops:
+                op = self._ops.popleft()
+                try:
+                    op()
+                except Exception as e:   # a bad op must not kill serving
+                    obs.record_event("gateway_op_error",
+                                     gateway=self.gw.name, err=repr(e))
+            now = time.monotonic()
+            for req in self.sched.reap(now):
+                # satellite: expired in QUEUE — cancelled before it
+                # ever took a slot; the scheduler already counted it
+                self._emit(req, ("done", {"tokens": [],
+                                          "finish_reason": "timeout"}))
+            while (req := self._pop_admissible()) is not None:
+                self._admit(req, time.monotonic())
+            if eng.queue or any(s is not None for s in eng.slots):
+                try:
+                    with self._tick_lock:
+                        eng.step()
+                except Exception as e:
+                    self._fail_all(e)
+                    return
+                self._dispatch()
+            else:
+                if self.draining and self.sched.depth() == 0 \
+                        and not self._live:
+                    return
+                self._wake.wait(0.005)
+                self._wake.clear()
+
+    def _pop_admissible(self) -> Optional[ServeRequest]:
+        """Hand the engine up to FREE-SLOT-many requests per tick (its
+        own step() admits every queued request that fits, so a burst
+        fills the batch in ONE tick instead of one-per-forward), but
+        never build a deeper engine backlog than that: requests beyond
+        the free slots stay in the scheduler, where policy can still
+        reorder, promote, or expire them."""
+        eng = self.engine
+        free = sum(s is None for s in eng.slots)
+        if len(eng.queue) >= free:
+            return None
+        return self.sched.pop()
+
+    def _admit(self, req: ServeRequest, now: float):
+        kw = dict(req.gen)
+        if req.deadline is not None:
+            # thread the REMAINING deadline budget into the engine so
+            # in-slot expiry uses its own timeout machinery
+            kw["timeout_s"] = max(req.deadline - now, 1e-3)
+        try:
+            self.engine.submit(req.request_id,
+                               np.asarray([req.input_ids], np.int32),
+                               **kw)
+        except BackpressureError as e:
+            # transient overload (an engine also taking out-of-band
+            # submit() traffic filled its queue since the free-slot
+            # check) — shed, don't tell the client its request was bad
+            self._emit(req, ("error", 429, str(e)))
+            return
+        except Exception as e:
+            self._emit(req, ("error", 400, str(e)))
+            return
+        req.t_admit = now
+        self._live[req.request_id] = req
+
+    def _fail_all(self, err: Exception):
+        obs.record_event("gateway_replica_error", gateway=self.gw.name,
+                         replica=self.replica.name, err=repr(err))
+        self.replica.mark(False)
+        self.gw._router.evict_unhealthy()
+        for req in list(self._live.values()):
+            self._emit(req, ("error", 500, f"replica failed: {err!r}"))
+        self._live.clear()
+        self.flush_queue(503, "replica failed; retry elsewhere")
+
+    def flush_queue(self, status: int, msg: str):
+        """Error out every request still waiting in the scheduler —
+        the dead/exiting-worker path: a queued client must get an
+        answer, never a hang. Safe off the tick thread once the
+        thread is gone (the scheduler locks internally)."""
+        for req in self.sched.reap():
+            self._emit(req, ("done", {"tokens": [],
+                                      "finish_reason": "timeout"}))
+        while (req := self.sched.pop()) is not None:
+            self._emit(req, ("error", status, msg))
+
+    # ------------------------------------------------------------ dispatch
+    def _token_out(self, req: ServeRequest, tok: int, now: float):
+        if req.t_first is None:
+            req.t_first = now
+            self.gw._h_ttft.observe((now - req.t_enqueue) * 1e3)
+        req.t_last = now
+        req.n_out += 1
+        self.gw._c_tokens.inc()
+        self._emit(req, ("token", int(tok)))
+
+    def _finish(self, req: ServeRequest, payload: Dict[str, Any],
+                now: float):
+        if req.t_first is not None and req.n_out >= 2:
+            self.gw._h_tpot.observe(
+                (req.t_last - req.t_first) / (req.n_out - 1) * 1e3)
+        self.gw._c_completed.inc()
+        self.sched.note_service(now - req.t_enqueue)
+        self._emit(req, ("done", payload))
+
+    def _dispatch(self):
+        """Push this tick's newly emitted tokens (stream()'s hold-back
+        rule, verbatim) and resolve finished / aborted requests."""
+        eng = self.engine
+        now = time.monotonic()
+        for s in eng.slots:
+            if s is None:
+                continue
+            req = self._live.get(s.request_id)
+            if req is None:
+                continue
+            hold = max((len(x) for x in s.stop), default=0)
+            n_pre = len(s.prefix)
+            upto = max(n_pre + len(s.tokens) - hold, req.emitted)
+            for i in range(req.emitted, upto):
+                self._token_out(req, s.prefix[i] if i < n_pre
+                                else s.tokens[i - n_pre], now)
+            req.emitted = upto
+        for rid in [r for r in self._live if r in eng.results]:
+            req = self._live.pop(rid)
+            toks = eng.results.pop(rid)
+            lps = eng.logprobs.pop(rid, [])
+            for t in toks[req.emitted:]:
+                self._token_out(req, t, now)
+            req.emitted = len(toks)
+            self._finish(req, {"tokens": [int(t) for t in toks],
+                               "logprobs": [float(v) for v in lps],
+                               "finish_reason": "stop"}, now)
+        for rid in [r for r in self._live if r in eng.cancelled]:
+            req = self._live.pop(rid)
+            reason = eng.cancelled.pop(rid)
+            self._finish(req, {"tokens": [],
+                               "finish_reason": reason}, now)
+
+
+class Gateway:
+    """Serve one or more PagedEngine replicas over HTTP/SSE.
+
+    ``engines``: a single engine or a list (each becomes a replica with
+    its own tick thread + SLO scheduler). ``port=0`` binds an ephemeral
+    port (``self.port`` after ``start()``).
+    """
+
+    def __init__(self, engines, host: str = "127.0.0.1", port: int = 0,
+                 *, max_queue: int = 256,
+                 interactive_ttft_ms: float = 500.0,
+                 promote_after_ms: float = 2000.0,
+                 routing: str = "prefix", spill_margin: float = 8.0,
+                 shutdown: Optional[GracefulShutdown] = None,
+                 name: Optional[str] = None):
+        if not isinstance(engines, (list, tuple)):
+            engines = [engines]
+        self.name = name or f"gw{next(_gateway_ids)}"
+        self.host, self.port = host, port
+        self._labels = {"gateway": self.name}
+        self._shutdown = shutdown
+        self._draining = False
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        reg = obs.registry()
+        self._c_requests = {
+            slo: reg.counter("gateway_requests_total", slo=slo,
+                             **self._labels)
+            for slo in (SLO_INTERACTIVE, SLO_BATCH)}
+        self._c_shed = reg.counter("gateway_shed_total", **self._labels)
+        self._c_completed = reg.counter("gateway_completed_total",
+                                        **self._labels)
+        self._c_tokens = reg.counter("gateway_tokens_total",
+                                     **self._labels)
+        self._c_disconnects = reg.counter("gateway_disconnects_total",
+                                          **self._labels)
+        self._h_ttft = reg.histogram("gateway_ttft_ms", **self._labels)
+        self._h_tpot = reg.histogram("gateway_tpot_ms", **self._labels)
+        self._workers: List[_ReplicaWorker] = []
+        replicas = []
+        # replicas sharing one MODEL object must not tick concurrently
+        # (functional()'s pure fn binds params onto the shared layer
+        # tree); one lock per distinct model serializes exactly those
+        model_locks: Dict[int, threading.Lock] = {}
+        for i, eng in enumerate(engines):
+            rep = EngineReplica(f"r{i}", eng)
+            sched = SLOScheduler(
+                max_queue=max_queue,
+                interactive_ttft_ms=interactive_ttft_ms,
+                promote_after_ms=promote_after_ms,
+                labels=dict(self._labels, replica=rep.name))
+            lock = model_locks.setdefault(
+                id(getattr(eng, "model", eng)), threading.Lock())
+            self._workers.append(_ReplicaWorker(self, rep, sched, lock))
+            replicas.append(rep)
+        self._router = PrefixAffinityRouter(
+            replicas, policy=routing, spill_margin=spill_margin,
+            labels=self._labels)
+        self._by_replica = {w.replica: w for w in self._workers}
+        # the reference engine defines prompt limits + the digest grid
+        self._ref = engines[0]
+
+    # -------------------------------------------------------------- digest
+    def _affinity_digests(self, ids: List[int]) -> Optional[List[str]]:
+        """The prompt's chunk-grid digest chain, LONGEST span first —
+        the router probes each span so a unique tail crossing a chunk
+        boundary still finds the replica warm on the shared spans."""
+        eng = self._ref
+        if not getattr(eng, "prefix_caching", False):
+            return None
+        try:
+            chain = eng.prefix_digests(ids)
+        except Exception:
+            return None
+        return chain[::-1] or None
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self):
+        self._loop = asyncio.get_running_loop()
+        for w in self._workers:
+            w.start()
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        obs.record_event("gateway_start", gateway=self.name,
+                         port=self.port,
+                         replicas=len(self._workers))
+        return self
+
+    async def drain(self, timeout: float = 30.0):
+        """Stop admitting, finish in-flight, flush metrics, close the
+        listener (the SIGTERM rolling-restart path)."""
+        if self._draining and self._server is None:
+            return
+        self._draining = True
+        for w in self._workers:
+            w.draining = True
+            w.wake()
+        deadline = time.monotonic() + timeout
+        for w in self._workers:
+            while w.is_alive() and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+        for w in self._workers:
+            if not w.is_alive():
+                # close the enqueue/exit race: a request that slipped
+                # into the scheduler as its tick thread returned gets
+                # a terminal answer here instead of a hung client
+                w.flush_queue(503, "draining: not admitting new "
+                                   "requests")
+        obs.record_event("gateway_drain", gateway=self.name)
+        obs.flush()
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+            self._server = None
+
+    async def run_until_shutdown(self, poll_s: float = 0.05):
+        """Serve until the GracefulShutdown latch fires (SIGTERM /
+        SIGINT / programmatic ``request()``), then drain and return —
+        the contract rolling restarts rely on."""
+        if self._shutdown is None:
+            self._shutdown = GracefulShutdown()
+        self._shutdown.install()
+        if self._server is None:
+            await self.start()
+        try:
+            while not self._shutdown.requested():
+                await asyncio.sleep(poll_s)
+        finally:
+            await self.drain()
+            self._shutdown.uninstall()
+
+    @property
+    def draining(self) -> bool:
+        if self._shutdown is not None and self._shutdown.requested():
+            self._draining = True
+            for w in self._workers:
+                if not w.draining:
+                    w.draining = True
+                    w.wake()
+        return self._draining
+
+    # ------------------------------------------------------------- health
+    def health(self) -> Dict[str, Any]:
+        """Aggregated snapshot, read from the SAME registry objects a
+        /metrics scrape exports (pinned equal by test)."""
+        return {
+            "gateway": self.name,
+            "draining": self.draining,
+            "requests": {slo: int(c.value)
+                         for slo, c in self._c_requests.items()},
+            "shed": int(self._c_shed.value),
+            "completed": int(self._c_completed.value),
+            "tokens": int(self._c_tokens.value),
+            "disconnects": int(self._c_disconnects.value),
+            "ttft_ms": self._h_ttft.stats(),
+            "tpot_ms": self._h_tpot.stats(),
+            "router": self._router.snapshot(),
+            "replicas": {
+                w.replica.name: dict(
+                    healthy=w.replica.healthy(),
+                    scheduler=w.sched.snapshot(),
+                    engine=w.engine.health())
+                for w in self._workers},
+        }
+
+    # ---------------------------------------------------------------- HTTP
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        try:
+            line = await asyncio.wait_for(reader.readline(), 30)
+            parts = line.decode("latin1").split()
+            if len(parts) < 3:
+                return
+            method, path = parts[0], parts[1]
+            headers: Dict[str, str] = {}
+            while True:
+                h = await asyncio.wait_for(reader.readline(), 30)
+                if h in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = h.decode("latin1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = b""
+            try:
+                n = int(headers.get("content-length", "0") or 0)
+                if n < 0:
+                    raise ValueError("negative")
+            except ValueError:
+                writer.write(_json_response(
+                    400, {"error": "bad Content-Length"}))
+                await writer.drain()
+                return
+            if n:
+                body = await asyncio.wait_for(reader.readexactly(n), 30)
+            await self._dispatch_http(method, path, body, reader, writer)
+        except (asyncio.IncompleteReadError, asyncio.TimeoutError,
+                ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _dispatch_http(self, method, path, body, reader, writer):
+        path = path.rstrip("/") or "/"
+        if method == "GET" and path == "/healthz":
+            writer.write(_json_response(200, self.health()))
+            await writer.drain()
+            return
+        if method == "GET" and path == "/metrics":
+            writer.write(_http_response(
+                200, obs.registry().prometheus_text().encode(),
+                ctype="text/plain; version=0.0.4"))
+            await writer.drain()
+            return
+        if method == "POST" and path == "/v1/generate":
+            await self._generate(body, reader, writer)
+            return
+        writer.write(_json_response(404, {"error": f"no route {path}"}))
+        await writer.drain()
+
+    # ------------------------------------------------------------ generate
+    def _parse_request(self, body: bytes) -> ServeRequest:
+        spec = json.loads(body.decode())
+        if not isinstance(spec, dict):
+            raise ValueError("request body must be a JSON object")
+        ids = spec.get("prompt", spec.get("input_ids"))
+        if not isinstance(ids, list) or not ids \
+                or not all(isinstance(t, int) for t in ids):
+            raise ValueError("prompt must be a non-empty list of "
+                             "token ids")
+        max_new = int(spec.get("max_new_tokens", 32))
+        cap = self._ref.M * self._ref.B
+        if len(ids) + max_new > cap:
+            raise ValueError(f"prompt+max_new_tokens {len(ids)}+"
+                             f"{max_new} exceeds per-request capacity "
+                             f"{cap}")
+        gen = {"max_new_tokens": max_new}
+        for k in ("eos_token_id", "temperature", "top_k", "top_p",
+                  "seed", "repetition_penalty"):
+            if spec.get(k) is not None:
+                gen[k] = spec[k]
+        if spec.get("stop") is not None:
+            gen["stop_sequences"] = [list(map(int, s))
+                                     for s in spec["stop"]]
+        timeout_s = spec.get("timeout_s")
+        deadline = (time.monotonic() + float(timeout_s)
+                    if timeout_s is not None else None)
+        digest = spec.get("affinity_key") or self._affinity_digests(ids)
+        return ServeRequest(
+            spec.get("request_id") or uuid.uuid4().hex[:16],
+            ids, gen, slo=spec.get("slo", SLO_INTERACTIVE),
+            tenant=str(spec.get("tenant", "default")),
+            priority=int(spec.get("priority", 0)),
+            deadline=deadline, digest=digest,
+            sink=asyncio.Queue(), stream=bool(spec.get("stream", True)))
+
+    async def _generate(self, body, reader, writer):
+        if self.draining:
+            writer.write(_json_response(
+                503, {"error": "draining: not admitting new requests"},
+                extra={"Retry-After": "1"}))
+            await writer.drain()
+            return
+        try:
+            req = self._parse_request(body)
+        except (ValueError, KeyError, TypeError) as e:
+            # TypeError covers wrong-typed fields (int({}) etc.);
+            # json.JSONDecodeError is a ValueError subclass
+            writer.write(_json_response(400, {"error": str(e)}))
+            await writer.drain()
+            return
+        try:
+            replica = self._router.route(req.digest)
+        except NoReplicaError as e:
+            writer.write(_json_response(503, {"error": str(e)},
+                                        extra={"Retry-After": "5"}))
+            await writer.drain()
+            return
+        worker = self._by_replica[replica]
+        try:
+            # the engine's own backpressure fields, read O(1) (a full
+            # health() snapshot per request is scrape-grade work) —
+            # live protection for engines that ALSO take out-of-band
+            # submit() traffic; the gateway's own admission keeps the
+            # engine queue shallower than this bound
+            eng = worker.engine
+            worker.sched.enqueue(
+                req, engine_health={"queued": len(eng.queue),
+                                    "queue_capacity": eng.max_queue})
+        except ShedError as e:
+            self._c_shed.inc()
+            writer.write(_json_response(
+                429, {"error": str(e),
+                      "retry_after_s": e.retry_after_s},
+                extra={"Retry-After": str(max(int(e.retry_after_s), 1))}))
+            await writer.drain()
+            return
+        self._c_requests[req.slo].inc()
+        worker.wake()
+        if not worker.is_alive() or not worker.replica.healthy():
+            # raced a worker exit: drain (thread checked its queue
+            # empty and returned as this request landed) or _fail_all
+            # (replica marked unhealthy BEFORE its queue flush, so
+            # either the flush drained this request or this check
+            # catches it) — nothing will ever serve it; take it back
+            # and shed instead of hanging the client
+            worker.sched.cancel(req.request_id)
+            writer.write(_json_response(
+                503, {"error": "replica unavailable; retry"},
+                extra={"Retry-After": "1"}))
+            await writer.drain()
+            return
+        if req.stream:
+            await self._stream_sse(worker, req, reader, writer)
+        else:
+            await self._wait_json(worker, req, reader, writer)
+
+    def _on_disconnect(self, worker: _ReplicaWorker, req: ServeRequest):
+        """Client dropped mid-request: cancel on the tick thread so the
+        slot/blocks free immediately (satellite: a dropped stream never
+        strands a slot)."""
+        self._c_disconnects.inc()
+        worker.post(lambda: worker.cancel_request(req.request_id))
+
+    async def _stream_sse(self, worker, req, reader, writer):
+        try:
+            writer.write(_SSE_HEAD)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            self._on_disconnect(worker, req)
+            return
+        eof = asyncio.ensure_future(reader.read())
+        try:
+            while True:
+                get = asyncio.ensure_future(req.sink.get())
+                if eof is None:
+                    ev = await get
+                else:
+                    done, _ = await asyncio.wait(
+                        {get, eof},
+                        return_when=asyncio.FIRST_COMPLETED)
+                    if get not in done:
+                        # read side closed. A dropped client AND a
+                        # legal HTTP half-close (shutdown(SHUT_WR)
+                        # after the body, still reading the response)
+                        # both look like EOF here — probe with an SSE
+                        # comment: only a truly dead peer fails the
+                        # write. Later token writes keep catching
+                        # disconnects once the watch is off.
+                        get.cancel()
+                        try:
+                            writer.write(b": half-close probe\n\n")
+                            await writer.drain()
+                        except (ConnectionError, OSError):
+                            self._on_disconnect(worker, req)
+                            return
+                        eof = None
+                        continue
+                    ev = get.result()
+                try:
+                    if ev[0] == "token":
+                        payload = {"token": ev[1]}
+                    elif ev[0] == "done":
+                        payload = dict(ev[1], done=True)
+                    else:
+                        payload = {"error": ev[2], "done": True}
+                    writer.write(b"data: " + json.dumps(payload).encode()
+                                 + b"\n\n")
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    self._on_disconnect(worker, req)
+                    return
+                if ev[0] != "token":
+                    return
+        finally:
+            if eof is not None and not eof.done():
+                eof.cancel()
+
+    async def _wait_json(self, worker, req, reader, writer):
+        # no EOF watch here: a JSON response can't carry a mid-wait
+        # probe, and a legal half-closing client must still get its
+        # response — a vanished one costs only the final failed write
+        while True:
+            ev = await req.sink.get()
+            if ev[0] == "token":
+                continue
+            try:
+                if ev[0] == "error":
+                    writer.write(_json_response(
+                        ev[1], {"error": ev[2],
+                                "request_id": req.request_id}))
+                else:
+                    info = ev[1]
+                    reason = info.get("finish_reason", "stop")
+                    if reason == "timeout":
+                        writer.write(_json_response(
+                            504, {"error": "deadline exceeded",
+                                  "request_id": req.request_id,
+                                  "finish_reason": reason}))
+                    else:
+                        writer.write(_json_response(
+                            200, dict(info,
+                                      request_id=req.request_id)))
+                await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+            return

@@ -1,0 +1,192 @@
+"""Prefix-cache-aware multi-replica routing (ISSUE 9; reference:
+session-affinity LB policies in production LLM serving — SGLang's
+cache-aware router, vLLM's prefix-aware scheduling — restated over
+PagedEngine's SHA-256 chain digests).
+
+A PagedEngine replica that already holds a prompt's shared-prefix
+blocks (system prompt, few-shot preamble) serves it with the prefill
+for that span SKIPPED — but only if the request lands on THAT replica.
+The router keys affinity off ``PagedEngine.prefix_digest()``: the same
+chain digest the engine's prefix cache is keyed by, so "does replica X
+have this prefix warm" is one dict lookup (``has_prefix``), not a
+heuristic.
+
+Routing order for a request carrying ``digest``:
+
+1. **warm** — healthy replicas whose engine reports the digest live in
+   its prefix cache; least-loaded among them wins (a hit).
+2. **sticky** — no replica is warm yet, but an earlier request with
+   the same digest was routed somewhere and may still be prefilling:
+   follow it so the second request arrives after the first registered
+   the blocks (a hit — this is what turns a burst of same-prefix
+   requests into one miss + N-1 hits instead of N misses).
+3. **fallback** — least-loaded healthy replica (a miss; the sticky map
+   remembers the choice).
+
+A warm/sticky target that is ``spill_margin`` load units more loaded
+than the least-loaded replica is abandoned for the fallback: affinity
+is a latency optimization, not a priority override, and a hot prefix
+must not melt one replica while others idle.
+
+Health eviction: a replica whose ``healthy()`` is False is skipped and
+its sticky entries drop (when it comes back it re-earns affinity by
+getting warm again). All replicas unhealthy raises
+:class:`NoReplicaError` (the gateway's 503).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+from ..utils import observability as obs
+
+__all__ = ["NoReplicaError", "EngineReplica", "PrefixAffinityRouter"]
+
+
+class NoReplicaError(RuntimeError):
+    """Every replica is unhealthy/evicted — nothing can take traffic."""
+
+
+class EngineReplica:
+    """Default replica adapter over a local ``PagedEngine``. The
+    gateway wraps it to fold its scheduler depth into ``load()`` and to
+    flip ``healthy`` on tick-thread failures; remote replicas would
+    implement the same three methods over RPC."""
+
+    def __init__(self, name: str, engine):
+        self.name = name
+        self.engine = engine
+        self._healthy = True
+
+    def healthy(self) -> bool:
+        return self._healthy
+
+    def mark(self, healthy: bool):
+        self._healthy = bool(healthy)
+
+    def has_prefix(self, digest: str) -> bool:
+        return self.engine.has_prefix(digest)
+
+    def load(self) -> float:
+        """Outstanding work units: live slots + engine-queued requests.
+        Read cross-thread without the engine's tick thread stopping —
+        both are O(1) host bookkeeping reads and a slightly stale load
+        only costs routing optimality, never correctness."""
+        eng = self.engine
+        return (sum(s is not None for s in eng.slots) + len(eng.queue))
+
+
+class PrefixAffinityRouter:
+    """Pick a replica per request. ``policy``: ``"prefix"`` (default,
+    the full affinity ladder), ``"least_loaded"``, or
+    ``"round_robin"`` (the A/B baseline the loadgen compares against).
+    """
+
+    POLICIES = ("prefix", "least_loaded", "round_robin")
+
+    def __init__(self, replicas: List[Any], policy: str = "prefix",
+                 spill_margin: float = 8.0, sticky_capacity: int = 1024,
+                 labels: Optional[Dict[str, str]] = None):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        if policy not in self.POLICIES:
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self.replicas = list(replicas)
+        self.policy = policy
+        self.spill_margin = float(spill_margin)
+        self._sticky: "OrderedDict[str, Any]" = OrderedDict()
+        self._sticky_cap = int(sticky_capacity)
+        self._rr = 0
+        self._lock = threading.Lock()
+        labels = labels or {}
+        reg = obs.registry()
+        self._c_hit = reg.counter("gateway_prefix_route_hits_total",
+                                  **labels)
+        self._c_miss = reg.counter("gateway_prefix_route_misses_total",
+                                   **labels)
+
+    # ------------------------------------------------------------ helpers
+    def _healthy(self) -> List[Any]:
+        up = [r for r in self.replicas if r.healthy()]
+        if not up:
+            raise NoReplicaError("all replicas unhealthy")
+        return up
+
+    @staticmethod
+    def _least_loaded(cands: List[Any]):
+        return min(cands, key=lambda r: r.load())
+
+    def _remember(self, digest: str, replica):
+        self._sticky[digest] = replica
+        self._sticky.move_to_end(digest)
+        while len(self._sticky) > self._sticky_cap:
+            self._sticky.popitem(last=False)
+
+    # -------------------------------------------------------------- route
+    def route(self, digests=None):
+        """Choose a replica for a request whose affinity keys are
+        ``digests`` — the prompt's chunk-grid digest CHAIN, longest
+        span first (a bare str is accepted as a one-element chain;
+        None/empty = no shared prefix: pure load balancing). The whole
+        chain is probed because a request whose unique tail crosses a
+        chunk boundary shares only its SHORTER spans with its
+        siblings — the longest digest alone would miss the warm
+        replica."""
+        if isinstance(digests, str):
+            digests = [digests]
+        digests = [d for d in (digests or ()) if d]
+        with self._lock:
+            up = self._healthy()
+            if self.policy == "round_robin":
+                pick = up[self._rr % len(up)]
+                self._rr += 1
+                if digests:
+                    self._c_miss.inc()
+                return pick
+            floor = self._least_loaded(up)
+            if self.policy == "least_loaded" or not digests:
+                if digests:
+                    self._c_miss.inc()
+                return floor
+            cap = floor.load() + self.spill_margin
+            for d in digests:            # longest shared span wins
+                warm = [r for r in up if r.has_prefix(d)]
+                if warm:
+                    pick = self._least_loaded(warm)
+                    if pick.load() <= cap:
+                        self._c_hit.inc()
+                        self._remember(digests[0], pick)
+                        return pick
+                    break                # overloaded: spill, don't scan on
+            for d in digests:
+                sticky = self._sticky.get(d)
+                if sticky is not None and sticky in up \
+                        and sticky.load() <= cap:
+                    self._c_hit.inc()
+                    self._sticky.move_to_end(d)
+                    return sticky
+            self._c_miss.inc()
+            for d in digests:            # future siblings of ANY span
+                self._remember(d, floor)
+            return floor
+
+    def evict_unhealthy(self):
+        """Drop sticky entries pointing at replicas that are down, so a
+        recovered replica re-earns affinity instead of inheriting stale
+        routing decisions."""
+        with self._lock:
+            dead = {k for k, r in self._sticky.items()
+                    if not r.healthy()}
+            for k in dead:
+                del self._sticky[k]
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "policy": self.policy,
+            "replicas_up": sum(r.healthy() for r in self.replicas),
+            "replicas": len(self.replicas),
+            "prefix_route_hits": int(self._c_hit.value),
+            "prefix_route_misses": int(self._c_miss.value),
+            "sticky_entries": len(self._sticky),
+        }

@@ -42,6 +42,11 @@ MUST_BE_SLOW = (
     r"test_kill_mid_run_then_resume_continues_trajectory",
     r"test_hang_checkpoints_exits_and_supervisor_finishes",
     r"test_nan_window_rolls_back_and_converges",
+    # ISSUE 9: open-loop gateway rate sweeps + the subprocess loadgen
+    # CLI e2e (each keeps a tier-1 in-process representative:
+    # test_loadgen_inprocess_smoke + the single-shot gateway e2e tests)
+    r"test_gateway\.py.*open_loop",
+    r"test_gateway\.py.*loadgen_cli",
     # ISSUE 7 sweep: the 4-worker speedup wall-clock bench was tier-1's
     # one pre-policy bench (flipped at 2.56x/3.0 under full-suite load;
     # the rest of test_dataloader_mp.py keeps the correctness coverage)

@@ -1,0 +1,315 @@
+#!/usr/bin/env python
+"""Open-loop load generator for the serving gateway (ISSUE 9
+satellite; reference: the open-loop methodology of the Gemma-on-TPU
+serving comparison in PAPERS.md — arrivals keep coming at the offered
+rate whether or not the server keeps up, so queueing delay shows up in
+TTFT instead of being hidden by a closed loop).
+
+Default mode self-hosts a gateway in-process (tiny-llama replicas with
+chunked prefill + prefix caching; ``--model stub`` swaps in a
+negligible-compute stub so CI measures the gateway machinery, not the
+model). ``--url HOST:PORT`` attaches to an external gateway instead.
+
+Workload: ``--share-frac`` of requests carry a shared, chunk-grid-
+aligned system prompt (``--sys-tokens``) plus a short unique tail —
+the prompt-sharing mix knob that makes prefix-affinity routing
+measurable; the rest are fully random prompts. ``--interactive-frac``
+splits the SLO-class mix.
+
+Reports ONE ``LOADGEN_JSON`` line: p50/p99 TTFT + TPOT, total
+tokens/s, goodput (tokens from requests whose TTFT met
+``--ttft-slo-ms``, per second), shed/timeout counts and the
+prefix-route hit split; and writes ``SERVE_LOADGEN_r07.json`` next to
+bench.py, which auto-ingests the ``gateway_p99_ttft_ms`` /
+``gateway_tokens_per_sec`` rung alongside ``paged_tokens_per_sec``
+(same device + freshness gating as the decode-profile rung).
+"""
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+OUT_DEFAULT = os.path.join(ROOT, "SERVE_LOADGEN_r07.json")
+
+
+def _force_platform():
+    """PADDLE_TPU_BENCH_PLATFORM=cpu forces a backend (the axon
+    sitecustomize re-selects its platform via jax.config after env
+    parsing, so only an in-process config.update wins — see bench.py)."""
+    plat = os.environ.get("PADDLE_TPU_BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+
+# ------------------------------------------------------------------ client
+async def sse_generate(host: str, port: int, payload: dict,
+                       timeout_s: float = 120.0):
+    """One SSE request; returns a per-request record with wire-level
+    TTFT/TPOT timings (measured at the CLIENT, queueing included)."""
+    rec = {"status": 0, "tokens": [], "ttft_ms": None, "tpot_ms": None,
+           "finish_reason": None, "retry_after": None}
+    t0 = time.perf_counter()
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        body = json.dumps(payload).encode()
+        writer.write((f"POST /v1/generate HTTP/1.1\r\nHost: {host}\r\n"
+                      f"Content-Length: {len(body)}\r\n\r\n").encode()
+                     + body)
+        await writer.drain()
+        status = await asyncio.wait_for(reader.readline(), timeout_s)
+        parts = status.split()
+        if len(parts) < 2:
+            # EOF before a status line (server mid-restart closed the
+            # accepted connection): a per-request conn_error, not a
+            # run-killing IndexError
+            raise ConnectionError("connection closed before response")
+        rec["status"] = int(parts[1])
+        while True:   # headers
+            ln = await asyncio.wait_for(reader.readline(), timeout_s)
+            if ln in (b"\r\n", b"\n", b""):
+                break
+            if ln.lower().startswith(b"retry-after:"):
+                rec["retry_after"] = ln.split(b":", 1)[1].strip().decode()
+        if rec["status"] != 200:
+            rec["finish_reason"] = "rejected"
+            return rec
+        t_first = t_last = None
+        while True:
+            ln = await asyncio.wait_for(reader.readline(), timeout_s)
+            if not ln:
+                break
+            ln = ln.strip()
+            if not ln.startswith(b"data: "):
+                continue
+            ev = json.loads(ln[6:])
+            if ev.get("done"):
+                rec["finish_reason"] = ev.get(
+                    "finish_reason", "error" if "error" in ev else None)
+                rec["tokens"] = ev.get("tokens", rec["tokens"])
+                break
+            now = time.perf_counter()
+            t_last = now
+            if t_first is None:
+                t_first = now
+                rec["ttft_ms"] = (now - t0) * 1e3
+            rec["tokens"].append(ev["token"])
+        n = len(rec["tokens"])
+        if t_first is not None and t_last is not None and n >= 2:
+            rec["tpot_ms"] = (t_last - t_first) / (n - 1) * 1e3
+        return rec
+    finally:
+        try:
+            writer.close()
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+# ----------------------------------------------------------------- fleet
+def _build_gateway(ns):
+    """Self-hosted replica fleet: chunked prefill + prefix caching on
+    every engine so affinity routing has warm blocks to find."""
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/paddle_tpu_loadgen_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.1)
+    except Exception:
+        pass
+    import paddle_tpu as pt
+    from paddle_tpu.generation.paged import PagedEngine
+    from paddle_tpu.serving import Gateway
+
+    pt.seed(0)
+    if ns.model == "stub":
+        model = _stub_model()
+        engine_kw = dict(max_slots=4, num_blocks=128, block_size=8,
+                         max_blocks_per_seq=16, prefill_buckets=(16,),
+                         chunk_prefill_tokens=ns.sys_tokens or 8,
+                         enable_prefix_cache=True)
+    else:
+        from paddle_tpu.models import LlamaForCausalLM
+        from paddle_tpu.models.llama import llama_tiny
+        model = LlamaForCausalLM(llama_tiny())
+        engine_kw = dict(max_slots=4, num_blocks=128, block_size=16,
+                         max_blocks_per_seq=16, prefill_buckets=(32,),
+                         chunk_prefill_tokens=ns.sys_tokens or 32,
+                         enable_prefix_cache=True)
+    engines = [PagedEngine(model, **engine_kw)
+               for _ in range(ns.replicas)]
+    gw = Gateway(engines, routing=ns.policy, max_queue=ns.max_queue)
+    return gw, engines
+
+
+def _stub_model():
+    """Negligible-compute CausalLM: loadgen numbers then measure
+    gateway + engine machinery, not model FLOPs (the shared reference
+    stub in ``paddle_tpu/generation/stub.py``)."""
+    from paddle_tpu.generation.stub import TickStubModel
+    return TickStubModel()
+
+
+# ------------------------------------------------------------------- run
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+async def run_loadgen(ns) -> dict:
+    rng = random.Random(ns.seed)
+    gw = engines = None
+    if ns.url:
+        host, _, port = ns.url.partition(":")
+        port = int(port)
+    else:
+        gw, engines = _build_gateway(ns)
+        await gw.start()
+        host, port = gw.host, gw.port
+    vocab = 120
+    sysp = [rng.randrange(1, vocab) for _ in range(ns.sys_tokens)]
+
+    def _payload(i):
+        shared = rng.random() < ns.share_frac
+        tail = [rng.randrange(1, vocab) for _ in range(ns.tail_tokens)]
+        prompt = (sysp + tail) if shared else \
+            [rng.randrange(1, vocab)
+             for _ in range(ns.sys_tokens + ns.tail_tokens)]
+        slo = "interactive" if rng.random() < ns.interactive_frac \
+            else "batch"
+        return {"prompt": prompt, "max_new_tokens": ns.max_new,
+                "temperature": 0.0, "slo": slo,
+                "tenant": f"t{i % ns.tenants}", "stream": True,
+                "timeout_s": ns.timeout_s}, shared
+
+    # warmup (compiles the prefill/decode executables untimed); a
+    # failed warmup against a restarting --url gateway must not kill
+    # the run the per-request guard below protects
+    try:
+        await sse_generate(host, port, _payload(0)[0])
+    except (ConnectionError, OSError, asyncio.TimeoutError):
+        pass
+
+    records = []
+
+    async def _one(i):
+        payload, shared = _payload(i)
+        try:
+            rec = await sse_generate(host, port, payload)
+        except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+            # one dropped connection (external gateway restarting,
+            # request timeout) must not discard the whole run's rung
+            rec = {"status": 0, "tokens": [], "ttft_ms": None,
+                   "tpot_ms": None, "finish_reason": "conn_error",
+                   "retry_after": None, "error": repr(e)[:80]}
+        rec["shared"] = shared
+        records.append(rec)
+
+    t0 = time.perf_counter()
+    tasks = []
+    for i in range(ns.requests):
+        tasks.append(asyncio.ensure_future(_one(i)))
+        if i < ns.requests - 1:
+            # open-loop Poisson arrivals: exponential gaps at the
+            # offered rate, slept regardless of completions
+            await asyncio.sleep(rng.expovariate(ns.rate))
+    await asyncio.gather(*tasks)
+    wall = time.perf_counter() - t0
+
+    ok = [r for r in records if r["finish_reason"] == "stop"]
+    shed = sum(r["status"] == 429 for r in records)
+    timeouts = sum(r["finish_reason"] == "timeout" for r in records)
+    ttfts = sorted(r["ttft_ms"] for r in ok if r["ttft_ms"] is not None)
+    tpots = sorted(r["tpot_ms"] for r in ok if r["tpot_ms"] is not None)
+    total_tokens = sum(len(r["tokens"]) for r in ok)
+    good_tokens = sum(len(r["tokens"]) for r in ok
+                      if r["ttft_ms"] is not None
+                      and r["ttft_ms"] <= ns.ttft_slo_ms)
+    rung = {
+        "metric": "gateway_serving",
+        "gateway_tokens_per_sec": round(total_tokens / wall, 1),
+        "gateway_p50_ttft_ms": round(_pct(ttfts, 0.50), 2),
+        "gateway_p99_ttft_ms": round(_pct(ttfts, 0.99), 2),
+        "gateway_p50_tpot_ms": round(_pct(tpots, 0.50), 2),
+        "gateway_p99_tpot_ms": round(_pct(tpots, 0.99), 2),
+        "goodput_tokens_per_sec": round(good_tokens / wall, 1),
+        "goodput_frac": round(good_tokens / max(total_tokens, 1), 3),
+        "requests": ns.requests,
+        "completed": len(ok),
+        "shed": shed,
+        "timeouts": timeouts,
+        "conn_errors": sum(r["finish_reason"] == "conn_error"
+                           for r in records),
+        "wall_s": round(wall, 2),
+        "rate_rps": ns.rate,
+        "share_frac": ns.share_frac,
+        "policy": ns.policy,
+        "replicas": ns.replicas,
+        "model": ns.model if not ns.url else "external",
+    }
+    if engines is not None:
+        rung["prefix_hit_tokens"] = sum(
+            e.stats["prefix_hit_tokens"] for e in engines)
+        router = gw.health()["router"]
+        rung["prefix_route_hits"] = router["prefix_route_hits"]
+        rung["prefix_route_misses"] = router["prefix_route_misses"]
+    if gw is not None:
+        await gw.drain()
+    return rung
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--rate", type=float, default=16.0,
+                    help="offered arrival rate, req/s (open loop)")
+    ap.add_argument("--share-frac", type=float, default=0.5,
+                    help="fraction of requests carrying the shared "
+                         "system prompt")
+    ap.add_argument("--sys-tokens", type=int, default=32)
+    ap.add_argument("--tail-tokens", type=int, default=8)
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--interactive-frac", type=float, default=0.7)
+    ap.add_argument("--ttft-slo-ms", type=float, default=1000.0)
+    ap.add_argument("--timeout-s", type=float, default=60.0)
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--policy", default="prefix",
+                    choices=("prefix", "least_loaded", "round_robin"))
+    ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--model", default="tiny",
+                    choices=("tiny", "stub"))
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--url", default=None,
+                    help="attach to HOST:PORT instead of self-hosting")
+    ap.add_argument("--out", default=OUT_DEFAULT,
+                    help="rung file bench.py auto-ingests "
+                         "('' disables the write)")
+    ns = ap.parse_args(argv)
+    _force_platform()
+    import jax
+    device = jax.devices()[0].device_kind
+    started = time.strftime("%Y-%m-%d %H:%M:%S")
+    rung = asyncio.run(run_loadgen(ns))
+    print("LOADGEN_JSON " + json.dumps(rung))
+    if ns.out:
+        tmp = ns.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"started": started, "device": device,
+                       "gateway": rung}, f, indent=1)
+        os.replace(tmp, ns.out)
+        print(f"wrote {ns.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
