@@ -368,11 +368,24 @@ class PagedEngine:
                       "cancellations", "rejected",
                       "spec_proposed", "spec_accepted")}
         self._h_decode = reg.histogram("paged_decode_step_ms",
+                                       buckets=obs.SERVING_MS_BUCKETS,
                                        **self._obs_labels)
         self._h_wait = reg.histogram("paged_queue_wait_ms",
+                                     buckets=obs.SERVING_MS_BUCKETS,
                                      **self._obs_labels)
         self._h_tpf = reg.histogram("paged_tokens_per_forward",
                                     **self._obs_labels)
+        # request-scoped tracing hook (ISSUE 10): when a front end (the
+        # serving gateway) sets this to a callable ``(request_id, kind,
+        # **fields)``, the engine reports each request's lifecycle as
+        # typed events — queue enter, slot take (with prefix-hit
+        # tokens), every prefill chunk, per-tick token batches (with
+        # spec proposed/accepted), preemption, finish/abort. Pure
+        # host-side bookkeeping on the existing transition paths: no
+        # device work, no extra dispatches/uploads (pinned by
+        # tests/test_reqtrace.py), and None (the default) keeps the
+        # engine entirely trace-free.
+        self.trace_sink = None
         # pools (and the seen masks) are donated: XLA aliases input to
         # output so a decode step costs one scatter, not a full copy
         self._decode_jit = jax.jit(self._decode_step,
@@ -893,6 +906,9 @@ class PagedEngine:
                                    stop=stop,
                                    rep=float(repetition_penalty),
                                    deadline=deadline))
+        if self.trace_sink is not None:
+            self.trace_sink(request_id, "engine_queue",
+                            queued=len(self.queue))
 
     def _blocks_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.B - 1) // self.B
@@ -1083,6 +1099,9 @@ class PagedEngine:
         obs.record_event("serve_admit",
                          engine=self._obs_labels["engine"],
                          request_id=req.request_id, slot=slot_id)
+        if self.trace_sink is not None:
+            self.trace_sink(req.request_id, "slot_take", slot=slot_id,
+                            prefix_hit_tokens=cached, blocks=need)
         self.slots[slot_id] = req
         row = np.zeros((self.M,), np.int32)
         row[:need] = req.blocks
@@ -1134,6 +1153,9 @@ class PagedEngine:
         req.lps.append(float(lp))
         req.prefill_pos = len(ids)
         self.seq_lens[slot_id] = len(ids)
+        if self.trace_sink is not None:
+            self.trace_sink(req.request_id, "prefill_done",
+                            tokens=len(ids), bucket=bucket)
         # stop check FIRST: a stop completing on the final budgeted (or
         # eos) token must still be trimmed
         if self._stop_hit(req) or req.max_new <= 1 \
@@ -1162,6 +1184,9 @@ class PagedEngine:
             np.float32(req.top_p), np.float32(req.rep),
             self.seen[slot_id], bucket=self.chunk)
         self._count("prefill_chunks")
+        if self.trace_sink is not None:
+            self.trace_sink(req.request_id, "prefill_chunk",
+                            start=start, tokens=live)
         req.prefill_pos = start + live
         self.seq_lens[slot_id] = req.prefill_pos
         # mid chunks keep the ids-only mask; the final chunk's committed
@@ -1177,6 +1202,9 @@ class PagedEngine:
             first = int(nxt)
             req.tokens.append(first)
             req.lps.append(float(lp))
+            if self.trace_sink is not None:
+                self.trace_sink(req.request_id, "prefill_done",
+                                tokens=len(ids))
             if self._stop_hit(req) or req.max_new <= 1 \
                     or (req.eos is not None and first == req.eos):
                 self._finish(slot_id)
@@ -1236,6 +1264,9 @@ class PagedEngine:
             lps = lps[:-slot.trim]
         self.results[slot.request_id] = toks
         self.logprobs[slot.request_id] = lps
+        if self.trace_sink is not None:
+            self.trace_sink(slot.request_id, "engine_finish",
+                            tokens=len(toks))
         self._release(slot_id)
 
     def _release(self, slot_id: int):
@@ -1289,6 +1320,9 @@ class PagedEngine:
         self.queue.insert(0, requeued)
         self._release(victim)
         self._count("preemptions")
+        if self.trace_sink is not None:
+            self.trace_sink(s.request_id, "preempt",
+                            emitted=len(s.tokens))
         obs.record_event("serve_preempt",
                          engine=self._obs_labels["engine"],
                          request_id=s.request_id,
@@ -1301,6 +1335,9 @@ class PagedEngine:
         self.cancelled[req.request_id] = reason
         self._count("timeouts" if reason == "timeout"
                     else "cancellations")
+        if self.trace_sink is not None:
+            self.trace_sink(req.request_id, "engine_abort",
+                            reason=reason, in_slot=slot_id is not None)
         if slot_id is not None:
             self._release(slot_id)
 
@@ -1353,6 +1390,61 @@ class PagedEngine:
             results_pending=len(self.results),
             aborted=len(self.cancelled))
         return snap
+
+    def debug_snapshot(self, max_digests: int = 32) -> Dict[str, Any]:
+        """Live engine introspection for the gateway's ``/debugz``
+        (ISSUE 10): the slot map, block-pool occupancy (``live`` =
+        blocks owned by running requests; ``fragmentation_frac`` = the
+        share of the pool parked in prefix-cache entries — reusable
+        only via eviction, the paged analogue of fragmentation), the
+        prefix-cache digests the router probes against, and the queued
+        request ids. Read cross-thread without stopping the tick
+        thread: every field is O(1)/O(R) host bookkeeping and a
+        slightly torn snapshot only costs debug fidelity, never
+        correctness."""
+        now = time.monotonic()
+        slots: List[Optional[Dict[str, Any]]] = []
+        for i, s in enumerate(list(self.slots)):
+            if s is None:
+                slots.append(None)
+                continue
+            slots.append({
+                "request_id": str(s.request_id),
+                "seq_len": int(self.seq_lens[i]),
+                "prompt_tokens": len(s.prompt),
+                "prefill_pos": s.prefill_pos,
+                "emitted": len(s.prefix) + len(s.tokens),
+                "remaining_budget": max(s.max_new - len(s.tokens), 0),
+                "blocks": len(s.blocks),
+                "spec_ema": round(float(s.spec_ema), 4),
+                "deadline_in_s": round(s.deadline - now, 3)
+                if s.deadline is not None else None,
+            })
+        total = self.P - 1               # block 0 is the garbage block
+        free = len(self.free_blocks)
+        parked = len(self.cached_free)
+        live = max(total - free - parked, 0)
+        try:
+            digests = [k.hex() for k in
+                       list(self.prefix_cache)[:max_digests]]
+            n_entries = len(self.prefix_cache)
+        except RuntimeError:             # resized mid-iteration: retry-free
+            digests, n_entries = [], -1
+        return {
+            "slots": slots,
+            "block_pool": {
+                "total": total, "free": free, "cached_free": parked,
+                "live": live,
+                "occupancy_frac": round(live / max(total, 1), 4),
+                "free_frac": round((free + parked) / max(total, 1), 4),
+                "fragmentation_frac": round(parked / max(total, 1), 4),
+            },
+            "prefix_cache": {"entries": n_entries, "digests": digests},
+            "queued": [str(r.request_id)
+                       for r in list(self.queue)[:max_digests]],
+            "spec": {"enabled": bool(self._spec_k), "k": self._spec_k,
+                     "ngram": self._spec_ngram if self._spec_k else 0},
+        }
 
     def close(self, drain: bool = True):
         """``drain=True`` (default) runs the engine until every queued
@@ -1446,6 +1538,7 @@ class PagedEngine:
         self._count("decode_steps")
         self._count("slot_steps", self.R)
         self._count("active_slot_steps", len(active))
+        sink = self.trace_sink
         for i in active:
             slot = self.slots[i]
             self.seq_lens[i] += 1   # the decode wrote last token's K/V
@@ -1453,6 +1546,8 @@ class PagedEngine:
             slot.tokens.append(tok)
             slot.lps.append(float(lps[i]))
             slot.key = self.keys[i].copy()
+            if sink is not None:
+                sink(slot.request_id, "tick", n=1)
             done = self._stop_hit(slot) or \
                 len(slot.tokens) >= slot.max_new or \
                 (slot.eos is not None and tok == slot.eos)
@@ -1492,20 +1587,28 @@ class PagedEngine:
         self._h_decode.observe((time.perf_counter() - t_decode) * 1e3)
         self._count("decode_steps", K)
         self._count("slot_steps", self.R * K)
+        sink = self.trace_sink
         for i in active:
             slot = self.slots[i]
+            appended = 0
+            finished = False
             for k in range(K):
                 self._count("active_slot_steps")
                 self.seq_lens[i] += 1   # device advanced its copy too
                 slot.tokens.append(int(nxt[k, i]))
                 slot.lps.append(float(lps[k, i]))
+                appended += 1
                 # stop check FIRST so a stop completing on the final
                 # budgeted (or eos) token still records its trim length;
                 # scan ticks past a row's done flag are garbage the
                 # break never reads (the device active mask froze them)
                 if self._stop_hit(slot) or bool(done[k, i]):
-                    self._finish(i)
+                    finished = True
                     break
+            if sink is not None:
+                sink(slot.request_id, "tick", n=appended)
+            if finished:
+                self._finish(i)
         return True
 
     def _spec_headroom(self, active):
@@ -1562,6 +1665,7 @@ class PagedEngine:
             acc = int(macc[active].sum())
             if acc:
                 self._count("spec_accepted", acc)
+        sink = self.trace_sink
         for i in active:
             slot = self.slots[i]
             n = int(nacc[i])
@@ -1573,18 +1677,24 @@ class PagedEngine:
                                  + _SPEC_EMA_ALPHA
                                  * (float(macc[i]) / float(kprop[i])))
             finished = False
+            appended = 0
             for j in range(n):
                 self._count("active_slot_steps")
                 self.seq_lens[i] += 1   # device advanced its copy too
                 slot.tokens.append(int(nxt[i, j]))
                 slot.lps.append(float(lps[i, j]))
+                appended += 1
                 # stop check FIRST: a stop completing on the final
                 # budgeted (or eos) token must still record its trim
                 if self._stop_hit(slot):
-                    self._finish(i)
                     finished = True
                     break
-            if not finished and bool(done[i]):
+            if sink is not None:
+                sink(slot.request_id, "tick", n=appended,
+                     proposed=int(kprop[i]), accepted=int(macc[i]))
+            if finished:
+                self._finish(i)
+            elif bool(done[i]):
                 self._finish(i)
         return True
 

@@ -8,6 +8,7 @@ See ``docs/SERVING.md`` for the API schema, SLO classes, drain
 semantics and the load-generator reading guide.
 """
 from .gateway import Gateway
+from .reqtrace import RequestTrace, RequestTraceRing
 from .router import EngineReplica, NoReplicaError, PrefixAffinityRouter
 from .scheduler import (SLO_BATCH, SLO_INTERACTIVE, ServeRequest,
                         ShedError, SLOScheduler)
@@ -15,6 +16,7 @@ from .scheduler import (SLO_BATCH, SLO_INTERACTIVE, ServeRequest,
 __all__ = [
     "Gateway",
     "EngineReplica", "NoReplicaError", "PrefixAffinityRouter",
+    "RequestTrace", "RequestTraceRing",
     "SLO_BATCH", "SLO_INTERACTIVE", "ServeRequest", "ShedError",
     "SLOScheduler",
 ]

@@ -51,6 +51,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import json
+import os
 import threading
 import time
 import uuid
@@ -62,6 +63,7 @@ import numpy as np
 from ..utils import observability as obs
 from ..utils.faults import BackpressureError
 from ..utils.shutdown import GracefulShutdown
+from .reqtrace import RequestTrace, RequestTraceRing
 from .router import EngineReplica, NoReplicaError, PrefixAffinityRouter
 from .scheduler import (SLO_BATCH, SLO_INTERACTIVE, ServeRequest,
                         ShedError, SLOScheduler)
@@ -122,6 +124,48 @@ class _ReplicaWorker(threading.Thread):
         self._wake = threading.Event()
         self._live: Dict[Any, ServeRequest] = {}
         self.draining = False
+        rl = dict(gw._labels, replica=replica.name)
+        # request-trace ring (ISSUE 10 tentpole): this replica's
+        # per-request timelines; the engine reports its lifecycle
+        # events through trace_sink (resolved via _live, which is
+        # populated BEFORE submit so queue-time events land too)
+        self.ring: Optional[RequestTraceRing] = None
+        if gw._trace:
+            self.ring = RequestTraceRing(
+                capacity=gw._trace_capacity,
+                slow_ttft_ms=gw._slow_ttft_ms, labels=rl)
+            self.engine.trace_sink = self._engine_trace
+        # autoscaler signals (ISSUE 10 satellite / ROADMAP 2c): free
+        # capacity gauges an external controller can scrape, updated
+        # from the tick loop — the same registry the scheduler's
+        # gateway_queue_depth already lives in
+        reg = obs.registry()
+        self._g_free_slots = reg.gauge("engine_free_slots", **rl)
+        self._g_block_free = reg.gauge("block_pool_free_frac", **rl)
+
+    def _engine_trace(self, request_id, kind, **fields):
+        """PagedEngine.trace_sink target: resolve the engine's typed
+        event onto the live request's trace (tick thread only)."""
+        req = self._live.get(request_id)
+        if req is not None and req.trace is not None:
+            req.trace.ev(kind, **fields)
+
+    def _trace_finish(self, req: ServeRequest, outcome: str,
+                      tpot_ms: Optional[float] = None):
+        if self.ring is not None and req.trace is not None:
+            self.ring.finish(req.trace, outcome, tokens=req.n_out,
+                             tpot_ms=tpot_ms)
+
+    def _set_capacity_gauges(self):
+        """Autoscaler signals (ISSUE 10 satellite / ROADMAP 2c): free
+        slots + allocatable-block fraction, scrapeable from the same
+        registry the scheduler's gateway_queue_depth lives in. O(1)
+        host reads, refreshed around every tick."""
+        eng = self.engine
+        self._g_free_slots.set(sum(s is None for s in eng.slots))
+        self._g_block_free.set(
+            (len(eng.free_blocks) + len(eng.cached_free))
+            / max(eng.P - 1, 1))
 
     # ------------------------------------------------------- cross-thread
     def post(self, fn):
@@ -132,20 +176,25 @@ class _ReplicaWorker(threading.Thread):
     def wake(self):
         self._wake.set()
 
-    def cancel_request(self, request_id):
+    def cancel_request(self, request_id, req: ServeRequest = None):
         """Client gone: drop it from wherever it currently lives —
         scheduler queue (never reached the engine) or the engine
         itself (slot + blocks free immediately). The engine-side
         record dicts are consumed here too (runs on the tick thread):
         nobody will ever read this request's result, and `_dispatch`
         only reaps rids still in `_live`, so leaving them would leak
-        one entry per disconnect in a long-running gateway."""
+        one entry per disconnect in a long-running gateway. ``req``
+        lets the caller hand over a still-queued request (not yet in
+        ``_live``) so its trace still closes."""
+        req = self._live.get(request_id, req)
         if not self.sched.cancel(request_id):
             self.engine.cancel(request_id)
             self.engine.cancelled.pop(request_id, None)
             self.engine.results.pop(request_id, None)
             self.engine.logprobs.pop(request_id, None)
         self._live.pop(request_id, None)
+        if req is not None:
+            self._trace_finish(req, "disconnect")
 
     def _emit(self, req: ServeRequest, ev):
         if req.sink is None:
@@ -172,8 +221,10 @@ class _ReplicaWorker(threading.Thread):
                 # ever took a slot; the scheduler already counted it
                 self._emit(req, ("done", {"tokens": [],
                                           "finish_reason": "timeout"}))
+                self._trace_finish(req, "expired")
             while (req := self._pop_admissible()) is not None:
                 self._admit(req, time.monotonic())
+            self._set_capacity_gauges()
             if eng.queue or any(s is not None for s in eng.slots):
                 try:
                     with self._tick_lock:
@@ -182,6 +233,9 @@ class _ReplicaWorker(threading.Thread):
                     self._fail_all(e)
                     return
                 self._dispatch()
+                # post-tick refresh: a scrape between ticks sees the
+                # capacity the step just freed, not last tick's view
+                self._set_capacity_gauges()
             else:
                 if self.draining and self.sched.depth() == 0 \
                         and not self._live:
@@ -208,6 +262,10 @@ class _ReplicaWorker(threading.Thread):
             # thread the REMAINING deadline budget into the engine so
             # in-slot expiry uses its own timeout machinery
             kw["timeout_s"] = max(req.deadline - now, 1e-3)
+        # register BEFORE submit: the engine's trace_sink resolves
+        # request ids through _live, and submit itself emits the
+        # engine_queue event
+        self._live[req.request_id] = req
         try:
             self.engine.submit(req.request_id,
                                np.asarray([req.input_ids], np.int32),
@@ -216,13 +274,16 @@ class _ReplicaWorker(threading.Thread):
             # transient overload (an engine also taking out-of-band
             # submit() traffic filled its queue since the free-slot
             # check) — shed, don't tell the client its request was bad
+            self._live.pop(req.request_id, None)
             self._emit(req, ("error", 429, str(e)))
+            self._trace_finish(req, "shed")
             return
         except Exception as e:
+            self._live.pop(req.request_id, None)
             self._emit(req, ("error", 400, str(e)))
+            self._trace_finish(req, "error")
             return
         req.t_admit = now
-        self._live[req.request_id] = req
 
     def _fail_all(self, err: Exception):
         obs.record_event("gateway_replica_error", gateway=self.gw.name,
@@ -231,6 +292,7 @@ class _ReplicaWorker(threading.Thread):
         self.gw._router.evict_unhealthy()
         for req in list(self._live.values()):
             self._emit(req, ("error", 500, f"replica failed: {err!r}"))
+            self._trace_finish(req, "error")
         self._live.clear()
         self.flush_queue(503, "replica failed; retry elsewhere")
 
@@ -242,14 +304,21 @@ class _ReplicaWorker(threading.Thread):
         for req in self.sched.reap():
             self._emit(req, ("done", {"tokens": [],
                                       "finish_reason": "timeout"}))
+            self._trace_finish(req, "expired")
         while (req := self.sched.pop()) is not None:
             self._emit(req, ("error", status, msg))
+            self._trace_finish(req, "error")
 
     # ------------------------------------------------------------ dispatch
     def _token_out(self, req: ServeRequest, tok: int, now: float):
         if req.t_first is None:
             req.t_first = now
-            self.gw._h_ttft.observe((now - req.t_enqueue) * 1e3)
+            self.gw._h_ttft.observe((now - req.t_enqueue) * 1e3,
+                                    exemplar=req.request_id)
+            if req.trace is not None:
+                req.trace.ev("first_token",
+                             ttft_ms=round(
+                                 (now - req.t_enqueue) * 1e3, 3))
         req.t_last = now
         req.n_out += 1
         self.gw._c_tokens.inc()
@@ -257,12 +326,32 @@ class _ReplicaWorker(threading.Thread):
 
     def _finish(self, req: ServeRequest, payload: Dict[str, Any],
                 now: float):
+        tpot_ms = None
         if req.t_first is not None and req.n_out >= 2:
-            self.gw._h_tpot.observe(
-                (req.t_last - req.t_first) / (req.n_out - 1) * 1e3)
+            tpot_ms = ((req.t_last - req.t_first)
+                       / (req.n_out - 1) * 1e3)
+            self.gw._h_tpot.observe(tpot_ms, exemplar=req.request_id)
         self.gw._c_completed.inc()
         self.sched.note_service(now - req.t_enqueue)
         self._emit(req, ("done", payload))
+        reason = payload.get("finish_reason", "stop")
+        outcome = {"stop": "stop", "timeout": "timeout",
+                   "cancelled": "cancelled"}.get(reason, "error")
+        if req.trace is not None:
+            req.trace.ev("finish", reason=reason, tokens=req.n_out)
+        self._trace_finish(req, outcome, tpot_ms=tpot_ms)
+        # goodput (ISSUE 10 satellite): tokens from requests that met
+        # their TTFT SLO (batch traffic has none — completing counts)
+        if reason == "stop" and req.n_out:
+            ttft_ms = ((req.t_first - req.t_enqueue) * 1e3
+                       if req.t_first is not None else None)
+            if req.slo != SLO_INTERACTIVE or (
+                    ttft_ms is not None
+                    and ttft_ms <= self.gw._slow_ttft_ms):
+                self.gw._c_good_tokens.inc(req.n_out)
+            self.gw._g_goodput.set(
+                self.gw._c_good_tokens.value
+                / max(self.gw._c_tokens.value, 1.0))
 
     def _dispatch(self):
         """Push this tick's newly emitted tokens (stream()'s hold-back
@@ -277,18 +366,24 @@ class _ReplicaWorker(threading.Thread):
                 continue
             hold = max((len(x) for x in s.stop), default=0)
             n_pre = len(s.prefix)
-            upto = max(n_pre + len(s.tokens) - hold, req.emitted)
-            for i in range(req.emitted, upto):
+            start = req.emitted
+            upto = max(n_pre + len(s.tokens) - hold, start)
+            for i in range(start, upto):
                 self._token_out(req, s.prefix[i] if i < n_pre
                                 else s.tokens[i - n_pre], now)
             req.emitted = upto
+            if upto > start and req.trace is not None:
+                req.trace.ev("stream_write", n=upto - start)
         for rid in [r for r in self._live if r in eng.results]:
             req = self._live.pop(rid)
             toks = eng.results.pop(rid)
             lps = eng.logprobs.pop(rid, [])
+            n_tail = len(toks) - req.emitted
             for t in toks[req.emitted:]:
                 self._token_out(req, t, now)
             req.emitted = len(toks)
+            if n_tail > 0 and req.trace is not None:
+                req.trace.ev("stream_write", n=n_tail)
             self._finish(req, {"tokens": [int(t) for t in toks],
                                "logprobs": [float(v) for v in lps],
                                "finish_reason": "stop"}, now)
@@ -313,7 +408,9 @@ class Gateway:
                  promote_after_ms: float = 2000.0,
                  routing: str = "prefix", spill_margin: float = 8.0,
                  shutdown: Optional[GracefulShutdown] = None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 trace: bool = True, trace_capacity: int = 512,
+                 slow_ttft_ms: Optional[float] = None):
         if not isinstance(engines, (list, tuple)):
             engines = [engines]
         self.name = name or f"gw{next(_gateway_ids)}"
@@ -323,6 +420,17 @@ class Gateway:
         self._draining = False
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # request-scoped tracing (ISSUE 10): default ON — the whole
+        # path is host-side bookkeeping, pinned to change nothing
+        # (bit-identical streams, same dispatch/upload counters).
+        # ``slow_ttft_ms`` is the DETERMINISTIC tail-retention
+        # threshold (default: the interactive TTFT SLO — "slow" means
+        # "missed its SLO"), shared with the goodput gauge.
+        self._trace = bool(trace)
+        self._trace_capacity = int(trace_capacity)
+        self._slow_ttft_ms = float(
+            interactive_ttft_ms if slow_ttft_ms is None
+            else slow_ttft_ms)
         reg = obs.registry()
         self._c_requests = {
             slo: reg.counter("gateway_requests_total", slo=slo,
@@ -335,8 +443,19 @@ class Gateway:
                                      **self._labels)
         self._c_disconnects = reg.counter("gateway_disconnects_total",
                                           **self._labels)
-        self._h_ttft = reg.histogram("gateway_ttft_ms", **self._labels)
-        self._h_tpot = reg.histogram("gateway_tpot_ms", **self._labels)
+        self._h_ttft = reg.histogram("gateway_ttft_ms",
+                                     buckets=obs.SERVING_MS_BUCKETS,
+                                     **self._labels)
+        self._h_tpot = reg.histogram("gateway_tpot_ms",
+                                     buckets=obs.SERVING_MS_BUCKETS,
+                                     **self._labels)
+        # goodput (ISSUE 10 satellite / ROADMAP 2c): tokens from
+        # requests that met their TTFT SLO, plus the running fraction —
+        # the autoscaler's quality-of-service signal
+        self._c_good_tokens = reg.counter("gateway_good_tokens_total",
+                                          **self._labels)
+        self._g_goodput = reg.gauge("gateway_goodput_frac",
+                                    **self._labels)
         self._workers: List[_ReplicaWorker] = []
         replicas = []
         # replicas sharing one MODEL object must not tick concurrently
@@ -410,6 +529,13 @@ class Gateway:
                                    "requests")
         obs.record_event("gateway_drain", gateway=self.name)
         obs.flush()
+        if obs.run_dir():
+            # park the request-trace rings next to the other run
+            # artifacts so trace_report finds them after a restart
+            try:
+                self.dump_traces(obs.run_dir())
+            except Exception:
+                pass
         if self._server is not None:
             self._server.close()
             try:
@@ -443,6 +569,53 @@ class Gateway:
                     w.draining = True
                     w.wake()
         return self._draining
+
+    # -------------------------------------------------------------- traces
+    def dump_traces(self, directory: str) -> List[str]:
+        """Write every replica's request-trace ring to
+        ``reqtrace_<gateway>_<replica>.json`` under ``directory`` (the
+        artifacts ``tools/trace_report.py`` ingests). No-op when
+        tracing is off."""
+        os.makedirs(directory, exist_ok=True)
+        out = []
+        for w in self._workers:
+            if w.ring is None:
+                continue
+            out.append(w.ring.dump(os.path.join(
+                directory,
+                f"reqtrace_{self.name}_{w.replica.name}.json")))
+        return out
+
+    def debugz(self) -> Dict[str, Any]:
+        """``GET /debugz`` (ISSUE 10): live engine introspection — the
+        slot map, block-pool occupancy/fragmentation, the prefix-cache
+        digests the router probes, scheduler queues + tenant debt,
+        per-replica EMAs, and the request-trace ring summaries. Reads
+        cross-thread without pausing the tick threads (debug fidelity,
+        not a consistency point)."""
+        reps: Dict[str, Any] = {}
+        for w in self._workers:
+            rep: Dict[str, Any] = {"healthy": w.replica.healthy(),
+                                   "alive": w.is_alive(),
+                                   "load": w.replica.load()}
+            try:
+                rep["engine"] = w.engine.debug_snapshot()
+            except Exception as e:       # torn mid-tick read: partial
+                rep["engine"] = {"error": repr(e)}
+            try:
+                rep["scheduler"] = w.sched.debug_snapshot()
+            except Exception as e:
+                rep["scheduler"] = {"error": repr(e)}
+            rep["trace_ring"] = (w.ring.summary()
+                                 if w.ring is not None else None)
+            reps[w.replica.name] = rep
+        return {
+            "gateway": self.name,
+            "draining": self.draining,
+            "slow_ttft_ms": self._slow_ttft_ms,
+            "router": self._router.snapshot(),
+            "replicas": reps,
+        }
 
     # ------------------------------------------------------------- health
     def health(self) -> Dict[str, Any]:
@@ -496,7 +669,8 @@ class Gateway:
                 return
             if n:
                 body = await asyncio.wait_for(reader.readexactly(n), 30)
-            await self._dispatch_http(method, path, body, reader, writer)
+            await self._dispatch_http(method, path, body, headers,
+                                      reader, writer)
         except (asyncio.IncompleteReadError, asyncio.TimeoutError,
                 ConnectionError, OSError):
             pass
@@ -507,10 +681,15 @@ class Gateway:
             except Exception:
                 pass
 
-    async def _dispatch_http(self, method, path, body, reader, writer):
+    async def _dispatch_http(self, method, path, body, headers, reader,
+                             writer):
         path = path.rstrip("/") or "/"
         if method == "GET" and path == "/healthz":
             writer.write(_json_response(200, self.health()))
+            await writer.drain()
+            return
+        if method == "GET" and path == "/debugz":
+            writer.write(_json_response(200, self.debugz()))
             await writer.drain()
             return
         if method == "GET" and path == "/metrics":
@@ -520,13 +699,15 @@ class Gateway:
             await writer.drain()
             return
         if method == "POST" and path == "/v1/generate":
-            await self._generate(body, reader, writer)
+            await self._generate(body, headers, reader, writer)
             return
         writer.write(_json_response(404, {"error": f"no route {path}"}))
         await writer.drain()
 
     # ------------------------------------------------------------ generate
-    def _parse_request(self, body: bytes) -> ServeRequest:
+    def _parse_request(self, body: bytes,
+                       headers: Optional[Dict[str, str]] = None
+                       ) -> ServeRequest:
         spec = json.loads(body.decode())
         if not isinstance(spec, dict):
             raise ValueError("request body must be a JSON object")
@@ -553,15 +734,23 @@ class Gateway:
         deadline = (time.monotonic() + float(timeout_s)
                     if timeout_s is not None else None)
         digest = spec.get("affinity_key") or self._affinity_digests(ids)
+        # trace-context id (ISSUE 10): body request_id wins, then an
+        # inbound X-Request-Id header (the loadgen's client-minted id
+        # — what lets trace_report join client and server views), then
+        # a gateway-minted one. The SAME id keys the response, the
+        # engine's ring entry and every metric exemplar.
+        rid = spec.get("request_id") \
+            or (headers or {}).get("x-request-id") \
+            or uuid.uuid4().hex[:16]
         return ServeRequest(
-            spec.get("request_id") or uuid.uuid4().hex[:16],
+            rid,
             ids, gen, slo=spec.get("slo", SLO_INTERACTIVE),
             tenant=str(spec.get("tenant", "default")),
             priority=int(spec.get("priority", 0)),
             deadline=deadline, digest=digest,
             sink=asyncio.Queue(), stream=bool(spec.get("stream", True)))
 
-    async def _generate(self, body, reader, writer):
+    async def _generate(self, body, headers, reader, writer):
         if self.draining:
             writer.write(_json_response(
                 503, {"error": "draining: not admitting new requests"},
@@ -569,15 +758,20 @@ class Gateway:
             await writer.drain()
             return
         try:
-            req = self._parse_request(body)
+            req = self._parse_request(body, headers)
         except (ValueError, KeyError, TypeError) as e:
             # TypeError covers wrong-typed fields (int({}) etc.);
             # json.JSONDecodeError is a ValueError subclass
             writer.write(_json_response(400, {"error": str(e)}))
             await writer.drain()
             return
+        if self._trace:
+            req.trace = RequestTrace(req.request_id, tenant=req.tenant,
+                                     slo=req.slo)
+            req.trace.ev("accept", stream=req.stream,
+                         prompt_tokens=len(req.input_ids))
         try:
-            replica = self._router.route(req.digest)
+            replica = self._router.route(req.digest, trace=req.trace)
         except NoReplicaError as e:
             writer.write(_json_response(503, {"error": str(e)},
                                         extra={"Retry-After": "5"}))
@@ -596,6 +790,10 @@ class Gateway:
                                     "queue_capacity": eng.max_queue})
         except ShedError as e:
             self._c_shed.inc()
+            if req.trace is not None:
+                req.trace.ev("shed", retry_after_s=e.retry_after_s)
+                if worker.ring is not None:
+                    worker.ring.finish(req.trace, "shed")
             writer.write(_json_response(
                 429, {"error": str(e),
                       "retry_after_s": e.retry_after_s},
@@ -612,6 +810,8 @@ class Gateway:
             # catches it) — nothing will ever serve it; take it back
             # and shed instead of hanging the client
             worker.sched.cancel(req.request_id)
+            if worker.ring is not None and req.trace is not None:
+                worker.ring.finish(req.trace, "error")
             writer.write(_json_response(
                 503, {"error": "replica unavailable; retry"},
                 extra={"Retry-After": "1"}))
@@ -627,7 +827,7 @@ class Gateway:
         slot/blocks free immediately (satellite: a dropped stream never
         strands a slot)."""
         self._c_disconnects.inc()
-        worker.post(lambda: worker.cancel_request(req.request_id))
+        worker.post(lambda: worker.cancel_request(req.request_id, req))
 
     async def _stream_sse(self, worker, req, reader, writer):
         try:

@@ -124,7 +124,7 @@ class PrefixAffinityRouter:
             self._sticky.popitem(last=False)
 
     # -------------------------------------------------------------- route
-    def route(self, digests=None):
+    def route(self, digests=None, trace=None):
         """Choose a replica for a request whose affinity keys are
         ``digests`` — the prompt's chunk-grid digest CHAIN, longest
         span first (a bare str is accepted as a one-element chain;
@@ -132,7 +132,21 @@ class PrefixAffinityRouter:
         chain is probed because a request whose unique tail crosses a
         chunk boundary shares only its SHORTER spans with its
         siblings — the longest digest alone would miss the warm
-        replica."""
+        replica.
+
+        ``trace`` (ISSUE 10): a :class:`~.reqtrace.RequestTrace` to
+        record the route DECISION on — which replica won and WHY
+        (``warm``/``sticky``/``miss``/``least_loaded``/
+        ``round_robin``), so a slow request's timeline says whether it
+        missed its warm replica."""
+
+        def _ev(verdict, pick):
+            if trace is not None:
+                trace.ev("route", verdict=verdict,
+                         replica=getattr(pick, "name", str(pick)),
+                         policy=self.policy, spans=len(digests))
+            return pick
+
         if isinstance(digests, str):
             digests = [digests]
         digests = [d for d in (digests or ()) if d]
@@ -143,12 +157,12 @@ class PrefixAffinityRouter:
                 self._rr += 1
                 if digests:
                     self._c_miss.inc()
-                return pick
+                return _ev("round_robin", pick)
             floor = self._least_loaded(up)
             if self.policy == "least_loaded" or not digests:
                 if digests:
                     self._c_miss.inc()
-                return floor
+                return _ev("least_loaded", floor)
             cap = floor.load() + self.spill_margin
             for d in digests:            # longest shared span wins
                 warm = [r for r in up if r.has_prefix(d)]
@@ -157,7 +171,7 @@ class PrefixAffinityRouter:
                     if pick.load() <= cap:
                         self._c_hit.inc()
                         self._remember(digests[0], pick)
-                        return pick
+                        return _ev("warm", pick)
                     break                # overloaded: spill, don't scan on
             for d in digests:
                 sticky = self._sticky.get(d)
@@ -165,11 +179,11 @@ class PrefixAffinityRouter:
                         and sticky.load() <= cap:
                     self._c_hit.inc()
                     self._sticky.move_to_end(d)
-                    return sticky
+                    return _ev("sticky", sticky)
             self._c_miss.inc()
             for d in digests:            # future siblings of ANY span
                 self._remember(d, floor)
-            return floor
+            return _ev("miss", floor)
 
     def evict_unhealthy(self):
         """Drop sticky entries pointing at replicas that are down, so a

@@ -79,13 +79,13 @@ class ServeRequest:
     __slots__ = ("request_id", "input_ids", "gen", "slo", "tenant",
                  "priority", "deadline", "t_enqueue", "digest", "sink",
                  "stream", "emitted", "t_admit", "t_first", "t_last",
-                 "n_out", "promoted")
+                 "n_out", "promoted", "trace")
 
     def __init__(self, request_id, input_ids, gen: Dict[str, Any],
                  slo: str = SLO_INTERACTIVE, tenant: str = "default",
                  priority: int = 0, deadline: Optional[float] = None,
                  digest: Optional[str] = None, sink=None,
-                 stream: bool = True):
+                 stream: bool = True, trace=None):
         if slo not in (SLO_INTERACTIVE, SLO_BATCH):
             raise ValueError(f"unknown SLO class {slo!r}")
         self.request_id = request_id
@@ -98,6 +98,7 @@ class ServeRequest:
         self.digest = digest
         self.sink = sink
         self.stream = bool(stream)
+        self.trace = trace        # RequestTrace (ISSUE 10) or None
         self.t_enqueue = time.monotonic()
         self.emitted = 0          # tokens already pushed to the sink
         self.t_admit: Optional[float] = None
@@ -132,7 +133,9 @@ class SLOScheduler:
         self._c_promoted = reg.counter("gateway_sched_promotions_total",
                                        **labels)
         self._g_depth = reg.gauge("gateway_queue_depth", **labels)
-        self._h_wait = reg.histogram("gateway_queue_wait_ms", **labels)
+        self._h_wait = reg.histogram("gateway_queue_wait_ms",
+                                     buckets=obs.SERVING_MS_BUCKETS,
+                                     **labels)
 
     # ------------------------------------------------------------- intake
     def depth(self) -> int:
@@ -162,6 +165,9 @@ class SLOScheduler:
                         self._retry_after_locked())
             self._q.append(req)
             self._g_depth.set(len(self._q))
+            if req.trace is not None:
+                req.trace.ev("queue_enter", slo=req.slo,
+                             tenant=req.tenant, depth=len(self._q))
 
     def cancel(self, request_id) -> bool:
         """Remove a still-queued request (client disconnect before
@@ -195,6 +201,9 @@ class SLOScheduler:
                       if r.deadline is not None and now > r.deadline]:
                 self._q.remove(r)
                 self._c_timeout.inc()
+                if r.trace is not None:
+                    r.trace.ev("queue_expire", wait_ms=round(
+                        (now - r.t_enqueue) * 1e3, 3))
                 out.append(r)
             if out:
                 self._g_depth.set(len(self._q))
@@ -238,7 +247,12 @@ class SLOScheduler:
                 pick.promoted = True
                 self._c_promoted.inc()
             self._g_depth.set(len(self._q))
-            self._h_wait.observe((now - pick.t_enqueue) * 1e3)
+            self._h_wait.observe((now - pick.t_enqueue) * 1e3,
+                                 exemplar=pick.request_id)
+            if pick.trace is not None:
+                pick.trace.ev("queue_leave", promoted=pick.promoted,
+                              wait_ms=round(
+                                  (now - pick.t_enqueue) * 1e3, 3))
             return pick
 
     # ------------------------------------------------------------ sizing
@@ -266,3 +280,25 @@ class SLOScheduler:
             "promotions": int(self._c_promoted.value),
             "queue_wait_ms": self._h_wait.stats(),
         }
+
+    def debug_snapshot(self, max_entries: int = 64) -> Dict[str, Any]:
+        """The /debugz view (ISSUE 10): the live queue contents (who is
+        waiting, how long, with what deadline) plus the fair-share
+        tenant-debt map and the service-time EMA that sizes
+        Retry-After — the introspection a "why is this request stuck"
+        investigation starts from."""
+        now = time.monotonic()
+        with self._lock:
+            q = [{"request_id": str(r.request_id), "slo": r.slo,
+                  "tenant": r.tenant, "priority": r.priority,
+                  "age_ms": round((now - r.t_enqueue) * 1e3, 1),
+                  "deadline_in_s":
+                      round(r.deadline - now, 3)
+                      if r.deadline is not None else None}
+                 for r in self._q[:max_entries]]
+            debt = dict(self._debt)
+            ema = self._service_ema_s
+        snap = self.snapshot()
+        snap.update(queue=q, tenant_debt=debt,
+                    service_ema_s=round(ema, 4))
+        return snap

@@ -50,6 +50,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 __all__ = [
     "ENV_RUN_ID", "ENV_ATTEMPT", "run_id", "attempt_id",
+    "DEFAULT_MS_BUCKETS", "SERVING_MS_BUCKETS", "BYTES_BUCKETS",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "SpanTracer", "FlightRecorder",
     "registry", "tracer", "recorder",
@@ -65,6 +66,17 @@ ENV_ATTEMPT = "PADDLE_TPU_ATTEMPT"
 # multi-minute checkpoint restores
 DEFAULT_MS_BUCKETS = (0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500,
                       1000, 2000, 5000, 10000, 30000, 60000)
+# Serving-latency buckets (ISSUE 10 satellite): explicit 1-2-5
+# log-spaced milliseconds, 0.1 ms .. 100 s. Quantiles are LINEAR
+# INTERPOLATION inside the covering bucket (clamped to observed
+# min/max), so the worst-case relative error of a reported p50/p99 is
+# bounded by the bucket ratio (2.5x) — documented with the boundaries
+# in docs/OBSERVABILITY.md. Every serving-path latency histogram
+# (gateway TTFT/TPOT, queue waits, decode-step, request attribution)
+# uses THESE buckets so cross-component percentiles are comparable.
+SERVING_MS_BUCKETS = (0.1, 0.2, 0.5, 1, 2, 5, 10, 20, 50, 100,
+                      200, 500, 1000, 2000, 5000, 10000, 20000,
+                      50000, 100000)
 # byte-sized things (checkpoint step dirs)
 BYTES_BUCKETS = (1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10, 1e11)
 
@@ -154,10 +166,18 @@ class Histogram:
     ``le``-bounded buckets + sum + count). Quantiles are estimated by
     linear interpolation inside the covering bucket, clamped to the
     observed min/max so a lone sample reports itself, not a bucket
-    edge."""
+    edge — the estimate's relative error is therefore bounded by the
+    covering bucket's hi/lo ratio (see ``SERVING_MS_BUCKETS``).
+
+    ``observe(v, exemplar=...)`` optionally tags the covering bucket
+    with an exemplar id (last-write-wins per bucket — the Prometheus
+    exemplar idea, kept in-process): ``stats()["p99_exemplar"]`` then
+    names a real request that landed in the p99 bucket, which is what
+    lets an SLO dashboard jump from "p99 is bad" straight to one
+    concrete slow request's trace."""
 
     __slots__ = ("buckets", "_counts", "_sum", "_count", "_min", "_max",
-                 "_lock")
+                 "_exemplars", "_lock")
 
     def __init__(self, buckets=DEFAULT_MS_BUCKETS):
         self.buckets = tuple(sorted(float(b) for b in buckets))
@@ -166,9 +186,10 @@ class Histogram:
         self._count = 0
         self._min = math.inf
         self._max = -math.inf
+        self._exemplars: List[Any] = [None] * (len(self.buckets) + 1)
         self._lock = threading.Lock()
 
-    def observe(self, v: float):
+    def observe(self, v: float, exemplar: Any = None):
         v = float(v)
         with self._lock:
             i = 0
@@ -179,6 +200,22 @@ class Histogram:
             self._count += 1
             self._min = min(self._min, v)
             self._max = max(self._max, v)
+            if exemplar is not None:
+                self._exemplars[i] = exemplar
+
+    def exemplar(self, q: float):
+        """Exemplar tagged on the bucket covering the q-quantile (None
+        when that bucket never saw a tagged observation)."""
+        with self._lock:
+            if self._count == 0:
+                return None
+            target = q * self._count
+            cum = 0
+            for i, c in enumerate(self._counts):
+                cum += c
+                if c and cum >= target:
+                    return self._exemplars[i]
+            return None
 
     def percentile(self, q: float) -> float:
         """Estimated q-quantile (q in [0, 1])."""
@@ -211,7 +248,7 @@ class Histogram:
         with self._lock:
             return tuple(self._counts), self._sum, self._count
 
-    def stats(self) -> Dict[str, float]:
+    def stats(self) -> Dict[str, Any]:
         with self._lock:
             count, total = self._count, self._sum
         return {
@@ -222,6 +259,7 @@ class Histogram:
             "max": self._max if count else 0.0,
             "p50": self.percentile(0.5),
             "p99": self.percentile(0.99),
+            "p99_exemplar": self.exemplar(0.99),
         }
 
 

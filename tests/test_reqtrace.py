@@ -1,0 +1,729 @@
+"""ISSUE 10: request-scoped tracing & SLO attribution across the
+serving stack.
+
+Contracts pinned here:
+
+- PROPAGATION: the trace id minted at the gateway (honoring an inbound
+  ``X-Request-Id`` header) is the SAME id on the HTTP response, in the
+  engine's ring entry (and its ``slot_take``/``engine_finish`` events)
+  and on the metric exemplars — one id traverses the whole stack.
+- ZERO-COST DEFAULT: tracing-on vs tracing-off gateway streams are
+  bitwise identical, and at the engine level a trace sink changes
+  neither tokens/logprobs nor the ``dispatch_count``/``h2d_uploads``
+  pins — the whole path is host-side bookkeeping.
+- TAIL RETENTION: full timelines are kept exactly for slow (ttft >
+  slow_ttft_ms, strict), shed, expired, cancelled, disconnected or
+  errored requests — a deterministic threshold, not sampling.
+- ATTRIBUTION: ``ttft = queue_wait + prefill + first_tick`` (+ the
+  accept->enqueue residual), exported as ``request_phase_ms`` labeled
+  histograms with exemplar request-ids.
+- INTROSPECTION: ``GET /debugz`` exposes the slot map, block pool,
+  prefix digests, scheduler queue + tenant debt and ring summaries;
+  ``tools/trace_report.py`` joins ring dumps with the loadgen's
+  client JSONL.
+
+Heavy many-request sweeps ride behind ``slow``
+(``tools/marker_audit.py``).
+"""
+import asyncio
+import importlib.util
+import json
+import os
+import time
+import types
+
+import numpy as np
+import pytest
+
+from paddle_tpu.generation.paged import PagedEngine
+from paddle_tpu.generation.stub import TickStubModel
+from paddle_tpu.serving import Gateway, PrefixAffinityRouter
+from paddle_tpu.serving.reqtrace import (RequestTrace, RequestTraceRing,
+                                         attribution, validate_ring_doc)
+from paddle_tpu.utils import observability as obs
+
+
+def _engine(**kw):
+    base = dict(max_slots=4, num_blocks=64, block_size=8,
+                max_blocks_per_seq=8, prefill_buckets=(16,),
+                chunk_prefill_tokens=8, enable_prefix_cache=True)
+    base.update(kw)
+    return PagedEngine(TickStubModel(), **base)
+
+
+# ------------------------------------------------------------- HTTP client
+async def _http(port, method, path, body=b"", headers=None):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        h = "".join(f"{k}: {v}\r\n"
+                    for k, v in (headers or {}).items())
+        writer.write((f"{method} {path} HTTP/1.1\r\nHost: t\r\n{h}"
+                      f"Content-Length: {len(body)}\r\n\r\n").encode()
+                     + body)
+        await writer.drain()
+        status = int((await reader.readline()).split()[1])
+        hdrs = {}
+        while True:
+            ln = await reader.readline()
+            if ln in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = ln.decode().partition(":")
+            hdrs[k.strip().lower()] = v.strip()
+        n = int(hdrs.get("content-length", "0") or 0)
+        payload = await reader.readexactly(n) if n else b""
+        return status, hdrs, payload
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+async def _sse(port, payload, headers=None, break_after=None):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    body = json.dumps(payload).encode()
+    try:
+        h = "".join(f"{k}: {v}\r\n"
+                    for k, v in (headers or {}).items())
+        writer.write((f"POST /v1/generate HTTP/1.1\r\nHost: t\r\n{h}"
+                      f"Content-Length: {len(body)}\r\n\r\n").encode()
+                     + body)
+        await writer.drain()
+        status = int((await reader.readline()).split()[1])
+        hdrs = {}
+        while True:
+            ln = await reader.readline()
+            if ln in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = ln.decode().partition(":")
+            hdrs[k.strip().lower()] = v.strip()
+        if status != 200:
+            n = int(hdrs.get("content-length", "0") or 0)
+            extra = await reader.readexactly(n) if n else b""
+            return status, [], (json.loads(extra) if extra else None)
+        toks, final = [], None
+        while True:
+            ln = await reader.readline()
+            if not ln:
+                break
+            ln = ln.strip()
+            if not ln.startswith(b"data: "):
+                continue
+            ev = json.loads(ln[6:])
+            if ev.get("done"):
+                final = ev
+                break
+            toks.append(ev["token"])
+            if break_after is not None and len(toks) >= break_after:
+                break
+        return status, toks, final
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+async def _poll(cond, timeout=10.0, every=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(every)
+    return False
+
+
+def _mk_trace(rid, marks, slo="interactive", tenant="t"):
+    """Synthetic trace with deterministic event times (ms)."""
+    tr = RequestTrace(rid, tenant=tenant, slo=slo)
+    for t, kind in marks:
+        tr.ev(kind, t_ms=t)
+    return tr
+
+
+# =========================================================== buckets/units
+def test_serving_buckets_log_spaced_and_exemplars():
+    """Satellite: explicit 1-2-5 log-spaced serving buckets; exemplar
+    rides the covering bucket and surfaces as p99_exemplar."""
+    b = obs.SERVING_MS_BUCKETS
+    assert b == tuple(sorted(b)) and len(set(b)) == len(b)
+    # 1-2-5 per decade: every bucket is 2x or 2.5x its predecessor
+    for lo, hi in zip(b, b[1:]):
+        assert hi / lo in (2.0, 2.5), (lo, hi)
+    h = obs.Histogram(buckets=b)
+    for _ in range(98):
+        h.observe(3.0, exemplar="fast")
+    for _ in range(2):
+        h.observe(4000.0, exemplar="slowreq")
+    s = h.stats()
+    assert s["p99_exemplar"] == "slowreq"
+    assert s["p50"] == pytest.approx(3.0, abs=2.0)
+    # the exposition path is untouched by exemplars
+    reg = obs.MetricsRegistry()
+    reg.histogram("t_ms", buckets=b, who="x").observe(7.0,
+                                                     exemplar="r1")
+    text = reg.prometheus_text()
+    assert 't_ms_bucket{who="x",le="10"} 1' in text
+    assert "r1" not in text       # exemplars stay in-process
+
+
+def test_ring_tail_retention_deterministic():
+    """Tentpole: retention is a deterministic threshold — slow/shed/
+    expired/cancelled keep full timelines, fast healthy requests keep
+    only the summary. Strictly-greater: ttft == threshold is NOT
+    slow."""
+    obs.reset()
+    ring = RequestTraceRing(capacity=8, slow_ttft_ms=50.0,
+                            labels={"gateway": "t-ret",
+                                    "replica": "r0"})
+    base = [(0.0, "accept"), (0.2, "queue_enter"), (1.0, "slot_take"),
+            (2.0, "prefill_done")]
+    fast = _mk_trace("fast", base + [(10.0, "first_token")])
+    at_thresh = _mk_trace("edge", base + [(50.0, "first_token")])
+    slow = _mk_trace("slow", base + [(50.1, "first_token")])
+    shed = _mk_trace("shed", [(0.0, "accept"), (0.1, "shed")])
+    exp = _mk_trace("exp", [(0.0, "accept"), (0.2, "queue_enter"),
+                            (99.0, "queue_expire")])
+    ring.finish(fast, "stop", tokens=4)
+    ring.finish(at_thresh, "stop", tokens=4)
+    ring.finish(slow, "stop", tokens=4)
+    ring.finish(shed, "shed")
+    ring.finish(exp, "expired")
+    by_id = {e["request_id"]: e for e in ring.snapshot()}
+    assert not by_id["fast"]["retained"] and not by_id["fast"]["events"]
+    assert not by_id["edge"]["retained"]
+    assert by_id["slow"]["retained"] and by_id["slow"]["events"]
+    assert by_id["shed"]["retained"]
+    assert by_id["exp"]["retained"]
+    assert by_id["exp"]["queue_wait_ms"] is None   # never took a slot
+    s = ring.summary()
+    assert s["traced"] == 5 and s["retained"] == 3
+    # idempotent: a second finisher (disconnect racing a tick finish)
+    # neither double-counts nor appends twice
+    assert ring.finish(slow, "disconnect") is None
+    assert ring.summary()["traced"] == 5
+    obs.reset()
+
+
+def test_ring_attribution_and_histogram_export():
+    """The decomposition is exact on the marks, and lands in labeled
+    registry histograms with the request id as the p99 exemplar."""
+    obs.reset()
+    ring = RequestTraceRing(capacity=8, slow_ttft_ms=1e9,
+                            labels={"gateway": "t-att",
+                                    "replica": "r0"})
+    tr = _mk_trace("rid-1", [(0.0, "accept"), (0.5, "queue_enter"),
+                             (10.5, "slot_take"), (40.5, "prefill_done"),
+                             (45.5, "first_token")])
+    e = ring.finish(tr, "stop", tokens=8, tpot_ms=1.25)
+    assert e["queue_wait_ms"] == 10.0
+    assert e["prefill_ms"] == 30.0
+    assert e["first_tick_ms"] == 5.0
+    assert e["ttft_ms"] == 45.5
+    assert e["tpot_ms"] == 1.25
+    # components telescope: ttft - sum == accept->enqueue residual
+    assert e["ttft_ms"] - (e["queue_wait_ms"] + e["prefill_ms"]
+                           + e["first_tick_ms"]) == pytest.approx(0.5)
+    text = obs.registry().prometheus_text()
+    assert 'request_ttft_ms_bucket{gateway="t-att"' in text
+    assert 'phase="queue_wait"' in text and 'phase="prefill"' in text \
+        and 'phase="first_tick"' in text
+    h = obs.registry().histogram("request_ttft_ms", slo="interactive",
+                                 gateway="t-att", replica="r0")
+    assert h.stats()["p99_exemplar"] == "rid-1"
+    obs.reset()
+
+
+def test_validate_ring_doc_catches_drift(tmp_path):
+    obs.reset()
+    ring = RequestTraceRing(capacity=4, slow_ttft_ms=0.0,
+                            labels={"gateway": "t-val",
+                                    "replica": "r0"})
+    ring.finish(_mk_trace("a", [(0.0, "accept"),
+                                (5.0, "first_token")]), "stop")
+    path = ring.dump(str(tmp_path / "reqtrace_t_r0.json"))
+    doc = json.load(open(path))
+    assert validate_ring_doc(doc) == []
+    bad = json.loads(json.dumps(doc))
+    bad["entries"][0]["outcome"] = "vanished"
+    bad["entries"][0]["events"].append([1.0, "not_a_kind", {}])
+    problems = validate_ring_doc(bad)
+    assert any("outcome" in p for p in problems)
+    assert any("not_a_kind" in p for p in problems)
+    assert validate_ring_doc({"schema": "??"})  # wrong schema flagged
+    obs.reset()
+
+
+def test_scheduler_router_trace_events():
+    """Unit: queue_enter/leave (with promotion) and route verdicts
+    land on the trace."""
+    from paddle_tpu.serving import ServeRequest, SLOScheduler
+    s = SLOScheduler(max_queue=8, promote_after_ms=10.0,
+                     labels={"gateway": "t-sch-ev"})
+    tr = RequestTrace("b1", slo="batch")
+    req = ServeRequest("b1", [1, 2, 3], {}, slo="batch", trace=tr)
+    s.enqueue(req)
+    time.sleep(0.03)                       # past promotion age
+    pick = s.pop()
+    kinds = [k for _, k, _ in tr.events]
+    assert kinds == ["queue_enter", "queue_leave"]
+    leave = tr.events[1][2]
+    assert leave["promoted"] is True and leave["wait_ms"] > 0
+    # expiry event
+    tr2 = RequestTrace("b2")
+    s.enqueue(ServeRequest("b2", [1], {}, trace=tr2,
+                           deadline=time.monotonic() - 1))
+    assert [r.request_id for r in s.reap()] == ["b2"]
+    assert [k for _, k, _ in tr2.events][-1] == "queue_expire"
+
+    class _Rep:
+        def __init__(self, name, warm=(), load=0):
+            self.name, self._warm, self._load = name, set(warm), load
+
+        def healthy(self):
+            return True
+
+        def has_prefix(self, d):
+            return d in self._warm
+
+        def load(self):
+            return self._load
+
+    r = PrefixAffinityRouter([_Rep("a", warm={"d1"}, load=1),
+                              _Rep("b")],
+                             labels={"gateway": "t-rt-ev"})
+    t_warm = RequestTrace("w")
+    assert r.route("d1", trace=t_warm).name == "a"
+    assert t_warm.events[0][1] == "route"
+    assert t_warm.events[0][2]["verdict"] == "warm"
+    assert t_warm.events[0][2]["replica"] == "a"
+    t_miss = RequestTrace("m")
+    r.route("d9", trace=t_miss)
+    assert t_miss.events[0][2]["verdict"] == "miss"
+
+
+# ============================================================= propagation
+def test_request_id_header_propagates_to_engine_ring():
+    """Tentpole pin: the client-minted X-Request-Id IS the gateway
+    response id AND the engine ring id, and the engine-side events
+    (slot_take, engine_finish) recorded under it."""
+    async def run():
+        gw = Gateway(_engine(), name="t-rid", slow_ttft_ms=0.0)
+        await gw.start()
+        try:
+            body = json.dumps(dict(prompt=list(range(1, 13)),
+                                   max_new_tokens=5,
+                                   stream=False)).encode()
+            st, _, payload = await _http(
+                gw.port, "POST", "/v1/generate", body,
+                headers={"X-Request-Id": "cli-42"})
+        finally:
+            await gw.drain()
+        return st, json.loads(payload), gw._workers[0].ring.snapshot()
+
+    st, resp, entries = asyncio.run(run())
+    assert st == 200 and resp["request_id"] == "cli-42"
+    assert [e["request_id"] for e in entries] == ["cli-42"]
+    e = entries[0]
+    assert e["outcome"] == "stop" and e["retained"]   # slow_ttft 0.0
+    kinds = [k for _, k, _ in e["events"]]
+    for want in ("accept", "route", "queue_enter", "queue_leave",
+                 "engine_queue", "slot_take", "prefill_chunk",
+                 "prefill_done", "first_token", "tick",
+                 "stream_write", "finish"):
+        assert want in kinds, f"missing {want}: {kinds}"
+    # lifecycle order (same-thread events)
+    assert kinds.index("queue_enter") < kinds.index("slot_take") \
+        < kinds.index("prefill_done") < kinds.index("first_token") \
+        < kinds.index("finish")
+    # attribution: components are non-negative and telescope into ttft
+    # (the residual is the gateway's accept->enqueue parse/route time)
+    comps = (e["queue_wait_ms"], e["prefill_ms"], e["first_tick_ms"])
+    assert all(c is not None and c >= 0 for c in comps)
+    resid = e["ttft_ms"] - sum(comps)
+    assert 0 <= resid < 1000
+    # slot_take carried the prefix-hit count (cold cache: 0)
+    st_ev = next(f for _, k, f in e["events"] if k == "slot_take")
+    assert st_ev["prefix_hit_tokens"] == 0
+
+
+def test_tracing_on_off_streams_bit_identical():
+    """Acceptance: default-on tracing changes nothing a client can
+    see — SSE streams bitwise equal with trace=True vs trace=False."""
+    reqs = [dict(prompt=list(range(1, 13)), max_new_tokens=8),
+            dict(prompt=[5, 9, 2, 7, 7, 1, 3, 8, 4],
+                 max_new_tokens=10, temperature=0.9, top_k=20, seed=7),
+            dict(prompt=list(range(40, 52)), max_new_tokens=12,
+                 stop=[[0]])]
+
+    async def serve(trace, name):
+        gw = Gateway(_engine(), name=name, trace=trace)
+        await gw.start()
+        try:
+            outs = []
+            for r in reqs:              # sequential: deterministic
+                outs.append(await _sse(gw.port, dict(r, stream=True)))
+        finally:
+            await gw.drain()
+        return outs
+
+    on = asyncio.run(serve(True, "t-tron"))
+    off = asyncio.run(serve(False, "t-troff"))
+    for (st1, t1, f1), (st2, t2, f2) in zip(on, off):
+        assert st1 == st2 == 200
+        assert t1 == t2
+        assert f1["tokens"] == f2["tokens"]
+        assert f1["logprobs"] == f2["logprobs"]
+
+
+def test_engine_trace_sink_parity_and_dispatch_pin():
+    """Engine-level pin: a trace sink changes neither the streams nor
+    the steady-tick dispatch/upload counters — tracing is free."""
+    def drive(eng):
+        eng.submit("a", np.asarray([list(range(1, 13))], np.int32),
+                   max_new_tokens=6)
+        eng.submit("b", np.asarray([[5, 9, 2, 7, 7, 1, 3]], np.int32),
+                   max_new_tokens=8, temperature=0.8, seed=3)
+        eng.submit("c", np.asarray([list(range(30, 39))], np.int32),
+                   max_new_tokens=5, stop_sequences=[[0]])
+        res = eng.run()
+        return res, dict(eng.logprobs)
+
+    plain = _engine()
+    res0, lps0 = drive(plain)
+    events = []
+    traced = _engine()
+    traced.trace_sink = lambda rid, kind, **f: events.append(
+        (rid, kind, f))
+    res1, lps1 = drive(traced)
+    assert res0 == res1 and lps0 == lps1
+    assert traced.dispatch_count == plain.dispatch_count
+    assert traced.h2d_uploads == plain.h2d_uploads
+    kinds_by_rid = {}
+    for rid, kind, _ in events:
+        kinds_by_rid.setdefault(rid, []).append(kind)
+    for rid in ("a", "b", "c"):
+        ks = kinds_by_rid[rid]
+        assert "engine_queue" in ks and "slot_take" in ks
+        assert "prefill_done" in ks and "engine_finish" in ks
+    # per-request tick token counts reconcile with the emitted stream
+    # (the first token comes from the prefill, the rest from ticks;
+    # "a" has no stop/eos so nothing was trimmed)
+    ticks_a = sum(f["n"] for rid, k, f in events
+                  if rid == "a" and k == "tick")
+    assert ticks_a == len(res1["a"]) - 1
+
+
+def test_spec_tick_events_carry_proposed_accepted():
+    """Speculative ticks report their proposed/accepted split on the
+    per-tick event (the ISSUE 10 event-catalog requirement)."""
+    events = []
+    eng = _engine(spec_tokens=2)
+    eng.trace_sink = lambda rid, kind, **f: events.append((kind, f))
+    prompt = [1, 2, 3, 4] * 4            # repetitive: drafts accept
+    eng.submit("s", np.asarray([prompt], np.int32), max_new_tokens=8)
+    res = eng.run()
+    ticks = [f for k, f in events if k == "tick"]
+    assert ticks and all("proposed" in f and "accepted" in f
+                         for f in ticks)
+    assert sum(f["n"] for f in ticks) == len(res["s"]) - 1
+
+
+# ================================================================ outcomes
+def test_shed_and_queue_expiry_outcomes_recorded():
+    async def run():
+        eng = _engine(max_slots=1)
+        gw = Gateway(eng, name="t-out", slow_ttft_ms=1e9)
+        await gw.start()
+        try:
+            long = asyncio.ensure_future(_sse(
+                gw.port, dict(prompt=list(range(1, 10)),
+                              max_new_tokens=50)))
+            await _poll(lambda: eng.health()["active_slots"] == 1)
+            body = json.dumps(dict(prompt=[4, 5, 6], max_new_tokens=4,
+                                   timeout_s=0.05,
+                                   stream=False)).encode()
+            st, _, payload = await _http(
+                gw.port, "POST", "/v1/generate", body,
+                headers={"X-Request-Id": "cli-exp"})
+            st_long, _, _ = await long
+            assert st == 504 and st_long == 200
+        finally:
+            await gw.drain()
+        return gw._workers[0].ring.snapshot()
+
+    entries = asyncio.run(run())
+    by_id = {e["request_id"]: e for e in entries}
+    exp = by_id["cli-exp"]
+    assert exp["outcome"] == "expired" and exp["retained"]
+    assert "queue_expire" in [k for _, k, _ in exp["events"]]
+    assert exp["queue_wait_ms"] is None    # never reached a slot
+    # the long request completed healthily under the huge threshold:
+    # summary kept, timeline dropped
+    stop = next(e for e in entries if e["outcome"] == "stop")
+    assert not stop["retained"] and not stop["events"]
+
+    async def run_shed():
+        gw = Gateway(_engine(), name="t-shed", max_queue=0)
+        await gw.start()
+        try:
+            st, _, body = await _sse(
+                gw.port, dict(prompt=list(range(1, 10)),
+                              max_new_tokens=4, request_id="cli-shed"))
+            assert st == 429
+        finally:
+            await gw.drain()
+        return gw._workers[0].ring.snapshot()
+
+    entries = asyncio.run(run_shed())
+    shed = {e["request_id"]: e for e in entries}["cli-shed"]
+    assert shed["outcome"] == "shed" and shed["retained"]
+    assert "shed" in [k for _, k, _ in shed["events"]]
+
+
+def test_disconnect_outcome_records_engine_abort():
+    async def run():
+        eng = _engine(max_slots=2)
+        gw = Gateway(eng, name="t-dct", slow_ttft_ms=1e9)
+        await gw.start()
+        try:
+            st, toks, _ = await _sse(
+                gw.port, dict(prompt=list(range(1, 10)),
+                              max_new_tokens=50,
+                              request_id="cli-gone"), break_after=2)
+            assert st == 200 and len(toks) == 2
+            freed = await _poll(
+                lambda: eng.health()["active_slots"] == 0)
+            assert freed
+        finally:
+            await gw.drain()
+        return gw._workers[0].ring.snapshot()
+
+    entries = asyncio.run(run())
+    e = {x["request_id"]: x for x in entries}["cli-gone"]
+    assert e["outcome"] == "disconnect" and e["retained"]
+    aborts = [f for _, k, f in e["events"] if k == "engine_abort"]
+    assert aborts and aborts[0]["reason"] == "cancelled"
+
+
+# =============================================================== debugz
+def test_debugz_schema_and_live_slot_map():
+    async def run():
+        eng = _engine()
+        gw = Gateway(eng, name="t-dbg", slow_ttft_ms=0.0)
+        await gw.start()
+        try:
+            long = asyncio.ensure_future(_sse(
+                gw.port, dict(prompt=list(range(1, 10)),
+                              max_new_tokens=40,
+                              request_id="cli-live")))
+            await _poll(lambda: eng.health()["active_slots"] == 1)
+            st, _, payload = await _http(gw.port, "GET", "/debugz")
+            live = json.loads(payload)
+            await long
+            # the trace closes on the tick thread a moment after the
+            # client sees the done event — wait for it
+            await _poll(
+                lambda: gw._workers[0].ring.summary()["traced"] == 1)
+            st2, _, payload2 = await _http(gw.port, "GET", "/debugz")
+            done = json.loads(payload2)
+        finally:
+            await gw.drain()
+        return st, live, st2, done
+
+    st, live, st2, done = asyncio.run(run())
+    assert st == 200 and st2 == 200
+    for top in ("gateway", "draining", "slow_ttft_ms", "router",
+                "replicas"):
+        assert top in live
+    rep = live["replicas"]["r0"]
+    for k in ("healthy", "alive", "load", "engine", "scheduler",
+              "trace_ring"):
+        assert k in rep
+    slot = next(s for s in rep["engine"]["slots"] if s is not None)
+    assert slot["request_id"] == "cli-live"
+    assert slot["remaining_budget"] <= 40 and slot["blocks"] >= 1
+    bp = rep["engine"]["block_pool"]
+    assert bp["total"] == 63
+    assert bp["free"] + bp["cached_free"] + bp["live"] == bp["total"]
+    assert 0 < bp["occupancy_frac"] <= 1
+    assert "tenant_debt" in rep["scheduler"]
+    assert "queue" in rep["scheduler"]
+    assert rep["trace_ring"]["capacity"] == 512
+    # after completion the ring summary shows the finished request
+    rec = done["replicas"]["r0"]["trace_ring"]["recent"]
+    assert any(r["request_id"] == "cli-live" for r in rec)
+    assert done["replicas"]["r0"]["engine"]["prefix_cache"]["entries"] \
+        >= 1
+
+
+def test_autoscaler_gauges_scrapeable():
+    """Satellite (ROADMAP 2c): engine_free_slots / block_pool_free_frac
+    / gateway_queue_depth / gateway_goodput_frac all come from the one
+    registry a /metrics scrape serves."""
+    async def run():
+        eng = _engine()
+        gw = Gateway(eng, name="t-scale", slow_ttft_ms=1e9)
+        await gw.start()
+        try:
+            st, _, fin = await _sse(
+                gw.port, dict(prompt=list(range(1, 10)),
+                              max_new_tokens=6))
+            assert st == 200
+            # the gauges refresh around ticks: wait for the post-finish
+            # tick-loop pass before scraping
+            await _poll(lambda: obs.registry().gauge(
+                "engine_free_slots", gateway="t-scale",
+                replica="r0").value == 4.0)
+            _, _, prom = await _http(gw.port, "GET", "/metrics")
+        finally:
+            await gw.drain()
+        return prom.decode()
+
+    prom = asyncio.run(run())
+
+    def val(prefix):
+        line = next(ln for ln in prom.splitlines()
+                    if ln.startswith(prefix))
+        return float(line.split()[-1])
+
+    assert val('engine_free_slots{gateway="t-scale"') == 4.0  # idle
+    frac = val('block_pool_free_frac{gateway="t-scale"')
+    assert 0.0 < frac <= 1.0
+    assert val('gateway_queue_depth{gateway="t-scale"') == 0.0
+    assert val('gateway_goodput_frac{gateway="t-scale"') == 1.0
+    assert val('gateway_good_tokens_total{gateway="t-scale"') == 6.0
+    assert val('request_traces_total{gateway="t-scale"') == 1.0
+
+
+# ============================================================ trace_report
+def _load_tool(name):
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_report_smoke_on_recorded_ring(tmp_path, capsys):
+    """Acceptance: trace_report decomposes TTFT per component and per
+    SLO class from a recorded ring, and joins the client JSONL."""
+    async def run():
+        gw = Gateway(_engine(), name="t-rep", slow_ttft_ms=0.0)
+        await gw.start()
+        try:
+            for i, slo in enumerate(("interactive", "interactive",
+                                     "batch")):
+                st, _, fin = await _sse(
+                    gw.port, dict(prompt=list(range(1, 13)),
+                                  max_new_tokens=4, slo=slo),
+                    headers={"X-Request-Id": f"cli-{i}"})
+                assert st == 200 and fin["finish_reason"] == "stop"
+        finally:
+            # drain first: the tick threads exit only after every
+            # in-flight finish (and its trace close) has run
+            await gw.drain()
+        gw.dump_traces(str(tmp_path))
+
+    asyncio.run(run())
+    jsonl = tmp_path / "lg.jsonl"
+    with open(jsonl, "w") as f:
+        for i, slo in enumerate(("interactive", "interactive",
+                                 "batch")):
+            f.write(json.dumps({"request_id": f"cli-{i}", "slo": slo,
+                                "ttft_ms": 100.0 + i,
+                                "outcome": "stop"}) + "\n")
+        f.write(json.dumps({"request_id": "cli-lost",
+                            "outcome": "conn_error"}) + "\n")
+    tr = _load_tool("trace_report")
+    docs = tr.load_rings([str(tmp_path)])
+    assert len(docs) == 1
+    s = tr.summarize(docs, client=tr.load_client_jsonl(str(jsonl)))
+    assert s["requests"] == 3 and s["retained"] == 3
+    inter = s["classes"]["interactive"]["components"]
+    assert inter["ttft_ms"]["n"] == 2
+    for comp in ("queue_wait_ms", "prefill_ms", "first_tick_ms"):
+        assert inter[comp]["p99"] >= 0 and inter[comp]["n"] == 2
+    assert inter["ttft_ms"]["p99_request_id"] in ("cli-0", "cli-1")
+    assert "batch" in s["classes"]
+    cj = s["client_join"]
+    assert cj["matched"] == 3 and cj["client_only"] == 1
+    out = tr.render(s)
+    assert "class interactive" in out and "queue_wait_ms" in out
+    # the CLI end of it
+    assert tr.main([str(tmp_path), "--jsonl", str(jsonl),
+                    "--json"]) == 0
+    got = json.loads(capsys.readouterr().out)
+    assert got["client_join"]["matched"] == 3
+
+
+def _loadgen_ns(**kw):
+    base = dict(requests=5, rate=100.0, share_frac=0.5, sys_tokens=8,
+                tail_tokens=4, max_new=6, interactive_frac=0.6,
+                ttft_slo_ms=5000.0, timeout_s=60.0, tenants=2,
+                replicas=1, policy="prefix", max_queue=256,
+                model="stub", seed=0, url=None, out="", jsonl="",
+                trace_dir="")
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+def test_loadgen_jsonl_joins_server_rings(tmp_path):
+    """Acceptance e2e (CPU loadgen run): client JSONL + server rings →
+    trace_report matches every completed request and decomposes its
+    TTFT."""
+    slg = _load_tool("serve_loadgen")
+    jsonl = str(tmp_path / "lg.jsonl")
+    rings = str(tmp_path / "rings")
+    rung = asyncio.run(slg.run_loadgen(_loadgen_ns(
+        jsonl=jsonl, trace_dir=rings)))
+    assert rung["completed"] == 5 and rung["jsonl"] == jsonl
+    recs = [json.loads(ln) for ln in open(jsonl)]
+    assert len(recs) == 5
+    assert all(r["request_id"].startswith("lg0-") for r in recs)
+    assert all(r["slo"] in ("interactive", "batch") for r in recs)
+    assert sum(r["outcome"] == "stop" for r in recs) == 5
+    tr = _load_tool("trace_report")
+    docs = tr.load_rings([rings])
+    assert docs, "loadgen wrote no trace rings"
+    s = tr.summarize(docs, client=tr.load_client_jsonl(jsonl))
+    assert s["client_join"]["matched"] == 5
+    for cls in s["classes"].values():
+        c = cls["components"]
+        assert c["ttft_ms"]["n"] == cls["requests"]
+        # server-side ttft telescopes into the three components
+        assert c["queue_wait_ms"]["n"] == cls["requests"]
+        assert c["prefill_ms"]["n"] == cls["requests"]
+        assert c["first_tick_ms"]["n"] == cls["requests"]
+
+
+@pytest.mark.slow
+def test_trace_retention_rate_sweep(tmp_path):
+    """Sweep (slow tier): a bounded ring under many requests keeps at
+    most ``capacity`` entries, retention stays deterministic (every
+    non-stop outcome retained), and the report still joins."""
+    slg = _load_tool("serve_loadgen")
+    tr = _load_tool("trace_report")
+    for rate in (8.0, 200.0):
+        obs.reset()
+        jsonl = str(tmp_path / f"lg_{rate}.jsonl")
+        rings = str(tmp_path / f"rings_{rate}")
+        rung = asyncio.run(slg.run_loadgen(_loadgen_ns(
+            requests=24, rate=rate, jsonl=jsonl, trace_dir=rings)))
+        docs = tr.load_rings([rings])
+        entries = [e for d in docs for e in d["entries"]]
+        assert len(entries) <= 512
+        # 24 measured + the loadgen's untimed warmup request
+        assert len(entries) == 25
+        for e in entries:
+            if e["outcome"] != "stop":
+                assert e["retained"], e
+            if not e["retained"]:
+                assert not e["events"]
+        s = tr.summarize(docs, client=tr.load_client_jsonl(jsonl))
+        assert s["client_join"]["matched"] == 24
+        assert rung["completed"] + rung["shed"] + rung["timeouts"] \
+            + rung["conn_errors"] == 24

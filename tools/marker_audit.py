@@ -47,6 +47,9 @@ MUST_BE_SLOW = (
     # test_loadgen_inprocess_smoke + the single-shot gateway e2e tests)
     r"test_gateway\.py.*open_loop",
     r"test_gateway\.py.*loadgen_cli",
+    # ISSUE 10: the many-request trace retention/attribution sweep
+    # (tier-1 keeps the single-shot propagation + retention pins)
+    r"test_reqtrace\.py.*sweep",
     # ISSUE 7 sweep: the 4-worker speedup wall-clock bench was tier-1's
     # one pre-policy bench (flipped at 2.56x/3.0 under full-suite load;
     # the rest of test_dataloader_mp.py keeps the correctness coverage)

@@ -327,6 +327,54 @@ def self_check() -> int:
         with open(os.path.join(run, "metrics_supervisor.prom"), "w") as f:
             f.write(sreg.prometheus_text())
 
+        # request-trace ring (ISSUE 10): write one with the library,
+        # re-validate with the same checker trace_report's loader
+        # runs — ring writer and report reader must not drift
+        from paddle_tpu.serving.reqtrace import (RequestTrace,
+                                                 RequestTraceRing,
+                                                 validate_ring_doc)
+        ring = RequestTraceRing(capacity=8, slow_ttft_ms=50.0,
+                                labels={"gateway": "chk",
+                                        "replica": "r0"})
+        slow = RequestTrace("chk-slow", slo="interactive")
+        for t, kind, fields in (
+                (0.0, "accept", {}), (0.1, "queue_enter", {}),
+                (10.0, "queue_leave", {}), (10.1, "slot_take", {}),
+                (40.0, "prefill_done", {}),
+                (80.0, "first_token", {}), (90.0, "finish", {})):
+            slow.ev(kind, t_ms=t, **fields)
+        ring.finish(slow, "stop", tokens=4)
+        fast = RequestTrace("chk-fast", slo="interactive")
+        for t, kind in ((0.0, "accept"), (0.1, "queue_enter"),
+                        (0.5, "slot_take"), (1.0, "prefill_done"),
+                        (2.0, "first_token")):
+            fast.ev(kind, t_ms=t)
+        ring.finish(fast, "stop", tokens=4)
+        shed = RequestTrace("chk-shed", slo="batch")
+        shed.ev("accept", t_ms=0.0)
+        shed.ev("shed", t_ms=0.2)
+        ring.finish(shed, "shed")
+        ring_path = os.path.join(run, "reqtrace_chk_r0.json")
+        ring.dump(ring_path)
+        with open(ring_path) as f:
+            ring_doc = json.load(f)
+        problems = validate_ring_doc(ring_doc)
+        expect(not problems,
+               f"trace-ring schema drift: {problems[:3]}")
+        by_id = {e["request_id"]: e for e in ring_doc["entries"]}
+        expect(by_id["chk-slow"]["retained"]
+               and by_id["chk-slow"]["events"],
+               "slow request's full timeline not retained")
+        expect(not by_id["chk-fast"]["retained"]
+               and not by_id["chk-fast"]["events"],
+               "fast healthy request not tail-dropped")
+        expect(by_id["chk-shed"]["retained"],
+               "shed request not retained")
+        expect(by_id["chk-slow"]["queue_wait_ms"] == 10.0
+               and by_id["chk-slow"]["prefill_ms"] == 29.9
+               and by_id["chk-slow"]["first_tick_ms"] == 40.0,
+               "attribution decomposition wrong")
+
         s = summarize(run)
         expect(s["steps_recorded"] == 5, "step_end events lost")
         expect(s["step_ms"]["p50"] > 0, "p50 not computed")

@@ -50,16 +50,24 @@ def _force_platform():
 
 # ------------------------------------------------------------------ client
 async def sse_generate(host: str, port: int, payload: dict,
-                       timeout_s: float = 120.0):
+                       timeout_s: float = 120.0,
+                       request_id: str = None):
     """One SSE request; returns a per-request record with wire-level
-    TTFT/TPOT timings (measured at the CLIENT, queueing included)."""
+    TTFT/TPOT timings (measured at the CLIENT, queueing included).
+    ``request_id`` (ISSUE 10) is the CLIENT-minted trace id, sent as
+    the ``X-Request-Id`` header the gateway honors — the join key
+    ``tools/trace_report.py`` matches client and server views on."""
     rec = {"status": 0, "tokens": [], "ttft_ms": None, "tpot_ms": None,
-           "finish_reason": None, "retry_after": None}
+           "finish_reason": None, "retry_after": None,
+           "request_id": request_id}
     t0 = time.perf_counter()
     reader, writer = await asyncio.open_connection(host, port)
     try:
         body = json.dumps(payload).encode()
+        rid_hdr = (f"X-Request-Id: {request_id}\r\n"
+                   if request_id else "")
         writer.write((f"POST /v1/generate HTTP/1.1\r\nHost: {host}\r\n"
+                      f"{rid_hdr}"
                       f"Content-Length: {len(body)}\r\n\r\n").encode()
                      + body)
         await writer.drain()
@@ -203,15 +211,20 @@ async def run_loadgen(ns) -> dict:
 
     async def _one(i):
         payload, shared = _payload(i)
+        rid = f"lg{ns.seed}-{i:05d}"     # client-minted trace id
         try:
-            rec = await sse_generate(host, port, payload)
+            rec = await sse_generate(host, port, payload,
+                                     request_id=rid)
         except (ConnectionError, OSError, asyncio.TimeoutError) as e:
             # one dropped connection (external gateway restarting,
             # request timeout) must not discard the whole run's rung
             rec = {"status": 0, "tokens": [], "ttft_ms": None,
                    "tpot_ms": None, "finish_reason": "conn_error",
-                   "retry_after": None, "error": repr(e)[:80]}
+                   "retry_after": None, "request_id": rid,
+                   "error": repr(e)[:80]}
         rec["shared"] = shared
+        rec["tenant"] = payload["tenant"]
+        rec["slo"] = payload["slo"]
         records.append(rec)
 
     t0 = time.perf_counter()
@@ -262,8 +275,38 @@ async def run_loadgen(ns) -> dict:
         router = gw.health()["router"]
         rung["prefix_route_hits"] = router["prefix_route_hits"]
         rung["prefix_route_misses"] = router["prefix_route_misses"]
+    # per-request JSONL (ISSUE 10 satellite): the CLIENT side of the
+    # trace join — request id, tenant, SLO class, wire TTFT/TPOT and
+    # outcome, one line per request, keyed by the X-Request-Id the
+    # server rings recorded
+    jsonl = getattr(ns, "jsonl", None)
+    if jsonl:
+        tmp = jsonl + ".tmp"
+        with open(tmp, "w") as f:
+            for r in sorted(records,
+                            key=lambda r: r.get("request_id") or ""):
+                f.write(json.dumps({
+                    "request_id": r.get("request_id"),
+                    "tenant": r.get("tenant"),
+                    "slo": r.get("slo"),
+                    "status": r.get("status"),
+                    "outcome": r.get("finish_reason"),
+                    "ttft_ms": r.get("ttft_ms"),
+                    "tpot_ms": r.get("tpot_ms"),
+                    "tokens": len(r.get("tokens", ())),
+                    "shared": r.get("shared"),
+                }) + "\n")
+        os.replace(tmp, jsonl)
+        rung["jsonl"] = jsonl
     if gw is not None:
         await gw.drain()
+        # server-side trace rings, dumped AFTER drain (the tick
+        # threads close every in-flight trace before exiting), where
+        # trace_report expects them:
+        #   python tools/trace_report.py TRACE_DIR --jsonl JSONL
+        trace_dir = getattr(ns, "trace_dir", None)
+        if trace_dir:
+            rung["trace_rings"] = gw.dump_traces(trace_dir)
     return rung
 
 
@@ -294,6 +337,12 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=OUT_DEFAULT,
                     help="rung file bench.py auto-ingests "
                          "('' disables the write)")
+    ap.add_argument("--jsonl", default="",
+                    help="per-request JSONL for trace_report's "
+                         "client-side join ('' disables)")
+    ap.add_argument("--trace-dir", default="", dest="trace_dir",
+                    help="dump the gateway's request-trace rings here "
+                         "(self-hosted mode; '' disables)")
     ns = ap.parse_args(argv)
     _force_platform()
     import jax
