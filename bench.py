@@ -563,7 +563,8 @@ def main():
         _ingest_rung(result, probe, "DECODE_PROFILE_r06.json", "paged",
                      "paged_profile",
                      ("paged_tokens_per_sec",
-                      "paged_spec_tokens_per_sec"))
+                      "paged_spec_tokens_per_sec",
+                      "paged_sampled_spec_tokens_per_sec"))
         _ingest_rung(result, probe, "SERVE_LOADGEN_r07.json", "gateway",
                      "gateway_profile",
                      ("gateway_tokens_per_sec", "gateway_p99_ttft_ms"))

@@ -41,6 +41,33 @@ TPU-native design — everything the XLA program sees is STATIC:
   window. Per-request adaptive k (device-resident accept-rate EMA) and
   per-row headroom checks fall individual rows back to the 1-token
   tick without leaving the program.
+- The verify is REJECTION-SAMPLED (ISSUE 11, Leviathan-style): every
+  active row is spec-eligible, not just greedy+penalty-free ones.
+  Greedy rows keep the bitwise longest-argmax-prefix rule; sampled
+  rows accept each drafted token with probability p(token) under their
+  own filtered distribution and resample rejections from the residual
+  (per-row PRNG keys split once per tick, folded per position), so
+  per-request output DISTRIBUTIONS are preserved exactly while
+  repetitive sampled traffic commits multiple tokens per forward;
+  penalized rows compose — the repetition penalty is applied to each
+  verify position over the window's own committed prefix (a
+  sequential in-program scan over the k+1 positions).
+- ``ring_mode`` (ISSUE 11, default on with the fused tick) removes the
+  last per-tick host synchronization: instead of a blocking D2H of
+  (next_token, logprob, done) per dispatch, the tick program appends
+  committed tokens into a device-resident RING BUFFER ([R, ring_len]
+  with per-slot monotone write cursors carried in the tick state), and
+  the host consumes the PREVIOUS dispatch's ring slice at the top of
+  the next ``step()`` — by then the program has had a full host
+  iteration to complete, so the ``jax.device_get`` finds the data
+  ready (double-buffered, non-blocking D2H) and dispatches issue
+  back-to-back. Stream writes, stop matching, finishes and trace
+  events are driven off drained ring entries, one step behind the
+  device; every slot transition (admit / finish / chunk / preempt /
+  cancel / expire / block growth) drains fully first, so the host
+  mirrors a transition reads are never stale. ``ring_mode=False``
+  keeps the synchronous per-tick readback as the bit-exactness
+  reference — drained streams are pinned BITWISE identical to it.
 
 Padded prompt positions scatter into a reserved GARBAGE block (physical
 block 0) so they can never corrupt a live block; it is never allocated.
@@ -282,7 +309,9 @@ class PagedEngine:
                  fused_tick: bool = True,
                  ticks_per_dispatch: int = 1,
                  spec_tokens: int = 0,
-                 spec_ngram: int = 2):
+                 spec_ngram: int = 2,
+                 ring_mode: Optional[bool] = None,
+                 ring_len: Optional[int] = None):
         cfg = model.config
         self.model = model
         self.fn, self.params = model.functional()
@@ -448,12 +477,14 @@ class PagedEngine:
         # path's ngram_speculative_generate), verifies all k+1
         # positions in ONE forward through the multi-query paged
         # attention, and commits the per-row accepted length in-program
-        # — still one dispatch and one small D2H per tick. Rows fall
-        # back to the 1-token tick per-request (inside the same
-        # program) when greedy-ineligible (sampled / penalized), when
-        # block headroom is missing, or when their accept-rate EMA
-        # collapses. Takes precedence over ticks_per_dispatch scanning:
-        # a spec tick is already a multi-token dispatch.
+        # — still one dispatch per tick. EVERY active row is eligible
+        # (ISSUE 11: greedy rows accept by argmax prefix, sampled rows
+        # by the rejection rule, penalized rows via the per-position
+        # penalty scan); a row falls back to the 1-token tick
+        # per-request (inside the same program) when block headroom is
+        # missing or its accept-rate EMA collapses. Takes precedence
+        # over ticks_per_dispatch scanning: a spec tick is already a
+        # multi-token dispatch.
         self._spec_k = int(spec_tokens)
         self._spec_ngram = int(spec_ngram)
         if self._spec_k:
@@ -473,6 +504,35 @@ class PagedEngine:
             self._tick_spec_greedy_jit = jax.jit(
                 functools.partial(self._fused_tick_spec, greedy=True),
                 donate_argnums=(1, 2))
+        # --- async token ring (ISSUE 11 tentpole) ---------------------
+        # ring_mode=True (the default whenever the tick is fused): the
+        # tick program appends committed (token, logprob) pairs into a
+        # device-resident ring carried in the tick state; the host
+        # consumes the PREVIOUS dispatch's slice at the top of the next
+        # step() instead of blocking on a per-dispatch readback.
+        # ring_mode=False keeps the synchronous readback (the bit-
+        # exactness reference). The ring must hold every entry one
+        # dispatch can commit with double-buffer slack, so its length
+        # is floored at twice the largest per-dispatch advance
+        # (scan K ticks, or the spec window k+1).
+        self._ring = bool(fused_tick) if ring_mode is None \
+            else bool(ring_mode)
+        if self._ring and not self._fused:
+            raise ValueError(
+                "ring_mode requires fused_tick=True: the ring is "
+                "carried in the fused tick's device state")
+        maxadv = max(self._ticks_per_dispatch, self._spec_k + 1)
+        self._ring_len = max(16, 2 * maxadv) if ring_len is None \
+            else max(int(ring_len), 2 * maxadv)
+        self._pending: Optional[Dict[str, Any]] = None  # outstanding tick
+        self._drained = np.zeros((self.R,), np.int64)   # consumed cursors
+        # readback instrumentation for the amortization contract:
+        # d2h_syncs counts BLOCKING readbacks (one per sync-mode tick;
+        # in ring mode only drains that actually had to wait),
+        # ring_drains counts pipelined ring consumptions
+        self.d2h_syncs = 0
+        self.ring_drains = 0
+        self.ring_blocking_drains = 0
 
     @property
     def stats(self) -> Dict[str, int]:
@@ -543,6 +603,18 @@ class PagedEngine:
         new_st.update(lens=st["lens"] + acti,
                       last=jnp.where(act, nxt, st["last"]),
                       keys=new_keys, rem=rem, active=act & ~done)
+        if "ring" in st:
+            # async token ring (ISSUE 11): append this tick's committed
+            # token into each active row's ring slot (write cursor mod
+            # ring length); inactive rows keep their current entry
+            r = jnp.arange(self.R)
+            idx = st["wcur"] % st["ring"].shape[1]
+            new_st.update(
+                ring=st["ring"].at[r, idx].set(
+                    jnp.where(act, nxt, st["ring"][r, idx])),
+                rlps=st["rlps"].at[r, idx].set(
+                    jnp.where(act, lps, st["rlps"][r, idx])),
+                wcur=st["wcur"] + acti)
         return (nxt, lps, done, seen,
                 [(c.kp, c.vp) for c in new_caches], new_st)
 
@@ -608,31 +680,50 @@ class PagedEngine:
 
     def _fused_tick_spec(self, params, pools, seen, st, *, greedy: bool):
         """ONE compiled program for a speculative multi-token tick
-        (ISSUE 7): per-row prompt-lookup drafts -> one k+1-position
-        verify forward through the multi-query paged attention -> the
-        shared longest-matched-prefix accept -> in-program commit of
-        the per-row accepted length (seq lens, committed-stream buffer,
-        budgets, done flags, adaptive-k EMA all advance on device).
+        (ISSUE 7, rejection-sampled verify ISSUE 11): per-row
+        prompt-lookup drafts -> one k+1-position verify forward through
+        the multi-query paged attention -> a sequential in-program
+        accept scan over the window -> commit of the per-row accepted
+        length (seq lens, committed-stream buffer, budgets, done flags,
+        adaptive-k EMA, token ring all advance on device).
 
         Per-row fallback, not per-batch: a row drafts 0..k tokens
-        (``kprop``) depending on greedy eligibility, its write headroom
-        (allocated blocks, read off the table — unallocated entries are
-        the garbage block id 0), its remaining budget, and its accept
-        EMA; kprop=0 rows ARE the plain 1-token tick inside the same
-        program, so mixed spec/non-spec batches stay one dispatch.
+        (``kprop``) depending on its write headroom (allocated blocks,
+        read off the table — unallocated entries are the garbage block
+        id 0), its remaining budget, and its accept EMA; kprop=0 rows
+        ARE the plain 1-token tick inside the same program, so mixed
+        spec/non-spec batches stay one dispatch.
 
-        Exactness: position 0 reproduces the plain tick bit-for-bit
-        (same penalty + sampler/argmax on the same logits; mixed ticks
-        split every row's key once, exactly like `_fused_tick`). Drafts
-        only ever land when they EQUAL the verify argmax, and spec
-        eligibility requires repetition_penalty == 1.0 — the penalty is
-        a per-row no-op then, so the vectorized verify needs no
-        in-window seen evolution. Rejected drafts' K/V and buffer
-        writes sit beyond the committed cursor and are overwritten
-        before they become readable (the batch path's rewind-free
-        trick)."""
-        from .prompt_lookup import accept_length, propose_ngram_rows
-        from .sampling import repetition_penalty_rows, sample_token_rows
+        The accept scan walks the k+1 window positions sequentially
+        (T is small and each step is O(R*V) elementwise work):
+
+        - position j's logits get the repetition penalty over ``seen``
+          AS OF position j — the window's own earlier commits included
+          — so penalized rows compose exactly (bitwise vs their
+          spec-off sequential ticks when greedy);
+        - greedy rows accept draft_j iff it equals the penalized
+          argmax (the ISSUE-7 longest-prefix rule, bitwise-pinned);
+        - sampled rows run the Leviathan residual rule
+          (``sampling.residual_resample_rows``): accept draft_j with
+          probability p_j(draft_j) under the row's filtered
+          distribution, else emit a residual resample — every
+          position's marginal equals the plain tick's, so per-request
+          DISTRIBUTIONS are preserved (not bitwise streams: the PRNG
+          consumption pattern differs from 1-token ticks by design).
+          Mixed ticks split every row's key once (the same per-tick
+          carry rate as `_fused_tick`) and fold the tick subkey per
+          position;
+        - a row stays alive past j only if it accepted a real draft
+          there; the first rejection's emitted token IS the
+          correction (or the bonus at position k after a full
+          accept); eos and budget truncate inside the scan.
+
+        Rejected drafts' K/V and buffer writes sit beyond the
+        committed cursor and are overwritten before they become
+        readable (the batch path's rewind-free trick)."""
+        from .prompt_lookup import mask_drafts, propose_ngram_rows
+        from .sampling import (fold_in_rows, repetition_penalty_rows,
+                               residual_resample_rows, split_key_rows)
         k = self._spec_k
         T = k + 1
         lens, active, temps = st["lens"], st["active"], st["temps"]
@@ -644,15 +735,13 @@ class PagedEngine:
         probe = (st["tickc"] % _SPEC_PROBE_EVERY) == 0
         want = jnp.where(st["ema"] >= _SPEC_EMA_FLOOR, k,
                          jnp.where(probe, 1, 0))
-        eligible = active & (temps <= 0.0) & (st["reps"] == 1.0)
         kprop = jnp.where(
-            eligible,
+            active,
             jnp.clip(jnp.minimum(jnp.minimum(want, capw - 1), rem - 1),
                      0, k), 0)
         drafts = propose_ngram_rows(st["toks"], C, k, self._spec_ngram,
                                     fill=-1)
-        drafts = jnp.where(jnp.arange(k)[None, :] < kprop[:, None],
-                           drafts, -1)        # -1 never matches/commits
+        drafts = mask_drafts(drafts, kprop)   # -1 never matches/commits
         ids = jnp.concatenate([st["last"][:, None],
                                jnp.maximum(drafts, 0)], axis=1)
         positions = lens[:, None] + jnp.arange(T)[None, :]
@@ -661,46 +750,58 @@ class PagedEngine:
                                      positions=positions,
                                      paged_decode=True)
         logits = logits.astype(jnp.float32)
-        # position 0 == the plain tick, bit-for-bit (penalty + sampler)
-        raw0 = repetition_penalty_rows(logits[:, 0], seen, st["reps"])
         if greedy:
-            g0 = jnp.argmax(raw0, axis=-1).astype(jnp.int32)
-            lp0 = jnp.take_along_axis(jax.nn.log_softmax(raw0, axis=-1),
-                                      g0[:, None], axis=-1)[:, 0]
-            new_keys = st["keys"]
+            new_keys = subs = st["keys"]
         else:
-            g0, lp0, new_keys = sample_token_rows(raw0, st["keys"],
-                                                  temps, st["tks"],
-                                                  st["tps"])
-        # verify positions 1..k: pure argmax (spec rows are penalty-free)
-        g_rest = jnp.argmax(logits[:, 1:], axis=-1).astype(jnp.int32)
-        lp_rest = jnp.take_along_axis(
-            jax.nn.log_softmax(logits[:, 1:], axis=-1),
-            g_rest[..., None], axis=-1)[..., 0]
-        G = jnp.concatenate([g0[:, None], g_rest], axis=1)    # [R, T]
-        LP = jnp.concatenate([lp0[:, None], lp_rest], axis=1)
-        # accept: longest draft==target prefix + the correction/bonus,
-        # truncated by budget and a window-interior eos
-        m = accept_length(drafts, G)
-        n_acc = jnp.minimum(m + 1, jnp.maximum(rem, 1))
-        is_eos = (st["eos"][:, None] >= 0) & (G == st["eos"][:, None])
-        hit = is_eos & (jnp.arange(T)[None, :] < n_acc[:, None])
-        eos_hit = jnp.any(hit, axis=1)
-        n_acc = jnp.where(eos_hit, jnp.argmax(hit, axis=1) + 1, n_acc)
-        n_eff = jnp.where(active, n_acc, 0)
-        done = active & (eos_hit | (rem - n_eff <= 0))
-        # commit: seen mask (emitted tokens only), committed-stream
-        # buffer (all T candidates — positions past n_acc sit beyond
-        # the committed cursor, never matched, overwritten next tick),
-        # cursor/budget/last/EMA advance
+            new_keys, subs = split_key_rows(st["keys"])
         r_idx = jnp.arange(self.R)
-        acc_win = jnp.arange(T)[None, :] < n_eff[:, None]
-        seen = seen.at[r_idx[:, None], G].max(acc_win)
+        # draft column j for traced j (the scan's bonus position k
+        # reads the appended -1 column: no draft, plain emit)
+        drafts_ext = jnp.concatenate(
+            [drafts, jnp.full((self.R, 1), -1, drafts.dtype)], axis=1)
+
+        def pos_step(carry, j):
+            seen_c, alive, nem, macc, eos_hit = carry
+            raw_j = repetition_penalty_rows(logits[:, j], seen_c,
+                                            st["reps"])
+            d_j = drafts_ext[:, j]
+            if greedy:
+                tok = jnp.argmax(raw_j, axis=-1).astype(jnp.int32)
+                acc = (d_j >= 0) & (tok == d_j)
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(raw_j, axis=-1),
+                    tok[:, None], axis=-1)[:, 0]
+            else:
+                tok, acc, lp = residual_resample_rows(
+                    raw_j, d_j, fold_in_rows(subs, j), temps,
+                    st["tks"], st["tps"])
+            emit = alive
+            seen_c = seen_c.at[r_idx, tok].max(emit)
+            nem = nem + emit.astype(jnp.int32)
+            macc = macc + (emit & acc).astype(jnp.int32)
+            is_eos = (st["eos"] >= 0) & (tok == st["eos"])
+            eos_hit = eos_hit | (emit & is_eos)
+            alive = emit & acc & ~is_eos & (nem < rem)
+            return (seen_c, alive, nem, macc, eos_hit), (tok, lp)
+
+        carry0 = (seen, active, jnp.zeros((self.R,), jnp.int32),
+                  jnp.zeros((self.R,), jnp.int32),
+                  jnp.zeros((self.R,), bool))
+        (seen, _, nem, m, eos_hit), (Yt, LPt) = jax.lax.scan(
+            pos_step, carry0, jnp.arange(T))
+        G = jnp.swapaxes(Yt, 0, 1)                            # [R, T]
+        LP = jnp.swapaxes(LPt, 0, 1)
+        n_eff = jnp.where(active, nem, 0)
+        done = active & (eos_hit | (rem - n_eff <= 0))
+        # commit: committed-stream buffer takes all T candidates —
+        # positions past n_eff sit beyond the committed cursor, are
+        # never matched, and are overwritten next tick
         toks = st["toks"].at[r_idx[:, None],
                              C[:, None] + jnp.arange(T)[None, :]].set(G)
         last = jnp.where(
             active,
-            jnp.take_along_axis(G, (n_acc - 1)[:, None], axis=1)[:, 0],
+            jnp.take_along_axis(
+                G, jnp.maximum(n_eff - 1, 0)[:, None], axis=1)[:, 0],
             st["last"])
         ema = jnp.where(
             kprop > 0,
@@ -713,6 +814,23 @@ class PagedEngine:
                       rem=rem - n_eff, active=active & ~done,
                       toks=toks, ema=ema,
                       tickc=st["tickc"] + active.astype(jnp.int32))
+        if "ring" in st:
+            # ring append of the emitted window (ISSUE 11): entries
+            # wcur..wcur+n_eff-1 mod ring_len; non-emitted positions
+            # keep the current ring contents. T <= ring_len/2, so the
+            # window's indices never collide within a row.
+            Lr = st["ring"].shape[1]
+            idx = (st["wcur"][:, None] + jnp.arange(T)[None, :]) % Lr
+            emit_win = jnp.arange(T)[None, :] < n_eff[:, None]
+            new_st.update(
+                ring=st["ring"].at[r_idx[:, None], idx].set(
+                    jnp.where(emit_win, G, st["ring"][r_idx[:, None],
+                                                      idx])),
+                rlps=st["rlps"].at[r_idx[:, None], idx].set(
+                    jnp.where(emit_win, LP, st["rlps"][r_idx[:, None],
+                                                       idx])),
+                wcur=st["wcur"] + n_eff,
+                kprop_last=kprop, macc_last=m)
         return (G, LP, n_eff, kprop, m, done, seen,
                 [(c.kp, c.vp) for c in new_caches], new_st)
 
@@ -780,6 +898,23 @@ class PagedEngine:
                 ema[i] = s.spec_ema
             self._dev.update(toks=jnp.asarray(tk), ema=jnp.asarray(ema),
                              tickc=jnp.zeros((self.R,), jnp.int32))
+        if self._ring:
+            # async token ring (ISSUE 11): rebuilt empty on every
+            # refresh — a refresh only ever runs with the ring fully
+            # drained (every transition drains first), so resetting
+            # the write cursors cannot lose entries
+            self._dev.update(
+                ring=jnp.zeros((self.R, self._ring_len), jnp.int32),
+                rlps=jnp.zeros((self.R, self._ring_len), jnp.float32),
+                wcur=jnp.zeros((self.R,), jnp.int32))
+            if self._spec_k:
+                # per-dispatch proposer stats ride the state so the
+                # drain can count spec_proposed/accepted without a
+                # second readback
+                self._dev.update(
+                    kprop_last=jnp.zeros((self.R,), jnp.int32),
+                    macc_last=jnp.zeros((self.R,), jnp.int32))
+            self._drained[:] = 0
         self._dev_dirty = False
 
     def _prefill(self, params, pools, table_row, ids, length, key,
@@ -1345,7 +1480,8 @@ class PagedEngine:
         """Abort queued and running requests whose deadline passed (the
         per-request timeout contract: checked once per scheduler tick —
         a jitted call is never interrupted mid-flight)."""
-        now = time.monotonic()
+        self._drain_pending()   # ring mode: never abort against a
+        now = time.monotonic()  # stale mirror / in-flight dispatch
         for req in [r for r in self.queue
                     if r.deadline is not None and now > r.deadline]:
             self.queue.remove(req)
@@ -1360,6 +1496,10 @@ class PagedEngine:
         """Abort a queued or running request (client disconnect). Its
         blocks/slot free immediately; no result is recorded. Returns
         False if the request is unknown or already finished."""
+        self._drain_pending()   # ring mode: a cancel racing an
+        # in-flight dispatch consumes its undrained entries first, so
+        # the release below cannot orphan ring tokens or free blocks
+        # the in-flight program still writes
         for req in self.queue:
             if req.request_id == request_id:
                 self.queue.remove(req)
@@ -1444,6 +1584,11 @@ class PagedEngine:
                        for r in list(self.queue)[:max_digests]],
             "spec": {"enabled": bool(self._spec_k), "k": self._spec_k,
                      "ngram": self._spec_ngram if self._spec_k else 0},
+            "ring": {"enabled": self._ring, "ring_len": self._ring_len,
+                     "outstanding": self._pending is not None,
+                     "drains": self.ring_drains,
+                     "blocking_drains": self.ring_blocking_drains,
+                     "d2h_syncs": self.d2h_syncs},
         }
 
     def close(self, drain: bool = True):
@@ -1454,6 +1599,7 @@ class PagedEngine:
         if drain:
             self.run()
             return
+        self._drain_pending()
         for req in list(self.queue):
             self.queue.remove(req)
             self._abort(req, "cancelled")
@@ -1462,10 +1608,13 @@ class PagedEngine:
                 self._abort(self.slots[i], "cancelled", slot_id=i)
 
     def step(self):
-        """One scheduler tick: expire overdue requests, admit EVERY
-        queued request that fits (slots + blocks), advance one prefill
-        chunk per prefilling slot, then one decode for all
-        prefill-complete slots."""
+        """One scheduler tick: drain the previous ring dispatch (ring
+        mode — its tokens land here, one step behind the device),
+        expire overdue requests, admit EVERY queued request that fits
+        (slots + blocks), advance one prefill chunk per prefilling
+        slot, then one decode for all prefill-complete slots (ring
+        mode dispatches WITHOUT a readback and returns)."""
+        self._drain_pending()
         self._expire()
         while self._try_admit():
             pass
@@ -1498,6 +1647,114 @@ class PagedEngine:
             return self._decode_fused(active, scan=scan)
         return self._decode_host(active)
 
+    def _drain_pending(self):
+        """Consume the outstanding ring dispatch (ring mode): fetch the
+        ring entries committed since the last drain and run the host
+        bookkeeping the sync path did inline — token/logprob appends,
+        stop matching (a stop completing from a DRAINED token finishes
+        the request; tokens the device committed past it die with the
+        slot release), device finish flags, spec counters/EMA mirrors,
+        trace events. Called at the top of every step() and by every
+        out-of-band mutation path (cancel / close / submit-side
+        expiry), so slot transitions never run against a stale mirror.
+        No-op when nothing is outstanding.
+
+        The D2H here is the double-buffered read: the dispatch being
+        drained was issued one host iteration ago (dispatches N and
+        N+1 bracket it), so on hardware the transfer overlaps the
+        in-flight program and the wait is ~zero — instrumented via
+        ``ring_blocking_drains`` (drains whose arrays were not yet
+        ready) against ``ring_drains`` (all of them)."""
+        p = self._pending
+        if p is None:
+            return
+        self._pending = None
+        st = self._dev
+        arrs = [st["ring"], st["rlps"], st["wcur"], st["active"]]
+        spec = self._spec_k > 0
+        if spec:
+            arrs += [st["kprop_last"], st["macc_last"]]
+        self.ring_drains += 1
+        try:
+            if not all(a.is_ready() for a in arrs):
+                self.ring_blocking_drains += 1
+                self.d2h_syncs += 1
+        except AttributeError:      # backend without is_ready probes
+            pass
+        t0 = time.perf_counter()
+        vals = jax.device_get(arrs)
+        # ring mode's decode-step histogram window is the drain wait —
+        # the only host-visible program-bound time left on the path
+        self._h_decode.observe((time.perf_counter() - t0) * 1e3)
+        ring, rlps, wcur, act_now = vals[:4]
+        kprop = macc = None
+        if spec:
+            kprop, macc = vals[4], vals[5]
+            prop = int(kprop[p["rows"]].sum())
+            if prop:
+                self._count("spec_proposed", prop)
+                acc = int(macc[p["rows"]].sum())
+                if acc:
+                    self._count("spec_accepted", acc)
+        Lr = self._ring_len
+        lag = self.dispatch_count - p["seq"] + 1   # dispatches until drain
+        sink = self.trace_sink
+        for i in p["rows"]:
+            slot = self.slots[i]
+            base = int(self._drained[i])
+            n_new = int(wcur[i]) - base
+            self._drained[i] = int(wcur[i])
+            if slot is None:        # released out-of-band since dispatch
+                continue
+            if spec:
+                self._h_tpf.observe(n_new)
+                if kprop[i]:
+                    # host mirror of the device EMA (same update; the
+                    # authority switch happens at the next refresh)
+                    slot.spec_ema = (
+                        (1.0 - _SPEC_EMA_ALPHA) * slot.spec_ema
+                        + _SPEC_EMA_ALPHA
+                        * (float(macc[i]) / float(kprop[i])))
+            appended, finished = self._consume_row(
+                i, ((ring[i, (base + j) % Lr],
+                     rlps[i, (base + j) % Lr], False)
+                    for j in range(n_new)))
+            if sink is not None:
+                ev = dict(n=appended, ring_lag=lag)
+                if spec:
+                    ev.update(proposed=int(kprop[i]),
+                              accepted=int(macc[i]))
+                sink(slot.request_id, "tick", **ev)
+            if finished or not bool(act_now[i]):
+                # host stop, or the device finish flag (eos/budget)
+                self._finish(i)
+
+    def _consume_row(self, i, entries):
+        """Shared per-row commit bookkeeping for every readback flavor
+        (sync tick/scan loop, sync spec window, ring drain): append
+        each ``(token, logprob, device_done)`` entry onto the slot —
+        stop check FIRST so a stop completing on the final budgeted
+        (or eos) token still records its trim length — and stop
+        consuming at a host stop or an entry's device done flag.
+        Tokens past the cut die with the slot release (the
+        scan/spec/ring over-commit contract). Returns
+        ``(appended, finished)``; the CALLER emits its trace event and
+        then finishes, keeping the tick -> engine_finish event order
+        the reqtrace pins rely on."""
+        slot = self.slots[i]
+        appended = 0
+        finished = False
+        for tok, lp, dflag in entries:
+            self._count("active_slot_steps")
+            self.seq_lens[i] += 1   # device advanced its copy too
+            slot.tokens.append(int(tok))
+            slot.lps.append(float(lp))
+            appended += 1
+            if self._stop_hit(slot) or dflag:
+                finished = True
+                break
+        return appended, finished
+
     def _up(self, x):
         """Host-mirror upload on the per-tick host path (counted so the
         fused path's zero-upload steady state is testable)."""
@@ -1516,6 +1773,7 @@ class PagedEngine:
         act_mask = np.zeros((self.R,), bool)
         act_mask[active] = True
         self.dispatch_count += 1
+        self.d2h_syncs += 1
         if np.all(self.temps[active] <= 0.0):
             # all-greedy tick: the argmax-only executable
             nxt, lps, self.seen, self.pools = self._decode_greedy_jit(
@@ -1581,6 +1839,18 @@ class PagedEngine:
             self.params, self.pools, self.seen, self._dev)
         if not greedy:
             self._dev_keys_dirty = True
+        if self._ring:
+            # async ring (ISSUE 11): NO readback — the program's
+            # committed tokens land in the device ring; the next
+            # step()'s drain consumes them while this program runs.
+            # Host bookkeeping (appends, stops, finishes, traces)
+            # happens there, one step behind the device.
+            self._pending = dict(rows=list(active),
+                                 seq=self.dispatch_count)
+            self._count("decode_steps", K)
+            self._count("slot_steps", self.R * K)
+            return True
+        self.d2h_syncs += 1
         nxt, lps, done = jax.device_get((nxt, lps, done))
         if not scan:                     # [R] -> [1, R]: one tick loop
             nxt, lps, done = nxt[None], lps[None], done[None]
@@ -1590,21 +1860,12 @@ class PagedEngine:
         sink = self.trace_sink
         for i in active:
             slot = self.slots[i]
-            appended = 0
-            finished = False
-            for k in range(K):
-                self._count("active_slot_steps")
-                self.seq_lens[i] += 1   # device advanced its copy too
-                slot.tokens.append(int(nxt[k, i]))
-                slot.lps.append(float(lps[k, i]))
-                appended += 1
-                # stop check FIRST so a stop completing on the final
-                # budgeted (or eos) token still records its trim length;
-                # scan ticks past a row's done flag are garbage the
-                # break never reads (the device active mask froze them)
-                if self._stop_hit(slot) or bool(done[k, i]):
-                    finished = True
-                    break
+            # scan ticks past a row's done flag are garbage the
+            # consume cut never reads (the device active mask froze
+            # them)
+            appended, finished = self._consume_row(
+                i, ((nxt[k, i], lps[k, i], bool(done[k, i]))
+                    for k in range(K)))
             if sink is not None:
                 sink(slot.request_id, "tick", n=appended)
             if finished:
@@ -1612,17 +1873,17 @@ class PagedEngine:
         return True
 
     def _spec_headroom(self, active):
-        """Best-effort block preallocation so spec-eligible rows can
-        write k+1 tokens this tick. Never preempts and keeps a
-        one-block-per-active-row reserve — a row that cannot get
-        headroom simply drafts less (or nothing): the device caps its
-        kprop by the write capacity read off the block table, which IS
-        the clean per-row 1-token fallback. Collapsed-EMA rows only
-        reserve probe headroom (one draft) instead of k."""
+        """Best-effort block preallocation so spec-eligible rows — ALL
+        active rows since the rejection-sampled verify (ISSUE 11);
+        sampled and penalized rows draft too — can write k+1 tokens
+        this tick. Never preempts and keeps a one-block-per-active-row
+        reserve; a row that cannot get headroom simply drafts less (or
+        nothing): the device caps its kprop by the write capacity read
+        off the block table, which IS the clean per-row 1-token
+        fallback. Collapsed-EMA rows only reserve probe headroom (one
+        draft) instead of k."""
         for i in active:
             s = self.slots[i]
-            if self.temps[i] > 0.0 or s.rep != 1.0:
-                continue
             if s.max_new - len(s.tokens) < 2:
                 continue
             k_want = self._spec_k if s.spec_ema >= _SPEC_EMA_FLOOR else 1
@@ -1654,6 +1915,16 @@ class PagedEngine:
          self._dev) = fn(self.params, self.pools, self.seen, self._dev)
         if not greedy:
             self._dev_keys_dirty = True
+        if self._ring:
+            # async ring (ISSUE 11): the accepted window rides the
+            # device ring; next step()'s drain appends it (spec
+            # counters/EMA from the kprop_last/macc_last state slots)
+            self._pending = dict(rows=list(active),
+                                 seq=self.dispatch_count)
+            self._count("decode_steps")
+            self._count("slot_steps", self.R)
+            return True
+        self.d2h_syncs += 1
         nxt, lps, nacc, kprop, macc, done = jax.device_get(
             (nxt, lps, nacc, kprop, macc, done))
         self._h_decode.observe((time.perf_counter() - t_decode) * 1e3)
@@ -1676,53 +1947,44 @@ class PagedEngine:
                 slot.spec_ema = ((1.0 - _SPEC_EMA_ALPHA) * slot.spec_ema
                                  + _SPEC_EMA_ALPHA
                                  * (float(macc[i]) / float(kprop[i])))
-            finished = False
-            appended = 0
-            for j in range(n):
-                self._count("active_slot_steps")
-                self.seq_lens[i] += 1   # device advanced its copy too
-                slot.tokens.append(int(nxt[i, j]))
-                slot.lps.append(float(lps[i, j]))
-                appended += 1
-                # stop check FIRST: a stop completing on the final
-                # budgeted (or eos) token must still record its trim
-                if self._stop_hit(slot):
-                    finished = True
-                    break
+            appended, finished = self._consume_row(
+                i, ((nxt[i, j], lps[i, j], False) for j in range(n)))
             if sink is not None:
                 sink(slot.request_id, "tick", n=appended,
                      proposed=int(kprop[i]), accepted=int(macc[i]))
-            if finished:
-                self._finish(i)
-            elif bool(done[i]):
+            if finished or bool(done[i]):
+                # host stop, or the device finish flag (eos/budget)
                 self._finish(i)
         return True
 
     def _scan_ticks(self, active) -> bool:
         """True when the next ``ticks_per_dispatch`` ticks may run inside
-        one compiled program with NO observable difference from K
-        single ticks. Conservative by construction — any condition a
+        one compiled program with NO stream-observable difference from
+        K single ticks. Conservative by construction — any condition a
         single tick would re-evaluate between tokens falls back to K=1:
 
         - an empty queue (a scan must not delay an admission a
           single-tick schedule would have made after token 1);
         - every occupied slot decode-active (no mid-chunk prefill
           interleaving, which runs between ticks);
-        - no stop sequences or deadlines on active rows (both are
-          HOST-side per-tick checks; eos/budget termination lives on
-          device and scans fine);
         - block headroom for each row's next min(K, remaining-budget)
           writes, preallocated here. Preallocation failure falls back
           to the single-tick path and its preemption logic rather than
-          preempting for speculative capacity."""
+          preempting for speculative capacity.
+
+        Stop sequences and deadlines no longer disqualify (ISSUE 11
+        widening): eos/budget finishes are in-program flags, a stop
+        completing mid-scan finishes the request at the host loop and
+        the tokens the device committed past it die with the slot
+        release (the speculative tick's contract), and deadline expiry
+        was always a per-step() check — a K-tick program coarsens its
+        granularity exactly like a long prefill chunk does."""
         K = self._ticks_per_dispatch
         for i, s in enumerate(self.slots):
             if s is None:
                 continue
             if i not in active:
                 return False          # occupied but not decode-active
-            if s.stop or s.deadline is not None:
-                return False
         if self.queue:
             return False
         # pre-check the WHOLE speculative demand against what

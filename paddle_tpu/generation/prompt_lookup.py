@@ -18,14 +18,22 @@ positions — harmless, the verify forward guards every proposal. The
 accept step is :func:`accept_length`, the longest-matched-prefix count
 shared by every speculative strategy (the rest of ``_commit`` — the
 token write-back and eos handling — is buffer-layout-specific and stays
-with its caller).
+with its caller). Because the proposer is DETERMINISTIC (the draft
+"distribution" is a one-hot at the copied token), it also feeds the
+rejection-sampled verify (ISSUE 11,
+``sampling.residual_resample_rows``): sampled rows accept a drafted
+token with probability p(token) and resample rejections from the
+residual, so the same drafts serve greedy and sampled consumers.
+:func:`mask_drafts` is the shared per-row gating — positions past a
+row's per-tick draft cap are invalidated to the fill token.
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["propose_ngram", "propose_ngram_rows", "accept_length"]
+__all__ = ["propose_ngram", "propose_ngram_rows", "accept_length",
+           "mask_drafts"]
 
 
 def propose_ngram(seq, n, num_draft: int, ngram: int, fill):
@@ -53,6 +61,19 @@ def propose_ngram_rows(seqs, ns, num_draft: int, ngram: int, fill=-1):
     pinned streams)."""
     return jax.vmap(
         lambda s, n: propose_ngram(s, n, num_draft, ngram, fill))(seqs, ns)
+
+
+def mask_drafts(drafts, kprop, fill=-1):
+    """Invalidate draft positions past each row's per-tick cap:
+    ``drafts`` [R, k], ``kprop`` [R] drafted-position counts ->
+    positions >= kprop become ``fill``. ``fill=-1`` can never equal a
+    real token id, so a gated position is rejected by the greedy
+    accept AND fails the rejection-sampled accept test (the residual
+    then degenerates to a plain sample — the per-row 1-token
+    fallback)."""
+    k = drafts.shape[-1]
+    return jnp.where(jnp.arange(k)[None, :] < kprop[:, None],
+                     drafts, fill)
 
 
 def accept_length(draft, target):

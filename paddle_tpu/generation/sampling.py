@@ -50,28 +50,19 @@ def repetition_penalty(logits, generated_mask, penalty: float):
     return jnp.where(seen, penalized, logits)
 
 
-def sample_token_rows(logits, keys, temperature, top_k, top_p):
-    """Per-ROW sampling for continuous batching: every parameter is an
-    array over rows, so one jitted decode step serves a mixed stream of
-    greedy and sampled requests (reference: PaddleNLP llm predictor's
-    per-request sampling config).
-
-    logits [R, V] (raw); keys [R, 2] uint32 per-row PRNG states;
-    temperature [R] f32 (<= 0 means greedy — BIT-exact argmax of the raw
-    fp32 logits, the same op the all-greedy step used); top_k [R] i32
-    (<= 0 disables); top_p [R] f32 (>= 1 disables). Unlike the static
-    processors above, k and p are traced values: top-k thresholds via
-    take_along_axis on the sorted row, not lax.top_k.
-
-    Returns (tokens [R] i32, logprobs [R] f32, new_keys [R, 2]).
-    Logprobs are of the CHOSEN token under the unfiltered softmax (what
-    serving APIs report), greedy rows included."""
+def filter_logits_rows(logits, temperature, top_k, top_p):
+    """Per-row temperature / top-k / top-p filtering on [R, V] fp32
+    logits with TRACED per-row params (k <= 0 / p >= 1 disable) —
+    the processor half of :func:`sample_token_rows`, factored out so
+    the rejection-sampled speculative verify
+    (:func:`residual_resample_rows`) filters each verify position with
+    EXACTLY the ops the plain sampled tick uses. Returns the filtered
+    logits (kept entries divided by temperature, rest NEG_INF)."""
     raw = logits.astype(jnp.float32)
-    R, V = raw.shape
+    V = raw.shape[-1]
     temperature = jnp.asarray(temperature, jnp.float32)
     top_k = jnp.asarray(top_k, jnp.int32)
     top_p = jnp.asarray(top_p, jnp.float32)
-
     lt = raw / jnp.maximum(temperature, 1e-6)[:, None]
     # per-row top-k: k-th largest value as threshold (k <= 0: keep all)
     sd = jnp.sort(lt, axis=-1)[..., ::-1]
@@ -89,7 +80,28 @@ def sample_token_rows(logits, keys, temperature, top_k, top_p):
     keep_sorted = (cum - probs) < top_p[:, None]   # always keeps argmax
     thresh = jnp.min(jnp.where(keep_sorted, sd2, jnp.inf), axis=-1,
                      keepdims=True)
-    lt = jnp.where((top_p[:, None] < 1.0) & (lt < thresh), NEG_INF, lt)
+    return jnp.where((top_p[:, None] < 1.0) & (lt < thresh), NEG_INF, lt)
+
+
+def sample_token_rows(logits, keys, temperature, top_k, top_p):
+    """Per-ROW sampling for continuous batching: every parameter is an
+    array over rows, so one jitted decode step serves a mixed stream of
+    greedy and sampled requests (reference: PaddleNLP llm predictor's
+    per-request sampling config).
+
+    logits [R, V] (raw); keys [R, 2] uint32 per-row PRNG states;
+    temperature [R] f32 (<= 0 means greedy — BIT-exact argmax of the raw
+    fp32 logits, the same op the all-greedy step used); top_k [R] i32
+    (<= 0 disables); top_p [R] f32 (>= 1 disables). Unlike the static
+    processors above, k and p are traced values: top-k thresholds via
+    take_along_axis on the sorted row, not lax.top_k.
+
+    Returns (tokens [R] i32, logprobs [R] f32, new_keys [R, 2]).
+    Logprobs are of the CHOSEN token under the unfiltered softmax (what
+    serving APIs report), greedy rows included."""
+    raw = logits.astype(jnp.float32)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    lt = filter_logits_rows(raw, temperature, top_k, top_p)
 
     keys = jnp.asarray(keys, jnp.uint32)
     pairs = jax.vmap(lambda k: jax.random.split(
@@ -103,6 +115,89 @@ def sample_token_rows(logits, keys, temperature, top_k, top_p):
                                    tokens[:, None].astype(jnp.int32),
                                    axis=-1)[:, 0]
     return tokens, logprobs, carry
+
+
+def split_key_rows(keys):
+    """Advance [R, 2] uint32 per-row PRNG states one split: returns
+    (carry [R, 2], sub [R, 2]) raw key data. The carry chain is the
+    same one :func:`sample_token_rows` advances — one split per tick —
+    so a rejection-sampled speculative tick consumes the row stream at
+    the same rate as the plain sampled tick."""
+    pairs = jax.vmap(lambda k: jax.random.split(
+        jax.random.wrap_key_data(k, impl="threefry2x32")))(
+        jnp.asarray(keys, jnp.uint32))
+    carry = jax.vmap(jax.random.key_data)(pairs[:, 0])
+    sub = jax.vmap(jax.random.key_data)(pairs[:, 1])
+    return carry, sub
+
+
+def fold_in_rows(keys, j):
+    """fold_in over [R, 2] raw key data: the per-position subkey
+    derivation of the rejection-sampled verify (position j of a tick's
+    sub key)."""
+    return jax.vmap(lambda k: jax.random.key_data(jax.random.fold_in(
+        jax.random.wrap_key_data(k, impl="threefry2x32"), j)))(
+        jnp.asarray(keys, jnp.uint32))
+
+
+def residual_resample_rows(logits, draft, keys, temperature, top_k,
+                           top_p):
+    """ONE verify position of rejection-sampled speculative decoding
+    with a DETERMINISTIC (one-hot) draft distribution, row-batched
+    (Leviathan et al. 2023, specialized: the draft proposes token d
+    with probability 1, so accept happens with prob p(d) and the
+    residual norm(max(0, p - q)) is p with d removed, renormalized).
+
+    logits [R, V] fp32 — the SAME (penalty-applied, unfiltered) logits
+    the plain tick would hand to :func:`sample_token_rows`; draft [R]
+    i32 proposed token ids (< 0 = no draft for this row/position: the
+    accept test always fails and the residual is the full filtered
+    distribution — i.e. a plain sample); keys [R, 2] uint32
+    PER-POSITION subkeys (callers fold the row's tick key by position,
+    :func:`fold_in_rows`); temperature/top_k/top_p as
+    :func:`sample_token_rows`. Rows with temperature <= 0 are greedy:
+    token = argmax(logits), accepted = (token == draft) — exactly the
+    longest-argmax-prefix rule the greedy speculative tick pins
+    bitwise, no RNG consumed.
+
+    Returns (tokens [R] i32, accepted [R] bool, logprobs [R] f32 of
+    the chosen token under the unfiltered softmax of ``logits``).
+
+    Distribution preservation (the reason sampled rows may ride
+    speculative ticks at all): with p the filtered per-row
+    distribution and q = onehot(d),
+    P(emit y) = p(d)·[y==d] + (1-p(d)) · p(y)·[y!=d] / (1-p(d)) = p(y)
+    — every position's marginal equals the plain tick's, whatever the
+    drafter proposed (pinned statistically in tests/test_ring_spec.py).
+    """
+    raw = logits.astype(jnp.float32)
+    R, V = raw.shape
+    temperature = jnp.asarray(temperature, jnp.float32)
+    d = jnp.asarray(draft, jnp.int32)
+    dc = jnp.clip(d, 0, V - 1)
+    has = d >= 0
+    lt = filter_logits_rows(raw, temperature, top_k, top_p)
+    keys = jnp.asarray(keys, jnp.uint32)
+    pairs = jax.vmap(lambda k: jax.random.split(
+        jax.random.wrap_key_data(k, impl="threefry2x32")))(keys)
+    # accept test: u < p(draft) under the FILTERED distribution
+    u = jax.vmap(lambda k: jax.random.uniform(k))(pairs[:, 0])
+    p_d = jnp.take_along_axis(jax.nn.softmax(lt, axis=-1),
+                              dc[:, None], axis=-1)[:, 0]
+    acc_s = has & (u < p_d)
+    # residual: mask the draft token to -inf; categorical renormalizes
+    lt_res = jnp.where((jnp.arange(V)[None, :] == dc[:, None])
+                       & has[:, None], NEG_INF, lt)
+    res = jax.vmap(lambda k, l: jax.random.categorical(k, l))(
+        pairs[:, 1], lt_res)
+    samp = jnp.where(acc_s, dc, res).astype(jnp.int32)
+    g = jnp.argmax(raw, axis=-1).astype(jnp.int32)
+    greedy = temperature <= 0.0
+    tokens = jnp.where(greedy, g, samp)
+    accepted = jnp.where(greedy, has & (g == d), acc_s)
+    logprobs = jnp.take_along_axis(jax.nn.log_softmax(raw, axis=-1),
+                                   tokens[:, None], axis=-1)[:, 0]
+    return tokens, accepted, logprobs
 
 
 def sample_token(logits, key, temperature=1.0, top_k=0, top_p=1.0,

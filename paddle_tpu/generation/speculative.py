@@ -86,16 +86,17 @@ def _mask_tail(tokens, n_end, total, pad):
 def _jit_rows(run, bsz, n_param_args):
     """jit `run` directly at bsz 1; otherwise vmap the per-row loop —
     while_loop batching gives every row its own cursor/cache index and
-    freezes finished rows."""
+    freezes finished rows. Args past the ids (e.g. the sampled path's
+    per-row PRNG keys) are row-mapped alongside them."""
     if bsz == 1:
         return jax.jit(run)
 
     @jax.jit
     def call(*args):
-        ps, ids = args[:n_param_args], args[n_param_args]
+        ps, rows = args[:n_param_args], args[n_param_args:]
         outs, nfwd, n_end = jax.vmap(
-            run, in_axes=(None,) * n_param_args + (0,))(
-                *ps, ids[:, None, :])
+            run, in_axes=(None,) * n_param_args + (0,) * len(rows))(
+                *ps, rows[0][:, None, :], *rows[1:])
         return outs[:, 0], nfwd, n_end
     return call
 
@@ -383,20 +384,37 @@ def ngram_speculative_generate(model, input_ids, max_new_tokens: int = 64,
                                num_draft_tokens: int = 4, ngram: int = 2,
                                eos_token_id: Optional[int] = None,
                                pad_token_id: int = 0, params=None,
-                               return_stats: bool = False):
-    """Greedy decode accelerated by PROMPT-LOOKUP drafting (reference:
-    PaddleNLP llm "inference with reference" speculate_method; Saxena's
-    prompt-lookup decoding): no draft model at all — when the model is
-    copying spans that already appeared (summarization, code edits,
-    RAG), the continuation of the most recent matching ``ngram`` is
-    proposed as the draft and one target forward verifies it.
+                               return_stats: bool = False,
+                               temperature: float = 0.0, top_k: int = 0,
+                               top_p: float = 1.0, key=None):
+    """Greedy OR sampled decode accelerated by PROMPT-LOOKUP drafting
+    (reference: PaddleNLP llm "inference with reference"
+    speculate_method; Saxena's prompt-lookup decoding): no draft model
+    at all — when the model is copying spans that already appeared
+    (summarization, code edits, RAG), the continuation of the most
+    recent matching ``ngram`` is proposed as the draft and one target
+    forward verifies it.
 
     The match scan is a static-shape compare over the token buffer
     (O(L*ngram) integer ops — noise next to a model forward) inside the
     same while_loop as the verify, so the whole decode stays ONE
-    compiled program. Exactness is the verify step's as always: output
-    equals ``generate(..., temperature=0.0)`` row by row, whatever the
-    match rate.
+    compiled program. With ``temperature <= 0`` (the default) exactness
+    is the verify step's as always: output equals
+    ``generate(..., temperature=0.0)`` row by row, whatever the match
+    rate.
+
+    ``temperature > 0`` (ISSUE 11): the verify is REJECTION-SAMPLED via
+    the shared ``sampling.residual_resample_rows`` primitive (the same
+    one the PagedEngine's fused speculative tick commits with) — each
+    drafted position is accepted with probability p(draft) under the
+    row's filtered (temperature/top-k/top-p) distribution and a
+    rejection emits a residual resample, so the OUTPUT DISTRIBUTION
+    equals plain sampled decoding exactly while repetitive streams
+    still commit multiple tokens per forward. A rejected position's
+    emitted token can never equal its draft (the residual excludes it),
+    so the shared ``_commit`` accept-length rule applies verbatim.
+    ``key`` (default PRNGKey(0)) seeds the run; batches split it one
+    sub-stream per row.
     """
     bsz = input_ids.shape[0]
     k = int(num_draft_tokens)
@@ -407,22 +425,41 @@ def ngram_speculative_generate(model, input_ids, max_new_tokens: int = 64,
         raise ValueError("ngram must be >= 1")
     if input_ids.shape[1] + 1 < g:
         raise ValueError(f"prompt too short for ngram={g}")
+    do_sample = temperature > 0.0
     fn, p0 = model.functional()
     t_params = params if params is not None else p0
     prompt_len = input_ids.shape[1]
     total = prompt_len + max_new_tokens
     eos = eos_token_id
+    T = k + 1
 
     cache_key = ("ngram", bsz, prompt_len, max_new_tokens, k, g, eos,
-                 pad_token_id, hash(tuple(p0)))
+                 pad_token_id, hash(tuple(p0)),
+                 (float(temperature), int(top_k), float(top_p))
+                 if do_sample else None)
     per_key = _spec_cache_for(model, model)
 
     def _stats(nfwd, n_end):
         return _spec_stats(nfwd, n_end, total, prompt_len, bsz)
 
+    def _row_keys():
+        kk = key if key is not None else jax.random.PRNGKey(0)
+        try:
+            kd = jax.random.key_data(kk)
+        except (TypeError, AttributeError):
+            kd = kk
+        kd = jnp.asarray(kd, jnp.uint32)
+        if bsz == 1:
+            return kd
+        rows = jax.random.split(
+            jax.random.wrap_key_data(kd, impl="threefry2x32"), bsz)
+        return jax.vmap(jax.random.key_data)(rows)
+
+    call_args = (t_params, input_ids) + ((_row_keys(),) if do_sample
+                                         else ())
     cached = per_key.get(cache_key)
     if cached is not None:
-        out, nfwd, n_end = cached(t_params, input_ids)
+        out, nfwd, n_end = cached(*call_args)
         return (out, _stats(nfwd, n_end)) if return_stats else out
 
     L = total + k + 1
@@ -434,11 +471,47 @@ def ngram_speculative_generate(model, input_ids, max_new_tokens: int = 64,
         from .prompt_lookup import propose_ngram
         return propose_ngram(tokens[0], n, k, g, pad_token_id)
 
-    def run(t_params, input_ids):
+    def _verify_targets(raw, draft, sub):
+        """Per-position verify targets g[T]: the greedy argmax, or the
+        rejection-sampled accept/resample (one call of the shared
+        row primitive over the T positions as its row axis)."""
+        if not do_sample:
+            return jnp.argmax(raw, axis=-1)
+        from .sampling import residual_resample_rows
+        pos_keys = jax.vmap(lambda j: jax.random.key_data(
+            jax.random.fold_in(
+                jax.random.wrap_key_data(sub, impl="threefry2x32"),
+                j)))(jnp.arange(T))
+        # position T-1 is the bonus slot: no draft (-1) = plain sample
+        d_ext = jnp.concatenate(
+            [draft, jnp.full((1,), -1, jnp.int32)]).astype(jnp.int32)
+        toks, _, _ = residual_resample_rows(
+            raw, d_ext, pos_keys,
+            jnp.full((T,), temperature, jnp.float32),
+            jnp.full((T,), top_k, jnp.int32),
+            jnp.full((T,), top_p, jnp.float32))
+        return toks
+
+    def run(t_params, input_ids, *keyrow):
         t_caches = model.init_kv_caches(1, L)
         t_logits, t_caches = fn(t_params, input_ids, kv_caches=t_caches,
                                 cache_index=0)
-        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(input_ids.dtype)
+        raw0 = t_logits[:, -1].astype(jnp.float32)
+        if do_sample:
+            # first token: a draftless position = one plain sample
+            # through the same primitive
+            from .sampling import residual_resample_rows, split_key_rows
+            kcur, sub0 = split_key_rows(keyrow[0][None])
+            kcur = kcur[0]
+            ftok, _, _ = residual_resample_rows(
+                raw0, jnp.full((1,), -1, jnp.int32), sub0,
+                jnp.full((1,), temperature, jnp.float32),
+                jnp.full((1,), top_k, jnp.int32),
+                jnp.full((1,), top_p, jnp.float32))
+            first = ftok.astype(input_ids.dtype)
+        else:
+            kcur = jnp.zeros((2,), jnp.uint32)
+            first = jnp.argmax(raw0, axis=-1).astype(input_ids.dtype)
         tokens = jnp.concatenate(
             [input_ids, jnp.full((1, max_new_tokens + k + 1), pad_token_id,
                                  input_ids.dtype)], axis=1)
@@ -447,29 +520,37 @@ def ngram_speculative_generate(model, input_ids, max_new_tokens: int = 64,
         done0 = jnp.bool_(False) if eos is None else (first[0] == eos)
 
         def body(state):
-            tokens, t_caches, n, done, nfwd = state
+            tokens, t_caches, n, done, nfwd, kcur = state
             draft = propose(tokens, n)
             tokens = jax.lax.dynamic_update_slice(tokens, draft[None],
                                                   (0, n))
             chunk = jax.lax.dynamic_slice(tokens, (0, n - 1), (1, k + 1))
             t_logits, t_caches = fn(t_params, chunk, kv_caches=t_caches,
                                     cache_index=n - 1)
-            gr = jnp.argmax(t_logits[0].astype(jnp.float32), axis=-1) \
+            raw = t_logits[0].astype(jnp.float32)        # [T, V]
+            if do_sample:
+                from .sampling import split_key_rows
+                kcur2, sub = split_key_rows(kcur[None])
+                kcur, sub = kcur2[0], sub[0]
+            else:
+                sub = kcur
+            gr = _verify_targets(raw, draft.astype(jnp.int32), sub) \
                 .astype(tokens.dtype)
             tokens, _, adv, done = _commit(tokens, gr, draft, n, k, eos,
                                            pad_token_id, done)
-            return (tokens, t_caches, n + adv, done, nfwd + 1)
+            return (tokens, t_caches, n + adv, done, nfwd + 1, kcur)
 
         def cond(state):
-            _, _, n, done, _ = state
+            _, _, n, done, _, _ = state
             return (n < total) & ~done
 
-        state = (tokens, t_caches, n0, done0, jnp.int32(1))
-        tokens, _, n_end, _, nfwd = jax.lax.while_loop(cond, body, state)
+        state = (tokens, t_caches, n0, done0, jnp.int32(1), kcur)
+        out = jax.lax.while_loop(cond, body, state)
+        tokens, n_end, nfwd = out[0], out[2], out[4]
         return _mask_tail(tokens, n_end, total, pad_token_id), nfwd, n_end
 
     call = _jit_rows(run, bsz, 1)
 
     per_key[cache_key] = call
-    out, nfwd, n_end = call(t_params, input_ids)
+    out, nfwd, n_end = call(*call_args)
     return (out, _stats(nfwd, n_end)) if return_stats else out

@@ -28,7 +28,16 @@ Architecture (one process, N replicas):
   reordered or shed), then one ``engine.step()`` and a token dispatch
   that mirrors ``PagedEngine.stream()``'s hold-back semantics, so a
   gateway SSE stream is BIT-IDENTICAL to a direct engine stream (a
-  yielded token is never retracted by a stop trim).
+  yielded token is never retracted by a stop trim). Ring-mode engines
+  (ISSUE 11, the default) surface each dispatch's tokens on the NEXT
+  ``step()`` — the tick thread consumes drained ring entries exactly
+  as it consumed the synchronous readback, so the dispatch loop below
+  is readback-architecture agnostic: against a ``ring_mode=False``
+  engine the SSE byte stream is bitwise the pre-ring one, and in ring
+  mode each request's byte stream is identical with token batches
+  landing one tick later (cancels posted to the tick thread drain the
+  in-flight dispatch before releasing the slot — ``/debugz`` shows
+  per-engine ring drain/blocking counters).
 - **Router** — :class:`PrefixAffinityRouter` keyed by
   ``PagedEngine.prefix_digest()`` picks the replica whose prefix cache
   already holds the prompt's shared span (least-loaded fallback,

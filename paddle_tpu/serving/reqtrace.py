@@ -61,6 +61,13 @@ SCHEMA = "reqtrace/1"
 #                           prefill_done, tick, preempt, engine_abort,
 #                           engine_finish
 #   gateway tick thread   : first_token, finish
+# Ring-mode engines (ISSUE 11) add a ``ring_lag`` field on tick
+# events: the dispatch-to-drain distance of the tokens the event
+# reports (1 in steady pipelined state). A tick event's wall time is
+# therefore the DRAIN time — host-visible token timing — not the
+# device commit time, which ran up to ring_lag dispatches earlier;
+# TTFT attribution is consistent because first_token/stream_write
+# share the same drain-side clock (docs/SERVING.md).
 EVENT_KINDS = frozenset({
     "accept", "route", "shed",
     "queue_enter", "queue_leave", "queue_expire",

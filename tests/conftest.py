@@ -155,6 +155,32 @@ _SLOW = (
     "test_kill_mid_run_then_resume_continues_trajectory",
     "test_hang_checkpoints_exits_and_supervisor_finishes",
     "test_nan_window_rolls_back_and_converges",
+    # ISSUE 11 tier-budget pass: the tier-1 suite was within one sweep
+    # of the 870s cap, so the top duration offenders (compile-bound
+    # exhaustive variants, each already in _HEAVY with a named cheaper
+    # tier-1 representative of the same machinery) move to the slow
+    # tier. Representatives staying in tier-1:
+    #   resnet fwd+grad / bottleneck  <- TestResNet::test_feature_pyramid
+    #   deepseek-v2 torch parity      <- TestDeepseekV3::v3_logits_match
+    #   ring-flash composition pair   <- plain ring exactness + flash suite
+    #   clip tower grads              <- TestCLIP::contrastive_roundtrip
+    #   mtp shapes+parity             <- mtp_training_decreases + spec e2e
+    #   dit diffusion loss            <- TestLoopAndLoss flow/ddpm losses
+    #   dataloader worker-info/rng    <- order_matches_serial + exceptions
+    #   vae diffusers roundtrip       <- dit/sd3 pipeline roundtrips
+    # Enforced by tools/marker_audit.py --check (pattern sync) and
+    # --budget-log (per-test wall-clock ceilings).
+    "TestResNet::test_forward_and_grad",
+    "TestResNet::test_bottleneck_variant_d",
+    "TestDeepseekV2Parity::test_logits_match_torch",
+    "TestRingFlash::test_matches_full_attention",
+    "TestRingFlash::test_gradients_flow",
+    "TestCLIP::test_grad_through_both_towers",
+    "TestMTP::test_mtp_shapes_and_main_parity",
+    "TestLoopAndLoss::test_diffusion_loss_with_dit",
+    "test_get_worker_info_and_distribution",
+    "test_worker_init_fn_controls_rng",
+    "test_vae_diffusers_roundtrip",
 )
 
 

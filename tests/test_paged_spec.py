@@ -180,24 +180,33 @@ class TestSpecStreamBitIdentity:
             assert len(r_on["m"]) == n
 
     def test_mixed_spec_sampled_penalized_slots_one_tick(self):
-        """One tick, three slot kinds: a greedy spec row, a seeded
-        sampled row (never drafts; splits its key once per tick exactly
-        like the plain tick), and a repetition-penalized greedy row
-        (penalty makes the verify position-dependent -> 1-token path).
-        Every stream stays bitwise exact."""
+        """One tick, three slot kinds (ISSUE 11 semantics): a greedy
+        spec row (bitwise), a seeded LOW-temperature sampled row
+        (rejection-sampled verify — the distribution is preserved, and
+        on the stub's decisive 8.0-margin logits at T=0.2 every
+        filtered distribution is numerically a point mass, so the
+        stream is deterministically the greedy one: the exact-pin the
+        acceptance criteria name), and a repetition-penalized greedy
+        row (the per-position penalty scan keeps it bitwise WHILE
+        drafting — the old engine fell it back to 1-token ticks).
+        Every stream stays exact."""
         subs = [
             ("spec", _cyc(8), dict(max_new_tokens=24)),
             ("samp", _cyc(5, start=2),
-             dict(max_new_tokens=18, temperature=0.8, top_k=12, seed=3)),
+             dict(max_new_tokens=18, temperature=0.2, top_k=12, seed=3)),
             ("pen", _cyc(6, start=4),
              dict(max_new_tokens=15, repetition_penalty=1.3)),
         ]
-        r_off, lp_off = _drain(_stub_engine(), subs)
+        off = _stub_engine()
+        r_off, lp_off = _drain(off, subs)
         eng = _stub_engine(spec_tokens=4)
         r_on, lp_on = _drain(eng, subs)
         assert r_off == r_on
         assert lp_off == lp_on
         assert eng.stats["spec_accepted"] > 0
+        # the sampled AND penalized rows actually rode the multi-token
+        # path: meaningfully fewer decode dispatches overall
+        assert eng.stats["decode_steps"] < off.stats["decode_steps"]
 
     def test_midstream_submit_bit_identical(self):
         """Continuous batching under spec: a submit landing mid-decode
@@ -289,10 +298,19 @@ class TestSpecStreamBitIdentity:
         ]
         r_off, lp_off = _drain(eng(), subs)
         r_on, lp_on = _drain(eng(spec_tokens=3), subs)
-        assert r_off == r_on
-        for k in lp_off:
-            np.testing.assert_allclose(lp_on[k], lp_off[k],
+        for key in ("a", "b", "c"):      # greedy rows: tokens exact
+            assert r_off[key] == r_on[key]
+            np.testing.assert_allclose(lp_on[key], lp_off[key],
                                        atol=1e-4, rtol=1e-4)
+        # the sampled row rides the rejection-sampled verify (ISSUE
+        # 11): its stream is preserved in DISTRIBUTION, not bitwise
+        # (the PRNG consumption pattern differs from 1-token ticks by
+        # design — the distribution pins live in test_ring_spec.py).
+        # Here: seeded determinism — the same seed through the spec
+        # engine twice is bitwise-identical
+        r_on2, lp_on2 = _drain(eng(spec_tokens=3), subs)
+        assert r_on["d"] == r_on2["d"] and lp_on["d"] == lp_on2["d"]
+        assert len(r_on["d"]) == len(r_off["d"])   # budget honored
 
 
 # ------------------------------------------------------ dispatch contract

@@ -20,6 +20,15 @@ suspicion. It ends with a ``PAGED_JSON`` line that bench.py ingests
 as the ``paged_tokens_per_sec`` rung (before/after captured in the
 same window). Writes DECODE_PROFILE_r06.json.
 
+Round 7 (ISSUE 11): the paged section runs the async token ring
+on/off A/B — ``fused_sync`` (one blocking D2H per dispatch, the r06
+architecture) vs ``fused`` (ring drains, pipelined one dispatch
+behind) with a ``blocking_d2h_per_tick`` column — and §6b sweeps the
+REJECTION-SAMPLED speculative tick on a repetitive sampled stream
+(accept rate, tokens/forward, the
+``paged_sampled_spec_tokens_per_sec`` rung bench.py auto-ingests
+beside the greedy spec rung).
+
 Usage: timeout 2100 python tools/decode_profile.py
 (budget covers ~20 cold generate compiles across base/fused/int8/int4
 plus the attention and paged sections; every subsection banks as it
@@ -218,9 +227,15 @@ def main():
         np.asarray(noop(z))
     floor_ms = (time.perf_counter() - t0) / 100 * 1e3
 
+    # ring on/off A/B (ISSUE 11): "fused_sync" is the r06 architecture
+    # (one BLOCKING D2H per dispatch); "fused" is the async token ring
+    # (drains ride one dispatch behind — blocking_d2h_per_tick shows
+    # the readback amortized away); the scan row composes ring + K=8
+    # (<= 1 drain per 8 ticks).
     paged = {"dispatch_floor_ms": round(floor_ms, 4)}
     rs2 = np.random.RandomState(1)
     for tag, kw in (("host_tick", dict(fused_tick=False)),
+                    ("fused_sync", dict(ring_mode=False)),
                     ("fused", {}),
                     ("fused_scan8", dict(ticks_per_dispatch=8))):
         K = max(1, kw.get("ticks_per_dispatch", 1))
@@ -237,6 +252,7 @@ def main():
             eng.step()
         _, sum0, cnt0 = eng._h_decode.export()
         d0, u0 = eng.dispatch_count, eng.h2d_uploads
+        s0, rd0 = eng.d2h_syncs, eng.ring_drains
         n_steps = max(1, 100 // K)
         t0 = time.perf_counter()
         for _ in range(n_steps):
@@ -257,16 +273,27 @@ def main():
             "dispatches_per_tick": round(
                 (eng.dispatch_count - d0) / (n_steps * K), 2),
             "h2d_uploads_per_tick": round(
-                (eng.h2d_uploads - u0) / (n_steps * K), 2)}
+                (eng.h2d_uploads - u0) / (n_steps * K), 2),
+            # the ISSUE 11 acceptance row: blocking readbacks per tick
+            # (sync modes pay 1/dispatch; ring drains of ready data
+            # count 0 here, ring drains that had to wait count 1)
+            "blocking_d2h_per_tick": round(
+                (eng.d2h_syncs - s0) / (n_steps * K), 3),
+            "ring_drains_per_tick": round(
+                (eng.ring_drains - rd0) / (n_steps * K), 3)}
         report["paged"] = paged
         bank()
     base = paged["host_tick"]["tokens_per_sec"]
-    for tag in ("fused", "fused_scan8"):
+    for tag in ("fused_sync", "fused", "fused_scan8"):
         paged[tag]["speedup_vs_host_tick"] = round(
             paged[tag]["tokens_per_sec"] / max(base, 1e-9), 2)
+    paged["fused"]["speedup_vs_sync"] = round(
+        paged["fused"]["tokens_per_sec"]
+        / max(paged["fused_sync"]["tokens_per_sec"], 1e-9), 2)
     # headline rung for bench.py ingestion: the best architecture wins
     paged["paged_tokens_per_sec"] = max(
-        paged[t]["tokens_per_sec"] for t in ("fused", "fused_scan8"))
+        paged[t]["tokens_per_sec"]
+        for t in ("fused_sync", "fused", "fused_scan8"))
     report["paged"] = paged
     bank()
 
@@ -284,17 +311,18 @@ def main():
         rep_model = LlamaForCausalLM(cfg)
         rep_model.lm_head.weight = rep_model.lm_head.weight * 0.0
 
-        def run_spec(m, new_tok=48, **kw):
+        def run_spec(m, new_tok=48, temperature=0.0, **kw):
             eng = PagedEngine(m, max_slots=8, num_blocks=64,
                               block_size=32, max_blocks_per_seq=8,
                               prefill_buckets=(32,), **kw)
             rs4 = np.random.RandomState(3)
+            samp = dict(temperature=temperature) if temperature else {}
             eng.submit("warm", rs4.randint(1, 255, (1, 8)),
-                       max_new_tokens=2)
+                       max_new_tokens=2, seed=0, **samp)
             eng.run()          # compile untimed
             for i in range(8):
                 eng.submit(i, rs4.randint(1, 255, (1, 8)),
-                           max_new_tokens=new_tok)
+                           max_new_tokens=new_tok, seed=i + 1, **samp)
             # every counter is DELTA'd past the warm-up request, like
             # the _h_decode window — cumulative reads would bias the
             # short spec runs (~6 dispatches) far more than spec-off
@@ -350,6 +378,92 @@ def main():
     except Exception as e:
         spec["error"] = repr(e)[:300]
         report["spec"] = spec
+        bank()
+
+    # --- 6b) SAMPLED speculative ticks (ISSUE 11): the rejection-
+    # sampled verify lets sampled rows ride spec ticks. A decisive
+    # TABLE stub (token t argmaxes to (t+1) % 7 with a 12.0 margin —
+    # the loadgen-style machinery-not-FLOPs trade) makes the sampled
+    # stream repetitive at T=0.7, so accept rates mirror real
+    # copy-heavy sampled traffic; spec-off on the same stream is the
+    # 1.0 tokens/forward baseline. Rung:
+    # paged_sampled_spec_tokens_per_sec (bench.py auto-ingests).
+    sspec = {}
+    try:
+        import jax as _jax
+        from paddle_tpu.generation.paged import (paged_chunk_attention,
+                                                 paged_decode_attention,
+                                                 paged_decode_write,
+                                                 paged_prefill_write)
+
+        class _SampCfg:
+            vocab_size = 128
+            num_hidden_layers = 1
+            num_key_value_heads = 1
+            head_dim = 8
+            dtype = jnp.float32
+
+        class SampStub:
+            config = _SampCfg()
+
+            def functional(self):
+                d, V = 8, 128
+                key = _jax.random.PRNGKey(0)
+                params = dict(
+                    emb=_jax.random.normal(key, (V, d)),
+                    table=_jax.nn.one_hot((jnp.arange(V) + 1) % 7,
+                                          V) * 12.0)
+
+                def fn(params, tokens, kv_caches=None, positions=None,
+                       paged_chunk=False, paged_decode=False):
+                    x = params["emb"][tokens]
+                    kv = x[:, :, None, :]
+                    pk = kv_caches[0]
+                    if tokens.shape[1] == 1 or paged_decode:
+                        pk = paged_decode_write(pk, kv, kv)
+                        o = paged_decode_attention(
+                            x[:, :, None, :], pk)[:, :, 0]
+                    else:
+                        pk = paged_prefill_write(pk, kv, kv)
+                        o = paged_chunk_attention(
+                            x[:, :, None, :], pk, positions)[:, :, 0]
+                    return (params["table"][tokens]
+                            + 0.0 * jnp.sum(o, -1, keepdims=True)), [pk]
+
+                return fn, params
+
+        samp_model = SampStub()
+        sspec["sampled_spec_off"] = run_spec(samp_model,
+                                             temperature=0.7)
+        for k in (2, 4):
+            sspec[f"sampled_spec_k{k}"] = run_spec(
+                samp_model, temperature=0.7, spec_tokens=k)
+            report["sampled_spec"] = sspec
+            bank()
+        sb = sspec["sampled_spec_off"]["tokens_per_sec"]
+        for key in sspec:
+            if key != "sampled_spec_off":
+                sspec[key]["speedup_vs_spec_off"] = round(
+                    sspec[key]["tokens_per_sec"] / max(sb, 1e-9), 2)
+        # the rung + its own baseline and tokens/forward: the stub is
+        # compute-free, so the ABSOLUTE number only means anything
+        # relative to sampled_spec_off on the same stub (on real
+        # models the forward dominates and tokens/forward is the
+        # transferable win — see docs/PERFORMANCE.md)
+        best_k = max((2, 4), key=lambda k: sspec[
+            f"sampled_spec_k{k}"]["tokens_per_sec"])
+        paged["paged_sampled_spec_tokens_per_sec"] = \
+            sspec[f"sampled_spec_k{best_k}"]["tokens_per_sec"]
+        paged["paged_sampled_spec_off_tokens_per_sec"] = sb
+        paged["paged_sampled_spec_tokens_per_forward"] = \
+            sspec[f"sampled_spec_k{best_k}"][
+                "tokens_per_forward_per_slot"]
+        report["sampled_spec"] = sspec
+        report["paged"] = paged
+        bank()
+    except Exception as e:
+        sspec["error"] = repr(e)[:300]
+        report["sampled_spec"] = sspec
         bank()
     # machine-ingestible line (bench.py merges DECODE_PROFILE_r06.json's
     # paged section into its decode rung when the file is present)

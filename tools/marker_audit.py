@@ -15,6 +15,18 @@ and fails if:
 - a pattern matches nothing at all (stale policy entry — the test was
   renamed or deleted and the guard is no longer guarding anything).
 
+``--budget-log LOG`` (ISSUE 11 satellite) additionally parses a pytest
+``--durations=N`` report out of LOG (e.g. the tier-1 verify's tee'd
+output) and fails if any single tier-1 test exceeded its declared
+wall-clock budget: ``DEFAULT_BUDGET_S`` for everything, with explicit
+(pattern, seconds) rows in ``BUDGETS`` for the few known-heavy tests
+that are allowed more. A new test that quietly costs 20s therefore
+fails CI-style review instead of silently eating the cap. Budgets are
+calibrated for the tier-1 verify's normal condition — the suite
+running ALONE on the machine (same as its 870s cap); a log from a run
+that shared the CPU with a bench/profiler inflates durations 2-8x and
+will false-positive.
+
 Run without flags for the marker census only.
 """
 import os
@@ -23,6 +35,45 @@ import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# --- per-test tier-1 wall-clock budgets (seconds) ----------------------
+# Any single `call` duration above its covering budget fails the audit.
+# Keep DEFAULT tight: the suite holds ~740 tests under a 870s cap, so
+# the sustainable average is ~1s/test — 12s outliers need a named row
+# and a reason.
+DEFAULT_BUDGET_S = 12.0
+BUDGETS = (
+    # torch-parity converters pay a one-off HF model build + save
+    (r"test_deepseek_v2\.py", 16.0),
+    (r"test_hf_interop\.py", 16.0),
+    # conv/attention-tower grads are compile-bound on 1 CPU core
+    (r"test_vision_models\.py", 16.0),
+)
+
+
+def _parse_durations(lines):
+    """Yield (seconds, nodeid) from pytest --durations report lines
+    (``  7.96s call     tests/test_x.py::test_y``). Only `call` rows
+    count — setup/teardown are fixture costs shared across tests."""
+    rx = re.compile(r"^\s*(\d+\.\d+)s\s+call\s+(\S+)")
+    for ln in lines:
+        m = rx.match(ln)
+        if m:
+            yield float(m.group(1)), m.group(2)
+
+
+def audit_durations(lines):
+    """Return budget-violation strings for a durations report."""
+    bad = []
+    for secs, node in _parse_durations(lines):
+        budget = DEFAULT_BUDGET_S
+        for pat, cap in BUDGETS:
+            if re.search(pat, node):
+                budget = cap
+                break
+        if secs > budget:
+            bad.append(f"{node}: {secs:.2f}s > budget {budget:.0f}s")
+    return bad
 
 # Patterns (regex, matched against pytest node ids) that must stay OUT
 # of the tier-1 run. Keep in sync with tests/conftest.py's _SLOW list
@@ -54,6 +105,21 @@ MUST_BE_SLOW = (
     # one pre-policy bench (flipped at 2.56x/3.0 under full-suite load;
     # the rest of test_dataloader_mp.py keeps the correctness coverage)
     r"test_dataloader_mp\.py.*speedup",
+    # ISSUE 11: the seeded sampled-spec distribution sweep (~190s of
+    # engine runs; tier-1 keeps the residual-resample marginal unit +
+    # the decisive-logits exact pin), and the ISSUE-11 tier-budget
+    # pass's conftest _SLOW demotions (each names its surviving tier-1
+    # representative in conftest.py)
+    r"test_ring_spec\.py.*distribution_parity_sweep",
+    r"test_vision_models\.py.*(forward_and_grad|bottleneck_variant"
+    r"|grad_through_both_towers)",
+    r"TestDeepseekV2Parity.*logits_match_torch",
+    r"TestMTP::test_mtp_shapes_and_main_parity",
+    r"TestRingFlash",
+    r"test_diffusion\.py.*diffusion_loss_with_dit",
+    r"test_dataloader_mp\.py.*(worker_info_and_distribution"
+    r"|worker_init_fn)",
+    r"test_vae_diffusers_roundtrip",
 )
 
 
@@ -69,7 +135,7 @@ def _collect(marker_expr):
     return nodes
 
 
-def check() -> int:
+def check(budget_log=None) -> int:
     slow = _collect("slow")
     tier1 = _collect("not slow")
     bad, stale = [], []
@@ -80,21 +146,38 @@ def check() -> int:
             bad.extend(f"{pat}: IN TIER-1 -> {n}" for n in leaked[:3])
         elif not any(rx.search(n) for n in slow):
             stale.append(pat)
+    over = []
+    if budget_log:
+        with open(budget_log) as f:
+            over = audit_durations(f)
     census = (f"tier-1 {len(tier1)} tests, slow {len(slow)} "
               f"(cap 870s; see ROADMAP 'Tier-1 verify')")
-    if bad or stale:
+    if bad or stale or over:
         print("marker audit FAILED:", file=sys.stderr)
         for line in bad:
             print(f"  budget leak  {line}", file=sys.stderr)
         for pat in stale:
             print(f"  stale policy {pat}: matches no collected test",
                   file=sys.stderr)
+        for line in over:
+            print(f"  over budget  {line}", file=sys.stderr)
         print(census, file=sys.stderr)
         return 1
     print(f"marker audit OK: {census}; "
-          f"{len(MUST_BE_SLOW)} slow-policy patterns enforced")
+          f"{len(MUST_BE_SLOW)} slow-policy patterns enforced"
+          + (f"; durations within budget ({budget_log})"
+             if budget_log else ""))
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(check())
+    log = None
+    argv = sys.argv[1:]
+    if "--budget-log" in argv:
+        i = argv.index("--budget-log")
+        if i + 1 >= len(argv):
+            print("usage: marker_audit.py [--budget-log "
+                  "DURATIONS_LOG]", file=sys.stderr)
+            sys.exit(2)
+        log = argv[i + 1]
+    sys.exit(check(budget_log=log))

@@ -151,6 +151,10 @@ def _build_gateway(ns):
                          max_blocks_per_seq=16, prefill_buckets=(32,),
                          chunk_prefill_tokens=ns.sys_tokens or 32,
                          enable_prefix_cache=True)
+    # --ring off: the synchronous-readback reference engines (ISSUE 11
+    # A/B — same workload, same gateway, only the tick readback
+    # architecture differs)
+    engine_kw["ring_mode"] = getattr(ns, "ring", "on") == "on"
     engines = [PagedEngine(model, **engine_kw)
                for _ in range(ns.replicas)]
     gw = Gateway(engines, routing=ns.policy, max_queue=ns.max_queue)
@@ -268,7 +272,12 @@ async def run_loadgen(ns) -> dict:
         "policy": ns.policy,
         "replicas": ns.replicas,
         "model": ns.model if not ns.url else "external",
+        "ring": getattr(ns, "ring", "on"),
     }
+    if engines is not None and getattr(ns, "ring", "on") == "on":
+        rung["ring_drains"] = sum(e.ring_drains for e in engines)
+        rung["ring_blocking_drains"] = sum(e.ring_blocking_drains
+                                           for e in engines)
     if engines is not None:
         rung["prefix_hit_tokens"] = sum(
             e.stats["prefix_hit_tokens"] for e in engines)
@@ -331,6 +340,10 @@ def main(argv=None) -> int:
     ap.add_argument("--max-queue", type=int, default=256)
     ap.add_argument("--model", default="tiny",
                     choices=("tiny", "stub"))
+    ap.add_argument("--ring", default="on", choices=("on", "off"),
+                    help="async token-ring decode on the replica "
+                         "engines (off = synchronous per-tick "
+                         "readback, the ISSUE 11 A/B reference)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--url", default=None,
                     help="attach to HOST:PORT instead of self-hosting")

@@ -174,6 +174,48 @@ def ragged_paged_multiquery_kernel():
     assert err < 3e-2, err
 check("ragged_paged_multiquery_kernel", ragged_paged_multiquery_kernel)
 
+def ring_tick_program():
+    # ISSUE 11: the ring-mode fused tick program (device-resident ring
+    # buffer + write cursors carried in the tick state, no per-tick
+    # readback) must compile and stream correctly on hardware. The
+    # negligible-compute stub keeps this a TICK-MACHINERY check, like
+    # the loadgen's --model stub.
+    from paddle_tpu.generation.paged import PagedEngine
+    from paddle_tpu.generation.stub import TickStubModel
+    eng = PagedEngine(TickStubModel(), max_slots=4, num_blocks=32,
+                      block_size=8, max_blocks_per_seq=8,
+                      prefill_buckets=(8,))
+    assert eng._ring
+    for i in range(3):
+        eng.submit(i, np.arange(1, 6)[None], max_new_tokens=12)
+    res = eng.run()
+    assert all(len(v) == 12 for v in res.values()), res
+    assert eng.ring_drains > 0
+check("ring_tick_program", ring_tick_program)
+
+def rejection_spec_tick():
+    # ISSUE 11: both rejection-sampled speculative tick shapes — the
+    # all-greedy program (argmax prefix rule) and the mixed program
+    # (per-position accept/residual-resample with per-row key folds) —
+    # must compile on hardware; the ring rides both.
+    from paddle_tpu.generation.paged import PagedEngine
+    from paddle_tpu.generation.stub import TickStubModel
+
+    def run(**kw):
+        eng = PagedEngine(TickStubModel(), max_slots=4, num_blocks=32,
+                          block_size=8, max_blocks_per_seq=8,
+                          prefill_buckets=(8,), spec_tokens=3)
+        eng.submit("g", np.asarray([1, 2, 3, 1, 2, 3])[None],
+                   max_new_tokens=10)
+        if kw.get("mixed"):
+            eng.submit("s", np.asarray([2, 3, 4, 2, 3])[None],
+                       max_new_tokens=10, temperature=0.8, seed=1)
+        res = eng.run()
+        assert all(len(v) == 10 for v in res.values()), res
+    run()              # all-greedy spec program
+    run(mixed=True)    # mixed greedy+sampled spec program
+check("rejection_spec_tick", rejection_spec_tick)
+
 def prefill_flash():
     # the generate() prefill branch: flash at cache_index==0 must match
     # the masked-dense-over-cache path it replaced (llama.py)
