@@ -976,7 +976,8 @@ class PagedEngine:
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, seed: Optional[int] = None,
                stop_sequences=None, repetition_penalty: float = 1.0,
-               timeout_s: Optional[float] = None):
+               timeout_s: Optional[float] = None,
+               resume_tokens=None, resume_lps=None):
         """temperature <= 0 keeps the bit-exact greedy path; a sampled
         request gets its own PRNG stream seeded by ``seed`` (default: a
         per-engine submission counter), so outputs are reproducible per
@@ -993,7 +994,18 @@ class PagedEngine:
         backlog. ``timeout_s`` (default: the engine's
         ``default_timeout_s``) caps the request's wall-clock lifetime;
         an expired request is aborted at the next tick and recorded in
-        ``self.cancelled`` with reason "timeout"."""
+        ``self.cancelled`` with reason "timeout".
+
+        ``resume_tokens`` (ISSUE 12, in-flight failover): tokens this
+        request ALREADY emitted on another engine before its replica
+        died, which must form the TAIL of ``input_ids`` — the same
+        fold-into-the-prompt transform ``_preempt_youngest`` applies,
+        so the re-prefill rebuilds identical K/V and a greedy stream
+        continues bitwise exactly where the dead replica stopped
+        (``results`` returns resume_tokens + the continuation; stop
+        sequences spanning the boundary still match/trim).
+        ``resume_lps`` carries their logprobs. ``max_new_tokens``
+        counts only the tokens still to emit."""
         if self.max_queue is not None:
             # reap already-dead queued requests first: capacity held by
             # expired work must not reject a live submit
@@ -1035,9 +1047,18 @@ class PagedEngine:
             else self.default_timeout_s
         deadline = (time.monotonic() + timeout_s) \
             if timeout_s is not None else None
+        resume = [int(t) for t in (resume_tokens or ())]
+        if resume and ids[-len(resume):] != resume:
+            raise ValueError(
+                "resume_tokens must be the tail of input_ids (the "
+                "preemption fold: prompt' = prompt + emitted)")
+        rlps = [float(v) for v in (resume_lps or ())]
+        if resume and len(rlps) != len(resume):
+            rlps = [float("nan")] * len(resume)
         self.queue.append(_Request(request_id, ids, max_new_tokens,
                                    eos_token_id, float(temperature),
                                    int(top_k), float(top_p), key,
+                                   prefix=resume, prefix_lps=rlps,
                                    stop=stop,
                                    rep=float(repetition_penalty),
                                    deadline=deadline))
@@ -1590,6 +1611,101 @@ class PagedEngine:
                      "blocking_drains": self.ring_blocking_drains,
                      "d2h_syncs": self.d2h_syncs},
         }
+
+    # ------------------------------------------------- fleet fault tolerance
+    def export_resumable(self) -> Dict[Any, Dict[str, Any]]:
+        """Resume descriptors for every queued or running request, read
+        from HOST mirrors only (ISSUE 12: the failover path calls this
+        on a crashed or hung engine — no device access, no jitted
+        calls, so it works whatever state the accelerator is in).
+
+        The host mirrors advance only when tokens are DRAINED
+        (``_consume_row``), so an in-flight ring/fused dispatch's
+        uncommitted tokens are invisible here and simply die with the
+        replica — exactly the tokens no client ever saw. Each
+        descriptor is the ``_preempt_youngest`` transform, ready for
+        ``submit(prompt, max_new_tokens=remaining,
+        resume_tokens=committed, ...)`` on a SURVIVING engine: a greedy
+        resume is bitwise the uninterrupted stream; a sampled resume
+        needs a re-derived key (the caller's job) and is
+        distribution-preserving, not bitwise."""
+        out: Dict[Any, Dict[str, Any]] = {}
+
+        def _desc(s: "_Request") -> Dict[str, Any]:
+            # one consistent snapshot of the (tokens, lps) pair: a
+            # SLOW-but-alive tick can still be appending (tokens
+            # first, then lps — see _consume_row), so read lps first
+            # and truncate both to the paired length; every derived
+            # field below uses the SAME n, keeping committed a strict
+            # tail of prompt and remaining consistent with it
+            lps = list(s.lps)
+            toks = list(s.tokens)[:len(lps)]
+            n = len(toks)
+            return {
+                "prompt": list(s.prompt) + toks,
+                "committed": list(s.prefix) + toks,
+                "committed_lps": list(s.prefix_lps) + lps[:n],
+                "remaining": max(s.max_new - n, 0),
+                "eos": s.eos,
+                "temperature": s.temperature,
+                "top_k": s.top_k,
+                "top_p": s.top_p,
+                "stop": [list(x) for x in s.stop],
+                "rep": s.rep,
+                "deadline": s.deadline,
+            }
+
+        for s in list(self.queue):
+            out[s.request_id] = _desc(s)
+        for s in list(self.slots):
+            if s is not None:
+                out[s.request_id] = _desc(s)
+        return out
+
+    def hard_reset(self):
+        """Forcibly return the engine to its empty post-``__init__``
+        state WITHOUT touching whatever the device is doing (ISSUE 12:
+        the supervisor's rebuild-in-place path after a tick-thread
+        crash or an abandoned hung dispatch). Every queued/running
+        request is dropped on the floor — the caller already failed
+        them over — and the KV pools and ``seen`` masks are rebuilt as
+        FRESH arrays: the old ones may have been donated into (or
+        still be owned by) a dead or in-flight program, so they are
+        never reused. Compiled executables survive (the jit caches key
+        on shapes, which don't change), so a restart costs one
+        allocation, not a recompile. Counters are monotonic and keep
+        counting across the reset."""
+        cfg = self.model.config
+        kvh, d = cfg.num_key_value_heads, cfg.head_dim
+        self.pools = [(jnp.zeros((self.P, self.B, kvh, d), cfg.dtype),
+                       jnp.zeros((self.P, self.B, kvh, d), cfg.dtype))
+                      for _ in range(cfg.num_hidden_layers)]
+        self.seen = jnp.zeros((self.R, cfg.vocab_size), bool)
+        self.free_blocks = list(range(1, self.P))
+        self.block_tables = np.zeros((self.R, self.M), np.int32)
+        self.seq_lens = np.zeros((self.R,), np.int32)
+        self.temps = np.zeros((self.R,), np.float32)
+        self.top_ks = np.zeros((self.R,), np.int32)
+        self.top_ps = np.ones((self.R,), np.float32)
+        self.reps = np.ones((self.R,), np.float32)
+        self.keys = np.zeros((self.R, 2), np.uint32)
+        self.slots = [None] * self.R
+        self.queue = []
+        self.results = {}
+        self.logprobs = {}
+        self.cancelled = {}
+        self.prefix_cache = {}
+        self._prefix_rev = {}
+        self.block_refs = {}
+        self.cached_free = {}
+        self._key_overrides = set()
+        self._dev = None
+        self._dev_dirty = True
+        self._dev_keys_dirty = False
+        self._pending = None
+        self._drained[:] = 0
+        obs.record_event("paged_hard_reset",
+                         engine=self._obs_labels["engine"])
 
     def close(self, drain: bool = True):
         """``drain=True`` (default) runs the engine until every queued

@@ -12,9 +12,11 @@ from .reqtrace import RequestTrace, RequestTraceRing
 from .router import EngineReplica, NoReplicaError, PrefixAffinityRouter
 from .scheduler import (SLO_BATCH, SLO_INTERACTIVE, ServeRequest,
                         ShedError, SLOScheduler)
+from .supervisor import CircuitBreaker, ReplicaSupervisor
 
 __all__ = [
     "Gateway",
+    "CircuitBreaker", "ReplicaSupervisor",
     "EngineReplica", "NoReplicaError", "PrefixAffinityRouter",
     "RequestTrace", "RequestTraceRing",
     "SLO_BATCH", "SLO_INTERACTIVE", "ServeRequest", "ShedError",

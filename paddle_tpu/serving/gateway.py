@@ -69,6 +69,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..utils import faults
 from ..utils import observability as obs
 from ..utils.faults import BackpressureError
 from ..utils.shutdown import GracefulShutdown
@@ -76,6 +77,7 @@ from .reqtrace import RequestTrace, RequestTraceRing
 from .router import EngineReplica, NoReplicaError, PrefixAffinityRouter
 from .scheduler import (SLO_BATCH, SLO_INTERACTIVE, ServeRequest,
                         ShedError, SLOScheduler)
+from .supervisor import BREAKER_CLOSED, CircuitBreaker, ReplicaSupervisor
 
 __all__ = ["Gateway"]
 
@@ -109,6 +111,20 @@ def _json_response(status: int, payload: Dict[str, Any],
                           extra=extra)
 
 
+def _release_probe(req: ServeRequest, replica, success=None):
+    """Report a probation probe's terminal outcome to its breaker.
+    EVERY path that terminates a probe request must come through here
+    (or probe_done directly): a probe that ends without reporting
+    leaks the breaker's single in-flight slot and the replica can
+    never rejoin. ``None`` = inconclusive (expiry/shed/disconnect —
+    releases the slot without moving the state machine)."""
+    if req.probe:
+        b = getattr(replica, "breaker", None)
+        if b is not None:
+            b.probe_done(success)
+        req.probe = False
+
+
 class _ReplicaWorker(threading.Thread):
     """Owns ONE PagedEngine: the only thread that ever touches it.
 
@@ -121,7 +137,8 @@ class _ReplicaWorker(threading.Thread):
     and tick freely."""
 
     def __init__(self, gw: "Gateway", replica: EngineReplica,
-                 sched: SLOScheduler, tick_lock: threading.Lock):
+                 sched: SLOScheduler, tick_lock: threading.Lock,
+                 ring: Optional[RequestTraceRing] = None):
         super().__init__(daemon=True,
                          name=f"gateway-{gw.name}-{replica.name}")
         self.gw = gw
@@ -133,16 +150,50 @@ class _ReplicaWorker(threading.Thread):
         self._wake = threading.Event()
         self._live: Dict[Any, ServeRequest] = {}
         self.draining = False
+        # fleet fault tolerance (ISSUE 12): ``failed`` latches once the
+        # failover hand-off ran (crash path on this thread, hang/drop
+        # on the supervisor — the latch makes them exclusive);
+        # ``abandoned`` tells a still-running (hung) thread a
+        # replacement owns the engine now — it must exit without
+        # touching shared state. ``t_busy`` is the watchdog's
+        # dispatch-to-drain deadline anchor: set before the engine
+        # step, cleared after the token dispatch. ``_chaos`` is the
+        # chaos harness's one-shot replica-addressed fault.
+        self.failed = False
+        self.fail_reason: Optional[str] = None
+        self.rebuild_failed = False
+        self.rebuilding = False
+        self.abandoned = False
+        self.t_busy: Optional[float] = None
+        # False until the first dispatch completes: a COLD engine's
+        # first step pays the executable build/deserialize, so the
+        # watchdog grants it a 10x grace deadline instead of reading
+        # the compile as a hang. An engine that has dispatched before
+        # (factory-warmed, or rebuilt in place with its jit caches
+        # intact) starts warmed and serves under the strict deadline
+        # from its first request.
+        self.warmed = getattr(replica.engine, "dispatch_count", 0) > 0
+        self._chaos: Optional[str] = None
+        # orders token emission against the failover snapshot: the
+        # tick thread holds it across _dispatch, the failover path
+        # holds it while latching ``abandoned`` and snapshotting/
+        # clearing ``_live`` — so a slow-but-alive step that outlives
+        # the watchdog can never emit concurrently with (or after)
+        # the failover's re-delivery of the same requests
+        self._io_lock = threading.Lock()
         rl = dict(gw._labels, replica=replica.name)
         # request-trace ring (ISSUE 10 tentpole): this replica's
         # per-request timelines; the engine reports its lifecycle
         # events through trace_sink (resolved via _live, which is
-        # populated BEFORE submit so queue-time events land too)
-        self.ring: Optional[RequestTraceRing] = None
+        # populated BEFORE submit so queue-time events land too).
+        # A rebuilt replica (ISSUE 12) inherits its predecessor's ring
+        # so the failure's timelines survive the restart.
+        self.ring = ring
         if gw._trace:
-            self.ring = RequestTraceRing(
-                capacity=gw._trace_capacity,
-                slow_ttft_ms=gw._slow_ttft_ms, labels=rl)
+            if self.ring is None:
+                self.ring = RequestTraceRing(
+                    capacity=gw._trace_capacity,
+                    slow_ttft_ms=gw._slow_ttft_ms, labels=rl)
             self.engine.trace_sink = self._engine_trace
         # autoscaler signals (ISSUE 10 satellite / ROADMAP 2c): free
         # capacity gauges an external controller can scrape, updated
@@ -185,6 +236,17 @@ class _ReplicaWorker(threading.Thread):
     def wake(self):
         self._wake.set()
 
+    def inject_fault(self, kind: str):
+        """Chaos-harness hook (``tools/serve_loadgen.py --chaos``):
+        arm a one-shot replica fault handled at the top of the next
+        tick — the same code paths the seeded ``tick_crash`` /
+        ``dispatch_hang`` / ``replica_drop`` fault sites take, but
+        addressed to THIS replica deterministically."""
+        if kind not in ("crash", "hang", "drop"):
+            raise ValueError(f"unknown chaos kind {kind!r}")
+        self._chaos = kind
+        self._wake.set()
+
     def cancel_request(self, request_id, req: ServeRequest = None):
         """Client gone: drop it from wherever it currently lives —
         scheduler queue (never reached the engine) or the engine
@@ -203,6 +265,8 @@ class _ReplicaWorker(threading.Thread):
             self.engine.logprobs.pop(request_id, None)
         self._live.pop(request_id, None)
         if req is not None:
+            # a disconnected probe proves nothing: slot released only
+            _release_probe(req, self.replica)
             self._trace_finish(req, "disconnect")
 
     def _emit(self, req: ServeRequest, ev):
@@ -216,7 +280,20 @@ class _ReplicaWorker(threading.Thread):
     # ------------------------------------------------------------ tick loop
     def run(self):
         eng = self.engine
+        rname = self.replica.name
         while True:
+            if self.abandoned:
+                return        # a replacement worker owns the engine now
+            # chaos entry points (ISSUE 12): the seeded fault sites +
+            # the loadgen's replica-addressed one-shots share one code
+            # path, so the chaos harness exercises exactly what real
+            # failures would hit. crash/hang stay ARMED until the
+            # worker is actually busy (an idle-tick kill that fizzles
+            # would understate the harness's injected-kill count).
+            if self._chaos == "drop" or faults.inject("replica_drop",
+                                                      replica=rname):
+                return        # hard exit, NO cleanup: the supervisor
+                              # finds the corpse and fails over
             while self._ops:
                 op = self._ops.popleft()
                 try:
@@ -228,6 +305,7 @@ class _ReplicaWorker(threading.Thread):
             for req in self.sched.reap(now):
                 # satellite: expired in QUEUE — cancelled before it
                 # ever took a slot; the scheduler already counted it
+                _release_probe(req, self.replica)
                 self._emit(req, ("done", {"tokens": [],
                                           "finish_reason": "timeout"}))
                 self._trace_finish(req, "expired")
@@ -235,13 +313,51 @@ class _ReplicaWorker(threading.Thread):
                 self._admit(req, time.monotonic())
             self._set_capacity_gauges()
             if eng.queue or any(s is not None for s in eng.slots):
+                chaos, self._chaos = self._chaos, None
                 try:
+                    if chaos == "crash" or faults.inject("tick_crash",
+                                                         replica=rname):
+                        raise RuntimeError("injected tick_crash")
+                    if chaos == "hang" or faults.inject("dispatch_hang",
+                                                        replica=rname):
+                        # the injected hang IS dispatch latency: open
+                        # the watchdog window before sleeping
+                        self.t_busy = time.monotonic()
+                        time.sleep(faults.dispatch_hang_seconds())
+                    if faults.inject("slow_replica", replica=rname):
+                        time.sleep(faults.slow_replica_seconds())
+                    if self.abandoned:
+                        # the watchdog fired while we slept: requests
+                        # failed over, the engine was rebuilt for a
+                        # replacement worker — touch NOTHING
+                        return
                     with self._tick_lock:
+                        # the dispatch-to-drain watchdog window opens
+                        # INSIDE the lock: waiting for a shared-model
+                        # sibling's tick is not THIS replica's hang,
+                        # and must not cascade watchdog fires onto
+                        # healthy siblings (a real in-step hang that
+                        # never releases the shared lock leaves its
+                        # siblings blocked-but-undetected — run
+                        # distinct model instances for isolation,
+                        # as the chaos loadgen does)
+                        self.t_busy = time.monotonic()
                         eng.step()
                 except Exception as e:
                     self._fail_all(e)
                     return
-                self._dispatch()
+                with self._io_lock:
+                    if self.abandoned:
+                        # a slow-but-not-hung step outlived the
+                        # watchdog: the failover path owns every live
+                        # request now — emit nothing, touch nothing
+                        return
+                    self._dispatch()
+                self.t_busy = None
+                # first full dispatch done: the cold-start compile is
+                # paid, so the watchdog's grace multiplier drops and
+                # the strict deadline applies from here on
+                self.warmed = True
                 # post-tick refresh: a scrape between ticks sees the
                 # capacity the step just freed, not last tick's view
                 self._set_capacity_gauges()
@@ -266,7 +382,30 @@ class _ReplicaWorker(threading.Thread):
         return self.sched.pop()
 
     def _admit(self, req: ServeRequest, now: float):
-        kw = dict(req.gen)
+        ids = req.input_ids
+        if req.resume is None:
+            kw = dict(req.gen)
+        else:
+            # failover resume (ISSUE 12): re-prefill prompt+committed
+            # on THIS replica and continue from where the dead one
+            # stopped — the engine's preemption fold, across replicas.
+            # A seeded sampled request re-derives a per-attempt key
+            # (distribution-preserving, not bitwise; an unseeded one
+            # just gets this engine's fresh counter stream).
+            d = req.resume
+            ids = d["prompt"]
+            kw = dict(max_new_tokens=max(int(d["remaining"]), 1),
+                      temperature=d["temperature"], top_k=d["top_k"],
+                      top_p=d["top_p"], repetition_penalty=d["rep"],
+                      resume_tokens=d["committed"],
+                      resume_lps=d["committed_lps"])
+            if d["eos"] is not None:
+                kw["eos_token_id"] = d["eos"]
+            if d["stop"]:
+                kw["stop_sequences"] = d["stop"]
+            seed = req.gen.get("seed")
+            if seed is not None:
+                kw["seed"] = int(seed) + 0x9E3779B1 * req.failovers
         if req.deadline is not None:
             # thread the REMAINING deadline budget into the engine so
             # in-slot expiry uses its own timeout machinery
@@ -277,33 +416,35 @@ class _ReplicaWorker(threading.Thread):
         self._live[req.request_id] = req
         try:
             self.engine.submit(req.request_id,
-                               np.asarray([req.input_ids], np.int32),
+                               np.asarray([ids], np.int32),
                                **kw)
         except BackpressureError as e:
             # transient overload (an engine also taking out-of-band
             # submit() traffic filled its queue since the free-slot
             # check) — shed, don't tell the client its request was bad
             self._live.pop(req.request_id, None)
+            _release_probe(req, self.replica)
             self._emit(req, ("error", 429, str(e)))
             self._trace_finish(req, "shed")
             return
         except Exception as e:
             self._live.pop(req.request_id, None)
+            _release_probe(req, self.replica)
             self._emit(req, ("error", 400, str(e)))
             self._trace_finish(req, "error")
             return
         req.t_admit = now
 
     def _fail_all(self, err: Exception):
+        """Tick-thread failure exit. Hardening satellite (ISSUE 12):
+        live requests now route through the FAILOVER path — each is
+        resubmitted to a surviving replica as prompt + committed
+        tokens; the bare error is only the no-survivor fallback inside
+        ``Gateway._failover_worker``. The supervisor then rebuilds
+        this replica's engine and rejoins it through the breaker."""
         obs.record_event("gateway_replica_error", gateway=self.gw.name,
                          replica=self.replica.name, err=repr(err))
-        self.replica.mark(False)
-        self.gw._router.evict_unhealthy()
-        for req in list(self._live.values()):
-            self._emit(req, ("error", 500, f"replica failed: {err!r}"))
-            self._trace_finish(req, "error")
-        self._live.clear()
-        self.flush_queue(503, "replica failed; retry elsewhere")
+        self.gw._failover_worker(self, reason="crash", err=err)
 
     def flush_queue(self, status: int, msg: str):
         """Error out every request still waiting in the scheduler —
@@ -311,10 +452,12 @@ class _ReplicaWorker(threading.Thread):
         answer, never a hang. Safe off the tick thread once the
         thread is gone (the scheduler locks internally)."""
         for req in self.sched.reap():
+            _release_probe(req, self.replica)
             self._emit(req, ("done", {"tokens": [],
                                       "finish_reason": "timeout"}))
             self._trace_finish(req, "expired")
         while (req := self.sched.pop()) is not None:
+            _release_probe(req, self.replica)
             self._emit(req, ("error", status, msg))
             self._trace_finish(req, "error")
 
@@ -346,6 +489,24 @@ class _ReplicaWorker(threading.Thread):
         reason = payload.get("finish_reason", "stop")
         outcome = {"stop": "stop", "timeout": "timeout",
                    "cancelled": "cancelled"}.get(reason, "error")
+        if req.probe:
+            # circuit-breaker probation (ISSUE 12): a clean finish
+            # counts toward closing; an engine timeout/cancel proves
+            # nothing and just releases the probe slot
+            b = getattr(self.replica, "breaker", None)
+            if b is not None:
+                b.probe_done(True if reason == "stop" else None)
+                if b.state == BREAKER_CLOSED and req.trace is not None:
+                    req.trace.ev("breaker_close",
+                                 replica=self.replica.name)
+            req.probe = False
+        elif reason == "stop":
+            # ordinary successes clear the consecutive-failure count —
+            # what makes failure_threshold > 1 mean CONSECUTIVE, not
+            # "N failures over the replica's lifetime"
+            b = getattr(self.replica, "breaker", None)
+            if b is not None:
+                b.record_success()
         if req.trace is not None:
             req.trace.ev("finish", reason=reason, tokens=req.n_out)
         self._trace_finish(req, outcome, tpot_ms=tpot_ms)
@@ -419,7 +580,26 @@ class Gateway:
                  shutdown: Optional[GracefulShutdown] = None,
                  name: Optional[str] = None,
                  trace: bool = True, trace_capacity: int = 512,
-                 slow_ttft_ms: Optional[float] = None):
+                 slow_ttft_ms: Optional[float] = None,
+                 supervise: bool = True,
+                 engine_factory=None,
+                 failover_budget: int = 2,
+                 watchdog_timeout_s: float = 30.0,
+                 watchdog_interval_s: float = 0.05,
+                 breaker_backoff_s: float = 1.0,
+                 breaker_backoff_max_s: float = 30.0,
+                 breaker_probes: int = 1):
+        """Fleet fault tolerance (ISSUE 12): ``supervise`` (default on)
+        runs the :class:`~.supervisor.ReplicaSupervisor` — tick-thread
+        crash/hang detection (``watchdog_timeout_s`` is the
+        dispatch-to-drain deadline), engine rebuild
+        (``engine_factory()`` when given, ``PagedEngine.hard_reset()``
+        in place otherwise) and circuit-breaker rejoin
+        (``breaker_backoff_s`` exponential backoff before the first
+        probation probe, ``breaker_probes`` successes to close).
+        ``failover_budget`` caps how many replica failures one request
+        may ride through before it errors out — the amplification
+        bound under cascading failures."""
         if not isinstance(engines, (list, tuple)):
             engines = [engines]
         self.name = name or f"gw{next(_gateway_ids)}"
@@ -465,12 +645,22 @@ class Gateway:
                                           **self._labels)
         self._g_goodput = reg.gauge("gateway_goodput_frac",
                                     **self._labels)
+        # fleet fault tolerance (ISSUE 12): the failover accounting
+        # the supervisor/crash paths share. _fo_lock serializes the
+        # per-worker failure latch and the worker-list swap.
+        self._engine_factory = engine_factory
+        self._failover_budget = int(failover_budget)
+        self._fo_lock = threading.Lock()
+        self._c_failovers = reg.counter("gateway_failovers_total",
+                                        **self._labels)
+        self._c_fo_exhausted = reg.counter(
+            "gateway_retry_budget_exhausted_total", **self._labels)
         self._workers: List[_ReplicaWorker] = []
         replicas = []
         # replicas sharing one MODEL object must not tick concurrently
         # (functional()'s pure fn binds params onto the shared layer
         # tree); one lock per distinct model serializes exactly those
-        model_locks: Dict[int, threading.Lock] = {}
+        self._model_locks: Dict[int, threading.Lock] = {}
         for i, eng in enumerate(engines):
             rep = EngineReplica(f"r{i}", eng)
             sched = SLOScheduler(
@@ -478,9 +668,7 @@ class Gateway:
                 interactive_ttft_ms=interactive_ttft_ms,
                 promote_after_ms=promote_after_ms,
                 labels=dict(self._labels, replica=rep.name))
-            lock = model_locks.setdefault(
-                id(getattr(eng, "model", eng)), threading.Lock())
-            self._workers.append(_ReplicaWorker(self, rep, sched, lock))
+            self._workers.append(self._make_worker(rep, sched))
             replicas.append(rep)
         self._router = PrefixAffinityRouter(
             replicas, policy=routing, spill_margin=spill_margin,
@@ -488,6 +676,227 @@ class Gateway:
         self._by_replica = {w.replica: w for w in self._workers}
         # the reference engine defines prompt limits + the digest grid
         self._ref = engines[0]
+        self._supervisor: Optional[ReplicaSupervisor] = None
+        if supervise:
+            for rep in replicas:
+                rep.breaker = CircuitBreaker(
+                    probes_to_close=breaker_probes,
+                    backoff_s=breaker_backoff_s,
+                    backoff_max_s=breaker_backoff_max_s,
+                    on_state=self._breaker_state_cb(rep))
+            self._supervisor = ReplicaSupervisor(
+                self, check_interval_s=watchdog_interval_s,
+                dispatch_timeout_s=watchdog_timeout_s)
+
+    def _make_worker(self, replica: EngineReplica, sched: SLOScheduler,
+                     ring: Optional[RequestTraceRing] = None
+                     ) -> _ReplicaWorker:
+        """Build a tick-thread worker for ``replica``'s CURRENT engine
+        (also the supervisor's rebuild hook — a fresh engine reuses
+        the replica name, scheduler, trace ring and metric labels)."""
+        key = id(getattr(replica.engine, "model", replica.engine))
+        lock = self._model_locks.setdefault(key, threading.Lock())
+        if len(self._model_locks) > 256:
+            # supervisor rebuilds with a fresh-model factory add one
+            # entry per restart; prune entries no current worker uses
+            # (kept small enough that a hung thread's still-referenced
+            # model — whose id therefore can't be recycled — is never
+            # re-keyed onto a fresh lock in practice)
+            live = {key} | {
+                id(getattr(w.engine, "model", w.engine))
+                for w in self._workers}
+            self._model_locks = {k: v for k, v in
+                                 self._model_locks.items()
+                                 if k in live}
+        return _ReplicaWorker(self, replica, sched, lock, ring=ring)
+
+    def _breaker_state_cb(self, replica: EngineReplica):
+        def cb(state: str):
+            if state == BREAKER_CLOSED:
+                # breaker closed = probation passed: back in rotation
+                replica.mark(True)
+            obs.record_event("gateway_breaker", gateway=self.name,
+                             replica=replica.name, state=state)
+        return cb
+
+    # ------------------------------------------------------------ failover
+    def _failover_worker(self, worker: _ReplicaWorker, reason: str,
+                         err: Optional[Exception] = None,
+                         stuck_ms: Optional[float] = None):
+        """Fail ONE replica (ISSUE 12 tentpole): latch it out of
+        rotation, open its breaker, and move every live/queued request
+        to a surviving replica — resubmitted as ``prompt + committed
+        tokens`` with the stream-resume offset, so the client sees no
+        duplicate and no gap. Requests that FINISHED on the dead
+        replica but were never delivered are completed from its result
+        mirrors. Runs on the dying tick thread (crash) or the
+        supervisor (hang/drop); the ``failed`` latch makes the two
+        callers mutually exclusive."""
+        with self._fo_lock:
+            if worker.failed:
+                return
+            worker.failed = True
+            worker.fail_reason = reason
+        # _io_lock orders this snapshot against the old thread's
+        # _dispatch: either its in-flight emission completes first and
+        # we snapshot the post-dispatch state, or we latch abandoned
+        # first and it emits nothing ever again. (The crash path runs
+        # ON the tick thread, which never holds the lock here.) The
+        # acquire is BOUNDED: a thread wedged INSIDE _dispatch would
+        # otherwise pin the fleet's one supervisor forever — on
+        # timeout we proceed unordered (abandoned is latched first,
+        # so the wedged dispatch can at worst duplicate-emit into
+        # sinks whose requests have already moved on).
+        worker.abandoned = True
+        locked = worker._io_lock.acquire(timeout=1.0)
+        try:
+            worker.replica.mark(False)
+            # host-mirror snapshot of the dead engine —
+            # export_resumable and the result dicts are plain host
+            # bookkeeping, safe to read whatever state the
+            # device/tick thread is stuck in
+            try:
+                desc = worker.engine.export_resumable()
+            except Exception:
+                desc = {}
+            try:
+                results = dict(worker.engine.results)
+                res_lps = dict(worker.engine.logprobs)
+            except Exception:
+                results, res_lps = {}, {}
+            live = list(worker._live.values())
+            worker._live.clear()
+        finally:
+            if locked:
+                worker._io_lock.release()
+        breaker = getattr(worker.replica, "breaker", None)
+        if breaker is not None:
+            breaker.record_failure()
+        self._router.evict_unhealthy()
+        for r in worker.sched.reap():
+            _release_probe(r, worker.replica)
+            worker._emit(r, ("done", {"tokens": [],
+                                      "finish_reason": "timeout"}))
+            worker._trace_finish(r, "expired")
+        queued = []
+        while (r := worker.sched.pop()) is not None:
+            queued.append(r)
+        now = time.monotonic()
+        for req in live + queued:
+            if req.trace is not None:
+                if stuck_ms is not None:
+                    req.trace.ev("watchdog_fire", stuck_ms=stuck_ms)
+                req.trace.ev("replica_fail",
+                             replica=worker.replica.name, reason=reason)
+                if breaker is not None:
+                    req.trace.ev("breaker_open",
+                                 replica=worker.replica.name)
+            # a probe caught in its target's failure IS the probe's
+            # answer: re-open with a longer backoff
+            _release_probe(req, worker.replica, False)
+            toks = results.get(req.request_id)
+            if toks is not None:
+                # finished on the dead replica, undelivered: deliver
+                # from the result mirrors instead of re-running it
+                for t in toks[req.emitted:]:
+                    worker._token_out(req, t, now)
+                req.emitted = len(toks)
+                worker._finish(
+                    req, {"tokens": [int(t) for t in toks],
+                          "logprobs": [float(v) for v in
+                                       res_lps.get(req.request_id, [])],
+                          "finish_reason": "stop"}, now)
+                continue
+            self._resubmit(req, desc.get(req.request_id), worker)
+        obs.record_event("gateway_replica_fail", gateway=self.name,
+                         replica=worker.replica.name, reason=reason,
+                         moved=len(live) + len(queued),
+                         err=repr(err) if err is not None else "")
+
+    def _resubmit(self, req: ServeRequest, desc: Optional[Dict],
+                  from_worker: _ReplicaWorker):
+        """One request's failover hop: charge the retry budget, pick a
+        surviving replica (healthy, alive, and NOT draining — a
+        draining replica never accepts failover traffic), attach the
+        resume descriptor and re-enqueue through that replica's
+        scheduler (failover traffic is still subject to shedding:
+        bounded budget + shedding is what keeps a replica failure from
+        amplifying into a retry storm under overload)."""
+        if desc is not None and int(desc["remaining"]) <= 0:
+            # budget fully committed at the kill boundary: deliver the
+            # committed stream instead of re-running anything (checked
+            # BEFORE the retry budget — a complete result in hand must
+            # never be 503'd)
+            now = time.monotonic()
+            toks = [int(t) for t in desc["committed"]]
+            for t in toks[req.emitted:]:
+                from_worker._token_out(req, t, now)
+            req.emitted = len(toks)
+            from_worker._finish(
+                req, {"tokens": toks,
+                      "logprobs": [float(v)
+                                   for v in desc["committed_lps"]],
+                      "finish_reason": "stop"}, now)
+            return
+        req.failovers += 1
+        if req.failovers > self._failover_budget:
+            self._c_fo_exhausted.inc()
+            self._fail_request(
+                req, from_worker, 503,
+                f"failover budget exhausted after "
+                f"{self._failover_budget} replica failures")
+            return
+        if desc is not None:
+            # attach BEFORE any enqueue: the target's tick thread may
+            # pop the request the moment it lands
+            req.resume = desc
+        cands = sorted(
+            (w for w in self._workers
+             if w is not from_worker and not w.failed
+             and not w.abandoned and not w.draining
+             and w.is_alive() and w.replica.healthy()),
+            key=lambda w: w.replica.load() + w.sched.depth())
+        for target in cands:
+            req.owner = target
+            try:
+                eng = target.engine
+                target.sched.enqueue(
+                    req, engine_health={"queued": len(eng.queue),
+                                        "queue_capacity": eng.max_queue})
+            except ShedError as e:
+                self._c_shed.inc()
+                self._fail_request(req, from_worker, 503,
+                                   f"failover shed: {e}")
+                return
+            if target.failed or not target.is_alive():
+                # the target failed CONCURRENTLY, after its own queue
+                # flush — take the request back and try the next
+                # survivor (left queued it would hang forever)
+                if target.sched.cancel(req.request_id):
+                    continue
+                # its failover path already claimed the request
+                return
+            if req.trace is not None:
+                req.trace.ev("resubmit",
+                             to_replica=target.replica.name,
+                             attempt=req.failovers)
+                req.trace.ev("resume_offset", offset=req.emitted,
+                             committed=len(desc["committed"])
+                             if desc else 0)
+            self._c_failovers.inc()
+            target.wake()
+            return
+        self._fail_request(req, from_worker, 503,
+                           "replica failed; no surviving replica")
+
+    def _fail_request(self, req: ServeRequest,
+                      worker: _ReplicaWorker, status: int, msg: str):
+        """Terminal failover error: tell the client and close the
+        trace on the failed replica's ring."""
+        worker._emit(req, ("error", status, msg))
+        if req.trace is not None:
+            req.trace.ev("finish", reason="error")
+        worker._trace_finish(req, "error")
 
     # -------------------------------------------------------------- digest
     def _affinity_digests(self, ids: List[int]) -> Optional[List[str]]:
@@ -508,6 +917,9 @@ class Gateway:
         self._loop = asyncio.get_running_loop()
         for w in self._workers:
             w.start()
+        if self._supervisor is not None \
+                and not self._supervisor.is_alive():
+            self._supervisor.start()
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
@@ -522,12 +934,21 @@ class Gateway:
         if self._draining and self._server is None:
             return
         self._draining = True
+        # supervision stops FIRST: a worker exiting because it drained
+        # must not be mistaken for a dropped replica and restarted,
+        # and a draining fleet never rebuilds (SIGTERM composes with
+        # an open breaker — the replica just stays down)
+        if self._supervisor is not None:
+            self._supervisor.stop()
         for w in self._workers:
             w.draining = True
             w.wake()
         deadline = time.monotonic() + timeout
         for w in self._workers:
-            while w.is_alive() and time.monotonic() < deadline:
+            # an abandoned (hung) worker never exits on its own; its
+            # replacement — if any — is what _workers holds
+            while w.is_alive() and not w.abandoned \
+                    and time.monotonic() < deadline:
                 await asyncio.sleep(0.01)
         for w in self._workers:
             if not w.is_alive():
@@ -603,10 +1024,14 @@ class Gateway:
         cross-thread without pausing the tick threads (debug fidelity,
         not a consistency point)."""
         reps: Dict[str, Any] = {}
-        for w in self._workers:
+        for w in list(self._workers):
+            b = getattr(w.replica, "breaker", None)
             rep: Dict[str, Any] = {"healthy": w.replica.healthy(),
                                    "alive": w.is_alive(),
-                                   "load": w.replica.load()}
+                                   "failed": w.failed,
+                                   "load": w.replica.load(),
+                                   "breaker": b.snapshot()
+                                   if b is not None else None}
             try:
                 rep["engine"] = w.engine.debug_snapshot()
             except Exception as e:       # torn mid-tick read: partial
@@ -618,10 +1043,23 @@ class Gateway:
             rep["trace_ring"] = (w.ring.summary()
                                  if w.ring is not None else None)
             reps[w.replica.name] = rep
+        sup = None
+        if self._supervisor is not None:
+            sup = {
+                "alive": self._supervisor.is_alive(),
+                "dispatch_timeout_s":
+                    self._supervisor.dispatch_timeout_s,
+                "watchdog_fires":
+                    int(self._supervisor._c_watchdog.value),
+            }
         return {
             "gateway": self.name,
             "draining": self.draining,
             "slow_ttft_ms": self._slow_ttft_ms,
+            "failover_budget": self._failover_budget,
+            "failovers": int(self._c_failovers.value),
+            "retry_budget_exhausted": int(self._c_fo_exhausted.value),
+            "supervisor": sup,
             "router": self._router.snapshot(),
             "replicas": reps,
         }
@@ -639,6 +1077,8 @@ class Gateway:
             "completed": int(self._c_completed.value),
             "tokens": int(self._c_tokens.value),
             "disconnects": int(self._c_disconnects.value),
+            "failovers": int(self._c_failovers.value),
+            "retry_budget_exhausted": int(self._c_fo_exhausted.value),
             "ttft_ms": self._h_ttft.stats(),
             "tpot_ms": self._h_tpot.stats(),
             "router": self._router.snapshot(),
@@ -779,46 +1219,83 @@ class Gateway:
                                      slo=req.slo)
             req.trace.ev("accept", stream=req.stream,
                          prompt_tokens=len(req.input_ids))
-        try:
-            replica = self._router.route(req.digest, trace=req.trace)
-        except NoReplicaError as e:
-            writer.write(_json_response(503, {"error": str(e)},
-                                        extra={"Retry-After": "5"}))
-            await writer.drain()
-            return
-        worker = self._by_replica[replica]
-        try:
-            # the engine's own backpressure fields, read O(1) (a full
-            # health() snapshot per request is scrape-grade work) —
-            # live protection for engines that ALSO take out-of-band
-            # submit() traffic; the gateway's own admission keeps the
-            # engine queue shallower than this bound
-            eng = worker.engine
-            worker.sched.enqueue(
-                req, engine_health={"queued": len(eng.queue),
-                                    "queue_capacity": eng.max_queue})
-        except ShedError as e:
-            self._c_shed.inc()
-            if req.trace is not None:
-                req.trace.ev("shed", retry_after_s=e.retry_after_s)
-                if worker.ring is not None:
-                    worker.ring.finish(req.trace, "shed")
-            writer.write(_json_response(
-                429, {"error": str(e),
-                      "retry_after_s": e.retry_after_s},
-                extra={"Retry-After": str(max(int(e.retry_after_s), 1))}))
-            await writer.drain()
-            return
-        self._c_requests[req.slo].inc()
-        worker.wake()
-        if not worker.is_alive() or not worker.replica.healthy():
-            # raced a worker exit: drain (thread checked its queue
-            # empty and returned as this request landed) or _fail_all
-            # (replica marked unhealthy BEFORE its queue flush, so
-            # either the flush drained this request or this check
-            # catches it) — nothing will ever serve it; take it back
-            # and shed instead of hanging the client
-            worker.sched.cancel(req.request_id)
+        worker = None
+        for attempt in (0, 1):
+            meta: Dict[str, Any] = {}
+            try:
+                replica = self._router.route(
+                    req.digest, trace=req.trace,
+                    allow_probe=attempt == 0, meta=meta)
+            except NoReplicaError as e:
+                writer.write(_json_response(503, {"error": str(e)},
+                                            extra={"Retry-After": "5"}))
+                await writer.drain()
+                return
+            worker = self._by_replica[replica]
+            # the router's verdict is the AUTHORITATIVE probe signal —
+            # only a request the router handed the breaker's probe
+            # slot may report probe_done (inferring from healthy()
+            # would let a replica failing between route and here
+            # impersonate the real probe and corrupt its accounting)
+            req.probe = meta.get("verdict") == "probe"
+            if req.probe and req.trace is not None:
+                req.trace.ev("breaker_half_open",
+                             replica=replica.name)
+            try:
+                # the engine's own backpressure fields, read O(1) (a
+                # full health() snapshot per request is scrape-grade
+                # work) — live protection for engines that ALSO take
+                # out-of-band submit() traffic; the gateway's own
+                # admission keeps the engine queue shallower than this
+                eng = worker.engine
+                worker.sched.enqueue(
+                    req, engine_health={"queued": len(eng.queue),
+                                        "queue_capacity": eng.max_queue})
+            except ShedError as e:
+                self._c_shed.inc()
+                # a shed probe says "overloaded", not "broken":
+                # release the slot without moving the breaker
+                _release_probe(req, worker.replica)
+                if req.trace is not None:
+                    req.trace.ev("shed", retry_after_s=e.retry_after_s)
+                    if worker.ring is not None:
+                        worker.ring.finish(req.trace, "shed")
+                writer.write(_json_response(
+                    429, {"error": str(e),
+                          "retry_after_s": e.retry_after_s},
+                    extra={"Retry-After":
+                           str(max(int(e.retry_after_s), 1))}))
+                await writer.drain()
+                return
+            worker.wake()
+            if worker.is_alive() and not worker.failed \
+                    and (worker.replica.healthy() or req.probe):
+                break
+            # raced a worker exit/failure: drain (thread checked its
+            # queue empty and returned as this request landed),
+            # _fail_all (flush drained this request or this check
+            # catches it), or a probe that reached a replica whose
+            # rebuild isn't live yet — nothing here will serve it;
+            # take it back and RE-ROUTE once through the plain ladder
+            # (ISSUE 12) before giving up with a 503. A probe that hit
+            # a FAILED/dead worker reports failure (re-opens, longer
+            # backoff) — treating it as inconclusive would let a
+            # permanently-unrebuildable replica turn every future
+            # request into a doomed probe detour forever.
+            if not worker.sched.cancel(req.request_id):
+                # somebody already CLAIMED it — the worker's failover
+                # drained its queue (resubmitting this request and
+                # updating req.owner) or its queue flush errored it
+                # into the sink. Either way events are coming;
+                # enqueueing a second copy would serve the request on
+                # two replicas into one sink. Probe accounting, if
+                # any, was settled by the claimant.
+                break
+            _release_probe(req, worker.replica,
+                           False if (worker.failed
+                                     or not worker.is_alive())
+                           else None)
+        else:
             if worker.ring is not None and req.trace is not None:
                 worker.ring.finish(req.trace, "error")
             writer.write(_json_response(
@@ -826,6 +1303,10 @@ class Gateway:
                 extra={"Retry-After": "1"}))
             await writer.drain()
             return
+        self._c_requests[req.slo].inc()
+        # the claimed-race break above may have handed ownership to a
+        # failover target already — never clobber that
+        req.owner = req.owner or worker
         if req.stream:
             await self._stream_sse(worker, req, reader, writer)
         else:
@@ -834,9 +1315,12 @@ class Gateway:
     def _on_disconnect(self, worker: _ReplicaWorker, req: ServeRequest):
         """Client dropped mid-request: cancel on the tick thread so the
         slot/blocks free immediately (satellite: a dropped stream never
-        strands a slot)."""
+        strands a slot). ``req.owner`` tracks failover moves, so the
+        cancel lands on the replica CURRENTLY serving the request, not
+        the one that accepted it."""
         self._c_disconnects.inc()
-        worker.post(lambda: worker.cancel_request(req.request_id, req))
+        w = req.owner or worker
+        w.post(lambda: w.cancel_request(req.request_id, req))
 
     async def _stream_sse(self, worker, req, reader, writer):
         try:
@@ -876,6 +1360,13 @@ class Gateway:
                 try:
                     if ev[0] == "token":
                         payload = {"token": ev[1]}
+                        if faults.inject("stream_stall",
+                                         request=str(req.request_id)):
+                            # slow client / congested wire stand-in:
+                            # stalls THIS coroutine only — the tick
+                            # loop and sibling streams keep moving
+                            await asyncio.sleep(
+                                faults.stream_stall_seconds())
                     elif ev[0] == "done":
                         payload = dict(ev[1], done=True)
                     else:

@@ -74,6 +74,16 @@ EVENT_KINDS = frozenset({
     "engine_queue", "slot_take", "prefill_chunk", "prefill_done",
     "first_token", "tick", "stream_write",
     "preempt", "engine_abort", "engine_finish", "finish",
+    # fleet fault tolerance (ISSUE 12): the failure-path events.
+    #   gateway/supervisor: replica_fail (replica + reason — crash/
+    #     hang/drop), watchdog_fire (stuck_ms), resubmit (to_replica +
+    #     attempt), resume_offset (offset = tokens the client already
+    #     saw, committed = engine-committed prefix length)
+    #   breaker lifecycle, attached to the requests that witness it:
+    #     breaker_open rides the failing requests' traces,
+    #     breaker_half_open / breaker_close ride the probe request's
+    "replica_fail", "watchdog_fire", "resubmit", "resume_offset",
+    "breaker_open", "breaker_half_open", "breaker_close",
 })
 
 # terminal outcomes a ring entry records (finish_reason superset)
@@ -212,7 +222,11 @@ class RequestTraceRing:
                     v, exemplar=trace.request_id)
         slow = comps["ttft_ms"] is not None \
             and comps["ttft_ms"] > self.slow_ttft_ms
-        retain = slow or outcome != "stop"
+        # ISSUE 12: a failed-over request's timeline is retained even
+        # when it finished fast and clean — the failover hop is exactly
+        # what a postmortem needs to see
+        failovers = sum(1 for _, k, _ in trace.events if k == "resubmit")
+        retain = slow or outcome != "stop" or failovers > 0
         entry = {
             "request_id": trace.request_id,
             "tenant": trace.tenant,
@@ -223,6 +237,7 @@ class RequestTraceRing:
             else None,
             "wall_accept": trace.wall0,
             "slow": slow,
+            "failovers": failovers,
             "retained": retain,
             "events": [list(e) for e in trace.events] if retain
             else [],

@@ -32,6 +32,19 @@ Health eviction: a replica whose ``healthy()`` is False is skipped and
 its sticky entries drop (when it comes back it re-earns affinity by
 getting warm again). All replicas unhealthy raises
 :class:`NoReplicaError` (the gateway's 503).
+
+Rejoin (ISSUE 12): eviction is no longer one-way. A replica carrying a
+:class:`~.supervisor.CircuitBreaker` re-enters rotation through it —
+when the breaker's backoff elapses it goes half-open and the router
+diverts exactly ONE request at a time to that replica as a probation
+probe (verdict ``probe``; the gateway marks the request so its
+terminal path reports ``probe_done``). Enough probe successes close
+the breaker, the supervisor flips ``healthy()`` back, and the replica
+is back in the warm -> sticky -> least-loaded ladder; a probe failure
+re-opens with a longer backoff. The probe check runs FIRST so a
+recovering replica gets its probe even while healthy siblings could
+absorb the traffic — and the failover path protects the probe request
+if the replica is still bad.
 """
 from __future__ import annotations
 
@@ -58,6 +71,11 @@ class EngineReplica:
         self.name = name
         self.engine = engine
         self._healthy = True
+        # circuit breaker (ISSUE 12): attached by the gateway's
+        # supervisor; None = legacy one-way health eviction. While the
+        # breaker is half-open the replica stays healthy()==False and
+        # re-enters rotation only via the router's probation probe.
+        self.breaker = None
 
     def healthy(self) -> bool:
         return self._healthy
@@ -124,7 +142,8 @@ class PrefixAffinityRouter:
             self._sticky.popitem(last=False)
 
     # -------------------------------------------------------------- route
-    def route(self, digests=None, trace=None):
+    def route(self, digests=None, trace=None, allow_probe=True,
+              meta=None):
         """Choose a replica for a request whose affinity keys are
         ``digests`` — the prompt's chunk-grid digest CHAIN, longest
         span first (a bare str is accepted as a one-element chain;
@@ -137,10 +156,17 @@ class PrefixAffinityRouter:
         ``trace`` (ISSUE 10): a :class:`~.reqtrace.RequestTrace` to
         record the route DECISION on — which replica won and WHY
         (``warm``/``sticky``/``miss``/``least_loaded``/
-        ``round_robin``), so a slow request's timeline says whether it
-        missed its warm replica."""
+        ``round_robin``/``probe``), so a slow request's timeline says
+        whether it missed its warm replica. ``meta`` (ISSUE 12): an
+        optional dict the verdict is written into (``meta["verdict"]``)
+        — the gateway's authoritative "was this the probation probe"
+        signal (inferring it from ``healthy()`` after the fact races a
+        concurrent replica failure and could mislabel a normal request
+        as the probe, corrupting the real probe's accounting)."""
 
         def _ev(verdict, pick):
+            if meta is not None:
+                meta["verdict"] = verdict
             if trace is not None:
                 trace.ev("route", verdict=verdict,
                          replica=getattr(pick, "name", str(pick)),
@@ -151,6 +177,21 @@ class PrefixAffinityRouter:
             digests = [digests]
         digests = [d for d in (digests or ()) if d]
         with self._lock:
+            # circuit-breaker probation (ISSUE 12): a half-open replica
+            # with a free probe slot takes this request as its probe —
+            # checked before the ladder so recovery is traffic-driven,
+            # and before _healthy() so a fleet that is ALL half-open
+            # probes instead of 503ing. ``allow_probe=False`` is the
+            # gateway's race-retry: a request whose probe target died
+            # re-routes through the plain ladder.
+            if allow_probe:
+                for r in self.replicas:
+                    b = getattr(r, "breaker", None)
+                    if b is not None and not r.healthy() \
+                            and b.try_probe():
+                        if digests:
+                            self._c_miss.inc()
+                        return _ev("probe", r)
             up = self._healthy()
             if self.policy == "round_robin":
                 pick = up[self._rr % len(up)]
@@ -196,7 +237,7 @@ class PrefixAffinityRouter:
                 del self._sticky[k]
 
     def snapshot(self) -> Dict[str, Any]:
-        return {
+        snap = {
             "policy": self.policy,
             "replicas_up": sum(r.healthy() for r in self.replicas),
             "replicas": len(self.replicas),
@@ -204,3 +245,8 @@ class PrefixAffinityRouter:
             "prefix_route_misses": int(self._c_miss.value),
             "sticky_entries": len(self._sticky),
         }
+        breakers = {r.name: r.breaker.state for r in self.replicas
+                    if getattr(r, "breaker", None) is not None}
+        if breakers:
+            snap["breakers"] = breakers
+        return snap

@@ -79,7 +79,8 @@ class ServeRequest:
     __slots__ = ("request_id", "input_ids", "gen", "slo", "tenant",
                  "priority", "deadline", "t_enqueue", "digest", "sink",
                  "stream", "emitted", "t_admit", "t_first", "t_last",
-                 "n_out", "promoted", "trace")
+                 "n_out", "promoted", "trace", "failovers", "probe",
+                 "resume", "owner")
 
     def __init__(self, request_id, input_ids, gen: Dict[str, Any],
                  slo: str = SLO_INTERACTIVE, tenant: str = "default",
@@ -106,6 +107,17 @@ class ServeRequest:
         self.t_last: Optional[float] = None
         self.n_out = 0
         self.promoted = False
+        # fleet fault tolerance (ISSUE 12): ``failovers`` counts
+        # replica-failure resubmissions against the gateway's retry
+        # budget; ``resume`` holds the engine-exported descriptor
+        # (prompt + committed tokens) the next _admit submits from;
+        # ``probe`` marks the request as a circuit-breaker probation
+        # probe; ``owner`` is the worker currently serving it (updated
+        # on failover so a disconnect cancels at the RIGHT replica).
+        self.failovers = 0
+        self.probe = False
+        self.resume: Optional[Dict[str, Any]] = None
+        self.owner = None
 
 
 class SLOScheduler:

@@ -51,11 +51,15 @@ ENV_VAR = "PADDLE_TPU_FAULTS"
 SEED_ENV_VAR = "PADDLE_TPU_FAULT_SEED"
 HANG_ENV_VAR = "PADDLE_TPU_FAULT_HANG_S"
 PREFETCH_STALL_ENV_VAR = "PADDLE_TPU_FAULT_PREFETCH_STALL_S"
+DISPATCH_HANG_ENV_VAR = "PADDLE_TPU_FAULT_DISPATCH_HANG_S"
+STREAM_STALL_ENV_VAR = "PADDLE_TPU_FAULT_STREAM_STALL_S"
+SLOW_REPLICA_ENV_VAR = "PADDLE_TPU_FAULT_SLOW_REPLICA_S"
 
 __all__ = [
     "SITES", "inject", "scoped", "configure", "reset", "parse_spec",
     "retry_with_backoff", "BackpressureError", "RequestTimeoutError",
-    "hang_seconds", "prefetch_stall_seconds", "main",
+    "hang_seconds", "prefetch_stall_seconds", "dispatch_hang_seconds",
+    "stream_stall_seconds", "slow_replica_seconds", "main",
 ]
 
 # ------------------------------------------------------------- inventory
@@ -103,6 +107,41 @@ SITES: Dict[str, Tuple[str, str]] = {
         "wedged host input pipeline stand-in; the consumer's stall "
         "timeout degrades the trainer to synchronous feeding instead of "
         "deadlocking the step loop)"),
+    # --- serving-fleet chaos (ISSUE 12): the five replica-level sites
+    # the chaos harness (tools/serve_loadgen.py --chaos) and the
+    # supervisor/failover tests arm. All wired into the gateway's
+    # replica tick loop / SSE writer.
+    "tick_crash": (
+        "paddle_tpu/serving/gateway.py:_ReplicaWorker.run",
+        "raise RuntimeError on the replica's tick thread before the "
+        "next engine.step() (software crash stand-in; exercises "
+        "_fail_all's failover hand-off: live requests resubmit to a "
+        "surviving replica, the supervisor rebuilds the engine and "
+        "rejoins it through the circuit breaker)"),
+    "dispatch_hang": (
+        "paddle_tpu/serving/gateway.py:_ReplicaWorker.run",
+        "sleep PADDLE_TPU_FAULT_DISPATCH_HANG_S (default 3600) on the "
+        "tick thread with the dispatch-busy marker set (wedged fused "
+        "dispatch stand-in; exercises the supervisor watchdog's "
+        "dispatch-to-drain deadline: the replica is abandoned, its "
+        "requests fail over, the engine is rebuilt)"),
+    "replica_drop": (
+        "paddle_tpu/serving/gateway.py:_ReplicaWorker.run",
+        "hard-exit the replica's tick thread with NO cleanup (process "
+        "kill stand-in; exercises the supervisor's dead-thread "
+        "detection + failover — nothing on the dying thread runs)"),
+    "stream_stall": (
+        "paddle_tpu/serving/gateway.py:Gateway._stream_sse",
+        "sleep PADDLE_TPU_FAULT_STREAM_STALL_S (default 5) in the SSE "
+        "writer before the next token event (slow client / congested "
+        "wire stand-in; one stalled stream must not stall the replica "
+        "tick loop or corrupt the stream's token order)"),
+    "slow_replica": (
+        "paddle_tpu/serving/gateway.py:_ReplicaWorker.run",
+        "sleep PADDLE_TPU_FAULT_SLOW_REPLICA_S (default 0.05) per tick "
+        "on the replica's tick thread (degraded-host stand-in; the "
+        "watchdog must NOT fire below its deadline, and least-loaded "
+        "routing shifts traffic off the slow replica)"),
 }
 
 
@@ -299,6 +338,21 @@ def hang_seconds() -> float:
 def prefetch_stall_seconds() -> float:
     """How long a fired ``prefetch_stall`` site wedges the producer."""
     return float(os.environ.get(PREFETCH_STALL_ENV_VAR, "30"))
+
+
+def dispatch_hang_seconds() -> float:
+    """How long a fired ``dispatch_hang`` site wedges the tick thread."""
+    return float(os.environ.get(DISPATCH_HANG_ENV_VAR, "3600"))
+
+
+def stream_stall_seconds() -> float:
+    """How long a fired ``stream_stall`` site delays the SSE writer."""
+    return float(os.environ.get(STREAM_STALL_ENV_VAR, "5"))
+
+
+def slow_replica_seconds() -> float:
+    """Per-tick delay of a fired ``slow_replica`` site."""
+    return float(os.environ.get(SLOW_REPLICA_ENV_VAR, "0.05"))
 
 
 # ---------------------------------------------------------------- retry

@@ -23,7 +23,10 @@ worker died". This module is the one substrate they all feed:
 - **Flight recorder** — a bounded ring buffer of recent structured
   events (step end, fault fires, rollbacks, prefetch stalls,
   checkpoint save/restore, preemption latch, serving
-  admits/rejects/preemptions) dumped to ``<run_dir>/flight_<attempt>.json``
+  admits/rejects/preemptions, and the serving fleet's failure
+  lifecycle: replica fail/restart, watchdog fires, circuit-breaker
+  transitions — ISSUE 12) dumped to
+  ``<run_dir>/flight_<attempt>.json``
   on crash, SIGTERM/preemption, or divergence rollback — the 30-second
   postmortem a print log can't give.
 

@@ -105,6 +105,11 @@ MUST_BE_SLOW = (
     # one pre-policy bench (flipped at 2.56x/3.0 under full-suite load;
     # the rest of test_dataloader_mp.py keeps the correctness coverage)
     r"test_dataloader_mp\.py.*speedup",
+    # ISSUE 12: the seeded chaos sweep — multi-seed open-loop loadgen
+    # runs with mid-run replica kills + full reference replays (tier-1
+    # keeps the single-kill failover e2e pins in test_failover.py:
+    # test_failover_stream_bitwise_vs_uninterrupted and friends)
+    r"test_failover\.py.*chaos",
     # ISSUE 11: the seeded sampled-spec distribution sweep (~190s of
     # engine runs; tier-1 keeps the residual-resample marginal unit +
     # the decisive-logits exact pin), and the ISSUE-11 tier-budget

@@ -23,6 +23,13 @@ prefix-route hit split; and writes ``SERVE_LOADGEN_r07.json`` next to
 bench.py, which auto-ingests the ``gateway_p99_ttft_ms`` /
 ``gateway_tokens_per_sec`` rung alongside ``paged_tokens_per_sec``
 (same device + freshness gating as the decode-profile rung).
+
+``--chaos`` (ISSUE 12) turns the run into the seeded fault-tolerance
+acceptance harness: replicas are killed/hung mid-run at deterministic
+points, every completed greedy stream is replayed BITWISE against a
+fresh reference engine, and the run fails (nonzero exit) on any
+corrupted stream, on 5xx counts beyond the retry-budget bound, or on
+a completed fraction below ``--goodput-floor`` (docs/SERVING.md).
 """
 import argparse
 import asyncio
@@ -123,7 +130,10 @@ async def sse_generate(host: str, port: int, payload: dict,
 # ----------------------------------------------------------------- fleet
 def _build_gateway(ns):
     """Self-hosted replica fleet: chunked prefill + prefix caching on
-    every engine so affinity routing has warm blocks to find."""
+    every engine so affinity routing has warm blocks to find. Returns
+    ``(gateway, engines, engine_factory)`` — the factory is what
+    ``--chaos`` hands the supervisor so killed replicas rebuild on
+    fresh engines."""
     import jax
     try:
         jax.config.update("jax_compilation_cache_dir",
@@ -138,11 +148,21 @@ def _build_gateway(ns):
 
     pt.seed(0)
     if ns.model == "stub":
-        model = _stub_model()
         engine_kw = dict(max_slots=4, num_blocks=128, block_size=8,
                          max_blocks_per_seq=16, prefill_buckets=(16,),
                          chunk_prefill_tokens=ns.sys_tokens or 8,
                          enable_prefix_cache=True)
+        # non-chaos rung semantics unchanged: ONE shared stub (ticks
+        # serialize on the per-model lock exactly as before). Under
+        # --chaos each engine gets its own stub — a hung replica's
+        # abandoned thread must never share a layer tree (or a tick
+        # lock) with its replacement.
+        shared_stub = None if getattr(ns, "chaos", False) \
+            else _stub_model()
+
+        def _model():
+            return shared_stub if shared_stub is not None \
+                else _stub_model()
     else:
         from paddle_tpu.models import LlamaForCausalLM
         from paddle_tpu.models.llama import llama_tiny
@@ -151,14 +171,45 @@ def _build_gateway(ns):
                          max_blocks_per_seq=16, prefill_buckets=(32,),
                          chunk_prefill_tokens=ns.sys_tokens or 32,
                          enable_prefix_cache=True)
+
+        def _model():
+            return model
     # --ring off: the synchronous-readback reference engines (ISSUE 11
     # A/B — same workload, same gateway, only the tick readback
     # architecture differs)
     engine_kw["ring_mode"] = getattr(ns, "ring", "on") == "on"
-    engines = [PagedEngine(model, **engine_kw)
-               for _ in range(ns.replicas)]
-    gw = Gateway(engines, routing=ns.policy, max_queue=ns.max_queue)
-    return gw, engines
+
+    chaos = bool(getattr(ns, "chaos", False))
+
+    def engine_factory():
+        eng = PagedEngine(_model(), **engine_kw)
+        if chaos:
+            # compile-before-traffic (what a real fleet's readiness
+            # probe guarantees): a cold engine's FIRST step pays the
+            # executable build/deserialize — far over the sub-second
+            # chaos watchdog deadline — so warm every engine (and
+            # every supervisor REBUILD, which runs this same factory)
+            # before it can take traffic
+            eng.submit("warmup", list(range(1, 5)), max_new_tokens=4)
+            eng.run()
+            eng.results.pop("warmup", None)
+            eng.logprobs.pop("warmup", None)
+        return eng
+
+    engines = [engine_factory() for _ in range(ns.replicas)]
+    gw_kw = dict(routing=ns.policy, max_queue=ns.max_queue)
+    if chaos:
+        # fast-recovery supervision knobs sized for a short chaos run:
+        # sub-second watchdog + breaker backoff so kills, failovers
+        # AND rejoins all land inside the measured window
+        gw_kw.update(engine_factory=engine_factory,
+                     failover_budget=getattr(ns, "failover_budget", 2),
+                     watchdog_timeout_s=getattr(
+                         ns, "watchdog_timeout_s", 0.5),
+                     watchdog_interval_s=0.02,
+                     breaker_backoff_s=0.2)
+    gw = Gateway(engines, **gw_kw)
+    return gw, engines, engine_factory
 
 
 def _stub_model():
@@ -179,14 +230,52 @@ def _pct(sorted_vals, q):
 
 async def run_loadgen(ns) -> dict:
     rng = random.Random(ns.seed)
-    gw = engines = None
+    gw = engines = engine_factory = None
+    chaos = bool(getattr(ns, "chaos", False))
     if ns.url:
+        if chaos:
+            raise SystemExit("--chaos requires self-hosted mode "
+                             "(it injects faults into its own fleet)")
         host, _, port = ns.url.partition(":")
         port = int(port)
     else:
-        gw, engines = _build_gateway(ns)
+        gw, engines, engine_factory = _build_gateway(ns)
         await gw.start()
         host, port = gw.host, gw.port
+    # chaos schedule (ISSUE 12): seeded kill/hang points spread evenly
+    # over the request stream — deterministic per (--seed,
+    # --chaos-kills, --chaos-mode), replica picked by a seeded RNG
+    chaos_plan = {}
+    chaos_events = []
+    if chaos:
+        if ns.replicas < 2:
+            raise SystemExit("--chaos needs --replicas >= 2: failover "
+                             "requires a surviving replica, so a "
+                             "single-replica chaos run can only fail")
+        if getattr(ns, "chaos_mode", "mix") == "hang" \
+                or getattr(ns, "chaos_mode", "mix") == "mix":
+            # a finite injected hang: the abandoned thread wakes after
+            # the watchdog already replaced it, sees the flag and exits
+            os.environ.setdefault("PADDLE_TPU_FAULT_DISPATCH_HANG_S",
+                                  "2")
+        crng = random.Random(ns.seed + 1)
+        kinds = {"kill": ("crash",), "hang": ("hang",),
+                 "mix": ("crash", "hang")}[getattr(ns, "chaos_mode",
+                                                   "mix")]
+        kills = max(int(getattr(ns, "chaos_kills", 2)), 1)
+        for j in range(kills):
+            pt = max(1, round((j + 1) * ns.requests / (kills + 1)))
+            while pt in chaos_plan and pt < ns.requests - 1:
+                pt += 1
+            if pt in chaos_plan:
+                # more kills than schedulable request points: say so
+                # instead of silently under-delivering fault coverage
+                print(f"warning: only {len(chaos_plan)} of "
+                      f"{kills} --chaos-kills fit before request "
+                      f"{ns.requests}", file=sys.stderr)
+                break
+            chaos_plan[pt] = (kinds[j % len(kinds)],
+                              crng.randrange(ns.replicas))
     vocab = 120
     sysp = [rng.randrange(1, vocab) for _ in range(ns.sys_tokens)]
 
@@ -229,12 +318,28 @@ async def run_loadgen(ns) -> dict:
         rec["shared"] = shared
         rec["tenant"] = payload["tenant"]
         rec["slo"] = payload["slo"]
+        if chaos:
+            rec["prompt"] = payload["prompt"]   # for the reference replay
         records.append(rec)
+
+    def _fire_chaos(i):
+        kind, target = chaos_plan[i]
+        workers = gw._workers
+        w = workers[target % len(workers)]
+        if w.failed or w.abandoned or not w.is_alive():
+            w = next((x for x in workers
+                      if x.is_alive() and not x.failed
+                      and not x.abandoned), w)
+        w.inject_fault(kind)
+        chaos_events.append({"at_request": i, "kind": kind,
+                             "replica": w.replica.name})
 
     t0 = time.perf_counter()
     tasks = []
     for i in range(ns.requests):
         tasks.append(asyncio.ensure_future(_one(i)))
+        if i in chaos_plan:
+            _fire_chaos(i)
         if i < ns.requests - 1:
             # open-loop Poisson arrivals: exponential gaps at the
             # offered rate, slept regardless of completions
@@ -316,7 +421,55 @@ async def run_loadgen(ns) -> dict:
         trace_dir = getattr(ns, "trace_dir", None)
         if trace_dir:
             rung["trace_rings"] = gw.dump_traces(trace_dir)
+    if chaos:
+        rung["chaos"] = _verify_chaos(ns, gw, engine_factory, records,
+                                      chaos_events)
     return rung
+
+
+def _verify_chaos(ns, gw, engine_factory, records, chaos_events):
+    """The --chaos acceptance gate (ISSUE 12): replay every COMPLETED
+    greedy stream on a fresh reference engine and demand bitwise
+    equality — a failover that duplicated, dropped or rewrote a token
+    shows up as a corrupted stream; assert the error count stays
+    within the retry-budget bound (kills <= budget ==> every stream
+    survives, so zero 5xx) and the completed fraction clears the
+    goodput floor. ``ok`` False flips the CLI's exit code."""
+    ref = engine_factory()
+    done = [r for r in records if r["finish_reason"] == "stop"]
+    for r in done:
+        ref.submit(r["request_id"], r["prompt"],
+                   max_new_tokens=ns.max_new)
+    expect = ref.run()
+    corrupted = [r["request_id"] for r in done
+                 if r["tokens"] != expect[r["request_id"]]]
+    errors = sum(r["finish_reason"] == "error" for r in records) \
+        + sum(r["status"] in (500, 503) for r in records)
+    h = gw.health()
+    budget = getattr(ns, "failover_budget", 2)
+    floor = float(getattr(ns, "goodput_floor", 0.95))
+    # the documented amplification bound: a request rides at most one
+    # failover per replica kill, so kills within the budget mean no
+    # request can exhaust it — any 5xx is then a real defect
+    error_bound = 0 if len(chaos_events) <= budget else ns.requests
+    completed_frac = len(done) / max(ns.requests, 1)
+    ch = {
+        "events": chaos_events,
+        "kills": len(chaos_events),
+        "failover_budget": budget,
+        "failovers": int(h["failovers"]),
+        "retry_budget_exhausted": int(h["retry_budget_exhausted"]),
+        "replays_checked": len(done),
+        "corrupted_streams": len(corrupted),
+        "corrupted_ids": corrupted[:8],
+        "errors_5xx": errors,
+        "error_bound": error_bound,
+        "completed_frac": round(completed_frac, 3),
+        "goodput_floor": floor,
+    }
+    ch["ok"] = (not corrupted and errors <= error_bound
+                and completed_frac >= floor)
+    return ch
 
 
 def main(argv=None) -> int:
@@ -344,6 +497,30 @@ def main(argv=None) -> int:
                     help="async token-ring decode on the replica "
                          "engines (off = synchronous per-tick "
                          "readback, the ISSUE 11 A/B reference)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="seeded chaos harness (ISSUE 12): kill/hang "
+                         "replicas mid-run, then assert zero "
+                         "corrupted streams (bitwise replay against "
+                         "a fresh reference engine), errors within "
+                         "the retry-budget bound, and the goodput "
+                         "floor; nonzero exit on violation")
+    ap.add_argument("--chaos-kills", type=int, default=2,
+                    help="replica faults to inject, spread evenly "
+                         "over the request stream")
+    ap.add_argument("--chaos-mode", default="mix",
+                    choices=("kill", "hang", "mix"),
+                    help="tick-thread crash, stuck dispatch, or "
+                         "alternating")
+    ap.add_argument("--failover-budget", type=int, default=2,
+                    help="replica failures one request may ride "
+                         "through before it errors (Gateway "
+                         "failover_budget)")
+    ap.add_argument("--watchdog-timeout-s", type=float, default=0.5,
+                    help="dispatch-to-drain watchdog deadline under "
+                         "--chaos")
+    ap.add_argument("--goodput-floor", type=float, default=0.95,
+                    help="minimum completed-request fraction the "
+                         "chaos run must clear")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--url", default=None,
                     help="attach to HOST:PORT instead of self-hosting")
@@ -370,6 +547,15 @@ def main(argv=None) -> int:
                        "gateway": rung}, f, indent=1)
         os.replace(tmp, ns.out)
         print(f"wrote {ns.out}", file=sys.stderr)
+    ch = rung.get("chaos")
+    if ch is not None and not ch["ok"]:
+        print("CHAOS FAILED: "
+              f"corrupted={ch['corrupted_streams']} "
+              f"errors_5xx={ch['errors_5xx']} (bound "
+              f"{ch['error_bound']}) completed_frac="
+              f"{ch['completed_frac']} (floor {ch['goodput_floor']})",
+              file=sys.stderr)
+        return 1
     return 0
 
 

@@ -112,7 +112,14 @@ def summarize(docs: List[dict],
             comps[key] = {"n": len(pairs),
                           "p50": round(p50, 2), "p99": round(p99, 2),
                           "p99_request_id": rid99}
+        # failover hops (ISSUE 12): how many requests in this class
+        # rode a replica failure, and the total resubmission count —
+        # failed-over timelines are always retained, so the hops are
+        # printed event-by-event below
+        fo = [e.get("failovers", 0) or 0 for e in es]
         classes[slo] = {"requests": len(es), "outcomes": outcomes,
+                        "failed_over": sum(1 for n in fo if n),
+                        "failover_hops": sum(fo),
                         "components": comps}
 
     slowest = sorted((e for e in entries if e.get("retained")
@@ -160,7 +167,11 @@ def render(s: Dict[str, Any]) -> str:
     for slo, cls in s["classes"].items():
         oc = " ".join(f"{k}={v}" for k, v in
                       sorted(cls["outcomes"].items()))
-        lines.append(f"class {slo}: n={cls['requests']}   {oc}")
+        fo = ""
+        if cls.get("failed_over"):
+            fo = (f"   failed-over {cls['failed_over']} "
+                  f"({cls['failover_hops']} hops)")
+        lines.append(f"class {slo}: n={cls['requests']}   {oc}{fo}")
         for key in ("ttft_ms",) + COMPONENTS + ("tpot_ms",):
             c = cls["components"][key]
             if not c["n"]:
@@ -172,9 +183,11 @@ def render(s: Dict[str, Any]) -> str:
     if s["slowest_retained"]:
         lines.append("slowest retained timelines:")
         for e in s["slowest_retained"]:
+            hop = (f" failovers={e['failovers']}"
+                   if e.get("failovers") else "")
             lines.append(
                 f"  {e['request_id']}  slo={e['slo']} "
-                f"outcome={e['outcome']} ttft={e['ttft_ms']}ms "
+                f"outcome={e['outcome']}{hop} ttft={e['ttft_ms']}ms "
                 f"(queue {e.get('queue_wait_ms')} / prefill "
                 f"{e.get('prefill_ms')} / first-tick "
                 f"{e.get('first_tick_ms')})")
