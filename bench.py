@@ -568,6 +568,9 @@ def main():
         _ingest_rung(result, probe, "SERVE_LOADGEN_r07.json", "gateway",
                      "gateway_profile",
                      ("gateway_tokens_per_sec", "gateway_p99_ttft_ms"))
+        _ingest_rung(result, probe, "SERVE_FLEET_r13.json", "fleet",
+                     "fleet_profile",
+                     ("fleet_tokens_per_sec", "goodput_per_replica"))
 
     # (c) always emit exactly one JSON line.
     if result is not None:

@@ -347,6 +347,11 @@ class PagedEngine:
         self.prefix_caching = bool(enable_prefix_cache)
         self.prefix_cache: Dict[tuple, tuple] = {}   # key -> block ids
         self._prefix_rev: Dict[int, set] = {}        # block -> keys
+        # fleet prefix gossip (ISSUE 13): bumped on every prefix-cache
+        # set mutation (register / evict / reset) so a remote poller
+        # can skip re-fetching an unchanged digest set. Monotonic for
+        # the engine's lifetime — never reset, even by hard_reset().
+        self.prefix_generation = 0
         self.block_refs: Dict[int, int] = {}         # live owner count
         self.cached_free: Dict[int, None] = {}       # LRU, insertion order
         L = cfg.num_hidden_layers
@@ -1110,6 +1115,7 @@ class PagedEngine:
             entry = self.prefix_cache.pop(key, None)
             if entry is not None:
                 self._unhook(key, entry)
+                self.prefix_generation += 1
         self._prefix_rev.pop(b, None)
 
     def _release_block(self, b: int):
@@ -1219,6 +1225,7 @@ class PagedEngine:
             if old is not None:  # last-writer-wins
                 self._unhook(key, old)
             self.prefix_cache[key] = entry
+            self.prefix_generation += 1
             for b in entry:
                 self._prefix_rev.setdefault(b, set()).add(key)
 
@@ -1600,7 +1607,8 @@ class PagedEngine:
                 "free_frac": round((free + parked) / max(total, 1), 4),
                 "fragmentation_frac": round(parked / max(total, 1), 4),
             },
-            "prefix_cache": {"entries": n_entries, "digests": digests},
+            "prefix_cache": {"entries": n_entries, "digests": digests,
+                             "generation": self.prefix_generation},
             "queued": [str(r.request_id)
                        for r in list(self.queue)[:max_digests]],
             "spec": {"enabled": bool(self._spec_k), "k": self._spec_k,
@@ -1694,6 +1702,9 @@ class PagedEngine:
         self.results = {}
         self.logprobs = {}
         self.cancelled = {}
+        if self.prefix_cache:
+            # the cache set changed (to empty): gossip must notice
+            self.prefix_generation += 1
         self.prefix_cache = {}
         self._prefix_rev = {}
         self.block_refs = {}

@@ -4,6 +4,13 @@ continuous-batching admission (:mod:`.scheduler`), prefix-cache-aware
 multi-replica routing (:mod:`.router`), and the stdlib-only gateway
 server with graceful SIGTERM drain (:mod:`.gateway`).
 
+The multi-host fleet layer (ISSUE 13) lives in :mod:`.fleet`: remote
+replica adapters over peer-gateway HTTP probes, the byte-for-byte
+proxying frontend with cross-process failover, prefix-digest gossip
+and the closed-loop autoscaler. Import it explicitly
+(``from paddle_tpu.serving.fleet import FleetFrontend, ...``) — the
+gateway itself stays importable without the fleet machinery.
+
 See ``docs/SERVING.md`` for the API schema, SLO classes, drain
 semantics and the load-generator reading guide.
 """

@@ -1,0 +1,32 @@
+"""Multi-host serving fleet (ISSUE 13): the layer that turns N
+gateway PROCESSES into one service — the "millions of users" tier the
+single-process gateway cannot reach (ROADMAP item 2).
+
+- :mod:`.remote` — :class:`RemoteReplica`: the router's duck-typed
+  replica seam (``healthy``/``load``/``has_prefix``) implemented over
+  cached HTTP probes of a peer gateway (``/healthz`` + the
+  ``/debugz/prefix`` digest gossip), with staleness bounds.
+- :mod:`.frontend` — :class:`FleetFrontend`: prefix-affinity routing
+  over remote peers, byte-for-byte SSE proxying, and mid-stream peer
+  failover through the HTTP face of the ISSUE-12 resume seam (greedy
+  streams bitwise identical across a peer death).
+- :mod:`.autoscaler` — :class:`FleetAutoscaler`: the closed loop over
+  the PR-8 gauges (queue depth, free slots, block pressure, goodput
+  fraction) with hysteresis + cooldown, spawning/draining replica
+  processes under SIGTERM-drain semantics.
+- :mod:`.manager` — :class:`LocalProcessManager`: the process backend
+  (spawn ``replica_main`` subprocesses, SIGTERM drains, SIGKILL
+  chaos).
+
+See ``docs/SERVING.md`` ("Fleet serving") and
+``docs/FAULT_TOLERANCE.md`` §4c (remote failure model).
+"""
+from .autoscaler import FleetAutoscaler
+from .frontend import FleetFrontend
+from .manager import LocalProcessManager
+from .remote import RemoteReplica, prefix_digest_chain
+
+__all__ = [
+    "FleetAutoscaler", "FleetFrontend", "LocalProcessManager",
+    "RemoteReplica", "prefix_digest_chain",
+]

@@ -1,0 +1,228 @@
+"""Closed-loop fleet autoscaling (ISSUE 13 tentpole; reference: the
+goodput-per-chip cost framing of the TPU-serving comparison paper in
+PAPERS.md — replicas cost chip-seconds whether or not they serve, so
+the controller's objective is goodput per replica-second, not raw
+queue draining).
+
+:class:`FleetAutoscaler` closes the loop the PR-8 gauges were exported
+for: it reads the signal quartet — ``gateway_queue_depth``,
+``engine_free_slots``, ``block_pool_free_frac``,
+``gateway_goodput_frac`` — off each peer's cached probe snapshot
+(:meth:`~.remote.RemoteReplica.signals`; one ``/healthz`` fetch per
+peer per probe interval, no new wire protocol) and drives a replica
+COUNT through a manager's ``scale_up()``/``scale_down()``:
+
+- **Scale up** when queue depth per replica, slot saturation, block
+  pressure, or a sagging goodput fraction stays over threshold for
+  ``hold_s`` — sustained pressure, not a one-poll blip.
+- **Scale down** when the fleet is demonstrably idle (no queue, load
+  under ``down_load_frac``) for ``hold_down_s``.
+- **Hysteresis + cooldown** — the up and down thresholds leave a dead
+  band between them, both conditions must HOLD for their window, and
+  any action opens a ``cooldown_s`` lockout: a diurnal load trace
+  scales up the ramp and back down the far side instead of flapping
+  at the crest. Spawns in flight count toward the target (a slow
+  cold-start must not trigger a second spawn).
+
+Replica processes come and go under the existing SIGTERM-drain
+semantics: the manager's ``scale_down`` SIGTERMs a gateway process,
+whose ``run_until_shutdown`` latches draining (503 new work, finish
+in-flight, flush, exit) — the autoscaler never drops a live stream.
+
+The controller is deliberately synchronous and clock-injectable:
+``step(now)`` makes one decision and is what unit tests drive;
+``start()`` wraps it in a daemon thread for real fleets. Accounting
+(``replica_seconds``, the goodput-per-replica denominator) rides the
+same loop.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ...utils import observability as obs
+
+__all__ = ["FleetAutoscaler"]
+
+
+class FleetAutoscaler:
+    """Replica-count controller over a manager.
+
+    ``manager`` duck type: ``replicas()`` -> list of objects with
+    ``signals()`` (:class:`~.remote.RemoteReplica` or a test fake),
+    ``pending()`` -> spawns in flight, ``scale_up()``,
+    ``scale_down()``. The local-process implementation is
+    :class:`~.manager.LocalProcessManager`."""
+
+    def __init__(self, manager, *,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 up_queue_depth: float = 2.0,
+                 up_free_slot_frac: float = 0.125,
+                 up_block_free_frac: float = 0.10,
+                 goodput_floor: Optional[float] = None,
+                 down_load_frac: float = 0.25,
+                 hold_s: float = 1.0, hold_down_s: float = 3.0,
+                 cooldown_s: float = 5.0,
+                 interval_s: float = 0.25,
+                 clock: Callable[[], float] = time.monotonic):
+        self.manager = manager
+        self.min_replicas = max(int(min_replicas), 1)
+        self.max_replicas = max(int(max_replicas), self.min_replicas)
+        self.up_queue_depth = float(up_queue_depth)
+        self.up_free_slot_frac = float(up_free_slot_frac)
+        self.up_block_free_frac = float(up_block_free_frac)
+        self.goodput_floor = goodput_floor
+        self.down_load_frac = float(down_load_frac)
+        self.hold_s = float(hold_s)
+        self.hold_down_s = float(hold_down_s)
+        self.cooldown_s = float(cooldown_s)
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._up_since: Optional[float] = None
+        self._down_since: Optional[float] = None
+        self._last_action: Optional[float] = None
+        self._last_t: Optional[float] = None
+        self.replica_seconds = 0.0
+        self.events: List[Dict[str, Any]] = []
+        self._halt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        labels = {"fleet": getattr(manager, "name", "fleet")}
+        reg = obs.registry()
+        self._g_replicas = reg.gauge("fleet_autoscale_replicas",
+                                     **labels)
+        self._c_up = reg.counter("fleet_scale_ups_total", **labels)
+        self._c_down = reg.counter("fleet_scale_downs_total", **labels)
+
+    # ------------------------------------------------------------- signals
+    def aggregate(self) -> Dict[str, Any]:
+        """Fold the per-peer signal quartet into the fleet view the
+        decision reads. Only HEALTHY peers contribute load numbers —
+        a dead peer's stale queue must not hold replicas up."""
+        sigs = [r.signals() for r in self.manager.replicas()]
+        live = [s for s in sigs if s.get("healthy")]
+        n = len(live)
+        qd = sum(s["queue_depth"] for s in live)
+        free = sum(s["free_slots"] for s in live)
+        total = sum(s["total_slots"] for s in live)
+        return {
+            "replicas": len(sigs),
+            "live": n,
+            "pending": int(self.manager.pending()),
+            "queue_depth": qd,
+            "queue_depth_per_replica": qd / max(n, 1),
+            "free_slots": free,
+            "total_slots": total,
+            "free_slot_frac": free / total if total else 1.0,
+            "load_frac": 1.0 - (free / total) if total else 0.0,
+            "block_pool_free_frac": min(
+                (s["block_pool_free_frac"] for s in live),
+                default=1.0),
+            "goodput_frac": min((s["goodput_frac"] for s in live),
+                                default=1.0),
+        }
+
+    # ------------------------------------------------------------ decision
+    def step(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One control decision. Returns the aggregate it saw plus the
+        action taken (``"up"``/``"down"``/``None``)."""
+        now = self._clock() if now is None else now
+        agg = self.aggregate()
+        # replica-seconds accounting: the goodput-per-replica
+        # denominator (chip cost proxy — a pending spawn is already
+        # paying its cold start, count it)
+        if self._last_t is not None:
+            self.replica_seconds += \
+                (agg["live"] + agg["pending"]) * max(
+                    now - self._last_t, 0.0)
+        self._last_t = now
+        n_eff = agg["live"] + agg["pending"]
+        self._g_replicas.set(n_eff)
+        action = None
+        pressure_up = (
+            agg["live"] > 0
+            and (agg["queue_depth_per_replica"] > self.up_queue_depth
+                 or agg["free_slot_frac"] <= self.up_free_slot_frac
+                 or agg["block_pool_free_frac"]
+                 <= self.up_block_free_frac
+                 or (self.goodput_floor is not None
+                     and agg["goodput_frac"] < self.goodput_floor)))
+        pressure_down = (
+            agg["queue_depth"] == 0
+            and agg["load_frac"] <= self.down_load_frac)
+        # hold windows: sustained pressure only (hysteresis lives in
+        # the dead band between up_* and down_* thresholds, plus the
+        # separate hold windows). Explicit None checks: t=0.0 is a
+        # legitimate window-open timestamp under an injected clock.
+        if pressure_up:
+            if self._up_since is None:
+                self._up_since = now
+        else:
+            self._up_since = None
+        if pressure_down:
+            if self._down_since is None:
+                self._down_since = now
+        else:
+            self._down_since = None
+        cooled = self._last_action is None \
+            or now - self._last_action >= self.cooldown_s
+        if (self._up_since is not None
+                and now - self._up_since >= self.hold_s
+                and cooled and n_eff < self.max_replicas):
+            self.manager.scale_up()
+            self._c_up.inc()
+            action = "up"
+        elif (self._down_since is not None
+                and now - self._down_since >= self.hold_down_s
+                and cooled and agg["pending"] == 0
+                and agg["live"] > self.min_replicas):
+            self.manager.scale_down()
+            self._c_down.inc()
+            action = "down"
+        if action is not None:
+            self._last_action = now
+            self._up_since = self._down_since = None
+            ev = {"t": round(now, 3), "action": action,
+                  "replicas_before": n_eff,
+                  "queue_depth_per_replica":
+                      round(agg["queue_depth_per_replica"], 2),
+                  "free_slot_frac": round(agg["free_slot_frac"], 3),
+                  "goodput_frac": round(agg["goodput_frac"], 3)}
+            self.events.append(ev)
+            obs.record_event("fleet_autoscale", **ev)
+        return dict(agg, action=action)
+
+    # ------------------------------------------------------------- thread
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._halt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="fleet-autoscaler")
+        self._thread.start()
+
+    def stop(self, timeout: float = 2.0):
+        self._halt.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+    def _loop(self):
+        while not self._halt.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception as e:  # control must outlive any bug
+                obs.record_event("fleet_autoscale_error", err=repr(e))
+
+    # ------------------------------------------------------------- exports
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "scale_ups": int(self._c_up.value),
+            "scale_downs": int(self._c_down.value),
+            "replica_seconds": round(self.replica_seconds, 3),
+            "cooldown_s": self.cooldown_s,
+            "events": list(self.events[-32:]),
+            "aggregate": self.aggregate(),
+        }

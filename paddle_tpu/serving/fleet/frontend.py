@@ -1,0 +1,692 @@
+"""Fleet front door: proxy ``/v1/generate`` over remote peer gateways
+(ISSUE 13 tentpole; reference: the router/edge tier production LLM
+fleets put in front of N model-server processes — an SSE-aware reverse
+proxy with cache-affinity routing and mid-stream failover, restated
+stdlib-only over the gateway's own HTTP surface).
+
+:class:`FleetFrontend` turns N gateway PROCESSES into one service:
+
+- **Routing** — the same :class:`~..router.PrefixAffinityRouter`
+  ladder the in-process gateway uses, over :class:`~.remote
+  .RemoteReplica` adapters (duck-typed seam: ``healthy``/``load``/
+  ``has_prefix`` read cached HTTP-probe snapshots). Affinity keys are
+  computed standalone (:func:`~.remote.prefix_digest_chain` — pinned
+  byte-for-byte to the engine's digests), probed against each peer's
+  GOSSIPED digest set, so the prefix cache is a fleet asset: a request
+  lands on ANY warm peer.
+- **Proxying** — the chosen peer's response is relayed BYTE-FOR-BYTE
+  (status line, headers, every SSE event — pinned bitwise against a
+  direct connection by test). Relaying parses events as they pass so
+  the frontend always knows the committed ``(token, logprob)`` prefix
+  of every in-flight stream.
+- **Mid-stream failover** — a peer that dies mid-stream (connection
+  drop, process kill, 5xx; the ``peer_conn_drop`` fault site injects
+  it deterministically) routes the request through the same
+  resume seam the in-process failover uses (ISSUE 12), now over HTTP:
+  resubmit ``prompt + committed`` with ``resume_tokens``/
+  ``resume_lps`` on a surviving peer, skip the re-emitted committed
+  prefix when relaying, and the client sees no duplicated and no
+  missing token — greedy streams finish BITWISE identical to an
+  uninterrupted run (tokens AND logprobs); seeded sampled requests
+  re-derive a per-hop seed (distribution-preserving, not bitwise —
+  the ISSUE 12 contract, unchanged). ``failover_budget`` bounds the
+  hops; a fully-committed-at-the-kill stream is synthesized from the
+  committed prefix, never retried.
+- **Rejoin** — a peer evicted by probe failures or a dropped stream
+  carries a :class:`~..supervisor.CircuitBreaker`: after backoff the
+  router hands it AT MOST ONE live probation probe; a proxied success
+  closes the breaker and re-admits the peer (remote failures heal the
+  same way local ones do).
+
+The frontend is deliberately model-free: no engine, no jax — it can
+run on a 2-vCPU edge box in front of a pod of accelerator hosts.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ...utils import faults
+from ...utils import observability as obs
+from ..gateway import _SSE_HEAD  # noqa: F401  (re-export convenience)
+from ..gateway import _http_response, _json_response
+from ..reqtrace import RequestTrace, RequestTraceRing
+from ..router import NoReplicaError, PrefixAffinityRouter
+from ..supervisor import BREAKER_CLOSED, CircuitBreaker
+from .remote import RemoteReplica, prefix_digest_chain
+
+__all__ = ["FleetFrontend"]
+
+_frontend_ids = itertools.count()
+
+# the per-hop seed fold for sampled requests, same constant the
+# in-process failover uses (docs/FAULT_TOLERANCE.md §4b)
+_SEED_FOLD = 0x9E3779B1
+
+
+class _ProxyState:
+    """Committed prefix of one proxied stream: exactly the (token,
+    logprob) units FORWARDED to the client (a unit read off the peer
+    but dropped by a fault/crash before forwarding is NOT committed —
+    the client never saw it)."""
+
+    __slots__ = ("tokens", "lps", "head_sent", "final", "t_first")
+
+    def __init__(self):
+        self.tokens: List[int] = []
+        self.lps: List[Optional[float]] = []
+        self.head_sent = False
+        self.final: Optional[Dict[str, Any]] = None
+        self.t_first: Optional[float] = None
+
+
+class FleetFrontend:
+    """Serve ``/v1/generate`` over N remote peer gateways.
+
+    ``peers``: list of :class:`RemoteReplica` (more can join at
+    runtime via :meth:`add_peer` — the autoscaler's spawn path).
+    ``chunk_tokens`` must match the peers' engines'
+    ``chunk_prefill_tokens`` for affinity routing (None disables
+    affinity: pure load balancing)."""
+
+    def __init__(self, peers: List[RemoteReplica],
+                 host: str = "127.0.0.1", port: int = 0, *,
+                 chunk_tokens: Optional[int] = None,
+                 routing: str = "prefix", spill_margin: float = 8.0,
+                 failover_budget: int = 2,
+                 peer_read_timeout_s: float = 30.0,
+                 peer_connect_timeout_s: float = 5.0,
+                 breakers: bool = True,
+                 breaker_backoff_s: float = 1.0,
+                 breaker_backoff_max_s: float = 30.0,
+                 breaker_probes: int = 1,
+                 name: Optional[str] = None,
+                 trace: bool = True, trace_capacity: int = 512):
+        self.name = name or f"fleet{next(_frontend_ids)}"
+        self.host, self.port = host, port
+        self.chunk_tokens = chunk_tokens
+        self._failover_budget = int(failover_budget)
+        self._peer_read_timeout_s = float(peer_read_timeout_s)
+        self._peer_connect_timeout_s = float(peer_connect_timeout_s)
+        self._breakers = bool(breakers)
+        self._breaker_kw = dict(backoff_s=breaker_backoff_s,
+                                backoff_max_s=breaker_backoff_max_s,
+                                probes_to_close=breaker_probes)
+        self._draining = False
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._active = 0
+        self.peers: List[RemoteReplica] = []
+        self._labels = {"gateway": self.name}
+        reg = obs.registry()
+        self._c_requests = reg.counter("fleet_requests_total",
+                                       **self._labels)
+        self._c_tokens = reg.counter("fleet_proxied_tokens_total",
+                                     **self._labels)
+        self._c_failovers = reg.counter("fleet_peer_failovers_total",
+                                        **self._labels)
+        self._c_exhausted = reg.counter(
+            "fleet_retry_budget_exhausted_total", **self._labels)
+        self._c_disconnects = reg.counter("fleet_disconnects_total",
+                                          **self._labels)
+        self._g_replicas = reg.gauge("fleet_replicas", **self._labels)
+        self._h_ttft = reg.histogram("fleet_ttft_ms",
+                                     buckets=obs.SERVING_MS_BUCKETS,
+                                     **self._labels)
+        # start the router EMPTY: every peer joins through the one
+        # membership path (add_peer — breaker attach + prober start)
+        self._router = PrefixAffinityRouter(
+            [], policy=routing, spill_margin=spill_margin,
+            labels=self._labels)
+        self.ring = RequestTraceRing(
+            capacity=trace_capacity,
+            labels=dict(self._labels, replica="frontend")) \
+            if trace else None
+        self.autoscaler = None      # attached via attach_autoscaler()
+        for p in peers:
+            self.add_peer(p)
+
+    # --------------------------------------------------------- membership
+    def add_peer(self, peer: RemoteReplica):
+        """Join a peer (initial fleet, autoscaler spawn, rejoin):
+        attach its breaker, start its prober, enter rotation."""
+        if self._breakers and peer.breaker is None:
+            peer.breaker = CircuitBreaker(
+                on_state=self._breaker_state_cb(peer),
+                **self._breaker_kw)
+        self._router.add_replica(peer)
+        if peer not in self.peers:
+            self.peers.append(peer)
+        peer.start()
+        self._g_replicas.set(len(self.peers))
+        obs.record_event("fleet_peer_join", fleet=self.name,
+                         peer=peer.name)
+
+    def remove_peer(self, peer: RemoteReplica):
+        """Leave rotation (autoscaler drain / permanent death). The
+        peer's prober stops; in-flight proxied streams to it finish on
+        their own (a draining peer completes what it owns)."""
+        self._router.remove_replica(peer)
+        if peer in self.peers:
+            self.peers.remove(peer)
+        peer.stop()
+        self._g_replicas.set(len(self.peers))
+        obs.record_event("fleet_peer_leave", fleet=self.name,
+                         peer=peer.name)
+
+    def _breaker_state_cb(self, peer: RemoteReplica):
+        def cb(state: str):
+            if state == BREAKER_CLOSED:
+                peer.mark(True)
+            obs.record_event("fleet_breaker", fleet=self.name,
+                             peer=peer.name, state=state)
+        return cb
+
+    def attach_autoscaler(self, scaler):
+        self.autoscaler = scaler
+
+    # ---------------------------------------------------------- lifecycle
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        if self.autoscaler is not None:
+            self.autoscaler.start()
+        obs.record_event("fleet_start", fleet=self.name,
+                         port=self.port, peers=len(self.peers))
+        return self
+
+    async def drain(self, timeout: float = 30.0):
+        """Stop admitting, let in-flight proxies finish, stop the
+        autoscaler and probers, close the listener."""
+        self._draining = True
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        deadline = time.monotonic() + timeout
+        while self._active > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        for p in list(self.peers):
+            p.stop()
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+            self._server = None
+        obs.record_event("fleet_drain", fleet=self.name)
+
+    def dump_traces(self, directory: str) -> List[str]:
+        """Write the frontend's own request-trace ring (the fleet's
+        hop records — what ``trace_report``'s fleet merge joins with
+        the peer gateways' rings by request id)."""
+        import os
+        if self.ring is None:
+            return []
+        os.makedirs(directory, exist_ok=True)
+        return [self.ring.dump(os.path.join(
+            directory, f"reqtrace_{self.name}_frontend.json"))]
+
+    # ------------------------------------------------------------- health
+    def healthz(self) -> Dict[str, Any]:
+        return {
+            "fleet": self.name,
+            "draining": self._draining,
+            "requests": int(self._c_requests.value),
+            "proxied_tokens": int(self._c_tokens.value),
+            "peer_failovers": int(self._c_failovers.value),
+            "retry_budget_exhausted": int(self._c_exhausted.value),
+            "disconnects": int(self._c_disconnects.value),
+            "failover_budget": self._failover_budget,
+            "router": self._router.snapshot(),
+            "peers": {p.name: {"healthy": p.healthy(),
+                               "load": p.load(),
+                               "url": f"{p.host}:{p.port}"}
+                      for p in self.peers},
+        }
+
+    def debugz(self) -> Dict[str, Any]:
+        return {
+            "fleet": self.name,
+            "draining": self._draining,
+            "router": self._router.snapshot(),
+            "peers": {p.name: p.snapshot() for p in self.peers},
+            "autoscaler": self.autoscaler.snapshot()
+            if self.autoscaler is not None else None,
+            "trace_ring": self.ring.summary()
+            if self.ring is not None else None,
+        }
+
+    # ---------------------------------------------------------------- HTTP
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        try:
+            line = await asyncio.wait_for(reader.readline(), 30)
+            parts = line.decode("latin1").split()
+            if len(parts) < 3:
+                return
+            method, path = parts[0], parts[1]
+            headers: Dict[str, str] = {}
+            while True:
+                h = await asyncio.wait_for(reader.readline(), 30)
+                if h in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = h.decode("latin1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+            try:
+                n = int(headers.get("content-length", "0") or 0)
+                if n < 0:
+                    raise ValueError("negative")
+            except ValueError:
+                writer.write(_json_response(
+                    400, {"error": "bad Content-Length"}))
+                await writer.drain()
+                return
+            body = await asyncio.wait_for(reader.readexactly(n), 30) \
+                if n else b""
+            path = path.partition("?")[0].rstrip("/") or "/"
+            if method == "GET" and path == "/healthz":
+                writer.write(_json_response(200, self.healthz()))
+                await writer.drain()
+            elif method == "GET" and path == "/debugz":
+                writer.write(_json_response(200, self.debugz()))
+                await writer.drain()
+            elif method == "GET" and path == "/metrics":
+                writer.write(_http_response(
+                    200, obs.registry().prometheus_text().encode(),
+                    ctype="text/plain; version=0.0.4"))
+                await writer.drain()
+            elif method == "POST" and path == "/v1/generate":
+                self._active += 1
+                try:
+                    await self._generate(body, headers, writer)
+                finally:
+                    self._active -= 1
+            else:
+                writer.write(_json_response(
+                    404, {"error": f"no route {path}"}))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, asyncio.TimeoutError,
+                ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------ generate
+    async def _generate(self, body: bytes, headers: Dict[str, str],
+                        writer: asyncio.StreamWriter):
+        if self._draining:
+            writer.write(_json_response(
+                503, {"error": "draining: not admitting new requests"},
+                extra={"Retry-After": "1"}))
+            await writer.drain()
+            return
+        try:
+            spec = json.loads(body.decode())
+            if not isinstance(spec, dict):
+                raise ValueError("request body must be a JSON object")
+            ids = spec.get("prompt", spec.get("input_ids"))
+            if not isinstance(ids, list) or not ids \
+                    or not all(isinstance(t, int) for t in ids):
+                raise ValueError("prompt must be a non-empty list of "
+                                 "token ids")
+        except ValueError as e:
+            writer.write(_json_response(400, {"error": str(e)}))
+            await writer.drain()
+            return
+        # one id across every hop and every process: body field wins,
+        # then the inbound header, then a minted one — written back
+        # into the proxied body so every peer's ring records the SAME
+        # id (what the fleet trace merge joins on)
+        rid = spec.get("request_id") \
+            or headers.get("x-request-id") \
+            or uuid.uuid4().hex[:16]
+        spec = dict(spec, request_id=rid, prompt=list(ids))
+        spec.pop("input_ids", None)
+        self._c_requests.inc()
+        trace = None
+        if self.ring is not None:
+            trace = RequestTrace(rid,
+                                 tenant=str(spec.get("tenant",
+                                                     "default")),
+                                 slo=str(spec.get("slo",
+                                                  "interactive")))
+            trace.ev("accept", stream=bool(spec.get("stream", True)),
+                     prompt_tokens=len(ids), fleet=self.name)
+        digests = spec.get("affinity_key")
+        if digests is None and self.chunk_tokens:
+            # longest span first — the router's probe order
+            digests = prefix_digest_chain(ids, self.chunk_tokens)[::-1]
+        orig_prompt = list(ids)
+        orig_max_new = int(spec.get("max_new_tokens", 32))
+        orig_seed = spec.get("seed")
+        st = _ProxyState()
+        hops = 0
+        t0 = time.monotonic()
+        while True:
+            meta: Dict[str, Any] = {}
+            try:
+                replica = self._router.route(
+                    digests, trace=trace, allow_probe=hops == 0,
+                    meta=meta)
+            except NoReplicaError as e:
+                await self._terminal_error(writer, st, trace, 503,
+                                           str(e))
+                return
+            probe = meta.get("verdict") == "probe"
+            if trace is not None:
+                trace.ev("proxy_to", replica=replica.name,
+                         attempt=hops)
+            outcome = await self._proxy_stream(replica, spec, rid,
+                                               writer, st, t0)
+            if outcome == "done":
+                final = st.final or {}
+                reason = final.get("finish_reason",
+                                   "error" if "error" in final
+                                   else "stop")
+                self._probe_done(replica, probe,
+                                 True if reason == "stop" else None)
+                if reason == "stop" and probe \
+                        and replica.breaker is not None \
+                        and replica.breaker.state == BREAKER_CLOSED \
+                        and trace is not None:
+                    trace.ev("breaker_close", replica=replica.name)
+                self._finish_trace(trace, {
+                    "stop": "stop", "timeout": "timeout",
+                    "cancelled": "cancelled"}.get(reason, "error"),
+                    st)
+                return
+            if outcome == "client_gone":
+                self._c_disconnects.inc()
+                self._probe_done(replica, probe, None)
+                self._finish_trace(trace, "disconnect", st)
+                return
+            if outcome == "shed":
+                # the peer shed with 429 (forwarded verbatim): the
+                # fleet is overloaded, not broken — no eviction, no
+                # budget charge, the client backs off
+                self._probe_done(replica, probe, None)
+                self._finish_trace(trace, "shed", st)
+                return
+            if outcome == "peer_shed":
+                # a SURVIVOR shed a mid-stream failover resubmit:
+                # overload, not failure — terminal for this request
+                # (an SSE error event; the head is already out), but
+                # the healthy peer is neither evicted nor charged
+                self._probe_done(replica, probe, None)
+                await self._terminal_error(
+                    writer, st, trace, 503,
+                    "failover resubmit shed: fleet overloaded",
+                    outcome="shed")
+                return
+            # ----------------------------------------------- peer failed
+            self._c_failovers.inc()
+            replica.note_proxy_failure()
+            self._router.evict_unhealthy()
+            self._probe_done(replica, probe, False)
+            if trace is not None:
+                trace.ev("peer_fail", replica=replica.name,
+                         reason=outcome)
+                if replica.breaker is not None:
+                    trace.ev("breaker_open", replica=replica.name)
+            obs.record_event("fleet_peer_fail", fleet=self.name,
+                             peer=replica.name, reason=outcome,
+                             request_id=rid)
+            hops += 1
+            remaining = orig_max_new - len(st.tokens)
+            # checked BEFORE the retry budget (the ISSUE 12 rule): a
+            # result the client already fully holds is never errored
+            if st.tokens and remaining <= 0:
+                # fully committed at the kill boundary: the client has
+                # every token — synthesize the final event instead of
+                # re-running anything (never 503 a complete result)
+                st.final = {"tokens": list(st.tokens),
+                            "logprobs": [v for v in st.lps],
+                            "finish_reason": "stop", "done": True}
+                try:
+                    writer.write(b"data: "
+                                 + json.dumps(st.final).encode()
+                                 + b"\n\n")
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    pass
+                self._finish_trace(trace, "stop", st)
+                return
+            if hops > self._failover_budget:
+                self._c_exhausted.inc()
+                await self._terminal_error(
+                    writer, st, trace, 503,
+                    f"failover budget exhausted after "
+                    f"{self._failover_budget} peer failures")
+                return
+            if st.tokens:
+                # the HTTP face of the ISSUE 12 resume seam: re-prefill
+                # prompt+committed on the survivor and skip the
+                # re-emitted committed prefix while relaying
+                spec = dict(spec,
+                            prompt=orig_prompt + list(st.tokens),
+                            resume_tokens=list(st.tokens),
+                            resume_lps=list(st.lps),
+                            max_new_tokens=remaining)
+            if orig_seed is not None:
+                # sampled streams re-derive a per-hop seed: the dead
+                # peer consumed an unknown amount of the original
+                # stream (distribution-preserving, not bitwise)
+                spec = dict(spec,
+                            seed=int(orig_seed) + _SEED_FOLD * hops)
+            if trace is not None:
+                trace.ev("resubmit", to_replica="", attempt=hops)
+                trace.ev("resume_offset", offset=len(st.tokens),
+                         committed=len(st.tokens))
+
+    def _probe_done(self, replica, probe: bool,
+                    success: Optional[bool]):
+        if probe and replica.breaker is not None:
+            replica.breaker.probe_done(success)
+
+    def _finish_trace(self, trace, outcome: str, st: _ProxyState):
+        if self.ring is not None and trace is not None:
+            if st.t_first is not None:
+                self._h_ttft.observe(
+                    (st.t_first) * 1e3, exemplar=trace.request_id)
+            self.ring.finish(trace, outcome, tokens=len(st.tokens))
+
+    async def _terminal_error(self, writer, st: _ProxyState, trace,
+                              status: int, msg: str,
+                              outcome: str = "error"):
+        try:
+            if st.head_sent:
+                writer.write(b"data: " + json.dumps(
+                    {"error": msg, "done": True}).encode() + b"\n\n")
+            else:
+                writer.write(_json_response(
+                    status, {"error": msg}, extra={"Retry-After": "1"}))
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+        self._finish_trace(trace, outcome, st)
+
+    # --------------------------------------------------------------- proxy
+    async def _proxy_stream(self, replica: RemoteReplica,
+                            spec: Dict[str, Any], rid: str,
+                            writer: asyncio.StreamWriter,
+                            st: _ProxyState, t0: float) -> str:
+        """One proxy attempt against ``replica``. Returns ``"done"``
+        (a terminal event/response was forwarded), ``"shed"`` (peer
+        429, forwarded), ``"client_gone"``, or a peer-failure reason
+        (``"peer_conn_drop"`` / ``"peer_error"`` / ``"peer_timeout"``
+        — the caller runs the failover loop). Forwarding is
+        byte-for-byte; the committed prefix in ``st`` advances only
+        when a unit has actually been written to the client."""
+        timeout = self._peer_read_timeout_s
+        body = json.dumps(spec).encode()
+        try:
+            # bounded connect: a black-holed peer (SYN dropped) must
+            # fail over in seconds, not the OS connect timeout —
+            # peer_read_timeout_s only guards reads on an open conn
+            pr, pw = await asyncio.wait_for(
+                asyncio.open_connection(replica.host, replica.port),
+                self._peer_connect_timeout_s)
+        except (OSError, asyncio.TimeoutError):
+            return "peer_error"
+        try:
+            pw.write((f"POST /v1/generate HTTP/1.1\r\n"
+                      f"Host: {replica.host}\r\n"
+                      f"X-Request-Id: {rid}\r\n"
+                      f"Content-Length: {len(body)}\r\n\r\n").encode()
+                     + body)
+            await pw.drain()
+            status_line = await asyncio.wait_for(pr.readline(), timeout)
+            parts = status_line.split()
+            if len(parts) < 2:
+                return "peer_conn_drop"
+            status = int(parts[1])
+            head = status_line
+            clen = 0
+            sse = False
+            while True:
+                ln = await asyncio.wait_for(pr.readline(), timeout)
+                if not ln:
+                    return "peer_conn_drop"
+                head += ln
+                if ln in (b"\r\n", b"\n"):
+                    break
+                low = ln.lower()
+                if low.startswith(b"content-length:"):
+                    clen = int(ln.split(b":", 1)[1])
+                if low.startswith(b"content-type:") \
+                        and b"text/event-stream" in low:
+                    sse = True
+            if not sse:
+                # one-shot JSON (nonstream, 4xx, 5xx): buffer, then
+                # decide — forwarded verbatim or treated as a peer
+                # failure the caller retries elsewhere
+                payload = await asyncio.wait_for(
+                    pr.readexactly(clen), timeout) if clen else b""
+                if status >= 500:
+                    return "peer_error"
+                if st.head_sent:
+                    # mid-SSE we cannot splice a fresh status line. A
+                    # 429 from a survivor is OVERLOAD, not failure —
+                    # terminal for this request (the ISSUE 12 rule:
+                    # failover traffic is still sheddable, which is
+                    # what stops a peer death amplifying into a retry
+                    # storm) but never evicts or charges the budget;
+                    # any other non-stream answer (peer restarted into
+                    # draining, resume rejected) is a failed hop.
+                    if status == 429:
+                        return "peer_shed"
+                    return "peer_error"
+                try:
+                    writer.write(head + payload)
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    return "client_gone"
+                if status == 429:
+                    return "shed"
+                st.final = {"finish_reason": "stop"} if status == 200 \
+                    else {"error": f"peer status {status}",
+                          "finish_reason": "error"}
+                if status == 200:
+                    try:
+                        doc = json.loads(payload)
+                        st.final = dict(doc,
+                                        finish_reason=doc.get(
+                                            "finish_reason", "stop"))
+                        st.tokens = list(doc.get("tokens", ()))
+                    except ValueError:
+                        pass
+                elif status == 504:
+                    st.final = {"finish_reason": "timeout"}
+                return "done"
+            # ------------------------------------------------- SSE stream
+            if not st.head_sent:
+                try:
+                    writer.write(head)
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    return "client_gone"
+                st.head_sent = True
+            skip = len(st.tokens)   # survivor re-emits the committed
+            seen = 0                # prefix first: drop, don't forward
+            while True:
+                try:
+                    ln = await asyncio.wait_for(pr.readline(), timeout)
+                except asyncio.TimeoutError:
+                    return "peer_timeout"
+                if not ln:
+                    return "peer_conn_drop"
+                unit = ln
+                if ln.rstrip(b"\r\n"):
+                    # data/comment line: its blank terminator belongs
+                    # to the same unit — forward them together so the
+                    # committed count only ever covers whole events
+                    try:
+                        nxt = await asyncio.wait_for(pr.readline(),
+                                                     timeout)
+                    except asyncio.TimeoutError:
+                        return "peer_timeout"
+                    if not nxt:
+                        return "peer_conn_drop"
+                    unit += nxt
+                if not ln.startswith(b"data: "):
+                    # SSE comment (half-close probe): relay verbatim
+                    try:
+                        writer.write(unit)
+                        await writer.drain()
+                    except (ConnectionError, OSError):
+                        return "client_gone"
+                    continue
+                try:
+                    ev = json.loads(ln[6:])
+                except ValueError:
+                    return "peer_error"
+                if ev.get("done"):
+                    if faults.inject("peer_conn_drop",
+                                     replica=replica.name):
+                        # severed between the last token and the done
+                        # event — the fully-committed-at-the-kill case
+                        return "peer_conn_drop"
+                    try:
+                        writer.write(unit)
+                        await writer.drain()
+                    except (ConnectionError, OSError):
+                        return "client_gone"
+                    st.final = ev
+                    if isinstance(ev.get("tokens"), list):
+                        st.tokens = list(ev["tokens"])
+                    return "done"
+                seen += 1
+                if seen <= skip:
+                    continue        # committed prefix replay: dedupe
+                if faults.inject("peer_conn_drop",
+                                 replica=replica.name):
+                    # sever the peer leg BEFORE forwarding: the unit
+                    # dies unseen, exactly like a real mid-wire kill
+                    return "peer_conn_drop"
+                try:
+                    writer.write(unit)
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    return "client_gone"
+                if st.t_first is None:
+                    st.t_first = time.monotonic() - t0
+                st.tokens.append(int(ev["token"]))
+                st.lps.append(ev.get("lp"))
+                self._c_tokens.inc()
+        except (asyncio.TimeoutError,):
+            return "peer_timeout"
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            return "peer_conn_drop"
+        finally:
+            try:
+                pw.close()
+            except Exception:
+                pass

@@ -1,0 +1,356 @@
+"""Remote replica adapter + prefix-digest gossip (ISSUE 13 tentpole;
+reference: the control-plane side of multi-host LLM serving fleets —
+envoy/k8s-style health probing + SGLang's cache-aware routing lifted
+from one process to N — restated stdlib-only over the gateway's
+existing HTTP surface).
+
+The router's replica seam is duck-typed on purpose
+(``healthy``/``load``/``has_prefix`` — see ``serving/router.py``):
+:class:`RemoteReplica` implements those three methods over HTTP probes
+of a PEER GATEWAY PROCESS, so the same
+:class:`~paddle_tpu.serving.router.PrefixAffinityRouter` ladder
+(warm -> sticky -> least-loaded, circuit-breaker probation) that
+places requests on local tick threads places them on remote gateways
+— without touching routing policy. The fleet frontend
+(:mod:`.frontend`) then proxies ``/v1/generate`` streams to the chosen
+peer byte-for-byte.
+
+Probing is CACHED with a staleness bound: the router calls
+``healthy()``/``load()``/``has_prefix()`` synchronously on the serving
+path, so those reads must never block on the network. A background
+prober refreshes two snapshots per peer:
+
+- ``GET /healthz`` — draining flag, per-replica slot/queue occupancy
+  (the ``load()`` the ladder sorts by) and the autoscaler signal
+  quartet (queue depth, free slots, block-pool free fraction, goodput
+  fraction — the PR-8 gauges, read remotely in one fetch).
+- ``GET /debugz/prefix?if_gen=N`` — the peer's prefix-digest set
+  (ISSUE 13 satellite). The monotonic ``generation`` counter makes the
+  poll conditional: an unchanged set answers a tiny marker instead of
+  re-shipping the digest list, so sub-second gossip stays cheap. The
+  gossiped set is what turns the prefix cache into a FLEET asset: the
+  router can place a request on ANY warm peer, not just the one an
+  earlier request happened to land on.
+
+A peer whose probes stop landing is evicted two ways: consecutive
+probe failures flip the health latch (and open the breaker when one is
+attached), and a snapshot older than ``stale_after_s`` fails
+``healthy()`` even before the failure count does — a wedged prober or
+a silently black-holed peer can never keep serving stale "healthy"
+answers to the router. The ``peer_slow`` fault site injects probe
+latency to exercise exactly that bound.
+"""
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...utils import faults
+from ...utils import observability as obs
+
+__all__ = ["RemoteReplica", "prefix_digest_chain"]
+
+
+def prefix_digest_chain(input_ids, chunk_tokens: int,
+                        max_tokens: Optional[int] = None) -> List[str]:
+    """The chunk-grid digest chain of a prompt, shortest span first —
+    byte-for-byte the keys ``PagedEngine.prefix_digests`` returns for
+    the same ``chunk_prefill_tokens`` (pinned by test). The fleet
+    frontend has no engine, so it computes routing keys standalone:
+    digest_k = SHA256(digest_{k-1} || int64 tokens of chunk k), for
+    every span k*C <= cap (default cap ``len(ids) - 1`` — at least one
+    live token must remain to prefill, the engine's own rule)."""
+    C = int(chunk_tokens)
+    if C <= 0:
+        return []
+    ids = [int(t) for t in np.asarray(input_ids).reshape(-1)]
+    cap = len(ids) - 1 if max_tokens is None \
+        else min(int(max_tokens), len(ids))
+    digests: List[str] = []
+    d = b""
+    k = 1
+    while k * C <= cap:
+        h = hashlib.sha256(d)
+        h.update(np.asarray(ids[(k - 1) * C:k * C], np.int64).tobytes())
+        d = h.digest()
+        digests.append(d.hex())
+        k += 1
+    return digests
+
+
+class RemoteReplica:
+    """One peer gateway process, adapted to the router's replica seam.
+
+    ``healthy()``/``load()``/``has_prefix()`` read the cached probe
+    snapshots only (never the network); :meth:`refresh` runs one
+    synchronous probe round (what the background prober loops, and
+    what deterministic tests call directly). ``breaker`` is attached
+    by the fleet frontend — while present, a peer evicted by probe
+    failures rejoins through the router's probation-probe ladder, not
+    by its probes merely coming back (a peer that answers /healthz but
+    drops every proxied stream must not re-enter rotation for free).
+    """
+
+    def __init__(self, name: str, host: str, port: int, *,
+                 probe_interval_s: float = 0.2,
+                 probe_timeout_s: float = 1.0,
+                 stale_after_s: float = 2.0,
+                 fail_threshold: int = 2,
+                 clock=time.monotonic):
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.stale_after_s = float(stale_after_s)
+        self.fail_threshold = max(int(fail_threshold), 1)
+        self._clock = clock
+        self.breaker = None           # attached by the fleet frontend
+        self._lock = threading.Lock()
+        self._healthy = True
+        self._fails = 0
+        self._snap: Dict[str, Any] = {}
+        self._snap_t: Optional[float] = None
+        # gossiped digest set (ISSUE 13): hex digests + the peer's
+        # generation counter the conditional fetch keys on
+        self._digests: frozenset = frozenset()
+        self._digest_gen = -1
+        self._digest_t: Optional[float] = None
+        self.probes_total = 0
+        self.probe_failures_total = 0
+        self.gossip_fetches_total = 0
+        self.gossip_unchanged_total = 0
+        self._halt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- probing
+    def _get_json(self, path: str) -> Dict[str, Any]:
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.probe_timeout_s)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            payload = resp.read()
+            if resp.status != 200:
+                raise ConnectionError(
+                    f"{path} answered {resp.status}")
+            return json.loads(payload)
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _fold_health(doc: Dict[str, Any]) -> Dict[str, Any]:
+        """Collapse a peer /healthz doc into the numbers the router and
+        autoscaler read: load units (live slots + engine queue +
+        scheduler queue), free/total slots, mean block-pool free
+        fraction, scheduler queue depth, goodput fraction, draining."""
+        load = 0.0
+        free_slots = total_slots = queue_depth = 0
+        block_free = []
+        for rep in (doc.get("replicas") or {}).values():
+            eng = rep.get("engine") or {}
+            sched = rep.get("scheduler") or {}
+            active = int(eng.get("active_slots", 0))
+            queued = int(eng.get("queued", 0))
+            sq = int(sched.get("queued", 0))
+            load += active + queued + sq
+            total = int(eng.get("max_slots", 0))
+            total_slots += total
+            free_slots += max(total - active, 0)
+            queue_depth += sq
+            tb = int(eng.get("total_blocks", 0))
+            if tb:
+                block_free.append(
+                    (int(eng.get("free_blocks", 0))
+                     + int(eng.get("cached_free_blocks", 0))) / tb)
+        return {
+            "draining": bool(doc.get("draining", False)),
+            "load": load,
+            "free_slots": free_slots,
+            "total_slots": total_slots,
+            "queue_depth": queue_depth,
+            "block_pool_free_frac": round(
+                sum(block_free) / len(block_free), 4)
+            if block_free else 1.0,
+            "goodput_frac": float(doc.get("goodput_frac", 1.0)),
+            "completed": int(doc.get("completed", 0)),
+            "tokens": int(doc.get("tokens", 0)),
+        }
+
+    def _probe_once(self):
+        """One probe round: /healthz, then the conditional gossip
+        fetch. Raises on any failure (the caller counts)."""
+        if faults.inject("peer_slow", replica=self.name):
+            time.sleep(faults.peer_slow_seconds())
+        snap = self._fold_health(self._get_json("/healthz"))
+        now = self._clock()
+        with self._lock:
+            self._snap = snap
+            self._snap_t = now
+        # gossip: skip the digest list when the peer's generation
+        # still matches what we hold (the cheap-poll satellite)
+        doc = self._get_json(
+            f"/debugz/prefix?if_gen={self._digest_gen}")
+        self.gossip_fetches_total += 1
+        with self._lock:
+            if doc.get("unchanged"):
+                self.gossip_unchanged_total += 1
+            else:
+                self._digests = frozenset(doc.get("digests") or ())
+                self._digest_gen = int(doc.get("generation", -1))
+            self._digest_t = self._clock()
+
+    def refresh(self) -> bool:
+        """One synchronous probe round; returns success. Updates the
+        health latch: ``fail_threshold`` consecutive failures evict
+        (opening the breaker when one is attached); a success clears
+        the failure count and — breakerless only — re-admits."""
+        self.probes_total += 1
+        try:
+            self._probe_once()
+        except (OSError, ValueError, ConnectionError,
+                http.client.HTTPException):
+            self.probe_failures_total += 1
+            with self._lock:
+                self._fails += 1
+                evict = self._fails >= self.fail_threshold \
+                    and self._healthy
+                if evict:
+                    self._healthy = False
+            if evict:
+                obs.record_event("fleet_peer_down", peer=self.name,
+                                 fails=self._fails)
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+            return False
+        with self._lock:
+            self._fails = 0
+            rejoin = not self._healthy and self.breaker is None
+            if rejoin:
+                # no breaker: probes coming back IS the rejoin. With a
+                # breaker, rejoin goes through the router's probation
+                # probe (frontend closes it -> on_state marks healthy).
+                self._healthy = True
+        if rejoin:
+            obs.record_event("fleet_peer_up", peer=self.name)
+        return True
+
+    # ---------------------------------------------------- background prober
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._halt.clear()
+        self._thread = threading.Thread(
+            target=self._probe_loop, daemon=True,
+            name=f"fleet-probe-{self.name}")
+        self._thread.start()
+
+    def stop(self, timeout: float = 2.0):
+        self._halt.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+    def _probe_loop(self):
+        while not self._halt.wait(self.probe_interval_s):
+            try:
+                self.refresh()
+            except Exception as e:  # the prober must outlive any bug
+                obs.record_event("fleet_probe_error", peer=self.name,
+                                 err=repr(e))
+
+    # ------------------------------------------------------ the router seam
+    def _fresh(self) -> bool:
+        t = self._snap_t
+        return t is not None \
+            and self._clock() - t <= self.stale_after_s
+
+    def healthy(self) -> bool:
+        """Staleness-bounded: a peer whose last good probe is older
+        than ``stale_after_s`` is unhealthy even before the failure
+        count evicts it — the router must never trust an answer nobody
+        has verified recently."""
+        with self._lock:
+            return self._healthy and self._fresh() \
+                and not self._snap.get("draining", False)
+
+    def mark(self, healthy: bool):
+        with self._lock:
+            self._healthy = bool(healthy)
+
+    def load(self) -> float:
+        with self._lock:
+            return float(self._snap.get("load", 0.0))
+
+    def has_prefix(self, digest: str) -> bool:
+        """Fleet-wide prefix awareness: True when the peer's GOSSIPED
+        digest set holds ``digest`` and the set is fresh. A stale set
+        answers False — a wrong warm-verdict only costs one prefill,
+        but the bound keeps the error window explicit."""
+        with self._lock:
+            if self._digest_t is None \
+                    or self._clock() - self._digest_t \
+                    > self.stale_after_s:
+                return False
+            return digest in self._digests
+
+    def note_proxy_failure(self):
+        """The frontend observed this peer fail an in-flight proxied
+        stream (conn drop / 5xx): evict immediately — stronger
+        evidence than a missed health probe."""
+        with self._lock:
+            self._healthy = False
+        if self.breaker is not None:
+            self.breaker.record_failure()
+
+    # ------------------------------------------------------------- exports
+    def signals(self) -> Dict[str, Any]:
+        """The autoscaler's per-peer signal read (cached, O(1))."""
+        with self._lock:
+            return {
+                "peer": self.name,
+                "healthy": self._healthy and self._fresh()
+                and not self._snap.get("draining", False),
+                "stale": not self._fresh(),
+                "load": float(self._snap.get("load", 0.0)),
+                "queue_depth": int(self._snap.get("queue_depth", 0)),
+                "free_slots": int(self._snap.get("free_slots", 0)),
+                "total_slots": int(self._snap.get("total_slots", 0)),
+                "block_pool_free_frac": float(
+                    self._snap.get("block_pool_free_frac", 1.0)),
+                "goodput_frac": float(
+                    self._snap.get("goodput_frac", 1.0)),
+            }
+
+    def snapshot(self) -> Dict[str, Any]:
+        """/debugz view of this peer's adapter state."""
+        with self._lock:
+            snap = dict(self._snap)
+            out = {
+                "peer": self.name,
+                "url": f"{self.host}:{self.port}",
+                "healthy_latch": self._healthy,
+                "healthy": self._healthy and self._fresh()
+                and not snap.get("draining", False),
+                "stale": not self._fresh(),
+                "consecutive_probe_failures": self._fails,
+                "probes": self.probes_total,
+                "probe_failures": self.probe_failures_total,
+                "snap": snap,
+                "gossip": {
+                    "digests": len(self._digests),
+                    "generation": self._digest_gen,
+                    "fetches": self.gossip_fetches_total,
+                    "unchanged_skips": self.gossip_unchanged_total,
+                },
+            }
+        b = self.breaker
+        if b is not None:
+            out["breaker"] = b.snapshot()
+        return out

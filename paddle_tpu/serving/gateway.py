@@ -462,7 +462,8 @@ class _ReplicaWorker(threading.Thread):
             self._trace_finish(req, "error")
 
     # ------------------------------------------------------------ dispatch
-    def _token_out(self, req: ServeRequest, tok: int, now: float):
+    def _token_out(self, req: ServeRequest, tok: int, now: float,
+                   lp: Optional[float] = None):
         if req.t_first is None:
             req.t_first = now
             self.gw._h_ttft.observe((now - req.t_enqueue) * 1e3,
@@ -474,7 +475,16 @@ class _ReplicaWorker(threading.Thread):
         req.t_last = now
         req.n_out += 1
         self.gw._c_tokens.inc()
-        self._emit(req, ("token", int(tok)))
+        # the event carries the token's logprob too (ISSUE 13): a fleet
+        # frontend proxying this stream needs (token, lp) pairs to
+        # resubmit prompt+committed WITH logprobs on a surviving peer,
+        # so a failed-over stream's final logprob list stays bitwise
+        # the uninterrupted run's. NaN (an lp-less resume prefix) maps
+        # to null — json.dumps would otherwise emit invalid JSON.
+        if lp is not None and lp != lp:
+            lp = None
+        self._emit(req, ("token", int(tok),
+                         float(lp) if lp is not None else None))
 
     def _finish(self, req: ServeRequest, payload: Dict[str, Any],
                 now: float):
@@ -539,8 +549,15 @@ class _ReplicaWorker(threading.Thread):
             start = req.emitted
             upto = max(n_pre + len(s.tokens) - hold, start)
             for i in range(start, upto):
-                self._token_out(req, s.prefix[i] if i < n_pre
-                                else s.tokens[i - n_pre], now)
+                if i < n_pre:
+                    tok = s.prefix[i]
+                    lp = (s.prefix_lps[i]
+                          if i < len(s.prefix_lps) else None)
+                else:
+                    tok = s.tokens[i - n_pre]
+                    lp = (s.lps[i - n_pre]
+                          if i - n_pre < len(s.lps) else None)
+                self._token_out(req, tok, now, lp=lp)
             req.emitted = upto
             if upto > start and req.trace is not None:
                 req.trace.ev("stream_write", n=upto - start)
@@ -549,8 +566,9 @@ class _ReplicaWorker(threading.Thread):
             toks = eng.results.pop(rid)
             lps = eng.logprobs.pop(rid, [])
             n_tail = len(toks) - req.emitted
-            for t in toks[req.emitted:]:
-                self._token_out(req, t, now)
+            for i in range(req.emitted, len(toks)):
+                self._token_out(req, toks[i], now,
+                                lp=lps[i] if i < len(lps) else None)
             req.emitted = len(toks)
             if n_tail > 0 and req.trace is not None:
                 req.trace.ev("stream_write", n=n_tail)
@@ -656,6 +674,11 @@ class Gateway:
         self._c_fo_exhausted = reg.counter(
             "gateway_retry_budget_exhausted_total", **self._labels)
         self._workers: List[_ReplicaWorker] = []
+        # prefix-gossip generation ratchet (ISSUE 13): keeps the
+        # exported generation monotonic across engine_factory rebuilds
+        # (see prefix_digest_summary)
+        self._prefix_gen_base = 0
+        self._prefix_gen_last = 0
         replicas = []
         # replicas sharing one MODEL object must not tick concurrently
         # (functional()'s pure fn binds params onto the shared layer
@@ -798,8 +821,10 @@ class Gateway:
             if toks is not None:
                 # finished on the dead replica, undelivered: deliver
                 # from the result mirrors instead of re-running it
-                for t in toks[req.emitted:]:
-                    worker._token_out(req, t, now)
+                rl = res_lps.get(req.request_id, [])
+                for i in range(req.emitted, len(toks)):
+                    worker._token_out(req, toks[i], now,
+                                      lp=rl[i] if i < len(rl) else None)
                 req.emitted = len(toks)
                 worker._finish(
                     req, {"tokens": [int(t) for t in toks],
@@ -829,8 +854,11 @@ class Gateway:
             # never be 503'd)
             now = time.monotonic()
             toks = [int(t) for t in desc["committed"]]
-            for t in toks[req.emitted:]:
-                from_worker._token_out(req, t, now)
+            clps = desc["committed_lps"]
+            for i in range(req.emitted, len(toks)):
+                from_worker._token_out(req, toks[i], now,
+                                       lp=clps[i] if i < len(clps)
+                                       else None)
             req.emitted = len(toks)
             from_worker._finish(
                 req, {"tokens": toks,
@@ -1016,6 +1044,41 @@ class Gateway:
                 f"reqtrace_{self.name}_{w.replica.name}.json")))
         return out
 
+    def prefix_digest_summary(self) -> Dict[str, Any]:
+        """Compact prefix-digest-set summary for fleet gossip (ISSUE
+        13 satellite): the union of every replica engine's live
+        prefix-cache digests plus a monotonic ``generation`` counter
+        (sum of the engines' ``prefix_generation``). A poller that
+        remembers the generation can skip re-fetching an unchanged set
+        (``GET /debugz/prefix?if_gen=N``) — the cheap conditional
+        fetch that makes sub-second gossip affordable.
+
+        Monotonicity is RATCHETED at the gateway: the per-engine
+        counters never reset in place (``hard_reset`` keeps counting)
+        but a supervisor rebuild through ``engine_factory`` swaps in a
+        FRESH engine whose counter restarts at 0 — the raw sum could
+        regress and later collide with a previously-served value,
+        making a poller's ``if_gen`` falsely read "unchanged". On any
+        observed regression the base absorbs the drop plus one, so
+        the exported generation strictly advances past every value
+        ever served (called from the asyncio thread only)."""
+        gen = 0
+        digests: set = set()
+        for w in list(self._workers):
+            eng = w.engine
+            gen += int(getattr(eng, "prefix_generation", 0))
+            try:
+                digests.update(k.hex() for k in
+                               list(getattr(eng, "prefix_cache", {})))
+            except RuntimeError:    # resized mid-iteration: torn read
+                pass                # is fine — the next poll catches up
+        if gen < self._prefix_gen_last:
+            self._prefix_gen_base += self._prefix_gen_last - gen + 1
+        self._prefix_gen_last = gen
+        return {"generation": self._prefix_gen_base + gen,
+                "entries": len(digests),
+                "digests": sorted(digests)}
+
     def debugz(self) -> Dict[str, Any]:
         """``GET /debugz`` (ISSUE 10): live engine introspection — the
         slot map, block-pool occupancy/fragmentation, the prefix-cache
@@ -1062,6 +1125,7 @@ class Gateway:
             "supervisor": sup,
             "router": self._router.snapshot(),
             "replicas": reps,
+            "prefix_digest_set": self.prefix_digest_summary(),
         }
 
     # ------------------------------------------------------------- health
@@ -1079,6 +1143,12 @@ class Gateway:
             "disconnects": int(self._c_disconnects.value),
             "failovers": int(self._c_failovers.value),
             "retry_budget_exhausted": int(self._c_fo_exhausted.value),
+            # the autoscaler's quality signal (ISSUE 13): same counters
+            # the gateway_goodput_frac gauge is derived from, readable
+            # by a remote fleet probe in one /healthz fetch
+            "goodput_frac": round(
+                self._c_good_tokens.value
+                / max(self._c_tokens.value, 1.0), 4),
             "ttft_ms": self._h_ttft.stats(),
             "tpot_ms": self._h_tpot.stats(),
             "router": self._router.snapshot(),
@@ -1132,7 +1202,29 @@ class Gateway:
 
     async def _dispatch_http(self, method, path, body, headers, reader,
                              writer):
+        path, _, query = path.partition("?")
         path = path.rstrip("/") or "/"
+        if method == "GET" and path == "/debugz/prefix":
+            # the gossip poll (ISSUE 13): ``?if_gen=N`` answers a tiny
+            # unchanged-marker instead of the digest list when the set
+            # generation still equals N
+            summary = self.prefix_digest_summary()
+            if_gen = None
+            for part in query.split("&"):
+                k, _, v = part.partition("=")
+                if k == "if_gen":
+                    try:
+                        if_gen = int(v)
+                    except ValueError:
+                        pass
+            if if_gen is not None and if_gen == summary["generation"]:
+                writer.write(_json_response(
+                    200, {"generation": summary["generation"],
+                          "unchanged": True}))
+            else:
+                writer.write(_json_response(200, summary))
+            await writer.drain()
+            return
         if method == "GET" and path == "/healthz":
             writer.write(_json_response(200, self.health()))
             await writer.drain()
@@ -1179,6 +1271,27 @@ class Gateway:
         if spec.get("stop") is not None:
             gen["stop_sequences"] = [list(map(int, s))
                                      for s in spec["stop"]]
+        # fleet failover resume (ISSUE 13): a fleet frontend whose peer
+        # died mid-stream resubmits prompt+committed here; the engine
+        # validates resume_tokens is the tail of the prompt and a
+        # greedy stream continues bitwise (the in-process failover
+        # seam, exposed over HTTP).
+        if spec.get("resume_tokens") is not None:
+            rt = spec["resume_tokens"]
+            if not isinstance(rt, list) \
+                    or not all(isinstance(t, int) for t in rt):
+                raise ValueError("resume_tokens must be a list of "
+                                 "token ids")
+            gen["resume_tokens"] = rt
+            rl = spec.get("resume_lps")
+            if rl is not None:
+                if not isinstance(rl, list) \
+                        or not all(isinstance(v, (int, float))
+                                   or v is None for v in rl):
+                    raise ValueError("resume_lps must be a list of "
+                                     "floats")
+                gen["resume_lps"] = [float("nan") if v is None
+                                     else float(v) for v in rl]
         timeout_s = spec.get("timeout_s")
         deadline = (time.monotonic() + float(timeout_s)
                     if timeout_s is not None else None)
@@ -1359,7 +1472,7 @@ class Gateway:
                     ev = get.result()
                 try:
                     if ev[0] == "token":
-                        payload = {"token": ev[1]}
+                        payload = {"token": ev[1], "lp": ev[2]}
                         if faults.inject("stream_stall",
                                          request=str(req.request_id)):
                             # slow client / congested wire stand-in:

@@ -84,6 +84,13 @@ EVENT_KINDS = frozenset({
     #     breaker_half_open / breaker_close ride the probe request's
     "replica_fail", "watchdog_fire", "resubmit", "resume_offset",
     "breaker_open", "breaker_half_open", "breaker_close",
+    # multi-host fleet (ISSUE 13): emitted by the fleet FRONTEND's own
+    # trace ring. ``proxy_to`` = the stream is being proxied to a peer
+    # gateway (replica + attempt); ``peer_fail`` = that peer died /
+    # dropped the connection mid-stream (reason) — the resubmit /
+    # resume_offset events that follow are the cross-PROCESS failover
+    # hop trace_report's fleet merge follows by request id.
+    "proxy_to", "peer_fail",
 })
 
 # terminal outcomes a ring entry records (finish_reason superset)

@@ -106,8 +106,9 @@ class PrefixAffinityRouter:
     def __init__(self, replicas: List[Any], policy: str = "prefix",
                  spill_margin: float = 8.0, sticky_capacity: int = 1024,
                  labels: Optional[Dict[str, str]] = None):
-        if not replicas:
-            raise ValueError("router needs at least one replica")
+        # an EMPTY initial list is legal (ISSUE 13: a fleet frontend
+        # starts bare and grows through add_replica); routing with no
+        # healthy replica raises NoReplicaError as always
         if policy not in self.POLICIES:
             raise ValueError(f"unknown routing policy {policy!r}")
         self.replicas = list(replicas)
@@ -225,6 +226,24 @@ class PrefixAffinityRouter:
             for d in digests:            # future siblings of ANY span
                 self._remember(d, floor)
             return _ev("miss", floor)
+
+    def add_replica(self, replica):
+        """Fleet membership grows at runtime (ISSUE 13: the autoscaler
+        spawning a replica, a rejoining peer). Idempotent."""
+        with self._lock:
+            if replica not in self.replicas:
+                self.replicas.append(replica)
+
+    def remove_replica(self, replica):
+        """Drop a replica from rotation (autoscaler drain / permanent
+        peer death) and forget its sticky affinity — a future replica
+        reusing the name re-earns warmth. Idempotent."""
+        with self._lock:
+            if replica in self.replicas:
+                self.replicas.remove(replica)
+            for k in [k for k, r in self._sticky.items()
+                      if r is replica]:
+                del self._sticky[k]
 
     def evict_unhealthy(self):
         """Drop sticky entries pointing at replicas that are down, so a

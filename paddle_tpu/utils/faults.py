@@ -54,12 +54,14 @@ PREFETCH_STALL_ENV_VAR = "PADDLE_TPU_FAULT_PREFETCH_STALL_S"
 DISPATCH_HANG_ENV_VAR = "PADDLE_TPU_FAULT_DISPATCH_HANG_S"
 STREAM_STALL_ENV_VAR = "PADDLE_TPU_FAULT_STREAM_STALL_S"
 SLOW_REPLICA_ENV_VAR = "PADDLE_TPU_FAULT_SLOW_REPLICA_S"
+PEER_SLOW_ENV_VAR = "PADDLE_TPU_FAULT_PEER_SLOW_S"
 
 __all__ = [
     "SITES", "inject", "scoped", "configure", "reset", "parse_spec",
     "retry_with_backoff", "BackpressureError", "RequestTimeoutError",
     "hang_seconds", "prefetch_stall_seconds", "dispatch_hang_seconds",
-    "stream_stall_seconds", "slow_replica_seconds", "main",
+    "stream_stall_seconds", "slow_replica_seconds",
+    "peer_slow_seconds", "main",
 ]
 
 # ------------------------------------------------------------- inventory
@@ -142,6 +144,23 @@ SITES: Dict[str, Tuple[str, str]] = {
         "on the replica's tick thread (degraded-host stand-in; the "
         "watchdog must NOT fire below its deadline, and least-loaded "
         "routing shifts traffic off the slow replica)"),
+    # --- multi-host fleet chaos (ISSUE 13): remote-replica fault
+    # sites wired into the fleet frontend's proxy path and the peer
+    # prober — the remote analogues of tick_crash/slow_replica.
+    "peer_conn_drop": (
+        "paddle_tpu/serving/fleet/frontend.py:"
+        "FleetFrontend._proxy_stream",
+        "sever the frontend->peer connection of an in-flight proxied "
+        "stream (peer gateway process death / network partition "
+        "stand-in; exercises the fleet failover path: resubmit "
+        "prompt+committed on a surviving peer, greedy streams stay "
+        "bitwise the uninterrupted run)"),
+    "peer_slow": (
+        "paddle_tpu/serving/fleet/remote.py:RemoteReplica._probe_once",
+        "sleep PADDLE_TPU_FAULT_PEER_SLOW_S (default 0.05) in a remote "
+        "replica's health/gossip probe (congested peer stand-in; the "
+        "staleness bound must evict a peer whose probes stop landing, "
+        "never wedge the router)"),
 }
 
 
@@ -353,6 +372,11 @@ def stream_stall_seconds() -> float:
 def slow_replica_seconds() -> float:
     """Per-tick delay of a fired ``slow_replica`` site."""
     return float(os.environ.get(SLOW_REPLICA_ENV_VAR, "0.05"))
+
+
+def peer_slow_seconds() -> float:
+    """Per-probe delay of a fired ``peer_slow`` site."""
+    return float(os.environ.get(PEER_SLOW_ENV_VAR, "0.05"))
 
 
 # ---------------------------------------------------------------- retry

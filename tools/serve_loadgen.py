@@ -30,10 +30,26 @@ points, every completed greedy stream is replayed BITWISE against a
 fresh reference engine, and the run fails (nonzero exit) on any
 corrupted stream, on 5xx counts beyond the retry-budget bound, or on
 a completed fraction below ``--goodput-floor`` (docs/SERVING.md).
+
+Fleet mode (ISSUE 13): ``--url`` may repeat (client-side round-robin
+over several fleet front doors), ``--diurnal`` replaces the flat
+offered rate with a seeded sinusoid over the run (the autoscaler's
+evaluation trace), and ``--fleet N`` self-hosts N SEPARATE gateway
+processes behind an in-process :class:`FleetFrontend` (remote-replica
+adapter routing + byte-for-byte SSE proxying). ``--fleet-kill K``
+SIGKILLs K replica processes at seeded mid-run points (the remote
+analogue of ``--chaos``: completed greedy streams replay bitwise, the
+goodput floor applies); ``--autoscale`` runs the closed-loop
+:class:`FleetAutoscaler` over the run and the rung reports
+``fleet_tokens_per_sec`` plus goodput-per-replica (good tokens per
+replica-second — the chip-cost framing of the TPU-serving comparison
+paper). The fleet rung lands in ``SERVE_FLEET_r13.json``, which
+bench.py auto-ingests beside the gateway rung.
 """
 import argparse
 import asyncio
 import json
+import math
 import os
 import random
 import sys
@@ -43,6 +59,23 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 OUT_DEFAULT = os.path.join(ROOT, "SERVE_LOADGEN_r07.json")
+OUT_FLEET = os.path.join(ROOT, "SERVE_FLEET_r13.json")
+
+
+def diurnal_rate(i: int, n_requests: int, base_rate: float,
+                 amp: float = 0.8, cycles: float = 1.0,
+                 phase: float = 0.0) -> float:
+    """Seeded sinusoidal offered-rate trace (ISSUE 13): request ``i``
+    of ``n_requests`` arrives at instantaneous rate
+    ``base * (1 + amp * sin(2*pi*cycles*i/n + phase))`` — a compressed
+    diurnal load curve the autoscaler must ride up AND back down.
+    Floored at 5% of base so the open loop never stalls entirely.
+    Deterministic in (i, n, base, amp, cycles, phase); the CLI derives
+    ``phase`` from ``--seed``."""
+    frac = i / max(n_requests - 1, 1)
+    r = base_rate * (1.0 + amp * math.sin(
+        2.0 * math.pi * cycles * frac + phase))
+    return max(r, 0.05 * base_rate)
 
 
 def _force_platform():
@@ -220,6 +253,49 @@ def _stub_model():
     return TickStubModel()
 
 
+def _build_fleet(ns):
+    """Fleet mode (ISSUE 13): spawn ``--fleet`` SEPARATE gateway
+    processes (``fleet/replica_main.py``, warmed before ready) and an
+    in-process :class:`FleetFrontend` routing over their
+    :class:`RemoteReplica` adapters. Returns
+    ``(frontend, manager, autoscaler_or_None)`` — the frontend is NOT
+    started yet (the caller awaits ``start()`` on its loop)."""
+    _force_platform()
+    from paddle_tpu.serving.fleet import (FleetAutoscaler,
+                                          FleetFrontend,
+                                          LocalProcessManager)
+    chunk = ns.sys_tokens or 8
+    fe = FleetFrontend([], chunk_tokens=chunk, routing=ns.policy,
+                       failover_budget=getattr(ns, "failover_budget",
+                                               2),
+                       breaker_backoff_s=0.2, name="fleet")
+    extra = []
+    trace_dir = getattr(ns, "trace_dir", None)
+    if trace_dir:
+        # peer gateways dump their reqtrace rings here on SIGTERM
+        # drain — the multi-run-dir input trace_report's fleet merge
+        # joins with the frontend's own ring by request id
+        extra += ["--run-dir", trace_dir]
+    manager = LocalProcessManager(
+        fe, model=ns.model if ns.model in ("stub", "tiny") else "stub",
+        chunk_tokens=chunk, extra_args=extra,
+        probe_interval_s=0.1, stale_after_s=1.5)
+    for _ in range(ns.fleet):
+        manager.spawn()
+    scaler = None
+    if getattr(ns, "autoscale", False):
+        scaler = FleetAutoscaler(
+            manager,
+            min_replicas=getattr(ns, "autoscale_min", 1),
+            max_replicas=getattr(ns, "autoscale_max",
+                                 max(ns.fleet, 2)),
+            up_queue_depth=1.0, hold_s=0.3, hold_down_s=1.5,
+            cooldown_s=getattr(ns, "autoscale_cooldown_s", 3.0),
+            interval_s=0.1)
+        fe.attach_autoscaler(scaler)
+    return fe, manager, scaler
+
+
 # ------------------------------------------------------------------- run
 def _pct(sorted_vals, q):
     if not sorted_vals:
@@ -231,17 +307,32 @@ def _pct(sorted_vals, q):
 async def run_loadgen(ns) -> dict:
     rng = random.Random(ns.seed)
     gw = engines = engine_factory = None
+    fe = manager = scaler = None
     chaos = bool(getattr(ns, "chaos", False))
-    if ns.url:
+    fleet = int(getattr(ns, "fleet", 0) or 0)
+    urls = ns.url if isinstance(ns.url, list) \
+        else ([ns.url] if ns.url else [])
+    if urls:
+        if chaos or fleet:
+            raise SystemExit("--chaos/--fleet require self-hosted "
+                             "mode (they inject faults into / spawn "
+                             "their own fleet)")
+        targets = []
+        for u in urls:
+            h, _, p = u.partition(":")
+            targets.append((h, int(p)))
+    elif fleet:
         if chaos:
-            raise SystemExit("--chaos requires self-hosted mode "
-                             "(it injects faults into its own fleet)")
-        host, _, port = ns.url.partition(":")
-        port = int(port)
+            raise SystemExit("--chaos is the single-process harness; "
+                             "the fleet analogue is --fleet-kill")
+        fe, manager, scaler = _build_fleet(ns)
+        await fe.start()
+        targets = [(fe.host, fe.port)]
     else:
         gw, engines, engine_factory = _build_gateway(ns)
         await gw.start()
-        host, port = gw.host, gw.port
+        targets = [(gw.host, gw.port)]
+    host, port = targets[0]
     # chaos schedule (ISSUE 12): seeded kill/hang points spread evenly
     # over the request stream — deterministic per (--seed,
     # --chaos-kills, --chaos-mode), replica picked by a seeded RNG
@@ -276,6 +367,26 @@ async def run_loadgen(ns) -> dict:
                 break
             chaos_plan[pt] = (kinds[j % len(kinds)],
                               crng.randrange(ns.replicas))
+    # fleet process-kill schedule (ISSUE 13): seeded SIGKILL points —
+    # the remote analogue of --chaos (no in-process hooks exist into a
+    # separate gateway process; death arrives as dropped connections
+    # and failed probes, which is exactly what the failover must eat)
+    fleet_kill_plan = set()
+    fleet_kill_events = []
+    if fleet and int(getattr(ns, "fleet_kill", 0) or 0) > 0:
+        kk = int(ns.fleet_kill)
+        for j in range(kk):
+            pt = max(1, round((j + 1) * ns.requests / (kk + 1)))
+            while pt in fleet_kill_plan and pt < ns.requests - 1:
+                pt += 1
+            if pt in fleet_kill_plan:
+                print(f"warning: only {len(fleet_kill_plan)} of {kk} "
+                      f"--fleet-kill points fit", file=sys.stderr)
+                break
+            fleet_kill_plan.add(pt)
+    krng = random.Random(ns.seed + 2)
+    # seeded diurnal phase: the trace is deterministic per --seed
+    phase = random.Random(ns.seed + 3).uniform(0, 2 * math.pi)
     vocab = 120
     sysp = [rng.randrange(1, vocab) for _ in range(ns.sys_tokens)]
 
@@ -295,18 +406,22 @@ async def run_loadgen(ns) -> dict:
     # warmup (compiles the prefill/decode executables untimed); a
     # failed warmup against a restarting --url gateway must not kill
     # the run the per-request guard below protects
-    try:
-        await sse_generate(host, port, _payload(0)[0])
-    except (ConnectionError, OSError, asyncio.TimeoutError):
-        pass
+    for wh, wp in targets:
+        try:
+            await sse_generate(wh, wp, _payload(0)[0])
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass
 
     records = []
 
     async def _one(i):
         payload, shared = _payload(i)
         rid = f"lg{ns.seed}-{i:05d}"     # client-minted trace id
+        # client-side round-robin over the fleet front doors (ISSUE
+        # 13 satellite: several --url targets, or the one frontend)
+        th, tp = targets[i % len(targets)]
         try:
-            rec = await sse_generate(host, port, payload,
+            rec = await sse_generate(th, tp, payload,
                                      request_id=rid)
         except (ConnectionError, OSError, asyncio.TimeoutError) as e:
             # one dropped connection (external gateway restarting,
@@ -318,7 +433,7 @@ async def run_loadgen(ns) -> dict:
         rec["shared"] = shared
         rec["tenant"] = payload["tenant"]
         rec["slo"] = payload["slo"]
-        if chaos:
+        if chaos or fleet:
             rec["prompt"] = payload["prompt"]   # for the reference replay
         records.append(rec)
 
@@ -334,16 +449,34 @@ async def run_loadgen(ns) -> dict:
         chaos_events.append({"at_request": i, "kind": kind,
                              "replica": w.replica.name})
 
+    def _fire_fleet_kill(i):
+        names = sorted(manager.procs)
+        if not names:
+            return
+        name = manager.kill(names[krng.randrange(len(names))])
+        fleet_kill_events.append({"at_request": i, "peer": name})
+
     t0 = time.perf_counter()
     tasks = []
     for i in range(ns.requests):
         tasks.append(asyncio.ensure_future(_one(i)))
         if i in chaos_plan:
             _fire_chaos(i)
+        if i in fleet_kill_plan:
+            _fire_fleet_kill(i)
         if i < ns.requests - 1:
             # open-loop Poisson arrivals: exponential gaps at the
-            # offered rate, slept regardless of completions
-            await asyncio.sleep(rng.expovariate(ns.rate))
+            # offered rate, slept regardless of completions. --diurnal
+            # modulates the instantaneous rate along the seeded
+            # sinusoid (the autoscaler's evaluation trace).
+            rate_i = ns.rate
+            if getattr(ns, "diurnal", False):
+                rate_i = diurnal_rate(
+                    i, ns.requests, ns.rate,
+                    amp=getattr(ns, "diurnal_amp", 0.8),
+                    cycles=getattr(ns, "diurnal_cycles", 1.0),
+                    phase=phase)
+            await asyncio.sleep(rng.expovariate(rate_i))
     await asyncio.gather(*tasks)
     wall = time.perf_counter() - t0
 
@@ -376,8 +509,10 @@ async def run_loadgen(ns) -> dict:
         "share_frac": ns.share_frac,
         "policy": ns.policy,
         "replicas": ns.replicas,
-        "model": ns.model if not ns.url else "external",
+        "model": ns.model if not urls else "external",
         "ring": getattr(ns, "ring", "on"),
+        "targets": len(targets),
+        "diurnal": bool(getattr(ns, "diurnal", False)),
     }
     if engines is not None and getattr(ns, "ring", "on") == "on":
         rung["ring_drains"] = sum(e.ring_drains for e in engines)
@@ -424,7 +559,91 @@ async def run_loadgen(ns) -> dict:
     if chaos:
         rung["chaos"] = _verify_chaos(ns, gw, engine_factory, records,
                                       chaos_events)
+    if fe is not None:
+        # fleet rung (ISSUE 13): fleet_tokens_per_sec is the headline
+        # bench.py promotes; goodput-per-replica divides the good
+        # tokens by REPLICA-SECONDS (the autoscaler's chip-cost
+        # denominator), so a fleet that scales down through the trough
+        # scores higher than one that holds peak capacity all run
+        hz = fe.healthz()
+        rep_secs = (scaler.replica_seconds if scaler is not None
+                    else fleet * wall)
+        rung["metric"] = "fleet_serving"
+        rung["fleet_tokens_per_sec"] = round(total_tokens / wall, 1)
+        rung["fleet_replicas"] = fleet
+        rung["fleet_peer_failovers"] = hz["peer_failovers"]
+        rung["fleet_retry_budget_exhausted"] = \
+            hz["retry_budget_exhausted"]
+        rung["replica_seconds"] = round(rep_secs, 2)
+        rung["mean_replicas"] = round(rep_secs / max(wall, 1e-9), 2)
+        rung["goodput_per_replica"] = round(
+            good_tokens / max(rep_secs, 1e-9), 2)
+        rung["router"] = hz["router"]
+        if fleet_kill_events:
+            rung["fleet_kills"] = fleet_kill_events
+        if scaler is not None:
+            snap = scaler.snapshot()
+            rung["autoscale"] = {
+                "scale_ups": snap["scale_ups"],
+                "scale_downs": snap["scale_downs"],
+                "min_replicas": snap["min_replicas"],
+                "max_replicas": snap["max_replicas"],
+                "events": snap["events"],
+            }
+        trace_dir = getattr(ns, "trace_dir", None)
+        if trace_dir:
+            rung["trace_rings"] = fe.dump_traces(trace_dir)
+        if ns.model == "stub":
+            rung["fleet_gate"] = _verify_fleet(ns, hz, records,
+                                               fleet_kill_events)
+        await fe.drain()
+        manager.stop_all()
     return rung
+
+
+def _verify_fleet(ns, fleet_health, records, kill_events):
+    """The fleet acceptance gate (ISSUE 13): replay every COMPLETED
+    greedy stream on a fresh single-engine reference (same stub
+    geometry the replica processes run — ``replica_main.py`` is the
+    single source of truth) and demand bitwise token equality: a
+    cross-process failover that duplicated, dropped or rewrote a token
+    shows up as a corrupted stream. Error counts must stay within the
+    retry-budget bound (process kills <= budget ==> zero 5xx) and the
+    completed fraction must clear ``--goodput-floor``."""
+    from paddle_tpu.generation.paged import PagedEngine
+    from paddle_tpu.generation.stub import TickStubModel
+    from paddle_tpu.serving.fleet.replica_main import stub_engine_kw
+    ref = PagedEngine(TickStubModel(),
+                      **stub_engine_kw(ns.sys_tokens or 8))
+    done = [r for r in records if r["finish_reason"] == "stop"]
+    for r in done:
+        ref.submit(r["request_id"], r["prompt"],
+                   max_new_tokens=ns.max_new)
+    expect = ref.run()
+    corrupted = [r["request_id"] for r in done
+                 if r["tokens"] != expect[r["request_id"]]]
+    errors = sum(r["finish_reason"] in ("error", "conn_error")
+                 for r in records) \
+        + sum(r["status"] in (500, 503) for r in records)
+    budget = getattr(ns, "failover_budget", 2)
+    floor = float(getattr(ns, "goodput_floor", 0.95))
+    error_bound = 0 if len(kill_events) <= budget else ns.requests
+    completed_frac = len(done) / max(ns.requests, 1)
+    gate = {
+        "kills": len(kill_events),
+        "failover_budget": budget,
+        "peer_failovers": int(fleet_health["peer_failovers"]),
+        "replays_checked": len(done),
+        "corrupted_streams": len(corrupted),
+        "corrupted_ids": corrupted[:8],
+        "errors_5xx": errors,
+        "error_bound": error_bound,
+        "completed_frac": round(completed_frac, 3),
+        "goodput_floor": floor,
+    }
+    gate["ok"] = (not corrupted and errors <= error_bound
+                  and completed_frac >= floor)
+    return gate
 
 
 def _verify_chaos(ns, gw, engine_factory, records, chaos_events):
@@ -522,8 +741,32 @@ def main(argv=None) -> int:
                     help="minimum completed-request fraction the "
                          "chaos run must clear")
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--url", default=None,
-                    help="attach to HOST:PORT instead of self-hosting")
+    ap.add_argument("--url", action="append", default=None,
+                    help="attach to HOST:PORT instead of self-hosting "
+                         "(repeatable: client-side round-robin over "
+                         "several fleet front doors)")
+    ap.add_argument("--diurnal", action="store_true",
+                    help="modulate the offered rate along a seeded "
+                         "sinusoid over the run (the autoscaler's "
+                         "evaluation trace; see --diurnal-amp/-cycles)")
+    ap.add_argument("--diurnal-amp", type=float, default=0.8,
+                    help="sinusoid amplitude as a fraction of --rate")
+    ap.add_argument("--diurnal-cycles", type=float, default=1.0,
+                    help="full day-cycles compressed into the run")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="self-host N SEPARATE gateway processes "
+                         "behind an in-process FleetFrontend "
+                         "(remote-replica adapter routing, ISSUE 13)")
+    ap.add_argument("--fleet-kill", type=int, default=0,
+                    help="SIGKILL this many replica processes at "
+                         "seeded mid-run points (fleet chaos: bitwise "
+                         "replay gate + goodput floor apply)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the closed-loop FleetAutoscaler over "
+                         "the run (pair with --diurnal)")
+    ap.add_argument("--autoscale-min", type=int, default=1)
+    ap.add_argument("--autoscale-max", type=int, default=4)
+    ap.add_argument("--autoscale-cooldown-s", type=float, default=3.0)
     ap.add_argument("--out", default=OUT_DEFAULT,
                     help="rung file bench.py auto-ingests "
                          "('' disables the write)")
@@ -534,6 +777,9 @@ def main(argv=None) -> int:
                     help="dump the gateway's request-trace rings here "
                          "(self-hosted mode; '' disables)")
     ns = ap.parse_args(argv)
+    if ns.fleet and ns.out == OUT_DEFAULT:
+        # the fleet rung is its own bench ladder entry
+        ns.out = OUT_FLEET
     _force_platform()
     import jax
     device = jax.devices()[0].device_kind
@@ -542,9 +788,10 @@ def main(argv=None) -> int:
     print("LOADGEN_JSON " + json.dumps(rung))
     if ns.out:
         tmp = ns.out + ".tmp"
+        section = "fleet" if ns.fleet else "gateway"
         with open(tmp, "w") as f:
             json.dump({"started": started, "device": device,
-                       "gateway": rung}, f, indent=1)
+                       section: rung}, f, indent=1)
         os.replace(tmp, ns.out)
         print(f"wrote {ns.out}", file=sys.stderr)
     ch = rung.get("chaos")
@@ -554,6 +801,15 @@ def main(argv=None) -> int:
               f"errors_5xx={ch['errors_5xx']} (bound "
               f"{ch['error_bound']}) completed_frac="
               f"{ch['completed_frac']} (floor {ch['goodput_floor']})",
+              file=sys.stderr)
+        return 1
+    fg = rung.get("fleet_gate")
+    if fg is not None and not fg["ok"]:
+        print("FLEET GATE FAILED: "
+              f"corrupted={fg['corrupted_streams']} "
+              f"errors_5xx={fg['errors_5xx']} (bound "
+              f"{fg['error_bound']}) completed_frac="
+              f"{fg['completed_frac']} (floor {fg['goodput_floor']})",
               file=sys.stderr)
         return 1
     return 0

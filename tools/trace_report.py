@@ -20,6 +20,16 @@ the ring entries, not bucket interpolations). The client join matches
 server rings against client-minted ``X-Request-Id``s: the TTFT delta
 is the wire + gateway parse overhead, and client-only outcomes (shed
 before a ring existed, connection errors) are counted separately.
+
+Fleet merge (ISSUE 13): point the CLI at SEVERAL gateway run dirs (or
+one shared ``--trace-dir`` a fleet loadgen run filled) and rings from
+different PROCESSES merge into one timeline. A request that crossed
+processes — proxied by the fleet frontend, or failed over to a
+surviving peer mid-stream — is followed by request id: the report
+counts cross-process requests, names the hop chain
+(``fleet/frontend -> gwA/r0 -> gwB/r0``), and prints the merged
+event-by-event timeline on one wall-clock axis (entries carry
+``wall_accept``; event times are offsets from it).
 """
 import argparse
 import glob
@@ -90,6 +100,62 @@ def load_client_jsonl(path: str) -> Dict[str, dict]:
     return recs
 
 
+def fleet_merge(docs: List[dict], top: int = 5) -> Optional[dict]:
+    """Join entries across rings from different PROCESSES by request
+    id (ISSUE 13). A request is cross-process when it has ring entries
+    in more than one dump, or its timeline carries fleet hop events
+    (``proxy_to``/``peer_fail``/``resubmit``). Returns None when the
+    input is a single-process view with no hops — the report then
+    stays in its classic shape."""
+    by_rid: Dict[str, List[tuple]] = {}
+    for d in docs:
+        lbl = d.get("labels") or {}
+        where = (f"{lbl.get('gateway', '?')}/"
+                 f"{lbl.get('replica', '?')}")
+        for e in d["entries"]:
+            by_rid.setdefault(str(e["request_id"]),
+                              []).append((where, e))
+
+    def _hops(entries):
+        return sum(1 for _, e in entries
+                   for _, k, _f in e.get("events", ())
+                   if k == "peer_fail")
+
+    cross = {rid: hops for rid, hops in by_rid.items()
+             if len(hops) > 1
+             or any(k in ("proxy_to", "peer_fail")
+                    for _, e in hops
+                    for _, k, _f in e.get("events", ()))}
+    if not cross:
+        return None
+    chains = []
+    for rid, hops in cross.items():
+        # order hops by accept wall time: the frontend accepts first,
+        # then each peer the request touched in failover order
+        hops = sorted(hops, key=lambda we: we[1].get("wall_accept", 0))
+        merged = []
+        for where, e in hops:
+            w0 = e.get("wall_accept") or 0.0
+            for t, kind, fields in e.get("events", ()):
+                merged.append((w0 + t / 1e3, where, kind, fields))
+        merged.sort(key=lambda ev: ev[0])
+        chains.append({
+            "request_id": rid,
+            "chain": [where for where, _ in hops],
+            "outcomes": {where: e["outcome"] for where, e in hops},
+            "peer_failovers": _hops(hops),
+            "events": merged,
+        })
+    chains.sort(key=lambda c: (-c["peer_failovers"],
+                               -len(c["chain"]), c["request_id"]))
+    return {
+        "cross_process_requests": len(cross),
+        "with_peer_failover": sum(1 for c in chains
+                                  if c["peer_failovers"]),
+        "chains": chains[:top],
+    }
+
+
 def summarize(docs: List[dict],
               client: Optional[Dict[str, dict]] = None,
               top: int = 5) -> Dict[str, Any]:
@@ -133,6 +199,9 @@ def summarize(docs: List[dict],
         "classes": classes,
         "slowest_retained": slowest,
     }
+    fleet = fleet_merge(docs, top=top)
+    if fleet is not None:
+        out["fleet"] = fleet
     if client is not None:
         server_ids = {e["request_id"] for e in entries}
         matched = [(client[e["request_id"]], e) for e in entries
@@ -194,6 +263,23 @@ def render(s: Dict[str, Any]) -> str:
             for t, kind, fields in e.get("events", [])[:24]:
                 extra = " ".join(f"{k}={v}" for k, v in fields.items())
                 lines.append(f"    {t:>10.3f}ms  {kind:<14s} {extra}")
+    fl = s.get("fleet")
+    if fl:
+        lines.append(
+            f"fleet: {fl['cross_process_requests']} cross-process "
+            f"requests ({fl['with_peer_failover']} rode a peer "
+            f"failover)")
+        for c in fl["chains"]:
+            oc = " ".join(f"{w}={o}" for w, o in
+                          sorted(c["outcomes"].items()))
+            lines.append(f"  {c['request_id']}  "
+                         f"{' -> '.join(c['chain'])}  "
+                         f"peer_failovers={c['peer_failovers']}  {oc}")
+            for t, where, kind, fields in c["events"][:32]:
+                extra = " ".join(f"{k}={v}"
+                                 for k, v in fields.items())
+                lines.append(f"    {t:.3f}  {where:<24s} "
+                             f"{kind:<14s} {extra}")
     cj = s.get("client_join")
     if cj:
         w = cj["wire_overhead_ms"]
