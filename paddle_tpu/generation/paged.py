@@ -69,6 +69,29 @@ TPU-native design — everything the XLA program sees is STATIC:
   keeps the synchronous per-tick readback as the bit-exactness
   reference — drained streams are pinned BITWISE identical to it.
 
+- ``delta_transitions`` (ISSUE 14, default on with the fused tick)
+  makes slot TRANSITIONS survive the dispatch pipeline: instead of
+  marking the whole device state dirty and rebuilding + re-uploading
+  every mirror (the ``_refresh_dev`` full rebuild, now the
+  ``delta_transitions=False`` reference path), each transition —
+  admit, finish, chunked-prefill advance, preempt, cancel, block
+  growth — packs ONE small per-slot descriptor (row index, tokens
+  head, table row, lens/budget/eos config, sampling params, PRNG key,
+  spec EMA) and a tiny compiled PATCH program scatters it into the
+  device-resident tick state in-program. Steady decode keeps issuing
+  back-to-back dispatches while churn costs one descriptor-sized H2D
+  (``h2d_upload_bytes`` counts the difference; ``full_rebuilds`` /
+  ``delta_patches`` count the events), and out-of-band transitions
+  (cancel, expiry) drain only the affected slot's pending ring
+  entries (``_drain_row``) instead of forcing a global drain.
+  Streams stay BITWISE identical to the full-rebuild reference per
+  request across every transition kind, ring on or off — with one
+  carve-out: sampled rows under ``spec_tokens>0`` are distribution-
+  preserving rather than bitwise (drafts may read the committed-token
+  buffer's uncommitted tail, which a rebuild zeroes and a patch
+  preserves; greedy spec stays bitwise — the argmax-prefix accept
+  rule is draft-invariant. See docs/PERFORMANCE.md).
+
 Padded prompt positions scatter into a reserved GARBAGE block (physical
 block 0) so they can never corrupt a live block; it is never allocated.
 """
@@ -311,7 +334,8 @@ class PagedEngine:
                  spec_tokens: int = 0,
                  spec_ngram: int = 2,
                  ring_mode: Optional[bool] = None,
-                 ring_len: Optional[int] = None):
+                 ring_len: Optional[int] = None,
+                 delta_transitions: Optional[bool] = None):
         cfg = model.config
         self.model = model
         self.fn, self.params = model.functional()
@@ -393,6 +417,12 @@ class PagedEngine:
         # spec_proposed/spec_accepted (ISSUE 7): drafted vs accepted
         # draft tokens — `health()` derives the accept rate from the
         # SAME registry objects a /metrics scrape exports
+        # full_rebuilds / delta_patches / h2d_upload_bytes (ISSUE 14):
+        # the transition-cost trio — how often the whole device state
+        # was rebuilt, how often a one-row delta patch sufficed, and
+        # the actual bytes that crossed H2D either way (the event
+        # counter ``h2d_uploads`` weights both the same; the bytes
+        # counter is what the delta path shrinks)
         self._counters = {
             k: reg.counter(f"paged_{k}_total", **self._obs_labels)
             for k in ("decode_steps", "prefills", "preemptions",
@@ -400,7 +430,9 @@ class PagedEngine:
                       "active_slot_steps", "prefix_hit_tokens",
                       "prefix_adopted_blocks", "timeouts",
                       "cancellations", "rejected",
-                      "spec_proposed", "spec_accepted")}
+                      "spec_proposed", "spec_accepted",
+                      "full_rebuilds", "delta_patches",
+                      "h2d_upload_bytes")}
         self._h_decode = reg.histogram("paged_decode_step_ms",
                                        buckets=obs.SERVING_MS_BUCKETS,
                                        **self._obs_labels)
@@ -409,6 +441,11 @@ class PagedEngine:
                                      **self._obs_labels)
         self._h_tpf = reg.histogram("paged_tokens_per_forward",
                                     **self._obs_labels)
+        # per-upload H2D size distribution (ISSUE 14): a one-row patch
+        # and a full-state rebuild land in very different buckets
+        self._h_bytes = reg.histogram("paged_h2d_bytes",
+                                      buckets=obs.BYTES_BUCKETS,
+                                      **self._obs_labels)
         # request-scoped tracing hook (ISSUE 10): when a front end (the
         # serving gateway) sets this to a callable ``(request_id, kind,
         # **fields)``, the engine reports each request's lifecycle as
@@ -446,9 +483,15 @@ class PagedEngine:
         # instrumentation for the one-dispatch-per-tick contract: jitted
         # engine-program launches and host->device mirror uploads (the
         # transition scatters on `seen` are not counted — they are slot-
-        # transition work, not steady-state ticks)
+        # transition work, not steady-state ticks). h2d_upload_bytes
+        # (ISSUE 14 satellite) weighs each upload event by its actual
+        # size: a full-state rebuild and a one-row delta patch are both
+        # ONE h2d_uploads event but differ by orders of magnitude here.
         self.dispatch_count = 0
         self.h2d_uploads = 0
+        self.h2d_upload_bytes = 0
+        self.full_rebuilds = 0
+        self.delta_patches = 0
         # NOTE: the small state dict is NOT donated — donating leaves
         # that pass through unchanged (tables, temps, ...) makes XLA
         # emit input->output aliases for them, and executables
@@ -534,10 +577,39 @@ class PagedEngine:
         # readback instrumentation for the amortization contract:
         # d2h_syncs counts BLOCKING readbacks (one per sync-mode tick;
         # in ring mode only drains that actually had to wait),
-        # ring_drains counts pipelined ring consumptions
+        # ring_drains counts pipelined ring consumptions and
+        # ring_scoped_drains the per-row out-of-band consumptions the
+        # delta path uses for cancel/expiry (ISSUE 14)
         self.d2h_syncs = 0
         self.ring_drains = 0
         self.ring_blocking_drains = 0
+        self.ring_scoped_drains = 0
+        # --- delta slot transitions (ISSUE 14 tentpole) ---------------
+        # delta_transitions=True (the default whenever the tick is
+        # fused): a slot transition packs ONE per-slot descriptor
+        # (_pack_descriptor) and a tiny compiled patch program
+        # (_apply_patch) scatters it into the device tick state —
+        # admits and finishes edit one row, block growth rewrites one
+        # table row — instead of marking the whole state dirty for a
+        # full _refresh_dev rebuild + re-upload. False keeps the
+        # all-or-nothing rebuild as the bit-exactness reference;
+        # streams are pinned BITWISE identical across both modes.
+        self._delta = bool(fused_tick) if delta_transitions is None \
+            else bool(delta_transitions)
+        if self._delta and not self._fused:
+            raise ValueError(
+                "delta_transitions requires fused_tick=True: patches "
+                "edit the fused tick's device-resident state")
+        self._delta_rows: set = set()   # slots awaiting a patch flush
+        # descriptor layout (int32 vector; floats/keys ride as raw
+        # bits): [0]=row [1]=lens [2]=last [3]=eos [4]=rem [5]=active
+        # [6]=key_override [7]=temp [8]=top_k [9]=top_p [10]=rep
+        # [11:13]=PRNG key [13]=spec ema [14]=spec tick counter
+        # [15:15+M]=block-table row [15+M:]=committed-token row (spec)
+        self._desc_len = 15 + self.M + (
+            (self.M * self.B + self._spec_k + 1) if self._spec_k else 0)
+        if self._delta:
+            self._patch_jit = jax.jit(self._apply_patch)
 
     @property
     def stats(self) -> Dict[str, int]:
@@ -839,6 +911,151 @@ class PagedEngine:
         return (G, LP, n_eff, kprop, m, done, seen,
                 [(c.kp, c.vp) for c in new_caches], new_st)
 
+    # --------------------------------- delta slot transitions (ISSUE 14)
+    def _mark_dirty(self, slot_id: int):
+        """A slot transition touched ``slot_id``'s mirrors. Delta mode
+        queues a one-row patch (flushed immediately before the next
+        dispatch; multiple transitions of one slot coalesce into its
+        final state); rebuild mode (or no device state yet) falls back
+        to the all-or-nothing ``_dev_dirty`` -> ``_refresh_dev``."""
+        if self._delta and self._dev is not None and not self._dev_dirty:
+            self._delta_rows.add(slot_id)
+        else:
+            self._dev_dirty = True
+
+    @staticmethod
+    def _slot_row_fields(s):
+        """The (last, eos, rem, active) scalars ONE slot contributes
+        to the device tick state — shared by the full rebuild (which
+        stacks R of them) and the delta descriptor (which uploads
+        exactly one), like ``token_buffer_row``/``seed_key_row``, so
+        the two upload paths cannot drift apart."""
+        eos = -1
+        rem = last = act = 0
+        if s is not None:
+            if s.eos is not None:
+                eos = s.eos
+            rem = max(s.max_new - len(s.tokens), 0)
+            if s.tokens and s.prefill_pos >= len(s.prompt):
+                act = 1
+                last = s.tokens[-1]
+        return last, eos, rem, act
+
+    def _pack_descriptor(self, i: int) -> np.ndarray:
+        """Pack slot ``i``'s CURRENT host-mirror state into one int32
+        descriptor vector (floats and the uint32 PRNG key ride as raw
+        bits). Field values follow ``_refresh_dev``'s per-row rules
+        exactly (``_slot_row_fields`` is the shared rule), so a
+        patched row is byte-for-byte what a full rebuild would have
+        uploaded for it — the bitwise-parity contract between the two
+        modes is structural, not incidental. The PRNG key is flagged
+        authoritative only for rows the HOST re-keyed (fresh admits,
+        chunk-final): for every other row the device key stream —
+        possibly advanced by sampled ticks since the last rebuild —
+        must survive the patch untouched."""
+        s = self.slots[i]
+        d = np.zeros((self._desc_len,), np.int32)
+        d[0] = i
+        d[1] = self.seq_lens[i]
+        d[2], d[3], d[4], d[5] = self._slot_row_fields(s)
+        d[6] = 1 if i in self._key_overrides else 0
+        d[7] = np.float32(self.temps[i]).view(np.int32)
+        d[8] = self.top_ks[i]
+        d[9] = np.float32(self.top_ps[i]).view(np.int32)
+        d[10] = np.float32(self.reps[i]).view(np.int32)
+        d[11:13] = self.keys[i].view(np.int32)
+        if self._spec_k:
+            from .prompt_lookup import token_buffer_row
+            d[13] = np.float32(s.spec_ema if s is not None
+                               else 1.0).view(np.int32)
+            # d[14] (spec tick counter) stays 0: a patched row's probe
+            # cadence restarts, exactly what a rebuild did for it
+            d[15 + self.M:] = token_buffer_row(
+                s.prompt + s.tokens if s is not None else (),
+                self._desc_len - 15 - self.M)
+        d[15:15 + self.M] = self.block_tables[i]
+        return d
+
+    def _apply_patch(self, st, desc):
+        """ONE compiled program scattering a packed per-slot descriptor
+        into the device tick state: the in-program slot transition.
+        Ring arrays and write cursors are deliberately untouched — the
+        cursors are monotone and the host's drained cursor already
+        equals the row's device cursor whenever a transition patches
+        it (every deactivation passes through a drain first), so a
+        readmitted slot simply continues the ring where the previous
+        tenant stopped."""
+        M = self.M
+        r = desc[0]
+
+        def f32(x):
+            return jax.lax.bitcast_convert_type(x, jnp.float32)
+
+        new = dict(st)
+        new["tables"] = st["tables"].at[r].set(desc[15:15 + M])
+        new["lens"] = st["lens"].at[r].set(desc[1])
+        new["last"] = st["last"].at[r].set(desc[2])
+        new["eos"] = st["eos"].at[r].set(desc[3])
+        new["rem"] = st["rem"].at[r].set(desc[4])
+        new["active"] = st["active"].at[r].set(desc[5] != 0)
+        new["temps"] = st["temps"].at[r].set(f32(desc[7]))
+        new["tks"] = st["tks"].at[r].set(desc[8])
+        new["tps"] = st["tps"].at[r].set(f32(desc[9]))
+        new["reps"] = st["reps"].at[r].set(f32(desc[10]))
+        key = jax.lax.bitcast_convert_type(desc[11:13], jnp.uint32)
+        new["keys"] = jnp.where(desc[6] != 0,
+                                st["keys"].at[r].set(key), st["keys"])
+        if "toks" in st:
+            new["toks"] = st["toks"].at[r].set(desc[15 + M:])
+            new["ema"] = st["ema"].at[r].set(f32(desc[13]))
+            new["tickc"] = st["tickc"].at[r].set(desc[14])
+        return new
+
+    def _flush_patches(self):
+        """Apply every queued one-row patch (immediately before a
+        dispatch, after the step's drain — so host mirrors and device
+        state agree for every untouched row). Each patch is one
+        descriptor-sized H2D + one tiny compiled dispatch; the counters
+        are what the churn profiler and the delta tests pin.
+
+        A synchronized transition WAVE (all R slots admitted at once,
+        a preemption storm) pays R sequential patch dispatches where
+        one batched rebuild upload could be cheaper — deliberately NOT
+        special-cased here: an admit wave is normal steady churn, and
+        the zero-rebuild contract the tests pin must hold through it.
+        The real fix is ROADMAP item 4(a2): fuse pending patches into
+        the NEXT tick's program, one dispatch for any wave size."""
+        if self._ring and int(self._drained.max(initial=0)) > 2 ** 30:
+            # int32 ring-cursor headroom guard: without periodic
+            # rebuilds the device write cursors grow forever; force
+            # one rebuild (which zeroes them) long before wraparound
+            self._refresh_dev()
+            return
+        for i in sorted(self._delta_rows):
+            desc = self._pack_descriptor(i)
+            self.h2d_uploads += 1
+            self.h2d_upload_bytes += desc.nbytes
+            self.delta_patches += 1
+            self._count("delta_patches")
+            self._count("h2d_upload_bytes", desc.nbytes)
+            self._h_bytes.observe(desc.nbytes)
+            self._dev = self._patch_jit(self._dev, jnp.asarray(desc))
+            # the device now holds this row's authoritative key (the
+            # patch either uploaded the host's override or preserved
+            # the device stream), same as a rebuild's upload
+            self._key_overrides.discard(i)
+        self._delta_rows.clear()
+
+    def _sync_dev(self):
+        """Bring the device tick state up to date before a dispatch:
+        full rebuild when forced (first dispatch, ``hard_reset``,
+        ``delta_transitions=False``), else flush pending one-row
+        patches."""
+        if self._dev is None or self._dev_dirty:
+            self._refresh_dev()
+        elif self._delta_rows:
+            self._flush_patches()
+
     def _sync_keys_from_dev(self):
         """Fold the device PRNG keys back into the host mirror. Rows the
         host re-keyed since the last upload (`_key_overrides`: fresh
@@ -854,10 +1071,13 @@ class PagedEngine:
         self._dev_keys_dirty = False
 
     def _refresh_dev(self):
-        """Rebuild the device-resident tick state from the host mirrors
-        (runs only on slot transitions — admissions, finishes, chunk
-        advances, preemptions, block growth — never on a steady-state
-        tick)."""
+        """FULL rebuild of the device-resident tick state from the host
+        mirrors. With ``delta_transitions=False`` this runs on every
+        slot transition (admissions, finishes, chunk advances,
+        preemptions, block growth — never on a steady-state tick); in
+        delta mode it is the forced-rebuild path only (first dispatch,
+        ``hard_reset``, ring-cursor headroom guard) and transitions
+        ride one-row ``_apply_patch`` programs instead."""
         self._sync_keys_from_dev()
         self._key_overrides.clear()
         eos = np.full((self.R,), -1, np.int32)
@@ -865,15 +1085,16 @@ class PagedEngine:
         last = np.zeros((self.R,), np.int32)
         act = np.zeros((self.R,), bool)
         for i, s in enumerate(self.slots):
-            if s is None:
-                continue
-            if s.eos is not None:
-                eos[i] = s.eos
-            rem[i] = max(s.max_new - len(s.tokens), 0)
-            if s.tokens and s.prefill_pos >= len(s.prompt):
-                act[i] = True
-                last[i] = s.tokens[-1]
+            last[i], eos[i], rem[i], a = self._slot_row_fields(s)
+            act[i] = bool(a)
         self.h2d_uploads += 1
+        self.full_rebuilds += 1
+        self._count("full_rebuilds")
+        nbytes = (self.block_tables.nbytes + self.seq_lens.nbytes
+                  + last.nbytes + self.keys.nbytes + self.temps.nbytes
+                  + self.top_ks.nbytes + self.top_ps.nbytes
+                  + self.reps.nbytes + eos.nbytes + rem.nbytes
+                  + act.nbytes)
         self._dev = dict(
             tables=jnp.asarray(self.block_tables),
             lens=jnp.asarray(self.seq_lens),
@@ -892,15 +1113,16 @@ class PagedEngine:
             # (prompt + emitted tokens per slot; the +k+1 tail slack
             # absorbs the tick's unconditional candidate writes), plus
             # the per-request accept EMA and the probe tick counter
+            from .prompt_lookup import token_buffer_row
             Lbuf = self.M * self.B + self._spec_k + 1
             tk = np.zeros((self.R, Lbuf), np.int32)
             ema = np.ones((self.R,), np.float32)
             for i, s in enumerate(self.slots):
                 if s is None:
                     continue
-                seq = s.prompt + s.tokens
-                tk[i, :len(seq)] = seq
+                tk[i] = token_buffer_row(s.prompt + s.tokens, Lbuf)
                 ema[i] = s.spec_ema
+            nbytes += tk.nbytes + ema.nbytes
             self._dev.update(toks=jnp.asarray(tk), ema=jnp.asarray(ema),
                              tickc=jnp.zeros((self.R,), jnp.int32))
         if self._ring:
@@ -920,6 +1142,10 @@ class PagedEngine:
                     kprop_last=jnp.zeros((self.R,), jnp.int32),
                     macc_last=jnp.zeros((self.R,), jnp.int32))
             self._drained[:] = 0
+        self.h2d_upload_bytes += nbytes
+        self._count("h2d_upload_bytes", nbytes)
+        self._h_bytes.observe(nbytes)
+        self._delta_rows.clear()
         self._dev_dirty = False
 
     def _prefill(self, params, pools, table_row, ids, length, key,
@@ -1046,8 +1272,8 @@ class PagedEngine:
             # cleared by serve_stream between calls), so repeated
             # unseeded sampled requests get distinct streams
             seed = self._submit_counter
-        key = np.asarray(jax.random.key_data(jax.random.PRNGKey(seed)),
-                         np.uint32)
+        from .sampling import seed_key_row
+        key = seed_key_row(seed)
         timeout_s = timeout_s if timeout_s is not None \
             else self.default_timeout_s
         deadline = (time.monotonic() + timeout_s) \
@@ -1275,7 +1501,7 @@ class PagedEngine:
         self.reps[slot_id] = req.rep
         self.keys[slot_id] = req.key
         self._key_overrides.add(slot_id)
-        self._dev_dirty = True
+        self._mark_dirty(slot_id)
 
         if self.chunk is not None:
             # chunked mode: admission only claims the slot + blocks; the
@@ -1337,7 +1563,7 @@ class PagedEngine:
         padded = np.zeros((1, self.chunk), np.int32)
         padded[0, :live] = ids[start:start + live]
         row = self.block_tables[slot_id]
-        self._dev_dirty = True       # lens/activation change this tick
+        self._mark_dirty(slot_id)    # lens/activation change this tick
         self.dispatch_count += 1
         nxt, lp, new_key, seen_mid, seen_fin, self.pools = self._chunk_jit(
             self.params, self.pools, jnp.asarray(row),
@@ -1390,7 +1616,7 @@ class PagedEngine:
                 return False
             slot.blocks.append(b)
             self.block_tables[slot_id, len(slot.blocks) - 1] = b
-            self._dev_dirty = True   # table row grew: re-upload mirrors
+            self._mark_dirty(slot_id)   # table row grew: patch/re-upload
         return True
 
     def _ensure_block(self, slot_id: int) -> bool:
@@ -1444,7 +1670,7 @@ class PagedEngine:
         self.seen = self.seen.at[slot_id].set(False)
         self.slots[slot_id] = None
         self._key_overrides.discard(slot_id)
-        self._dev_dirty = True
+        self._mark_dirty(slot_id)
 
     def _preempt_youngest(self, exclude: int) -> bool:
         """Memory pressure: requeue the most recently admitted OTHER
@@ -1507,9 +1733,12 @@ class PagedEngine:
     def _expire(self):
         """Abort queued and running requests whose deadline passed (the
         per-request timeout contract: checked once per scheduler tick —
-        a jitted call is never interrupted mid-flight)."""
-        self._drain_pending()   # ring mode: never abort against a
-        now = time.monotonic()  # stale mirror / in-flight dispatch
+        a jitted call is never interrupted mid-flight). A running
+        expiry drains first (ring mode: never abort against a stale
+        mirror / in-flight dispatch) — scoped to the expiring row in
+        delta mode, so a queue-capacity reap on the submit path no
+        longer forces a global drain."""
+        now = time.monotonic()
         for req in [r for r in self.queue
                     if r.deadline is not None and now > r.deadline]:
             self.queue.remove(req)
@@ -1518,16 +1747,23 @@ class PagedEngine:
             s = self.slots[i]
             if s is not None and s.deadline is not None \
                     and now > s.deadline:
-                self._abort(s, "timeout", slot_id=i)
+                self._drain_slot(i)
+                s = self.slots[i]   # the drain may have finished it
+                if s is not None and s.deadline is not None \
+                        and now > s.deadline:
+                    self._abort(s, "timeout", slot_id=i)
 
     def cancel(self, request_id) -> bool:
         """Abort a queued or running request (client disconnect). Its
         blocks/slot free immediately; no result is recorded. Returns
-        False if the request is unknown or already finished."""
-        self._drain_pending()   # ring mode: a cancel racing an
-        # in-flight dispatch consumes its undrained entries first, so
-        # the release below cannot orphan ring tokens or free blocks
-        # the in-flight program still writes
+        False if the request is unknown or already finished.
+
+        A RUNNING cancel racing an in-flight dispatch drains that
+        slot's undrained ring entries first, so the release below
+        cannot orphan ring tokens or free blocks the in-flight program
+        still writes — scoped to the cancelled row in delta mode
+        (ISSUE 14: the siblings' pending tokens stay pending), the
+        global drain in rebuild mode."""
         for req in self.queue:
             if req.request_id == request_id:
                 self.queue.remove(req)
@@ -1536,6 +1772,10 @@ class PagedEngine:
         for i in range(self.R):
             s = self.slots[i]
             if s is not None and s.request_id == request_id:
+                self._drain_slot(i)
+                s = self.slots[i]
+                if s is None or s.request_id != request_id:
+                    return False   # finished in the drained entries
                 self._abort(s, "cancelled", slot_id=i)
                 return True
         return False
@@ -1598,6 +1838,13 @@ class PagedEngine:
             n_entries = len(self.prefix_cache)
         except RuntimeError:             # resized mid-iteration: retry-free
             digests, n_entries = [], -1
+        try:
+            # same cross-thread torn-read contract as the digests: the
+            # tick thread mutates _delta_rows; a mid-iteration resize
+            # costs this field, never the whole snapshot
+            pending = sorted(self._delta_rows)
+        except RuntimeError:
+            pending = []
         return {
             "slots": slots,
             "block_pool": {
@@ -1617,7 +1864,19 @@ class PagedEngine:
                      "outstanding": self._pending is not None,
                      "drains": self.ring_drains,
                      "blocking_drains": self.ring_blocking_drains,
+                     "scoped_drains": self.ring_scoped_drains,
                      "d2h_syncs": self.d2h_syncs},
+            # slot-transition cost accounting (ISSUE 14): how churn is
+            # being paid for — one-row patches vs full-state rebuilds,
+            # and the H2D bytes either way
+            "transitions": {
+                "delta_enabled": self._delta,
+                "full_rebuilds": self.full_rebuilds,
+                "delta_patches": self.delta_patches,
+                "pending_patch_rows": pending,
+                "h2d_uploads": self.h2d_uploads,
+                "h2d_upload_bytes": self.h2d_upload_bytes,
+            },
         }
 
     # ------------------------------------------------- fleet fault tolerance
@@ -1713,6 +1972,7 @@ class PagedEngine:
         self._dev = None
         self._dev_dirty = True
         self._dev_keys_dirty = False
+        self._delta_rows = set()
         self._pending = None
         self._drained[:] = 0
         obs.record_event("paged_hard_reset",
@@ -1823,38 +2083,113 @@ class PagedEngine:
                 acc = int(macc[p["rows"]].sum())
                 if acc:
                     self._count("spec_accepted", acc)
-        Lr = self._ring_len
         lag = self.dispatch_count - p["seq"] + 1   # dispatches until drain
-        sink = self.trace_sink
         for i in p["rows"]:
-            slot = self.slots[i]
-            base = int(self._drained[i])
-            n_new = int(wcur[i]) - base
-            self._drained[i] = int(wcur[i])
-            if slot is None:        # released out-of-band since dispatch
-                continue
-            if spec:
-                self._h_tpf.observe(n_new)
-                if kprop[i]:
-                    # host mirror of the device EMA (same update; the
-                    # authority switch happens at the next refresh)
-                    slot.spec_ema = (
-                        (1.0 - _SPEC_EMA_ALPHA) * slot.spec_ema
-                        + _SPEC_EMA_ALPHA
-                        * (float(macc[i]) / float(kprop[i])))
-            appended, finished = self._consume_row(
-                i, ((ring[i, (base + j) % Lr],
-                     rlps[i, (base + j) % Lr], False)
-                    for j in range(n_new)))
-            if sink is not None:
-                ev = dict(n=appended, ring_lag=lag)
-                if spec:
-                    ev.update(proposed=int(kprop[i]),
-                              accepted=int(macc[i]))
-                sink(slot.request_id, "tick", **ev)
-            if finished or not bool(act_now[i]):
-                # host stop, or the device finish flag (eos/budget)
-                self._finish(i)
+            self._commit_row_drain(
+                i, ring[i], rlps[i], wcur[i], act_now[i],
+                int(kprop[i]) if spec else 0,
+                int(macc[i]) if spec else 0, lag)
+
+    def _commit_row_drain(self, i, ring_i, rlps_i, wc, act_i,
+                          kp, ma, lag) -> bool:
+        """Per-row host bookkeeping shared by the global drain's loop
+        and the scoped drain (ISSUE 14) — one implementation so the
+        two paths cannot drift: advance the drained cursor, mirror the
+        device spec EMA, append/stop-match via ``_consume_row``, emit
+        the trace tick event, honor the device finish flag.
+        ``ring_i``/``rlps_i`` are this row's ring slices; ``kp``/``ma``
+        its spec counters (0 when spec is off). Returns False for rows
+        released out-of-band since dispatch (cursor still advanced)."""
+        slot = self.slots[i]
+        base = int(self._drained[i])
+        n_new = int(wc) - base
+        self._drained[i] = int(wc)
+        if slot is None:        # released out-of-band since dispatch
+            return False
+        if self._spec_k:
+            self._h_tpf.observe(n_new)
+            if kp:
+                # host mirror of the device EMA (same update; the
+                # authority switch happens at the next refresh)
+                slot.spec_ema = ((1.0 - _SPEC_EMA_ALPHA) * slot.spec_ema
+                                 + _SPEC_EMA_ALPHA
+                                 * (float(ma) / float(kp)))
+        Lr = self._ring_len
+        appended, finished = self._consume_row(
+            i, ((ring_i[(base + j) % Lr], rlps_i[(base + j) % Lr],
+                 False) for j in range(n_new)))
+        if self.trace_sink is not None:
+            ev = dict(n=appended, ring_lag=lag)
+            if self._spec_k:
+                ev.update(proposed=int(kp), accepted=int(ma))
+            self.trace_sink(slot.request_id, "tick", **ev)
+        if finished or not bool(act_i):
+            # host stop, or the device finish flag (eos/budget)
+            self._finish(i)
+        return True
+
+    def _drain_row(self, i: int):
+        """SCOPED ring drain (ISSUE 14): consume ONLY slot ``i``'s
+        pending entries from the outstanding dispatch. An out-of-band
+        transition (cancel, deadline expiry) synchronizes with the
+        in-flight program through this row's output slices alone — the
+        ``device_get`` still waits for the whole program, so releasing
+        the row's blocks afterwards can never race an in-flight write
+        — while the SIBLING rows' entries stay pending for the next
+        ``step()``'s normal drain: their mirrors are untouched, their
+        tokens survive. No-op when nothing is outstanding or the row
+        was not part of the dispatch."""
+        p = self._pending
+        if p is None or i not in p["rows"]:
+            return
+        st = self._dev
+        base_arrs = [st["ring"], st["rlps"], st["wcur"], st["active"]]
+        spec = self._spec_k > 0
+        if spec:
+            base_arrs += [st["kprop_last"], st["macc_last"]]
+        # a scoped drain IS a ring drain: counting it in both keeps
+        # the blocking/all ratio a profiler reads <= 1
+        self.ring_drains += 1
+        self.ring_scoped_drains += 1
+        try:
+            # probe the DISPATCH OUTPUTS, not the row slices built
+            # below — the slices are freshly enqueued computations
+            # whose is_ready() would read False even when the
+            # in-flight program finished long ago, inflating the
+            # blocking-drain counters a profiler reads as "host
+            # falling behind"
+            if not all(a.is_ready() for a in base_arrs):
+                self.ring_blocking_drains += 1
+                self.d2h_syncs += 1
+        except AttributeError:      # backend without is_ready probes
+            pass
+        t0 = time.perf_counter()
+        vals = jax.device_get([a[i] for a in base_arrs])
+        # same histogram window as the global drain: in ring mode the
+        # drain wait is the program-bound time, scoped drains included
+        self._h_decode.observe((time.perf_counter() - t0) * 1e3)
+        ring_i, rlps_i, wc, act_i = vals[:4]
+        p["rows"].remove(i)
+        if not p["rows"]:
+            self._pending = None
+        kp = ma = 0
+        if spec:
+            kp, ma = int(vals[4]), int(vals[5])
+        if self._commit_row_drain(
+                i, ring_i, rlps_i, wc, act_i, kp, ma,
+                self.dispatch_count - p["seq"] + 1) and kp:
+            self._count("spec_proposed", kp)
+            if ma:
+                self._count("spec_accepted", ma)
+
+    def _drain_slot(self, i: int):
+        """Drain before mutating slot ``i``'s mirrors out-of-band:
+        scoped to the row in delta mode, the full global drain in
+        rebuild mode (whose transition semantics it preserves)."""
+        if self._delta:
+            self._drain_row(i)
+        else:
+            self._drain_pending()
 
     def _consume_row(self, i, entries):
         """Shared per-row commit bookkeeping for every readback flavor
@@ -1884,8 +2219,12 @@ class PagedEngine:
 
     def _up(self, x):
         """Host-mirror upload on the per-tick host path (counted so the
-        fused path's zero-upload steady state is testable)."""
+        fused path's zero-upload steady state is testable; bytes too —
+        the ISSUE 14 cost accounting covers every upload flavor)."""
         self.h2d_uploads += 1
+        self.h2d_upload_bytes += x.nbytes
+        self._count("h2d_upload_bytes", x.nbytes)
+        self._h_bytes.observe(x.nbytes)
         return jnp.asarray(x)
 
     def _decode_host(self, active):
@@ -1953,8 +2292,7 @@ class PagedEngine:
         readback, and the decode-step histogram then records the whole
         dispatch wall (divide by ticks_per_dispatch for per-token)."""
         K = self._ticks_per_dispatch if scan else 1
-        if self._dev is None or self._dev_dirty:
-            self._refresh_dev()
+        self._sync_dev()
         t_decode = time.perf_counter()
         self.dispatch_count += 1
         greedy = np.all(self.temps[active] <= 0.0)
@@ -2032,8 +2370,7 @@ class PagedEngine:
         slot's release), and honoring the device done flag. Mirrors
         re-upload only on slot transitions, exactly like the plain
         fused tick."""
-        if self._dev is None or self._dev_dirty:
-            self._refresh_dev()
+        self._sync_dev()
         t_decode = time.perf_counter()
         self.dispatch_count += 1
         greedy = np.all(self.temps[active] <= 0.0)

@@ -33,7 +33,22 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["propose_ngram", "propose_ngram_rows", "accept_length",
-           "mask_drafts"]
+           "mask_drafts", "token_buffer_row"]
+
+
+def token_buffer_row(seq, length: int, fill: int = 0):
+    """ONE slot's committed-stream buffer row [length] (prompt +
+    emitted tokens, ``fill``-padded) — the row-scoped init shared by
+    the PagedEngine's full-state rebuild (which stacks R of these) and
+    the ISSUE-14 delta patch descriptor (which uploads exactly one),
+    so a patched row's proposer input is byte-identical to what a
+    rebuild would have produced for it. Host-side numpy on purpose:
+    this is mirror packing, not traced compute."""
+    import numpy as np
+    row = np.full((length,), fill, np.int32)
+    n = min(len(seq), length)
+    row[:n] = np.asarray(seq[:n], np.int64)
+    return row
 
 
 def propose_ngram(seq, n, num_draft: int, ngram: int, fill):

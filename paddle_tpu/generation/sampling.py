@@ -117,6 +117,17 @@ def sample_token_rows(logits, keys, temperature, top_k, top_p):
     return tokens, logprobs, carry
 
 
+def seed_key_row(seed: int):
+    """The [2] uint32 raw key data for ONE row's PRNG stream, seeded by
+    ``seed`` — the row-scoped key init shared by ``PagedEngine.submit``
+    and the delta-transition descriptor packing (ISSUE 14): an admitted
+    row's device key is byte-identical whether it rides a full mirror
+    rebuild or a one-row patch, because both start from this value."""
+    import numpy as np
+    return np.asarray(jax.random.key_data(jax.random.PRNGKey(seed)),
+                      np.uint32)
+
+
 def split_key_rows(keys):
     """Advance [R, 2] uint32 per-row PRNG states one split: returns
     (carry [R, 2], sub [R, 2]) raw key data. The carry chain is the

@@ -1099,6 +1099,17 @@ class Gateway:
                 rep["engine"] = w.engine.debug_snapshot()
             except Exception as e:       # torn mid-tick read: partial
                 rep["engine"] = {"error": repr(e)}
+            # slot-transition cost counters (ISSUE 14), surfaced at the
+            # replica top level so a fleet poller need not dig into the
+            # engine snapshot — the snapshot's own block when it read
+            # cleanly, rebuilt from the engine counters when it tore
+            tr = rep["engine"].get("transitions") \
+                if isinstance(rep["engine"], dict) else None
+            rep["transitions"] = tr if tr is not None else {
+                "delta_enabled": getattr(w.engine, "_delta", None),
+                **{k: getattr(w.engine, k, None)
+                   for k in ("full_rebuilds", "delta_patches",
+                             "h2d_uploads", "h2d_upload_bytes")}}
             try:
                 rep["scheduler"] = w.sched.debug_snapshot()
             except Exception as e:

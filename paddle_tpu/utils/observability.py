@@ -80,8 +80,12 @@ DEFAULT_MS_BUCKETS = (0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500,
 SERVING_MS_BUCKETS = (0.1, 0.2, 0.5, 1, 2, 5, 10, 20, 50, 100,
                       200, 500, 1000, 2000, 5000, 10000, 20000,
                       50000, 100000)
-# byte-sized things (checkpoint step dirs)
-BYTES_BUCKETS = (1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10, 1e11)
+# byte-sized things: checkpoint step dirs at the top, per-upload H2D
+# transfers at the bottom (ISSUE 14 — a one-row delta patch descriptor
+# is ~0.1-2 KB, a full paged-engine mirror rebuild 10-500 KB; the
+# sub-10KB rungs make the two distinguishable in one histogram)
+BYTES_BUCKETS = (64, 256, 1024, 4096, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9,
+                 1e10, 1e11)
 
 
 def run_id() -> str:

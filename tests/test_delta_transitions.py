@@ -1,0 +1,410 @@
+"""ISSUE 14: persistent decode program — in-program slot transitions
+with delta mirror patches.
+
+Contracts, each pinned against the full-rebuild reference
+(``delta_transitions=False``, the pre-ISSUE-14 path kept verbatim):
+
+- STREAM PARITY: greedy and seeded-sampled token/logprob streams are
+  BITWISE identical between delta mode and the rebuild reference
+  across every transition kind — admit, finish, chunked-prefill
+  advance, preempt, cancel, block growth — with the ring on and off.
+- SCOPED DRAIN: an out-of-band transition (cancel/expiry) consumes
+  only the affected slot's pending ring entries; untouched siblings'
+  pending tokens survive and land at the next step()'s normal drain.
+- UPLOAD ACCOUNTING: steady churn runs 0 full-state rebuilds in delta
+  mode (one-row patches carry every transition) and the byte counter
+  — the ISSUE 14 small-fix satellite — shows the patch path moving
+  far fewer H2D bytes than the rebuild path for the same workload.
+- FAILOVER: ``export_resumable()`` descriptors, read off host mirrors
+  that now advance via scoped drains, stay equal across modes, and a
+  resume from them continues the stream bitwise.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.generation.paged import PagedEngine
+from paddle_tpu.generation.stub import TickStubModel
+
+
+def _cyc(n, start=0):
+    return (np.arange(n) % 5 + 1 + start)[None]
+
+
+def _engine(**kw):
+    base = dict(max_slots=4, num_blocks=32, block_size=8,
+                max_blocks_per_seq=8, prefill_buckets=(16,))
+    base.update(kw)
+    return PagedEngine(TickStubModel(), **base)
+
+
+def _drain(eng, submits):
+    for rid, ids, skw in submits:
+        eng.submit(rid, ids, **skw)
+    res = eng.run()
+    return res, dict(eng.logprobs)
+
+
+# mixed greedy/sampled workload exercising admit, finish, eos, stops
+# and block growth (prompts + budgets cross the 8-token block grid)
+MIXED_SUBS = [
+    ("g", _cyc(6), dict(max_new_tokens=20)),
+    ("s", _cyc(8, 2), dict(max_new_tokens=14, temperature=0.8,
+                           top_k=20, seed=5)),
+    ("st", _cyc(9, 1), dict(max_new_tokens=24, stop_sequences=[[3, 4]])),
+    ("e", _cyc(5, 3), dict(max_new_tokens=16, eos_token_id=2)),
+]
+
+
+class TestDeltaParity:
+    @pytest.mark.parametrize("ring", [True, False])
+    def test_transition_matrix_bitwise(self, ring):
+        """Admit/finish/growth/stop/eos churn + a mid-run second wave
+        (admits into slots whose previous tenants finished): delta and
+        rebuild modes agree on every token and every logprob float."""
+        def run(delta):
+            eng = _engine(ring_mode=ring, delta_transitions=delta)
+            res, lps = _drain(eng, MIXED_SUBS)
+            # second wave: readmits into released rows (the ring
+            # cursors continue where the previous tenant stopped)
+            res2, lps2 = _drain(eng, [
+                ("w1", _cyc(4, 1), dict(max_new_tokens=9)),
+                ("w2", _cyc(7, 2), dict(max_new_tokens=11,
+                                        temperature=0.6, seed=9)),
+            ])
+            res.update(res2)
+            lps.update(lps2)
+            return eng, res, lps
+
+        er, rr, lr = run(delta=False)
+        ed, rd, ld = run(delta=True)
+        assert rr == rd
+        assert lr == ld
+        assert er.full_rebuilds > 1          # reference churned rebuilds
+        assert ed.full_rebuilds == 1         # delta paid the first only
+        assert ed.delta_patches > 0
+
+    @pytest.mark.parametrize("ring", [True, False])
+    def test_midstream_admit_interleave_exact(self, ring):
+        """A submit() landing mid-decode rides a one-row patch; the
+        per-request emission interleave matches the rebuild reference
+        exactly (same ring mode on both sides)."""
+        def run(delta):
+            eng = _engine(ring_mode=ring, delta_transitions=delta)
+            eng.submit("r0", _cyc(6), max_new_tokens=18)
+            out = []
+            for n, pair in enumerate(eng.stream()):
+                out.append(pair)
+                if n == 4:
+                    eng.submit("r1", _cyc(10, 3), max_new_tokens=12,
+                               temperature=0.8, seed=3)
+            return out, dict(eng.results), dict(eng.logprobs)
+
+        sr, rr, lr = run(delta=False)
+        sd, rd, ld = run(delta=True)
+        assert sr == sd          # emission order too, not just results
+        assert rr == rd and lr == ld
+
+    def test_chunked_prefill_and_prefix_cache_parity(self):
+        """Chunk advances are lens-only patches until the final chunk
+        activates the row; prefix-cache adoption (a table-row patch
+        pointing at shared physical blocks) stays bitwise too."""
+        sys_p = list(range(1, 17))
+
+        def run(delta):
+            eng = _engine(max_slots=2, chunk_prefill_tokens=8,
+                          enable_prefix_cache=True,
+                          prefill_buckets=(8,),
+                          delta_transitions=delta)
+            r1, l1 = _drain(eng, [
+                ("x", np.asarray(sys_p + [20, 21])[None],
+                 dict(max_new_tokens=10)),
+            ])
+            # second request adopts x's registered prefix blocks
+            r2, l2 = _drain(eng, [
+                ("y", np.asarray(sys_p + [30])[None],
+                 dict(max_new_tokens=8, temperature=0.5, seed=7)),
+            ])
+            r1.update(r2)
+            l1.update(l2)
+            return eng, r1, l1
+
+        er, rr, lr = run(False)
+        ed, rd, ld = run(True)
+        assert rr == rd and lr == ld
+        assert ed.stats["prefix_hit_tokens"] == \
+            er.stats["prefix_hit_tokens"] > 0
+        assert ed.full_rebuilds == 1
+
+    def test_preemption_parity(self):
+        """Block-pool pressure forces recompute-mode preemption (a
+        release patch + a requeue) mid-run; streams and preemption
+        counts match the rebuild reference, sampled victim included."""
+        kw = dict(max_slots=2, num_blocks=6, block_size=8,
+                  max_blocks_per_seq=4, prefill_buckets=(16,))
+        subs = [("p", _cyc(8), dict(max_new_tokens=14)),
+                ("q", _cyc(11, 2), dict(max_new_tokens=14,
+                                        temperature=0.9, seed=5))]
+        er, rr, lr = (lambda e: (e, *_drain(e, subs)))(
+            _engine(delta_transitions=False, **kw))
+        ed, rd, ld = (lambda e: (e, *_drain(e, subs)))(
+            _engine(**kw))
+        assert rr == rd and lr == ld
+        assert er.stats["preemptions"] == ed.stats["preemptions"] > 0
+
+    def test_cancel_race_parity(self):
+        """cancel() between steps (in-flight dispatch in ring mode):
+        the survivor's stream matches the rebuild-mode run token for
+        token, and the cancel lands identically."""
+        def run(delta):
+            eng = _engine(delta_transitions=delta)
+            eng.submit("keep", _cyc(6), max_new_tokens=20)
+            eng.submit("kill", _cyc(9, 3), max_new_tokens=20)
+            for _ in range(4):
+                eng.step()
+            assert eng.cancel("kill")
+            res = eng.run()
+            return eng, res, dict(eng.logprobs)
+
+        er, rr, lr = run(False)
+        ed, rd, ld = run(True)
+        assert rr == rd and lr == ld
+        assert er.cancelled == ed.cancelled == {"kill": "cancelled"}
+        assert len(ed.free_blocks) == ed.P - 1
+
+    def test_spec_greedy_parity(self):
+        """Speculative ticks: the descriptor carries the committed-
+        token row, accept EMA and probe counter, so greedy spec
+        streams (draft-invariant by the argmax-prefix rule) stay
+        bitwise across modes through admit/finish churn."""
+        def run(delta):
+            eng = _engine(prefill_buckets=(8,), spec_tokens=3,
+                          delta_transitions=delta)
+            res, lps = _drain(eng, [
+                ("g", _cyc(6), dict(max_new_tokens=15)),
+                ("h", _cyc(8, 2), dict(max_new_tokens=10)),
+            ])
+            res2, lps2 = _drain(eng, [
+                ("i", _cyc(5, 1), dict(max_new_tokens=12))])
+            res.update(res2)
+            lps.update(lps2)
+            return eng, res, lps
+
+        er, rr, lr = run(False)
+        ed, rd, ld = run(True)
+        assert rr == rd and lr == ld
+        assert ed.full_rebuilds == 1 and ed.delta_patches > 0
+
+    def test_delta_requires_fused_tick(self):
+        with pytest.raises(ValueError):
+            _engine(fused_tick=False, delta_transitions=True)
+
+
+class TestScopedDrain:
+    def test_sibling_pending_tokens_survive(self):
+        """A cancel's scoped drain consumes ONLY the cancelled row's
+        pending entries; the sibling's in-flight tokens stay pending
+        and land at the next step() — none lost, none duplicated."""
+        eng = _engine()
+        eng.submit("keep", _cyc(6), max_new_tokens=20)
+        eng.submit("kill", _cyc(9, 3), max_new_tokens=20)
+        for _ in range(4):
+            eng.step()
+        assert eng._pending is not None
+        keep_slot = next(s for s in eng.slots
+                         if s is not None and s.request_id == "keep")
+        n_keep = len(keep_slot.tokens)
+        assert eng.cancel("kill")
+        # the survivor's entries were NOT consumed by the cancel
+        assert eng._pending is not None
+        assert len(keep_slot.tokens) == n_keep
+        assert eng.ring_scoped_drains == 1
+        res = eng.run()
+        ref = _engine(ring_mode=False, delta_transitions=False)
+        ref.submit("keep", _cyc(6), max_new_tokens=20)
+        assert res["keep"] == ref.run()["keep"]
+
+    def test_scoped_drain_on_spec_engine(self):
+        """The scoped drain's spec branch (per-row kprop/macc counters
+        + EMA mirror) composes with a cancel racing an in-flight
+        speculative dispatch; the survivor stays bitwise."""
+        kw = dict(prefill_buckets=(8,), spec_tokens=3)
+        eng = _engine(**kw)
+        eng.submit("keep", _cyc(6), max_new_tokens=20)
+        eng.submit("kill", _cyc(9, 3), max_new_tokens=20)
+        for _ in range(4):
+            eng.step()
+        assert eng._pending is not None
+        assert eng.cancel("kill")
+        assert eng.ring_scoped_drains == 1
+        res = eng.run()
+        ref = _engine(ring_mode=False, delta_transitions=False, **kw)
+        ref.submit("keep", _cyc(6), max_new_tokens=20)
+        assert res["keep"] == ref.run()["keep"]
+
+    def test_expire_scopes_to_deadline_slot(self):
+        """A running-request deadline expiry on the SUBMIT path (the
+        bounded-queue reap, which used to force a global drain) drains
+        only the expiring slot: the sibling's pending tokens stay
+        pending and its stream is unaffected (bitwise vs a run without
+        the expiring tenant, by batch-composition independence)."""
+        eng = _engine(max_queue=8)
+        eng.submit("keep", _cyc(6), max_new_tokens=16)
+        eng.submit("doomed", _cyc(7, 2), max_new_tokens=50)
+        for _ in range(4):
+            eng.step()
+        assert eng._pending is not None
+        doomed = next(s for s in eng.slots
+                      if s is not None and s.request_id == "doomed")
+        doomed.deadline = 0.0      # already past on the monotonic clock
+        sc0 = eng.ring_scoped_drains
+        # the bounded-queue submit runs _expire against the in-flight
+        # dispatch — scoped to the doomed row, sibling left pending
+        eng.submit("late", _cyc(4), max_new_tokens=4)
+        assert eng.cancelled.get("doomed") == "timeout"
+        assert eng.ring_scoped_drains == sc0 + 1
+        assert eng._pending is not None
+        res = eng.run()
+        assert eng.cancelled.get("doomed") == "timeout"
+        ref = _engine(ring_mode=False, delta_transitions=False)
+        ref.submit("keep", _cyc(6), max_new_tokens=16)
+        assert res["keep"] == ref.run()["keep"]
+
+
+class TestUploadAccounting:
+    def test_zero_rebuilds_steady_churn(self):
+        """THE ISSUE 14 acceptance counter: a churny stream (short
+        requests, a finish + admit every few ticks) runs ZERO
+        full-state rebuilds after the first dispatch in delta mode —
+        every transition rides a one-row patch — while the rebuild
+        reference pays one full rebuild per churn tick."""
+        def churn(delta):
+            eng = _engine(delta_transitions=delta)
+            eng.submit("w", _cyc(4), max_new_tokens=2)
+            eng.run()                       # compile + first rebuild
+            fr0, dp0 = eng.full_rebuilds, eng.delta_patches
+            b0 = eng.h2d_upload_bytes
+            for i in range(12):
+                eng.submit(i, _cyc(4 + i % 3), max_new_tokens=4)
+            eng.run()
+            return (eng, eng.full_rebuilds - fr0,
+                    eng.delta_patches - dp0, eng.h2d_upload_bytes - b0)
+
+        _, fr_d, dp_d, bytes_d = churn(True)
+        _, fr_r, dp_r, bytes_r = churn(False)
+        assert fr_d == 0 and dp_d > 0       # steady churn: patches only
+        assert fr_r >= 6 and dp_r == 0      # reference: rebuild storm
+        # the small-fix satellite: bytes weigh what events hide
+        assert 0 < bytes_d < bytes_r
+
+    def test_steady_ticks_no_patches_no_bytes(self):
+        """Between transitions nothing is uploaded at all: the
+        1-dispatch/0-upload steady pins extend to the byte counter and
+        the patch counter."""
+        eng = _engine(block_size=64, max_blocks_per_seq=2)
+        for i in range(4):
+            eng.submit(f"r{i}", _cyc(6), max_new_tokens=100)
+        for _ in range(6):
+            eng.step()
+        d0, u0 = eng.dispatch_count, eng.h2d_uploads
+        b0, p0 = eng.h2d_upload_bytes, eng.delta_patches
+        for _ in range(20):
+            eng.step()
+        assert eng.dispatch_count - d0 == 20
+        assert eng.h2d_uploads - u0 == 0
+        assert eng.h2d_upload_bytes - b0 == 0
+        assert eng.delta_patches - p0 == 0
+
+    def test_counters_flow_to_stats_health_and_snapshot(self):
+        """full_rebuilds / delta_patches / h2d_upload_bytes reach the
+        registry-backed stats (and so health() and a /metrics scrape)
+        and the debug_snapshot transitions block, equal to the plain
+        attributes the tests and tools read."""
+        eng = _engine()
+        eng.submit("a", _cyc(5), max_new_tokens=6)
+        eng.run()
+        st = eng.stats
+        assert st["full_rebuilds"] == eng.full_rebuilds == 1
+        assert st["delta_patches"] == eng.delta_patches
+        assert st["h2d_upload_bytes"] == eng.h2d_upload_bytes > 0
+        snap = eng.debug_snapshot()["transitions"]
+        assert snap["delta_enabled"] is True
+        assert snap["full_rebuilds"] == eng.full_rebuilds
+        assert snap["delta_patches"] == eng.delta_patches
+        assert snap["h2d_upload_bytes"] == eng.h2d_upload_bytes
+        # the final finish's release patch coalesces until the next
+        # dispatch would flush it — visible here as the pending row
+        assert snap["pending_patch_rows"] == [0]
+        h = eng.health()
+        assert h["full_rebuilds"] == eng.full_rebuilds
+
+
+class TestFailoverParity:
+    def test_export_resumable_parity_and_bitwise_resume(self):
+        """Mirrors advanced by (scoped) drains export the same resume
+        descriptors as the rebuild reference, and a resume from them
+        continues the stream bitwise (the ISSUE 12/13 failover gate
+        with delta mode default-on)."""
+        def partial(delta):
+            eng = _engine(max_slots=2, delta_transitions=delta)
+            eng.submit("r1", _cyc(6), max_new_tokens=30)
+            eng.submit("r2", _cyc(7, 1), max_new_tokens=30,
+                       temperature=0.7, seed=2)
+            for _ in range(9):
+                eng.step()
+            return eng.export_resumable()
+
+        exp_d = partial(True)
+        assert exp_d == partial(False)
+        # greedy resume on a fresh delta engine == uninterrupted run
+        d = exp_d["r1"]
+        fresh = _engine(max_slots=2)
+        fresh.submit("r1", np.asarray(d["prompt"])[None],
+                     max_new_tokens=d["remaining"],
+                     resume_tokens=d["committed"],
+                     resume_lps=d["committed_lps"])
+        resumed = fresh.run()["r1"]
+        ref = _engine(max_slots=2)
+        ref.submit("r1", _cyc(6), max_new_tokens=30)
+        assert resumed == ref.run()["r1"]
+
+
+@pytest.mark.slow
+class TestDeltaSweep:
+    @pytest.mark.parametrize("ring", [True, False])
+    @pytest.mark.parametrize("chunk", [None, 8])
+    @pytest.mark.parametrize("spec", [0, 3])
+    def test_parity_sweep(self, ring, chunk, spec):
+        """Heavy matrix: ring x chunked-prefill x speculative, longer
+        budgets, staggered second wave — delta vs rebuild bitwise.
+        (Tier-1 keeps the single-combination pins above.)"""
+        if spec and chunk:
+            kw = dict(chunk_prefill_tokens=chunk, spec_tokens=spec,
+                      prefill_buckets=(8,))
+        elif chunk:
+            kw = dict(chunk_prefill_tokens=chunk, prefill_buckets=(8,))
+        elif spec:
+            kw = dict(spec_tokens=spec, prefill_buckets=(8,))
+        else:
+            kw = {}
+        # sampled rows join only the non-spec combos: sampled + spec
+        # across modes is distribution-preserving, not bitwise (the
+        # drafts read the uncommitted buffer tail, which rebuilds zero
+        # and patches preserve — documented in PERFORMANCE.md)
+        subs = [(f"r{j}", _cyc(5 + j % 4, j), dict(
+            max_new_tokens=10 + 3 * (j % 3),
+            **({} if (j % 2 == 0 or spec) else
+               dict(temperature=0.7, seed=j, top_k=12))))
+            for j in range(6)]
+
+        def run(delta):
+            eng = _engine(ring_mode=ring, delta_transitions=delta, **kw)
+            res, lps = _drain(eng, subs[:4])
+            res2, lps2 = _drain(eng, subs[4:])
+            res.update(res2)
+            lps.update(lps2)
+            return res, lps
+
+        rr, lr = run(False)
+        rd, ld = run(True)
+        assert rr == rd
+        assert lr == ld

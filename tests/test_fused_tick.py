@@ -217,7 +217,10 @@ class TestDispatchContract:
     def test_one_dispatch_zero_uploads_per_steady_tick(self):
         """THE ISSUE 6 acceptance counter: N steady-state fused ticks =
         exactly N compiled dispatches and ZERO host->device mirror
-        uploads (the host path re-uploads every mirror every tick)."""
+        uploads (the host path re-uploads every mirror every tick).
+        ISSUE 14 extends the pin to BYTES: upload events of wildly
+        different sizes (a one-row patch vs a full rebuild) were
+        indistinguishable in the event counter alone."""
         eng = _stub_engine()
         for i in range(8):
             eng.submit(f"r{i}", np.arange(1, 9)[None],
@@ -225,11 +228,13 @@ class TestDispatchContract:
         for _ in range(6):       # admit + prefill + first refresh
             eng.step()
         d0, u0 = eng.dispatch_count, eng.h2d_uploads
+        b0 = eng.h2d_upload_bytes
         n = 25
         for _ in range(n):
             eng.step()
         assert eng.dispatch_count - d0 == n
         assert eng.h2d_uploads - u0 == 0
+        assert eng.h2d_upload_bytes - b0 == 0
 
         host = _stub_engine(fused_tick=False)
         for i in range(8):
@@ -237,9 +242,12 @@ class TestDispatchContract:
                         max_new_tokens=120)
         for _ in range(6):
             host.step()
-        u0 = host.h2d_uploads
+        u0, b0 = host.h2d_uploads, host.h2d_upload_bytes
         host.step()
         assert host.h2d_uploads - u0 >= 5   # tables/lens/last/reps/act
+        # and the bytes satellite: every per-tick re-upload is weighed
+        assert host.h2d_upload_bytes - b0 >= \
+            host.block_tables.nbytes + host.seq_lens.nbytes
 
     def test_scan_amortizes_dispatches(self):
         """K=8: one dispatch advances all slots 8 tokens."""

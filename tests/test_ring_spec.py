@@ -120,8 +120,12 @@ class TestRingParity:
 
     def test_cancel_races_inflight_dispatch(self):
         """cancel() landing between steps — an undrained dispatch in
-        flight — must drain first, then release: no token loss on the
-        survivor, no stranded blocks, the cancelled request recorded."""
+        flight — must drain the cancelled SLOT first, then release: no
+        token loss on the survivor, no stranded blocks, the cancelled
+        request recorded. Since ISSUE 14 the drain is SCOPED to the
+        cancelled row (delta mode, the default): the survivor's
+        pending entries stay pending for the next step()'s normal
+        drain instead of being forced out by a sibling's cancel."""
         eng = _engine()
         eng.submit("keep", _cyc(6), max_new_tokens=20)
         eng.submit("kill", _cyc(9, start=3), max_new_tokens=20)
@@ -129,7 +133,9 @@ class TestRingParity:
             eng.step()
         assert eng._pending is not None      # dispatch in flight
         assert eng.cancel("kill")
-        assert eng._pending is None          # guard drained it
+        # scoped: the survivor's entries are still outstanding
+        assert eng._pending is not None
+        assert eng.ring_scoped_drains == 1
         assert eng.cancelled["kill"] == "cancelled"
         res = eng.run()
         assert "kill" not in res
@@ -207,6 +213,12 @@ class TestReadbackAmortization:
         assert (ds, us) == (20, 0)
         assert ss == 20                      # one blocking D2H per tick
         ring, (dr, ur, sr) = steady()
+        if sr > 5:
+            # the is_ready probe is wall-clock sensitive: on a
+            # contended box the compute thread can lag the host loop
+            # and drains genuinely wait. One retry before judging —
+            # a real blocking-per-tick regression fails both runs.
+            ring, (dr, ur, sr) = steady()
         assert (dr, ur) == (20, 0)           # dispatch/upload pins hold
         assert sr <= 5                       # drains found data ready
         assert ring.ring_drains >= 20

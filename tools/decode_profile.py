@@ -29,6 +29,12 @@ REJECTION-SAMPLED speculative tick on a repetitive sampled stream
 ``paged_sampled_spec_tokens_per_sec`` rung bench.py auto-ingests
 beside the greedy spec rung).
 
+Round 8 (ISSUE 14): §7 churn A/B — short-request traffic with a slot
+transition every few ticks, ``delta_transitions`` on vs off (one-row
+patch programs vs full mirror rebuild+re-upload per transition), with
+uploads/tick, upload BYTES/tick and rebuild/patch counts per row and
+the ``paged_churn_tokens_per_sec`` rung bench.py auto-ingests.
+
 Usage: timeout 2100 python tools/decode_profile.py
 (budget covers ~20 cold generate compiles across base/fused/int8/int4
 plus the attention and paged sections; every subsection banks as it
@@ -465,6 +471,93 @@ def main():
         sspec["error"] = repr(e)[:300]
         report["sampled_spec"] = sspec
         bank()
+    # --- 7) churn A/B (ISSUE 14): slot transitions under serving-like
+    # traffic — short requests queued deep, so a finish + admit lands
+    # every few ticks. delta_transitions=False pays a FULL host-mirror
+    # rebuild + re-upload per churn tick (the pre-ISSUE-14 path);
+    # delta mode pays one descriptor-sized patch per transition and
+    # keeps dispatching. Rows report uploads/tick, upload BYTES/tick
+    # (the satellite counter), rebuild/patch counts and tokens/s; the
+    # delta row's throughput is the ``paged_churn_tokens_per_sec``
+    # rung bench.py auto-ingests beside the other paged rungs.
+    # The stub keeps this a TRANSITION-MACHINERY A/B (like §6b's
+    # decisive-table stub: the absolute number only means anything
+    # relative to the other row on the same stub — on real models the
+    # forward dominates and the transferable win is upload bytes +
+    # zero rebuild stalls). Budgets are STAGGERED (max_new=4+i%5) so a
+    # finish+admit lands every 1-2 ticks instead of 8 at once — the
+    # serving churn shape; synchronized batch finishes amortize a full
+    # rebuild over 8 transitions and favor the reference.
+    churn = {}
+    try:
+        from paddle_tpu.generation.stub import TickStubModel
+
+        def run_churn(n_req=96, **kw):
+            eng = PagedEngine(TickStubModel(), max_slots=8,
+                              num_blocks=64, block_size=32,
+                              max_blocks_per_seq=8,
+                              prefill_buckets=(32,), **kw)
+            rs6 = np.random.RandomState(7)
+            # two STAGGERED warm requests: the second's admit lands
+            # mid-decode of the first, so the transition path (the
+            # patch program in delta mode) compiles untimed like the
+            # tick/prefill executables — a cold first patch otherwise
+            # bills its trace+compile to the measured window
+            eng.submit("warm", rs6.randint(1, 120, (1, 8)),
+                       max_new_tokens=6)
+            eng.step()
+            eng.step()
+            eng.submit("warm2", rs6.randint(1, 120, (1, 8)),
+                       max_new_tokens=4)
+            eng.run()
+            for i in range(n_req):
+                eng.submit(i, rs6.randint(1, 120, (1, 8)),
+                           max_new_tokens=4 + i % 5)
+            st0 = eng.stats
+            u0, b0 = eng.h2d_uploads, eng.h2d_upload_bytes
+            fr0, dp0 = eng.full_rebuilds, eng.delta_patches
+            t0 = time.perf_counter()
+            res = eng.run()
+            dt = time.perf_counter() - t0
+            n_tok = sum(len(v) for key, v in res.items()
+                        if key not in ("warm", "warm2"))
+            ticks = max(eng.stats["decode_steps"]
+                        - st0["decode_steps"], 1)
+            return {
+                "tokens_per_sec": round(n_tok / dt, 1),
+                "decode_ticks": ticks,
+                "full_rebuilds": eng.full_rebuilds - fr0,
+                "delta_patches": eng.delta_patches - dp0,
+                "h2d_uploads_per_tick": round(
+                    (eng.h2d_uploads - u0) / ticks, 3),
+                "h2d_upload_bytes_per_tick": round(
+                    (eng.h2d_upload_bytes - b0) / ticks, 1),
+            }
+
+        # best-of-3 per mode: single-core wall clocks on a shared box
+        # are noisy and the A/B question is the achievable rate
+        def best(**kw):
+            rows = [run_churn(**kw) for _ in range(3)]
+            return max(rows, key=lambda r: r["tokens_per_sec"])
+
+        churn["full_rebuild"] = best(delta_transitions=False)
+        churn["delta"] = best()
+        churn["delta"]["speedup_vs_rebuild"] = round(
+            churn["delta"]["tokens_per_sec"]
+            / max(churn["full_rebuild"]["tokens_per_sec"], 1e-9), 2)
+        # the ISSUE 14 acceptance row: steady churn, zero full rebuilds
+        churn["delta_zero_rebuilds"] = \
+            churn["delta"]["full_rebuilds"] == 0
+        paged["paged_churn_tokens_per_sec"] = \
+            churn["delta"]["tokens_per_sec"]
+        report["churn"] = churn
+        report["paged"] = paged
+        bank()
+    except Exception as e:
+        churn["error"] = repr(e)[:300]
+        report["churn"] = churn
+        bank()
+
     # machine-ingestible line (bench.py merges DECODE_PROFILE_r06.json's
     # paged section into its decode rung when the file is present)
     print("PAGED_JSON " + json.dumps(paged), flush=True)

@@ -31,6 +31,13 @@ fresh reference engine, and the run fails (nonzero exit) on any
 corrupted stream, on 5xx counts beyond the retry-budget bound, or on
 a completed fraction below ``--goodput-floor`` (docs/SERVING.md).
 
+``--churn`` (ISSUE 14) swaps in a transition-heavy mix — short,
+staggered per-request budgets so replica slots finish and readmit
+every few ticks — and the rung records ``full_rebuilds`` /
+``delta_patches`` / ``h2d_upload_bytes`` from the engines;
+``--delta off`` keeps the full-rebuild transition path as the A/B
+reference (pair them to see what slot churn costs each way).
+
 Fleet mode (ISSUE 13): ``--url`` may repeat (client-side round-robin
 over several fleet front doors), ``--diurnal`` replaces the flat
 offered rate with a seeded sinusoid over the run (the autoscaler's
@@ -209,8 +216,11 @@ def _build_gateway(ns):
             return model
     # --ring off: the synchronous-readback reference engines (ISSUE 11
     # A/B — same workload, same gateway, only the tick readback
-    # architecture differs)
+    # architecture differs); --delta off likewise keeps the full-
+    # rebuild transition reference (ISSUE 14 A/B)
     engine_kw["ring_mode"] = getattr(ns, "ring", "on") == "on"
+    engine_kw["delta_transitions"] = \
+        getattr(ns, "delta", "on") == "on"
 
     chaos = bool(getattr(ns, "chaos", False))
 
@@ -312,6 +322,14 @@ async def run_loadgen(ns) -> dict:
     fleet = int(getattr(ns, "fleet", 0) or 0)
     urls = ns.url if isinstance(ns.url, list) \
         else ([ns.url] if ns.url else [])
+    if (urls or fleet) and getattr(ns, "delta", "on") == "off":
+        # --fleet replica processes and external --url servers run
+        # their own engine defaults (replica_main has no --delta);
+        # silently recording "delta": "off" would mislabel a delta-on
+        # run as the full-rebuild reference in the A/B rung
+        raise SystemExit("--delta off requires in-process replicas "
+                         "(no --fleet / --url): fleet peers and "
+                         "external servers don't receive it")
     if urls:
         if chaos or fleet:
             raise SystemExit("--chaos/--fleet require self-hosted "
@@ -398,7 +416,12 @@ async def run_loadgen(ns) -> dict:
              for _ in range(ns.sys_tokens + ns.tail_tokens)]
         slo = "interactive" if rng.random() < ns.interactive_frac \
             else "batch"
-        return {"prompt": prompt, "max_new_tokens": ns.max_new,
+        # --churn (ISSUE 14): transition-heavy traffic — short,
+        # STAGGERED budgets so a slot finishes (and an admit lands)
+        # every few ticks per replica. Deterministic in i so the
+        # chaos/fleet replay gates can rebuild the exact request.
+        mn = 2 + (i % 6) if getattr(ns, "churn", False) else ns.max_new
+        return {"prompt": prompt, "max_new_tokens": mn,
                 "temperature": 0.0, "slo": slo,
                 "tenant": f"t{i % ns.tenants}", "stream": True,
                 "timeout_s": ns.timeout_s}, shared
@@ -435,6 +458,7 @@ async def run_loadgen(ns) -> dict:
         rec["slo"] = payload["slo"]
         if chaos or fleet:
             rec["prompt"] = payload["prompt"]   # for the reference replay
+            rec["max_new"] = payload["max_new_tokens"]
         records.append(rec)
 
     def _fire_chaos(i):
@@ -511,6 +535,8 @@ async def run_loadgen(ns) -> dict:
         "replicas": ns.replicas,
         "model": ns.model if not urls else "external",
         "ring": getattr(ns, "ring", "on"),
+        "delta": getattr(ns, "delta", "on"),
+        "churn": bool(getattr(ns, "churn", False)),
         "targets": len(targets),
         "diurnal": bool(getattr(ns, "diurnal", False)),
     }
@@ -519,6 +545,12 @@ async def run_loadgen(ns) -> dict:
         rung["ring_blocking_drains"] = sum(e.ring_blocking_drains
                                            for e in engines)
     if engines is not None:
+        # ISSUE 14: how the run's slot churn was paid for — one-row
+        # patches vs full-state rebuilds, and the H2D bytes either way
+        rung["full_rebuilds"] = sum(e.full_rebuilds for e in engines)
+        rung["delta_patches"] = sum(e.delta_patches for e in engines)
+        rung["h2d_upload_bytes"] = sum(e.h2d_upload_bytes
+                                       for e in engines)
         rung["prefix_hit_tokens"] = sum(
             e.stats["prefix_hit_tokens"] for e in engines)
         router = gw.health()["router"]
@@ -618,7 +650,7 @@ def _verify_fleet(ns, fleet_health, records, kill_events):
     done = [r for r in records if r["finish_reason"] == "stop"]
     for r in done:
         ref.submit(r["request_id"], r["prompt"],
-                   max_new_tokens=ns.max_new)
+                   max_new_tokens=r.get("max_new", ns.max_new))
     expect = ref.run()
     corrupted = [r["request_id"] for r in done
                  if r["tokens"] != expect[r["request_id"]]]
@@ -658,7 +690,7 @@ def _verify_chaos(ns, gw, engine_factory, records, chaos_events):
     done = [r for r in records if r["finish_reason"] == "stop"]
     for r in done:
         ref.submit(r["request_id"], r["prompt"],
-                   max_new_tokens=ns.max_new)
+                   max_new_tokens=r.get("max_new", ns.max_new))
     expect = ref.run()
     corrupted = [r["request_id"] for r in done
                  if r["tokens"] != expect[r["request_id"]]]
@@ -716,6 +748,15 @@ def main(argv=None) -> int:
                     help="async token-ring decode on the replica "
                          "engines (off = synchronous per-tick "
                          "readback, the ISSUE 11 A/B reference)")
+    ap.add_argument("--delta", default="on", choices=("on", "off"),
+                    help="delta slot transitions on the replica "
+                         "engines (off = full mirror rebuild per "
+                         "transition, the ISSUE 14 A/B reference)")
+    ap.add_argument("--churn", action="store_true",
+                    help="transition-heavy workload mix (ISSUE 14): "
+                         "short staggered max-new budgets so slots "
+                         "finish + readmit every few ticks; the rung "
+                         "records full_rebuilds/delta_patches")
     ap.add_argument("--chaos", action="store_true",
                     help="seeded chaos harness (ISSUE 12): kill/hang "
                          "replicas mid-run, then assert zero "

@@ -216,6 +216,32 @@ def rejection_spec_tick():
     run(mixed=True)    # mixed greedy+sampled spec program
 check("rejection_spec_tick", rejection_spec_tick)
 
+def delta_patch_program():
+    # ISSUE 14: the delta-transition patch program — admit-row scatter
+    # plus table-row append into the device-resident tick state — must
+    # compile and stream correctly on hardware at the r05 serving
+    # block geometry (block_size 16 x 16 blocks/seq). Churny short
+    # requests (more requests than slots, budgets crossing the block
+    # grid) force admit/finish/growth patches; after the first
+    # dispatch's rebuild, every transition must ride a patch.
+    from paddle_tpu.generation.paged import PagedEngine
+    from paddle_tpu.generation.stub import TickStubModel
+    eng = PagedEngine(TickStubModel(), max_slots=4, num_blocks=64,
+                      block_size=16, max_blocks_per_seq=16,
+                      prefill_buckets=(16,))
+    assert eng._delta
+    eng.submit("w", np.arange(1, 6)[None], max_new_tokens=2)
+    eng.run()
+    fr0 = eng.full_rebuilds
+    for i in range(8):
+        # 9 + 24 = 33 tokens: crosses two block boundaries -> growth
+        eng.submit(i, np.arange(1, 10)[None], max_new_tokens=24)
+    res = eng.run()
+    assert all(len(v) == 24 for k, v in res.items() if k != "w"), res
+    assert eng.delta_patches > 0
+    assert eng.full_rebuilds == fr0, (eng.full_rebuilds, fr0)
+check("delta_patch_program", delta_patch_program)
+
 def prefill_flash():
     # the generate() prefill branch: flash at cache_index==0 must match
     # the masked-dense-over-cache path it replaced (llama.py)
