@@ -19,10 +19,12 @@ from .reqtrace import RequestTrace, RequestTraceRing
 from .router import EngineReplica, NoReplicaError, PrefixAffinityRouter
 from .scheduler import (SLO_BATCH, SLO_INTERACTIVE, ServeRequest,
                         ShedError, SLOScheduler)
+from .slo import BurnRateEngine, BurnRule
 from .supervisor import CircuitBreaker, ReplicaSupervisor
 
 __all__ = [
     "Gateway",
+    "BurnRateEngine", "BurnRule",
     "CircuitBreaker", "ReplicaSupervisor",
     "EngineReplica", "NoReplicaError", "PrefixAffinityRouter",
     "RequestTrace", "RequestTraceRing",

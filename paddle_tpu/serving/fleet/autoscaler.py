@@ -23,6 +23,13 @@ COUNT through a manager's ``scale_up()``/``scale_down()``:
   scales up the ramp and back down the far side instead of flapping
   at the crest. Spawns in flight count toward the target (a slow
   cold-start must not trigger a second spawn).
+- **Windowed signals** (ISSUE 15) — ``signal_mode="windowed"``
+  (default) compares thresholds against each pressure signal's MEAN
+  over the last ``signal_window_s`` seconds instead of the latest
+  probe sample: one noisy tick can neither open a hold window nor
+  reset a legitimately-running one, so a spiky trace produces
+  strictly fewer scale events (pinned by test) while steady traffic
+  decides identically to ``"instant"``, the A/B reference.
 
 Replica processes come and go under the existing SIGTERM-drain
 semantics: the manager's ``scale_down`` SIGTERMs a gateway process,
@@ -39,6 +46,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from ...utils import observability as obs
@@ -65,7 +73,25 @@ class FleetAutoscaler:
                  hold_s: float = 1.0, hold_down_s: float = 3.0,
                  cooldown_s: float = 5.0,
                  interval_s: float = 0.25,
+                 signal_mode: str = "windowed",
+                 signal_window_s: float = 2.0,
                  clock: Callable[[], float] = time.monotonic):
+        """``signal_mode`` (ISSUE 15): ``"windowed"`` (default) bases
+        every pressure comparison on the MEAN of each signal over the
+        last ``signal_window_s`` seconds of ``step()`` samples —
+        one noisy probe tick can no longer open (or reset) a hold
+        window, so a spiky trace scales strictly less than it did on
+        instantaneous gauges. ``"instant"`` keeps the single-sample
+        decision as the A/B reference (decision parity on steady
+        traffic is pinned by test: constant signals make the windowed
+        mean equal the instant value). Capacity facts (live/pending
+        replica counts, slot totals) always read instant — a scale
+        decision must see the fleet it is actually scaling."""
+        if signal_mode not in ("windowed", "instant"):
+            raise ValueError(f"unknown signal_mode {signal_mode!r}")
+        self.signal_mode = signal_mode
+        self.signal_window_s = float(signal_window_s)
+        self._sig_hist: deque = deque(maxlen=4096)
         self.manager = manager
         self.min_replicas = max(int(min_replicas), 1)
         self.max_replicas = max(int(max_replicas), self.min_replicas)
@@ -122,12 +148,39 @@ class FleetAutoscaler:
                                 default=1.0),
         }
 
+    # the pressure signals the windowed mode smooths; capacity facts
+    # (replicas/live/pending/free_slots/total_slots) stay instant
+    _WINDOWED_FIELDS = ("queue_depth", "queue_depth_per_replica",
+                        "free_slot_frac", "load_frac",
+                        "block_pool_free_frac", "goodput_frac")
+
+    def _effective(self, agg: Dict[str, Any],
+                   now: float) -> Dict[str, Any]:
+        """Fold this tick's aggregate into the signal history and
+        return the view the decision reads: the instant aggregate in
+        ``instant`` mode, the per-field window MEAN in ``windowed``
+        mode (ISSUE 15 — the same trajectory-not-point shift the
+        /metricsz plane makes, applied to the control loop)."""
+        self._sig_hist.append(
+            (now, {k: agg[k] for k in self._WINDOWED_FIELDS}))
+        lo = now - self.signal_window_s
+        while self._sig_hist and self._sig_hist[0][0] < lo:
+            self._sig_hist.popleft()
+        if self.signal_mode == "instant":
+            return agg
+        eff = dict(agg)
+        n = len(self._sig_hist)
+        for k in self._WINDOWED_FIELDS:
+            eff[k] = sum(s[1][k] for s in self._sig_hist) / n
+        return eff
+
     # ------------------------------------------------------------ decision
     def step(self, now: Optional[float] = None) -> Dict[str, Any]:
-        """One control decision. Returns the aggregate it saw plus the
-        action taken (``"up"``/``"down"``/``None``)."""
+        """One control decision. Returns the (mode-effective)
+        aggregate it saw plus the action taken
+        (``"up"``/``"down"``/``None``)."""
         now = self._clock() if now is None else now
-        agg = self.aggregate()
+        agg = self._effective(self.aggregate(), now)
         # replica-seconds accounting: the goodput-per-replica
         # denominator (chip cost proxy — a pending spawn is already
         # paying its cold start, count it)
@@ -184,6 +237,7 @@ class FleetAutoscaler:
             self._up_since = self._down_since = None
             ev = {"t": round(now, 3), "action": action,
                   "replicas_before": n_eff,
+                  "signal_mode": self.signal_mode,
                   "queue_depth_per_replica":
                       round(agg["queue_depth_per_replica"], 2),
                   "free_slot_frac": round(agg["free_slot_frac"], 3),
@@ -223,6 +277,8 @@ class FleetAutoscaler:
             "scale_downs": int(self._c_down.value),
             "replica_seconds": round(self.replica_seconds, 3),
             "cooldown_s": self.cooldown_s,
+            "signal_mode": self.signal_mode,
+            "signal_window_s": self.signal_window_s,
             "events": list(self.events[-32:]),
             "aggregate": self.aggregate(),
         }

@@ -32,6 +32,13 @@ stdlib-only over the gateway's own HTTP surface).
   the ISSUE 12 contract, unchanged). ``failover_budget`` bounds the
   hops; a fully-committed-at-the-kill stream is synthesized from the
   committed prefix, never retried.
+- **Federated live metrics** (ISSUE 15) — ``GET /metricsz`` folds
+  every peer's CACHED windowed telemetry doc (fetched on the probe
+  rounds, staleness-bounded) into one fleet view: per-replica
+  sections plus summed token/request rates, queue depth, worst
+  goodput and the max SLO burn per class with every active alert
+  tagged by peer — the "is the fleet healthy NOW" answer that used to
+  take N manual scrapes and a join.
 - **Rejoin** — a peer evicted by probe failures or a dropped stream
   carries a :class:`~..supervisor.CircuitBreaker`: after backoff the
   router hands it AT MOST ONE live probation probe; a proxied success
@@ -53,7 +60,7 @@ from typing import Any, Dict, List, Optional
 from ...utils import faults
 from ...utils import observability as obs
 from ..gateway import _SSE_HEAD  # noqa: F401  (re-export convenience)
-from ..gateway import _http_response, _json_response
+from ..gateway import _http_response, _json_response, _query_param
 from ..reqtrace import RequestTrace, RequestTraceRing
 from ..router import NoReplicaError, PrefixAffinityRouter
 from ..supervisor import BREAKER_CLOSED, CircuitBreaker
@@ -260,6 +267,77 @@ class FleetFrontend:
             if self.ring is not None else None,
         }
 
+    def metricsz(self, window_s: Optional[float] = None
+                 ) -> Dict[str, Any]:
+        """Federated ``GET /metricsz`` (ISSUE 15): every peer's cached
+        windowed doc under its own per-replica key, plus fleet totals
+        (summed token/request rates, queue depth, worst goodput, max
+        burn per SLO class, every active alert tagged with its peer).
+        Reads ONLY the probe caches — no network on the serving path;
+        a stale peer is excluded from totals, the same staleness bound
+        routing applies. ``?window_s=N`` re-targets the probers' next
+        rounds (cached federation converges within one interval)."""
+        if window_s:
+            for p in self.peers:
+                p.set_metrics_window(window_s)
+        replicas: Dict[str, Any] = {}
+        tok_rate = req_rate = queue_depth = 0.0
+        goodput_min: Optional[float] = None
+        burn_max: Dict[str, float] = {}
+        alerts_active: List[dict] = []
+        live = 0
+        for p in self.peers:
+            mz = p.metricsz()
+            replicas[p.name] = mz
+            doc = mz.get("doc")
+            if mz.get("stale") or not doc or not doc.get("enabled"):
+                continue
+            live += 1
+            # fold ONLY the peer's own gateway="<name>" label variants:
+            # a peer co-hosted with other gateways in one process (one
+            # shared registry) samples THEIR series too, and summing
+            # every variant would double-count the fleet totals
+            own = doc.get("gateway")
+            tag = f'gateway="{own}"' if own else None
+            for full, view in (doc.get("metrics") or {}).items():
+                if tag is not None and "{" in full and tag not in full:
+                    continue
+                base = full.split("{", 1)[0]
+                if base == "gateway_tokens_total":
+                    tok_rate += view.get("rate_per_s", 0.0)
+                elif base == "gateway_requests_total":
+                    req_rate += view.get("rate_per_s", 0.0)
+                elif base == "gateway_queue_depth":
+                    queue_depth += view.get("last", 0.0)
+                elif base == "gateway_goodput_frac":
+                    v = view.get("last", 1.0)
+                    goodput_min = v if goodput_min is None \
+                        else min(goodput_min, v)
+            slo = doc.get("slo") or {}
+            for cls, by_window in (slo.get("burn") or {}).items():
+                for b in by_window.values():
+                    if b > burn_max.get(cls, 0.0):
+                        burn_max[cls] = b
+            for a in slo.get("active") or ():
+                alerts_active.append(dict(a, peer=p.name))
+        return {
+            "fleet": self.name,
+            "enabled": True,
+            "window_s": float(window_s) if window_s else None,
+            "peers": len(self.peers),
+            "live_peers": live,
+            "replicas": replicas,
+            "totals": {
+                "tokens_per_sec": round(tok_rate, 3),
+                "requests_per_sec": round(req_rate, 3),
+                "queue_depth": queue_depth,
+                "goodput_frac_min": goodput_min,
+                "burn_rate_max": {k: round(v, 3)
+                                  for k, v in burn_max.items()},
+                "alerts_active": alerts_active,
+            },
+        }
+
     # ---------------------------------------------------------------- HTTP
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter):
@@ -287,12 +365,18 @@ class FleetFrontend:
                 return
             body = await asyncio.wait_for(reader.readexactly(n), 30) \
                 if n else b""
-            path = path.partition("?")[0].rstrip("/") or "/"
+            path, _, query = path.partition("?")
+            path = path.rstrip("/") or "/"
             if method == "GET" and path == "/healthz":
                 writer.write(_json_response(200, self.healthz()))
                 await writer.drain()
             elif method == "GET" and path == "/debugz":
                 writer.write(_json_response(200, self.debugz()))
+                await writer.drain()
+            elif method == "GET" and path == "/metricsz":
+                window_s = _query_param(query, "window_s")
+                writer.write(_json_response(
+                    200, self.metricsz(window_s)))
                 await writer.drain()
             elif method == "GET" and path == "/metrics":
                 writer.write(_http_response(

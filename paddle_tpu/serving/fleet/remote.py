@@ -31,6 +31,12 @@ prober refreshes two snapshots per peer:
   gossiped set is what turns the prefix cache into a FLEET asset: the
   router can place a request on ANY warm peer, not just the one an
   earlier request happened to land on.
+- ``GET /metricsz?window_s=N`` — the peer's WINDOWED telemetry view
+  (ISSUE 15): counter rates, gauge means, windowed histogram
+  quantiles and the SLO burn/alert block, cached per probe round so
+  the frontend's federated ``/metricsz`` is an O(peers) cache walk.
+  Best-effort: a peer without the endpoint stays healthy — live
+  metrics are a lens, not a liveness signal.
 
 A peer whose probes stop landing is evicted two ways: consecutive
 probe failures flip the health latch (and open the breaker when one is
@@ -102,6 +108,7 @@ class RemoteReplica:
                  probe_timeout_s: float = 1.0,
                  stale_after_s: float = 2.0,
                  fail_threshold: int = 2,
+                 metrics_window_s: float = 5.0,
                  clock=time.monotonic):
         self.name = name
         self.host = host
@@ -110,6 +117,7 @@ class RemoteReplica:
         self.probe_timeout_s = float(probe_timeout_s)
         self.stale_after_s = float(stale_after_s)
         self.fail_threshold = max(int(fail_threshold), 1)
+        self.metrics_window_s = float(metrics_window_s)
         self._clock = clock
         self.breaker = None           # attached by the fleet frontend
         self._lock = threading.Lock()
@@ -126,6 +134,13 @@ class RemoteReplica:
         self.probe_failures_total = 0
         self.gossip_fetches_total = 0
         self.gossip_unchanged_total = 0
+        # federated live metrics (ISSUE 15): the peer's windowed
+        # /metricsz doc, cached per probe round like the health snap —
+        # the frontend's fleet view reads only these caches, never the
+        # network
+        self._metricsz: Dict[str, Any] = {}
+        self._metricsz_t: Optional[float] = None
+        self.metricsz_failures_total = 0
         self._halt = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -205,6 +220,21 @@ class RemoteReplica:
                 self._digests = frozenset(doc.get("digests") or ())
                 self._digest_gen = int(doc.get("generation", -1))
             self._digest_t = self._clock()
+        # federated metrics (ISSUE 15): cache the peer's windowed view
+        # on the SAME probe round — no new connections beyond the
+        # round's, and the frontend's fleet /metricsz reads the cache.
+        # Best-effort: a peer without the endpoint (older build) or
+        # with its sampler off must not read as unhealthy — health is
+        # /healthz's verdict alone.
+        try:
+            mz = self._get_json(
+                f"/metricsz?window_s={self.metrics_window_s:g}")
+            with self._lock:
+                self._metricsz = mz
+                self._metricsz_t = self._clock()
+        except (OSError, ValueError, ConnectionError,
+                http.client.HTTPException):
+            self.metricsz_failures_total += 1
 
     def refresh(self) -> bool:
         """One synchronous probe round; returns success. Updates the
@@ -300,6 +330,28 @@ class RemoteReplica:
                 return False
             return digest in self._digests
 
+    def set_metrics_window(self, window_s: float):
+        """Change the window the NEXT probe rounds fetch (the
+        frontend's ``?window_s=N`` pass-through — cached federation
+        converges to the new window within one probe interval)."""
+        self.metrics_window_s = float(window_s)
+
+    def metricsz(self) -> Dict[str, Any]:
+        """The cached windowed-metrics doc (ISSUE 15), staleness-
+        tagged: a peer nobody probed within ``stale_after_s`` reports
+        ``stale`` and the frontend excludes it from fleet totals —
+        the same freshness bound ``healthy()`` applies."""
+        with self._lock:
+            age = None if self._metricsz_t is None \
+                else self._clock() - self._metricsz_t
+            return {
+                "peer": self.name,
+                "age_s": round(age, 3) if age is not None else None,
+                "stale": age is None or age > self.stale_after_s,
+                "doc": dict(self._metricsz) if self._metricsz
+                else None,
+            }
+
     def note_proxy_failure(self):
         """The frontend observed this peer fail an in-flight proxied
         stream (conn drop / 5xx): evict immediately — stronger
@@ -348,6 +400,14 @@ class RemoteReplica:
                     "generation": self._digest_gen,
                     "fetches": self.gossip_fetches_total,
                     "unchanged_skips": self.gossip_unchanged_total,
+                },
+                "metricsz": {
+                    "window_s": self.metrics_window_s,
+                    "cached": bool(self._metricsz),
+                    "age_s": round(self._clock() - self._metricsz_t,
+                                   3)
+                    if self._metricsz_t is not None else None,
+                    "failures": self.metricsz_failures_total,
                 },
             }
         b = self.breaker

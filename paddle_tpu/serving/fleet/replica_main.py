@@ -90,8 +90,18 @@ def main(argv=None) -> int:
     ap.add_argument("--watchdog-timeout-s", type=float, default=30.0)
     ap.add_argument("--run-dir", default=None,
                     help="observability run dir: the gateway dumps "
-                         "its request-trace rings here on drain "
-                         "(what trace_report's fleet merge ingests)")
+                         "its request-trace rings (and its "
+                         "series_<gw>.json trajectory, ISSUE 15) "
+                         "here on drain")
+    ap.add_argument("--slo-window-scale", type=float, default=1.0,
+                    help="scale the burn-rate alert windows "
+                         "(loadgen --slo-windows pass-through; "
+                         "<1 lets a CI-length run fire real alerts)")
+    ap.add_argument("--telemetry", default="on",
+                    choices=("on", "off"),
+                    help="off = no sampler, no burn-rate alerting "
+                         "(the pre-ISSUE-15 gateway, the A/B "
+                         "reference)")
     ns = ap.parse_args(argv)
 
     plat = os.environ.get("PADDLE_TPU_BENCH_PLATFORM")
@@ -111,10 +121,14 @@ def main(argv=None) -> int:
         return build_engine(ns.model, ns.chunk_tokens)
 
     engines = [factory() for _ in range(max(ns.engines, 1))]
+    telemetry_kw = dict(slo_window_scale=ns.slo_window_scale) \
+        if ns.telemetry == "on" else \
+        dict(sample_interval_s=None, slo_alerting=False)
     gw = Gateway(engines, host=ns.host, port=ns.port,
                  max_queue=ns.max_queue, name=ns.name,
                  engine_factory=factory,
-                 watchdog_timeout_s=ns.watchdog_timeout_s)
+                 watchdog_timeout_s=ns.watchdog_timeout_s,
+                 **telemetry_kw)
 
     async def serve():
         await gw.start()

@@ -77,6 +77,7 @@ from .reqtrace import RequestTrace, RequestTraceRing
 from .router import EngineReplica, NoReplicaError, PrefixAffinityRouter
 from .scheduler import (SLO_BATCH, SLO_INTERACTIVE, ServeRequest,
                         ShedError, SLOScheduler)
+from .slo import BurnRateEngine
 from .supervisor import BREAKER_CLOSED, CircuitBreaker, ReplicaSupervisor
 
 __all__ = ["Gateway"]
@@ -109,6 +110,21 @@ def _json_response(status: int, payload: Dict[str, Any],
                    extra: Dict[str, str] = None) -> bytes:
     return _http_response(status, json.dumps(payload).encode(),
                           extra=extra)
+
+
+def _query_param(query: str, key: str, conv=float):
+    """``?key=value`` lookup in a raw query string (last occurrence
+    wins), parsed with ``conv``; None when absent or unparseable.
+    Shared by the gateway's and the fleet frontend's HTTP handlers."""
+    out = None
+    for part in query.split("&"):
+        k, _, v = part.partition("=")
+        if k == key:
+            try:
+                out = conv(v)
+            except ValueError:
+                pass
+    return out
 
 
 def _release_probe(req: ServeRequest, replica, success=None):
@@ -606,7 +622,13 @@ class Gateway:
                  watchdog_interval_s: float = 0.05,
                  breaker_backoff_s: float = 1.0,
                  breaker_backoff_max_s: float = 30.0,
-                 breaker_probes: int = 1):
+                 breaker_probes: int = 1,
+                 sample_interval_s: Optional[float] = 0.25,
+                 sample_capacity: int = 512,
+                 slo_alerting: bool = True,
+                 slo_targets: Optional[Dict[str, float]] = None,
+                 slo_rules=None,
+                 slo_window_scale: float = 1.0):
         """Fleet fault tolerance (ISSUE 12): ``supervise`` (default on)
         runs the :class:`~.supervisor.ReplicaSupervisor` — tick-thread
         crash/hang detection (``watchdog_timeout_s`` is the
@@ -617,7 +639,20 @@ class Gateway:
         probation probe, ``breaker_probes`` successes to close).
         ``failover_budget`` caps how many replica failures one request
         may ride through before it errors out — the amplification
-        bound under cascading failures."""
+        bound under cascading failures.
+
+        Telemetry plane (ISSUE 15): ``sample_interval_s`` runs a
+        :class:`~paddle_tpu.utils.observability.MetricsTimeSeries`
+        sampler (None/0 disables — today's snapshot-only behavior)
+        that backs ``GET /metricsz?window_s=N`` and the
+        ``series_<gateway>.json`` drain artifact; ``slo_alerting``
+        runs a :class:`~.slo.BurnRateEngine` over the reqtrace
+        outcome stream (requires ``trace=True`` — the ring's
+        idempotent finish is the dedupe point), with
+        ``slo_window_scale`` shrinking the burn windows for
+        CI-speed runs. Both are host-side and pull-only: streams and
+        the steady-tick dispatch/upload pins are unchanged with the
+        plane on (pinned by ``tests/test_telemetry.py``)."""
         if not isinstance(engines, (list, tuple)):
             engines = [engines]
         self.name = name or f"gw{next(_gateway_ids)}"
@@ -673,6 +708,20 @@ class Gateway:
                                         **self._labels)
         self._c_fo_exhausted = reg.counter(
             "gateway_retry_budget_exhausted_total", **self._labels)
+        # telemetry plane (ISSUE 15): the windowed time-series sampler
+        # behind /metricsz + the SLO burn-rate engine over the trace
+        # rings' outcome stream. Built BEFORE the workers so
+        # _make_worker can attach the engine to each ring it creates.
+        self.sampler = None
+        if sample_interval_s:
+            self.sampler = obs.MetricsTimeSeries(
+                name=self.name, interval_s=float(sample_interval_s),
+                capacity=sample_capacity)
+        self._slo: Optional[BurnRateEngine] = None
+        if slo_alerting and self._trace:
+            self._slo = BurnRateEngine(
+                targets=slo_targets, rules=slo_rules,
+                window_scale=slo_window_scale, labels=self._labels)
         self._workers: List[_ReplicaWorker] = []
         # prefix-gossip generation ratchet (ISSUE 13): keeps the
         # exported generation monotonic across engine_factory rebuilds
@@ -731,7 +780,30 @@ class Gateway:
             self._model_locks = {k: v for k, v in
                                  self._model_locks.items()
                                  if k in live}
-        return _ReplicaWorker(self, replica, sched, lock, ring=ring)
+        w = _ReplicaWorker(self, replica, sched, lock, ring=ring)
+        if self._slo is not None and w.ring is not None \
+                and self._slo_observe not in w.ring.observers:
+            # the burn engine rides the ring's idempotent finish — a
+            # rebuilt worker inherits its predecessor's ring, so the
+            # observer survives supervisor restarts too
+            w.ring.observers.append(self._slo_observe)
+        return w
+
+    def _slo_observe(self, entry: Dict[str, Any]):
+        """Ring-finish observer (ISSUE 15): fold one terminal outcome
+        into the burn-rate engine. 'Bad' = the request broke its
+        class's promise — any non-stop outcome, plus (interactive
+        only) a TTFT over the SLO threshold, the same rule the
+        goodput gauge applies. A zero-token clean finish has no TTFT
+        and counts good."""
+        eng = self._slo
+        if eng is None:
+            return
+        ttft = entry.get("ttft_ms")
+        ok = entry["outcome"] == "stop" and (
+            entry["slo"] != SLO_INTERACTIVE
+            or ttft is None or ttft <= self._slow_ttft_ms)
+        eng.observe(entry["slo"], ok)
 
     def _breaker_state_cb(self, replica: EngineReplica):
         def cb(state: str):
@@ -945,6 +1017,12 @@ class Gateway:
         self._loop = asyncio.get_running_loop()
         for w in self._workers:
             w.start()
+        if self.sampler is not None:
+            if self._slo is not None:
+                # alerts must RESOLVE on wall time even when traffic
+                # stops — the sampler tick is the evaluation heartbeat
+                self.sampler.add_hook(self._slo.evaluate)
+            self.sampler.start()
         if self._supervisor is not None \
                 and not self._supervisor.is_alive():
             self._supervisor.start()
@@ -986,6 +1064,15 @@ class Gateway:
                 w.flush_queue(503, "draining: not admitting new "
                                    "requests")
         obs.record_event("gateway_drain", gateway=self.name)
+        if self.sampler is not None:
+            # stop the sampler thread and leave the trajectory on disk
+            # (series_<gateway>.json, beside the reqtrace rings) so a
+            # SIGTERM'd replica's windowed history survives it
+            # (ISSUE 15 small fix)
+            self.sampler.stop()
+            self.sampler.flush_series(
+                alerts=self._slo.alerts if self._slo is not None
+                else None)
         obs.flush()
         if obs.run_dir():
             # park the request-trace rings next to the other run
@@ -1079,6 +1166,30 @@ class Gateway:
                 "entries": len(digests),
                 "digests": sorted(digests)}
 
+    def metricsz(self, window_s: Optional[float] = None
+                 ) -> Dict[str, Any]:
+        """``GET /metricsz?window_s=N`` (ISSUE 15): windowed rates +
+        quantiles as JSON, beside the Prometheus text endpoint —
+        counter rates, gauge means and TRUE windowed histogram
+        quantiles over the last N seconds, derived from the sampler's
+        rings, plus the SLO burn/alert block. ``enabled: false`` when
+        the sampler is off (the federating frontend skips those)."""
+        if self.sampler is None:
+            return {"gateway": self.name, "enabled": False}
+        w = float(window_s) if window_s else \
+            max(self.sampler.interval_s * 8, 2.0)
+        doc: Dict[str, Any] = {
+            "gateway": self.name,
+            "enabled": True,
+            "window_s": w,
+            "interval_s": self.sampler.interval_s,
+            "samples_taken": self.sampler.samples_taken,
+            "metrics": self.sampler.window(w),
+        }
+        if self._slo is not None:
+            doc["slo"] = self._slo.snapshot()
+        return doc
+
     def debugz(self) -> Dict[str, Any]:
         """``GET /debugz`` (ISSUE 10): live engine introspection — the
         slot map, block-pool occupancy/fragmentation, the prefix-cache
@@ -1137,6 +1248,19 @@ class Gateway:
             "router": self._router.snapshot(),
             "replicas": reps,
             "prefix_digest_set": self.prefix_digest_summary(),
+            # telemetry plane (ISSUE 15)
+            "telemetry": {
+                "sampler": None if self.sampler is None else {
+                    "running": self.sampler.running,
+                    "interval_s": self.sampler.interval_s,
+                    "capacity": self.sampler.capacity,
+                    "samples_taken": self.sampler.samples_taken,
+                    "metrics": len(self.sampler.names()),
+                    "dropped_metrics": self.sampler.dropped_metrics,
+                },
+                "slo": self._slo.snapshot()
+                if self._slo is not None else None,
+            },
         }
 
     # ------------------------------------------------------------- health
@@ -1220,14 +1344,7 @@ class Gateway:
             # unchanged-marker instead of the digest list when the set
             # generation still equals N
             summary = self.prefix_digest_summary()
-            if_gen = None
-            for part in query.split("&"):
-                k, _, v = part.partition("=")
-                if k == "if_gen":
-                    try:
-                        if_gen = int(v)
-                    except ValueError:
-                        pass
+            if_gen = _query_param(query, "if_gen", int)
             if if_gen is not None and if_gen == summary["generation"]:
                 writer.write(_json_response(
                     200, {"generation": summary["generation"],
@@ -1248,6 +1365,12 @@ class Gateway:
             writer.write(_http_response(
                 200, obs.registry().prometheus_text().encode(),
                 ctype="text/plain; version=0.0.4"))
+            await writer.drain()
+            return
+        if method == "GET" and path == "/metricsz":
+            # windowed JSON beside the Prometheus text (ISSUE 15)
+            window_s = _query_param(query, "window_s")
+            writer.write(_json_response(200, self.metricsz(window_s)))
             await writer.drain()
             return
         if method == "POST" and path == "/v1/generate":

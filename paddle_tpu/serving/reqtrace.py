@@ -181,6 +181,12 @@ class RequestTraceRing:
         self.slow_ttft_ms = float(slow_ttft_ms)
         self.labels = {k: str(v) for k, v in (labels or {}).items()}
         self._ring: deque = deque(maxlen=self.capacity)
+        # finish observers (ISSUE 15): called once per closed trace
+        # with the appended entry — the ring's ``trace.done`` latch is
+        # the dedupe point, so the SLO burn-rate engine riding here
+        # sees each request's terminal outcome EXACTLY once even when
+        # a disconnect races a tick-thread finish
+        self.observers: list = []
         self._lock = threading.Lock()
         reg = obs.registry()
         self._c_traced = reg.counter("request_traces_total",
@@ -253,6 +259,11 @@ class RequestTraceRing:
         if retain:
             self._c_retained.inc()
         self._ring.append(entry)
+        for fn in list(self.observers):
+            try:
+                fn(entry)
+            except Exception:
+                pass   # an observer bug must not break request finish
         return entry
 
     # ----------------------------------------------------------- exports

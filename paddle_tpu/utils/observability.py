@@ -20,6 +20,14 @@ worker died". This module is the one substrate they all feed:
   env (``$PADDLE_TPU_RUN_ID`` / ``$PADDLE_TPU_ATTEMPT``), and every
   event timestamps in epoch microseconds, so per-attempt trace files
   from a preempted-and-relaunched job stitch into ONE timeline.
+- **MetricsTimeSeries** (ISSUE 15) — a bounded background sampler
+  that turns the registry's instantaneous values into windowed
+  HISTORY: per-metric ring buffers of periodic snapshots, from which
+  counter *rates* and true windowed histogram quantiles are derived
+  (``window(W)``), dumped as ``series_<name>.json`` beside the other
+  run artifacts and served live as the gateway's ``GET /metricsz``.
+  Pull-only — zero overhead on the metric write path when not
+  started.
 - **Flight recorder** — a bounded ring buffer of recent structured
   events (step end, fault fires, rollbacks, prefetch stalls,
   checkpoint save/restore, preemption latch, serving
@@ -56,6 +64,8 @@ __all__ = [
     "DEFAULT_MS_BUCKETS", "SERVING_MS_BUCKETS", "BYTES_BUCKETS",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "SpanTracer", "FlightRecorder",
+    "MetricsTimeSeries", "SERIES_SCHEMA",
+    "quantile_from_bucket_counts", "validate_series_doc",
     "registry", "tracer", "recorder",
     "counter", "gauge", "histogram", "span", "record_event",
     "configure", "run_dir", "flight_path", "trace_path", "metrics_path",
@@ -505,12 +515,412 @@ class FlightRecorder:
         return path
 
 
+# ------------------------------------------------------------- time series
+SERIES_SCHEMA = "series/1"
+
+
+def quantile_from_bucket_counts(bounds, counts, q: float) -> float:
+    """Estimated q-quantile of a (non-cumulative) per-bucket count
+    vector over the ``bounds`` grid — the same linear-interpolation
+    rule :meth:`Histogram.percentile` uses, applied to a WINDOWED
+    delta of two cumulative samples (so ``/metricsz?window_s=N`` can
+    report the p99 of the last N seconds, not of the process
+    lifetime). The +Inf tail clamps to the last finite edge; without
+    observed min/max the interpolation starts at each bucket's own
+    lower edge (0 for the first)."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    lo = 0.0
+    for i, c in enumerate(counts):
+        hi = bounds[i] if i < len(bounds) else bounds[-1]
+        if c:
+            if cum + c >= target:
+                frac = (target - cum) / c
+                return lo + frac * (hi - lo)
+            cum += c
+        lo = hi
+    return float(bounds[-1])
+
+
+class MetricsTimeSeries:
+    """Bounded in-process time-series history over a MetricsRegistry
+    (ISSUE 15 tentpole).
+
+    A background daemon thread (``start()``) snapshots EVERY metric in
+    the registry each ``interval_s`` into per-metric ring buffers:
+
+    - counters / gauges → ``(t, value)`` samples; ``window(W)``
+      derives the counter's RATE over the last W seconds from the
+      delta between the newest sample and the last sample at-or-before
+      the window start.
+    - histograms → ``(t, count, sum, bucket_counts)`` samples (the
+      one-lock-consistent :meth:`Histogram.export` view), so
+      ``window(W)`` can subtract two cumulative samples and report
+      TRUE windowed quantiles (p50/p99 of the last W seconds) via
+      :func:`quantile_from_bucket_counts`, plus the windowed
+      observation rate and mean.
+
+    Torn-read-safety: every sampled read goes through the metric's own
+    lock (``Counter.value`` / ``Histogram.export``) and the registry's
+    item lock, so a concurrent ``observe()`` can never tear a sample;
+    the sampler's own rings take ``self._lock`` against concurrent
+    ``window()`` / ``to_doc()`` readers.
+
+    Memory bound (hard): ``capacity`` samples per metric ring,
+    ``max_metrics`` tracked metric series (extras are counted in
+    ``dropped_metrics``, never stored). Worst case ≈
+    ``max_metrics × capacity × (4 + n_buckets) × 8`` bytes — the
+    defaults (512 metrics × 256 samples × ~24 floats) bound the whole
+    plane under ~25 MB, and a typical serving registry (~100 metrics,
+    mostly scalars) sits around 0.5 MB. Zero overhead when not
+    started: nothing hooks the metric write path, ever — sampling is
+    pull-only.
+
+    ``start()`` after a ``stop()`` begins FROM ZERO (fresh rings,
+    ``samples_taken`` reset) — the same per-call isolation contract
+    ``elastic.supervise()`` keeps. Started samplers are tracked
+    module-wide so :func:`reset` can stop their threads and flush
+    their series files (``series_<name>.json`` in the run dir).
+
+    ``hooks``: callables invoked as ``hook(now)`` after each sampling
+    pass (outside the ring lock) — the burn-rate engine rides here so
+    alerts resolve on wall time even when traffic stops.
+    """
+
+    def __init__(self, name: str = "default", registry=None,
+                 interval_s: float = 0.25, capacity: int = 256,
+                 max_metrics: int = 512, clock=time.monotonic):
+        self.name = str(name)
+        self._registry = registry          # None = process default
+        self.interval_s = float(interval_s)
+        self.capacity = max(int(capacity), 2)
+        self.max_metrics = max(int(max_metrics), 1)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._series: Dict[str, Dict[str, Any]] = {}
+        self._hooks: List[Any] = []
+        self.samples_taken = 0
+        self.dropped_metrics = 0
+        self._halt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ sampling
+    def _reg(self) -> MetricsRegistry:
+        return self._registry if self._registry is not None \
+            else registry()
+
+    def sample(self, now: Optional[float] = None) -> float:
+        """One sampling pass (what the thread loops; deterministic
+        tests call it directly with an injected clock)."""
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            for name, lkey, kind, m in self._reg()._items():
+                full = _full_name(name, lkey)
+                ent = self._series.get(full)
+                if ent is None:
+                    if len(self._series) >= self.max_metrics:
+                        self.dropped_metrics += 1
+                        continue
+                    ent = {"kind": kind,
+                           "samples": deque(maxlen=self.capacity)}
+                    if kind == "histogram":
+                        ent["buckets"] = m.buckets
+                    self._series[full] = ent
+                if kind == "histogram":
+                    counts, total, cnt = m.export()
+                    ent["samples"].append((now, cnt, total, counts))
+                else:
+                    ent["samples"].append((now, m.value))
+            self.samples_taken += 1
+        for hook in list(self._hooks):
+            try:
+                hook(now)
+            except Exception:
+                pass   # a broken hook must not kill the sampler
+        return now
+
+    def add_hook(self, fn):
+        if fn not in self._hooks:
+            self._hooks.append(fn)
+
+    # ------------------------------------------------------------- thread
+    def start(self) -> "MetricsTimeSeries":
+        """Start (or restart) the background sampler. A restart begins
+        from zero — fresh rings, counters reset — mirroring the
+        ``supervise()`` per-call isolation contract."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        with self._lock:
+            self._series.clear()
+            self.samples_taken = 0
+            self.dropped_metrics = 0
+        self._halt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"metrics-sampler-{self.name}")
+        self._thread.start()
+        _track_sampler(self)
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def stop(self, timeout: float = 2.0):
+        self._halt.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        _untrack_sampler(self)
+
+    def _loop(self):
+        while not self._halt.wait(self.interval_s):
+            try:
+                self.sample()
+            except Exception:
+                pass   # telemetry must outlive any bug
+
+    # ------------------------------------------------------------ queries
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def series(self, full_name: str) -> List[tuple]:
+        with self._lock:
+            ent = self._series.get(full_name)
+            return list(ent["samples"]) if ent else []
+
+    def window(self, window_s: float,
+               now: Optional[float] = None) -> Dict[str, Any]:
+        """The windowed view ``GET /metricsz?window_s=N`` serves:
+        per metric, the rate / mean / quantiles of the last
+        ``window_s`` seconds derived from the sampled rings."""
+        now = self._clock() if now is None else float(now)
+        lo = now - float(window_s)
+        out: Dict[str, Any] = {}
+        with self._lock:
+            items = [(full, ent["kind"], ent.get("buckets"),
+                      list(ent["samples"]))
+                     for full, ent in self._series.items()]
+        for full, kind, buckets, samples in items:
+            if not samples:
+                continue
+            # rate baseline: the last sample at-or-before the window
+            # start (so a window covering k samples integrates k full
+            # inter-sample deltas, not k-1); fall back to the earliest
+            # in-window sample when the ring doesn't reach back
+            base = None
+            inside = []
+            for s in samples:
+                if s[0] < lo:
+                    base = s
+                else:
+                    inside.append(s)
+            if not inside:
+                inside = [samples[-1]]
+            if base is None:
+                base = inside[0]
+            last = inside[-1]
+            dt = last[0] - base[0]
+            if kind == "counter":
+                rate = (last[1] - base[1]) / dt if dt > 0 else 0.0
+                out[full] = {"kind": "counter",
+                             "last": last[1],
+                             "delta": last[1] - base[1],
+                             "rate_per_s": round(rate, 6)}
+            elif kind == "gauge":
+                vals = [s[1] for s in inside]
+                out[full] = {"kind": "gauge",
+                             "last": last[1],
+                             "mean": round(sum(vals) / len(vals), 6),
+                             "min": min(vals), "max": max(vals)}
+            else:
+                dcount = last[1] - base[1]
+                dsum = last[2] - base[2]
+                dcounts = [max(b - a, 0) for a, b in
+                           zip(base[3], last[3])]
+                rate = dcount / dt if dt > 0 else 0.0
+                out[full] = {
+                    "kind": "histogram",
+                    "count": dcount,
+                    "rate_per_s": round(rate, 6),
+                    "mean": round(dsum / dcount, 6) if dcount else 0.0,
+                    "p50": round(quantile_from_bucket_counts(
+                        buckets, dcounts, 0.5), 6),
+                    "p99": round(quantile_from_bucket_counts(
+                        buckets, dcounts, 0.99), 6),
+                }
+        return out
+
+    # ------------------------------------------------------------ exports
+    def to_doc(self, alerts: Optional[List[dict]] = None
+               ) -> Dict[str, Any]:
+        """The ``series/1`` document (``validate_series_doc`` checks
+        it; ``tools/fleet_dash.py`` renders it). ``alerts`` attaches a
+        burn-rate alert log so one file carries a replica's whole
+        trajectory + its SLO incidents."""
+        with self._lock:
+            metrics = {}
+            for full, ent in self._series.items():
+                rec: Dict[str, Any] = {
+                    "kind": ent["kind"],
+                    "samples": [list(s[:3]) + [list(s[3])]
+                                if ent["kind"] == "histogram"
+                                else list(s)
+                                for s in ent["samples"]],
+                }
+                if ent["kind"] == "histogram":
+                    rec["buckets"] = list(ent["buckets"])
+                metrics[full] = rec
+            taken, dropped = self.samples_taken, self.dropped_metrics
+        clock_now = self._clock()
+        return {"schema": SERIES_SCHEMA, "name": self.name,
+                "interval_s": self.interval_s,
+                "capacity": self.capacity,
+                "samples_taken": taken,
+                "dropped_metrics": dropped,
+                "dumped_wall": time.time(),
+                "clock_now": clock_now,
+                "metrics": metrics,
+                "alerts": list(alerts or ())}
+
+    def dump(self, path: str,
+             alerts: Optional[List[dict]] = None) -> str:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_doc(alerts=alerts), f)
+        os.replace(tmp, path)
+        return path
+
+    def flush_series(self, alerts: Optional[List[dict]] = None
+                     ) -> Optional[str]:
+        """Write ``series_<name>.json`` into the configured run dir
+        (no-op without one) — what a SIGTERM'd replica leaves on disk
+        beside its reqtrace ring."""
+        d = run_dir()
+        if d is None:
+            return None
+        try:
+            return self.dump(os.path.join(
+                d, f"series_{self.name}.json"), alerts=alerts)
+        except Exception:
+            return None
+
+
+def validate_series_doc(doc: Any) -> List[str]:
+    """Schema check for a dumped time-series document (``obs_report
+    --check`` runs this so the sampler's writer and ``fleet_dash``'s
+    reader cannot drift apart). Returns a list of problems (empty =
+    valid): schema tag, per-metric sample shapes, the ring bound
+    (``len(samples) <= capacity``), monotone sample times, monotone
+    counter values (what makes rate derivation sound), histogram
+    bucket-vector lengths, and the alert-log entry shape."""
+    bad: List[str] = []
+    if not isinstance(doc, dict):
+        return ["doc is not an object"]
+    if doc.get("schema") != SERIES_SCHEMA:
+        bad.append(f"schema != {SERIES_SCHEMA!r}: {doc.get('schema')!r}")
+    cap = doc.get("capacity")
+    if not isinstance(cap, int) or cap < 2:
+        bad.append(f"capacity not an int >= 2: {cap!r}")
+        cap = None
+    metrics = doc.get("metrics")
+    if not isinstance(metrics, dict):
+        return bad + ["metrics is not an object"]
+    for full, ent in metrics.items():
+        where = f"metrics[{full!r}]"
+        if not isinstance(ent, dict):
+            bad.append(f"{where} not an object")
+            continue
+        kind = ent.get("kind")
+        if kind not in ("counter", "gauge", "histogram"):
+            bad.append(f"{where} unknown kind {kind!r}")
+            continue
+        samples = ent.get("samples")
+        if not isinstance(samples, list):
+            bad.append(f"{where}.samples not a list")
+            continue
+        if cap is not None and len(samples) > cap:
+            bad.append(f"{where} ring bound violated: "
+                       f"{len(samples)} > capacity {cap}")
+        n_b = None
+        if kind == "histogram":
+            buckets = ent.get("buckets")
+            if not isinstance(buckets, list) or not buckets:
+                bad.append(f"{where}.buckets missing")
+            else:
+                n_b = len(buckets) + 1   # +Inf tail
+        want = 2 if kind != "histogram" else 4
+        prev_t = prev_v = None
+        for j, s in enumerate(samples):
+            if not isinstance(s, list) or len(s) != want \
+                    or not all(isinstance(x, (int, float))
+                               for x in s[:want - 1 if kind ==
+                                          "histogram" else want]):
+                bad.append(f"{where}.samples[{j}] malformed")
+                continue
+            t = s[0]
+            if prev_t is not None and t < prev_t:
+                bad.append(f"{where}.samples[{j}] time went backwards")
+            prev_t = t
+            if kind == "counter":
+                if prev_v is not None and s[1] < prev_v:
+                    bad.append(f"{where}.samples[{j}] counter "
+                               f"regressed (rate would go negative)")
+                prev_v = s[1]
+            if kind == "histogram":
+                counts = s[3]
+                if not isinstance(counts, list) \
+                        or (n_b is not None and len(counts) != n_b):
+                    bad.append(f"{where}.samples[{j}] bucket vector "
+                               f"length != len(buckets)+1")
+                elif sum(counts) != s[1]:
+                    bad.append(f"{where}.samples[{j}] bucket counts "
+                               f"don't sum to the sample count")
+    alerts = doc.get("alerts", [])
+    if not isinstance(alerts, list):
+        bad.append("alerts is not a list")
+    else:
+        for j, a in enumerate(alerts):
+            if not isinstance(a, dict):
+                bad.append(f"alerts[{j}] not an object")
+                continue
+            if a.get("kind") not in ("fire", "resolve"):
+                bad.append(f"alerts[{j}] unknown kind "
+                           f"{a.get('kind')!r}")
+            for k in ("slo", "rule"):
+                if not isinstance(a.get(k), str):
+                    bad.append(f"alerts[{j}] missing {k!r}")
+            if not isinstance(a.get("t"), (int, float)):
+                bad.append(f"alerts[{j}] missing numeric 't'")
+    return bad
+
+
 # --------------------------------------------------------- process default
 _registry = MetricsRegistry()
 _tracer = SpanTracer()
 _recorder = FlightRecorder()
 _run_dir: Optional[str] = None
 _state_lock = threading.Lock()
+# started samplers, tracked so reset() can stop their threads and
+# flush their series files (ISSUE 15 small fix: a leaked sampler
+# thread would keep writing into a test's fresh registry)
+_samplers: List["MetricsTimeSeries"] = []
+
+
+def _track_sampler(s: "MetricsTimeSeries"):
+    with _state_lock:
+        if s not in _samplers:
+            _samplers.append(s)
+
+
+def _untrack_sampler(s: "MetricsTimeSeries"):
+    with _state_lock:
+        if s in _samplers:
+            _samplers.remove(s)
 
 
 def registry() -> MetricsRegistry:
@@ -615,9 +1025,22 @@ def publish(writer, step: int) -> None:
 
 
 def reset() -> None:
-    """Fresh registry / tracer / recorder and no run dir (tests)."""
+    """Fresh registry / tracer / recorder and no run dir (tests).
+    Running samplers are STOPPED first — and their series flushed into
+    the (still-configured) run dir — so no background thread keeps
+    sampling the new registry and no trajectory is silently lost
+    (ISSUE 15 small fix)."""
     global _registry, _tracer, _recorder, _run_dir
     with _state_lock:
+        samplers = list(_samplers)
+    for s in samplers:
+        try:
+            s.stop()
+            s.flush_series()
+        except Exception:
+            pass
+    with _state_lock:
+        _samplers.clear()
         _registry = MetricsRegistry()
         _tracer = SpanTracer()
         _recorder = FlightRecorder()

@@ -471,9 +471,14 @@ def test_autoscaler_hysteresis_cooldown_up_and_down():
     never below min; flapping signals produce no flapping actions."""
     t = [0.0]
     m = _FakeManager(1)
+    # signal_mode="instant": this test pins the hold/cooldown state
+    # machine against single-sample transitions; the windowed default
+    # (ISSUE 15) smooths those — its semantics (steady-traffic parity,
+    # noisy-trace flap reduction) are pinned in test_telemetry.py
     sc = FleetAutoscaler(m, min_replicas=1, max_replicas=3,
                          up_queue_depth=2.0, hold_s=1.0,
                          hold_down_s=2.0, cooldown_s=5.0,
+                         signal_mode="instant",
                          clock=lambda: t[0])
     # a blip: pressure seen once, gone before the hold elapses
     m.reps[0].queue_depth = 10

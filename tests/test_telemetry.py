@@ -1,0 +1,969 @@
+"""ISSUE 15: fleet telemetry plane — time-series sampler, SLO
+burn-rate alerting, federated live metrics, windowed autoscaling.
+
+Contracts pinned here:
+
+- SAMPLER MATH: counter rates, gauge window means and TRUE windowed
+  histogram quantiles derived from the sampled rings are pinned to
+  exact values under an injected clock; rings obey the hard capacity
+  bound; a sampler restart begins from zero; ``observability.reset()``
+  stops the thread and flushes ``series_<name>.json``.
+- OFF THE HOT PATH: greedy SSE streams are BITWISE identical with the
+  sampler + alerting on vs off, and the steady-tick
+  1-dispatch/0-upload/0-byte engine pins hold with a sampler thread
+  running — the plane is provably pull-only.
+- BURN-RATE RULES: fire requires BOTH windows over threshold, resolve
+  takes hysteresis (no flap in the dead band), windows scale linearly
+  with the knob, alerts land in the flight recorder and the
+  ``slo_burn_rate{class=,window=}`` gauges.
+- FEDERATION: a frontend folds N peers' cached ``/metricsz`` docs
+  into one fleet view with per-replica sections and totals; a stale
+  peer is excluded from totals (same bound routing uses).
+- WINDOWED AUTOSCALING: decision parity with instant mode on steady
+  traffic; strictly fewer scale events on a seeded noisy trace.
+
+Sweeps (multi-window burn matrix), the multi-PROCESS federation e2e
+and the chaos-alert loadgen e2e ride behind ``slow`` (see
+``tools/marker_audit.py``).
+"""
+import asyncio
+import importlib.util
+import json
+import os
+import random
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.generation.paged import PagedEngine
+from paddle_tpu.generation.stub import TickStubModel
+from paddle_tpu.serving import BurnRateEngine, BurnRule, Gateway
+from paddle_tpu.serving.fleet.autoscaler import FleetAutoscaler
+from paddle_tpu.serving.fleet.remote import RemoteReplica
+from paddle_tpu.utils import observability as obs
+
+
+def _engine(**kw):
+    base = dict(max_slots=4, num_blocks=64, block_size=8,
+                max_blocks_per_seq=8, prefill_buckets=(16,),
+                chunk_prefill_tokens=8, enable_prefix_cache=True)
+    base.update(kw)
+    return PagedEngine(TickStubModel(), **base)
+
+
+# ------------------------------------------------------------- HTTP client
+async def _http(port, method, path, body=b""):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        writer.write((f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+                      f"Content-Length: {len(body)}\r\n\r\n").encode()
+                     + body)
+        await writer.drain()
+        status = int((await reader.readline()).split()[1])
+        headers = {}
+        while True:
+            ln = await reader.readline()
+            if ln in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = ln.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        n = int(headers.get("content-length", "0") or 0)
+        payload = await reader.readexactly(n) if n else b""
+        return status, payload
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+async def _sse_raw(port, payload):
+    """One SSE request, returning the RAW response bytes (status line,
+    headers, every event) — what the bitwise sampler-on/off pin
+    compares."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    body = json.dumps(payload).encode()
+    try:
+        writer.write((f"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+                      f"Content-Length: {len(body)}\r\n\r\n").encode()
+                     + body)
+        await writer.drain()
+        return await reader.read()
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+# ================================================================ sampler
+class TestTimeSeries:
+    def test_ring_bound_kinds_and_restart_from_zero(self):
+        reg = obs.MetricsRegistry()
+        c = reg.counter("c_total")
+        reg.gauge("g")
+        reg.histogram("h_ms", buckets=(1, 2, 5))
+        clk = [0.0]
+        ts = obs.MetricsTimeSeries(name="t", registry=reg,
+                                   capacity=4, clock=lambda: clk[0])
+        for i in range(7):
+            clk[0] = float(i)
+            c.inc()
+            ts.sample()
+        assert ts.samples_taken == 7
+        assert len(ts.series("c_total")) == 4       # hard ring bound
+        assert sorted(ts.names()) == ["c_total", "g", "h_ms"]
+        # histogram samples carry the cumulative bucket vector
+        t, cnt, total, counts = ts.series("h_ms")[-1]
+        assert cnt == 0 and len(counts) == 4        # 3 buckets + Inf
+        # a restart begins from zero (the supervise() isolation
+        # contract, mirrored)
+        ts.start()
+        assert ts.samples_taken == 0 and ts.names() == []
+        ts.stop()
+
+    def test_windowed_rates_means_and_quantiles_pinned(self):
+        reg = obs.MetricsRegistry()
+        c = reg.counter("toks_total")
+        g = reg.gauge("queue")
+        h = reg.histogram("lat_ms", buckets=(1, 2, 5))
+        clk = [0.0]
+        ts = obs.MetricsTimeSeries(name="t", registry=reg,
+                                   capacity=64, clock=lambda: clk[0])
+        for i in range(6):
+            clk[0] = float(i)
+            c.inc(5)
+            g.set(i)
+            # era split: old observations land in bucket (2, 5],
+            # recent ones in (1, 2] — the windowed quantile must see
+            # ONLY the recent era
+            h.observe(4.0 if i < 3 else 1.5)
+            ts.sample()
+        # lo=2.5: baseline = the last sample before it (t=2, the 4.0
+        # era's close), in-window samples t=3,4,5 — exactly the 1.5 era
+        w = ts.window(2.5, now=5.0)
+        # counter: (30-15)/(5-2) = 5/s exactly
+        assert w["toks_total"]["rate_per_s"] == 5.0
+        assert w["toks_total"]["delta"] == 15.0
+        assert w["queue"]["mean"] == 4.0            # (3+4+5)/3
+        assert w["queue"]["last"] == 5.0
+        # histogram: 3 recent observations of 1.5 -> p50 interpolates
+        # to exactly 1.5 inside the (1, 2] bucket; the old 4.0s are
+        # OUTSIDE the window and must not leak in
+        assert w["lat_ms"]["count"] == 3
+        assert w["lat_ms"]["p50"] == 1.5
+        assert w["lat_ms"]["mean"] == 1.5
+        # whole-history window: the baseline is the FIRST sample, so
+        # a delta-of-cumulative view integrates the 5 deltas after it
+        # (two 4.0s + three 1.5s)
+        w_all = ts.window(100.0, now=5.0)
+        assert w_all["lat_ms"]["count"] == 5
+        assert w_all["lat_ms"]["mean"] == pytest.approx(2.5)
+
+    def test_sampler_thread_torn_read_safe(self):
+        """A real sampler thread against concurrent observe(): every
+        histogram sample's bucket vector must sum to its count (the
+        one-lock export), and counter samples stay monotone."""
+        obs.reset()
+        h = obs.histogram("tt_ms", buckets=(1, 2, 5))
+        c = obs.counter("tt_total")
+        ts = obs.MetricsTimeSeries(name="tt", interval_s=0.002,
+                                   capacity=512)
+        halt = threading.Event()
+
+        def hammer():
+            while not halt.is_set():
+                h.observe(1.5)
+                c.inc()
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        ts.start()
+        for t in threads:
+            t.start()
+        time.sleep(0.15)
+        halt.set()
+        for t in threads:
+            t.join()
+        ts.stop()
+        hs = ts.series("tt_ms")
+        assert len(hs) >= 3
+        for _, cnt, _, counts in hs:
+            assert sum(counts) == cnt               # never torn
+        cs = [v for _, v in ts.series("tt_total")]
+        assert cs == sorted(cs)                     # monotone
+        doc = ts.to_doc()
+        assert obs.validate_series_doc(
+            json.loads(json.dumps(doc))) == []
+        obs.reset()
+
+    def test_reset_stops_sampler_and_flushes_series(self, tmp_path):
+        """ISSUE 15 small fix: reset() must stop tracked sampler
+        threads and leave series_<name>.json in the run dir — a
+        leaked thread would keep sampling the fresh registry."""
+        obs.reset()
+        obs.configure(str(tmp_path))
+        obs.counter("x_total").inc(3)
+        ts = obs.MetricsTimeSeries(name="gwX", interval_s=0.005)
+        ts.start()
+        time.sleep(0.05)
+        thread = ts._thread
+        obs.reset()
+        assert not thread.is_alive()
+        path = tmp_path / "series_gwX.json"
+        assert path.exists()
+        doc = json.loads(path.read_text())
+        assert obs.validate_series_doc(doc) == []
+        assert any(k.startswith("x_total")
+                   for k in doc["metrics"])
+
+    def test_validator_catches_drift(self):
+        reg = obs.MetricsRegistry()
+        reg.counter("c_total").inc()
+        reg.histogram("h_ms", buckets=(1, 2))
+        clk = [0.0]
+        ts = obs.MetricsTimeSeries(name="v", registry=reg, capacity=4,
+                                   clock=lambda: clk[0])
+        for i in range(3):
+            clk[0] = float(i)
+            ts.sample()
+        good = json.loads(json.dumps(ts.to_doc(alerts=[
+            {"kind": "fire", "slo": "interactive", "rule": "page",
+             "t": 1.0}])))
+        assert obs.validate_series_doc(good) == []
+
+        def broken(mut):
+            d = json.loads(json.dumps(good))
+            mut(d)
+            return obs.validate_series_doc(d)
+
+        assert broken(lambda d: d.update(schema="series/0"))
+        assert broken(lambda d: d["metrics"]["c_total"]["samples"]
+                      .__setitem__(0, [0.0]))          # malformed
+        assert broken(lambda d: d["metrics"]["c_total"]
+                      .update(samples=[[0.0, 5.0], [1.0, 1.0]]))
+        assert broken(lambda d: d["metrics"]["h_ms"]["samples"][0]
+                      .__setitem__(3, [0]))            # bucket vector
+        assert broken(lambda d: d["alerts"][0].update(kind="page"))
+        # ring bound: more samples than capacity claims
+        assert broken(lambda d: d["metrics"]["c_total"].update(
+            samples=[[float(i), float(i)] for i in range(9)]))
+
+
+# ============================================================== burn rate
+def _burn(**kw):
+    base = dict(targets={"interactive": 0.9},
+                rules=(BurnRule("page", 5.0, 20.0, 2.0),),
+                clock=None)
+    base.update(kw)
+    clk = [0.0]
+    if base["clock"] is None:
+        base["clock"] = lambda: clk[0]
+    eng = BurnRateEngine(**base)
+    return eng, clk
+
+
+class TestBurnRate:
+    def test_fire_needs_both_windows_then_fires_once(self):
+        eng, clk = _burn()
+        # clean history fills the slow window
+        for i in range(20):
+            clk[0] = float(i)
+            assert eng.observe("interactive", True) == []
+        # a 2-sample bad blip: fast burn spikes but the SLOW window
+        # stays under threshold -> no page (the SRE "is it real" gate)
+        clk[0] = 20.0
+        eng.observe("interactive", False)
+        clk[0] = 20.5
+        eng.observe("interactive", False)
+        assert eng.burn_rate("interactive", 5.0) > 2.0
+        assert eng.burn_rate("interactive", 20.0) < 2.0
+        assert eng.active() == []
+        # sustained burn: both windows over -> exactly one fire
+        evs = []
+        for i in range(6):
+            clk[0] = 21.0 + i
+            evs += eng.observe("interactive", False)
+        fires = [e for e in evs if e["kind"] == "fire"]
+        assert len(fires) == 1
+        assert fires[0]["slo"] == "interactive"     # names the class
+        assert fires[0]["rule"] == "page"
+        assert fires[0]["burn_fast"] >= 2.0 \
+            and fires[0]["burn_slow"] >= 2.0
+        assert len(eng.active()) == 1
+        assert eng.fires_total == 1
+
+    def test_resolve_hysteresis_no_flap_in_dead_band(self):
+        eng, clk = _burn(resolve_frac=0.5)
+        for i in range(10):
+            clk[0] = float(i)
+            eng.observe("interactive", False)
+        assert len(eng.active()) == 1
+        # drift the fast burn into the dead band (threshold/2 ..
+        # threshold): still active — no resolve, no second fire
+        t = 10.0
+        for i in range(12):
+            t += 0.5
+            clk[0] = t
+            eng.observe("interactive", i % 4 == 0)   # mostly bad
+        assert len(eng.active()) == 1
+        assert eng.fires_total == 1
+        # clean traffic pushes fast burn under threshold/2 -> resolve
+        for i in range(30):
+            t += 0.5
+            clk[0] = t
+            eng.observe("interactive", True)
+        assert eng.active() == []
+        kinds = [a["kind"] for a in eng.alerts]
+        assert kinds == ["fire", "resolve"]          # no flap
+        assert eng.alerts[-1]["fired_t"] == eng.alerts[0]["t"]
+
+    def test_window_scale_knob_scales_fire_time(self):
+        times = {}
+        for scale in (1.0, 0.1):
+            eng, clk = _burn(window_scale=scale)
+            t, dt = 0.0, 0.1 * scale
+            fired = None
+            for i in range(600):
+                t += dt
+                clk[0] = t
+                for e in eng.observe("interactive", False):
+                    if e["kind"] == "fire" and fired is None:
+                        fired = t
+                if fired is not None:
+                    break
+            assert fired is not None
+            times[scale] = fired
+        # the same outcome pattern fires at 1/10 the wall time
+        assert times[0.1] == pytest.approx(times[1.0] * 0.1,
+                                           rel=0.05)
+
+    def test_gauges_flight_events_and_evaluate_heartbeat(self):
+        obs.reset()
+        eng, clk = _burn(labels={"gateway": "gwT"})
+        for i in range(10):
+            clk[0] = float(i)
+            eng.observe("interactive", False)
+        snap = obs.registry().snapshot()
+        key = ('slo_burn_rate{class="interactive",gateway="gwT",'
+               'window="5s"}')
+        assert key in snap and snap[key] > 2.0
+        fires = [e for e in obs.recorder().snapshot()
+                 if e["kind"] == "alert_fire"]
+        assert fires and fires[0]["slo"] == "interactive"
+        # traffic STOPS; the evaluate() heartbeat (the sampler hook)
+        # still resolves the alert once the window empties
+        clk[0] = 60.0
+        evs = eng.evaluate()
+        assert [e["kind"] for e in evs] == ["resolve"]
+        assert eng.snapshot()["burn"]["interactive"]["5s"] == 0.0
+        obs.reset()
+
+    @pytest.mark.slow
+    def test_multi_window_burn_sweep(self):
+        """Sweep seeded outcome streams x window scales x thresholds:
+        behavior is invariant to the scale knob (it stretches time,
+        not decisions — pinned on the full fire/resolve transition
+        sequence by event INDEX), and the first fire arrives monotone
+        later as the threshold rises (hysteresis makes raw fire
+        COUNTS non-monotone: a low threshold fires once and stays
+        active where a mid one flaps — that's by design)."""
+        for seed in range(4):
+            rng = random.Random(seed)
+            stream = [rng.random() < 0.7 for _ in range(400)]
+            by_scale = {}
+            # power-of-two scales + a binary-exact 0.25 step keep
+            # every window-boundary comparison exactly scale-
+            # equivariant (an accumulated 0.2*scale drifts in the
+            # last ulp and flips boundary events between scales)
+            for scale in (0.25, 1.0, 4.0):
+                runs = []
+                for thr in (1.0, 3.0, 9.0):
+                    eng, clk = _burn(
+                        rules=(BurnRule("r", 5.0, 15.0, thr),),
+                        window_scale=scale)
+                    t = 0.0
+                    transitions = []
+                    for i, ok in enumerate(stream):
+                        t += 0.25 * scale
+                        clk[0] = t
+                        for e in eng.observe("interactive", ok):
+                            transitions.append((i, e["kind"]))
+                    runs.append(tuple(transitions))
+                by_scale[scale] = runs
+                first_fire = [
+                    next((i for i, k in tr if k == "fire"),
+                         len(stream))
+                    for tr in runs]
+                assert first_fire == sorted(first_fire), \
+                    (seed, scale, first_fire)
+            assert by_scale[0.25] == by_scale[1.0] == by_scale[4.0], \
+                (seed, {s: [len(r) for r in v]
+                        for s, v in by_scale.items()})
+
+
+# ======================================================= gateway telemetry
+def _run(coro):
+    return asyncio.run(coro)
+
+
+class TestGatewayTelemetry:
+    def test_metricsz_endpoint_windowed_rates(self):
+        pt.seed(0)
+        eng = _engine()
+
+        async def run():
+            gw = Gateway(eng, sample_interval_s=0.02,
+                         slo_window_scale=0.01)
+            await gw.start()
+            for i in range(6):
+                st, _ = await _http(
+                    gw.port, "POST", "/v1/generate",
+                    json.dumps({"prompt": [1, 2, 3, 4, 5 + i],
+                                "max_new_tokens": 4,
+                                "stream": False}).encode())
+                assert st == 200
+            await asyncio.sleep(0.15)
+            st, payload = await _http(gw.port, "GET",
+                                      "/metricsz?window_s=30")
+            assert st == 200
+            doc = json.loads(payload)
+            assert doc["enabled"] and doc["window_s"] == 30.0
+            toks = [v for k, v in doc["metrics"].items()
+                    if k.startswith("gateway_tokens_total")]
+            assert toks and toks[0]["rate_per_s"] > 0
+            assert toks[0]["delta"] == 24.0          # 6 req x 4 toks
+            ttft = [v for k, v in doc["metrics"].items()
+                    if k.startswith("gateway_ttft_ms")]
+            assert ttft and ttft[0]["count"] == 6 \
+                and ttft[0]["p99"] >= ttft[0]["p50"] > 0
+            assert "slo" in doc and "burn" in doc["slo"]
+            # debugz carries the telemetry block
+            st, payload = await _http(gw.port, "GET", "/debugz")
+            tz = json.loads(payload)["telemetry"]
+            assert tz["sampler"]["running"] \
+                and tz["sampler"]["samples_taken"] > 0
+            await gw.drain()
+
+        _run(run())
+
+    def test_sampler_off_metricsz_disabled(self):
+        pt.seed(0)
+        eng = _engine()
+
+        async def run():
+            gw = Gateway(eng, sample_interval_s=None,
+                         slo_alerting=False)
+            await gw.start()
+            st, payload = await _http(gw.port, "GET", "/metricsz")
+            assert st == 200
+            assert json.loads(payload) == {"gateway": gw.name,
+                                           "enabled": False}
+            assert gw.debugz()["telemetry"] == {"sampler": None,
+                                                "slo": None}
+            await gw.drain()
+
+        _run(run())
+
+    def test_sampler_on_off_sse_streams_bitwise(self):
+        """THE off-the-hot-path pin: the full SSE byte stream (status
+        line, headers, every event) is identical with the telemetry
+        plane on vs off — sampling is pull-only and alerting is
+        host-side bookkeeping."""
+        payloads = [{"prompt": [1, 2, 3, 4, 5 + i],
+                     "max_new_tokens": 5, "stream": True,
+                     "request_id": f"bw-{i}"}
+                    for i in range(5)]
+
+        async def serve(telemetry):
+            pt.seed(0)
+            kw = dict(sample_interval_s=0.01,
+                      slo_window_scale=0.01) if telemetry else \
+                dict(sample_interval_s=None, slo_alerting=False)
+            gw = Gateway(_engine(), **kw)
+            await gw.start()
+            out = []
+            for p in payloads:
+                out.append(await _sse_raw(gw.port, p))
+            await gw.drain()
+            return out
+
+        on = _run(serve(True))
+        off = _run(serve(False))
+        assert on == off                              # bitwise
+
+    def test_steady_tick_dispatch_upload_pins_with_sampler(self):
+        """The ISSUE 6/14 steady-tick counters, re-pinned with a
+        sampler thread running: N ticks = N dispatches, 0 uploads,
+        0 bytes — the plane never touches the engine hot path."""
+        obs.reset()
+        # the test_fused_tick pin geometry: block_size 64 so no block-
+        # growth transition lands inside the measured steady window
+        eng = PagedEngine(TickStubModel(), max_slots=4,
+                          num_blocks=256, block_size=64,
+                          max_blocks_per_seq=8,
+                          prefill_buckets=(16,))
+        ts = obs.MetricsTimeSeries(name="pin", interval_s=0.001)
+        ts.start()
+        try:
+            for i in range(4):
+                eng.submit(f"r{i}", np.arange(1, 9)[None],
+                           max_new_tokens=120)
+            for _ in range(6):
+                eng.step()
+            d0, u0 = eng.dispatch_count, eng.h2d_uploads
+            b0 = eng.h2d_upload_bytes
+            n = 20
+            for _ in range(n):
+                eng.step()
+            assert eng.dispatch_count - d0 == n
+            assert eng.h2d_uploads - u0 == 0
+            assert eng.h2d_upload_bytes - b0 == 0
+            assert ts.samples_taken > 0               # it really ran
+        finally:
+            ts.stop()
+            obs.reset()
+
+    def test_slo_alert_fires_in_gateway_and_flight_recorder(self):
+        """Deterministic alert e2e: slow_ttft_ms=0 makes every
+        interactive request an SLO miss — the burn alert MUST fire,
+        name the class, land in the flight recorder and ride the
+        drained series file."""
+        obs.reset()
+        pt.seed(0)
+        eng = _engine()
+
+        async def run(tmp):
+            obs.configure(tmp)
+            gw = Gateway(eng, sample_interval_s=0.02,
+                         slo_window_scale=0.01, slow_ttft_ms=0.0)
+            await gw.start()
+            for i in range(8):
+                st, _ = await _http(
+                    gw.port, "POST", "/v1/generate",
+                    json.dumps({"prompt": [1, 2, 3, 4, 5 + i],
+                                "max_new_tokens": 4,
+                                "stream": False}).encode())
+                assert st == 200
+            await asyncio.sleep(0.2)
+            snap = gw._slo.snapshot()
+            assert snap["fires_total"] >= 1
+            assert [a for a in snap["active"]
+                    if a["slo"] == "interactive"]
+            assert snap["peak_burn"]["interactive"] >= 10.0
+            await gw.drain()
+            return gw.name
+
+        import tempfile
+        with tempfile.TemporaryDirectory() as tmp:
+            name = _run(run(tmp))
+            fires = [e for e in obs.recorder().snapshot()
+                     if e["kind"] == "alert_fire"]
+            assert fires and fires[0]["slo"] == "interactive"
+            series = os.path.join(tmp, f"series_{name}.json")
+            assert os.path.exists(series)
+            with open(series) as f:
+                doc = json.load(f)
+            assert obs.validate_series_doc(doc) == []
+            assert any(a["kind"] == "fire" and
+                       a["slo"] == "interactive"
+                       for a in doc["alerts"])
+            burn = [k for k in doc["metrics"]
+                    if k.startswith("slo_burn_rate")]
+            assert burn                                # trajectory too
+        obs.reset()
+
+
+# ============================================================== federation
+class TestFederation:
+    def test_frontend_federated_metricsz_and_staleness(self):
+        """N real gateways -> RemoteReplica caches -> ONE federated
+        /metricsz with per-replica sections + fleet totals; a peer
+        whose cache goes stale drops out of the totals (the routing
+        staleness bound, reused)."""
+        from paddle_tpu.serving.fleet import FleetFrontend
+        pt.seed(0)
+        engines = [_engine(), _engine()]
+
+        async def run():
+            gws = [Gateway(engines[i], name=f"fgw{i}",
+                           sample_interval_s=0.02,
+                           slo_window_scale=0.01)
+                   for i in range(2)]
+            for gw in gws:
+                await gw.start()
+            for i, gw in enumerate(gws):
+                for j in range(4):
+                    st, _ = await _http(
+                        gw.port, "POST", "/v1/generate",
+                        json.dumps({"prompt": [1, 2, 3, 4,
+                                               5 + i * 10 + j],
+                                    "max_new_tokens": 4,
+                                    "stream": False}).encode())
+                    assert st == 200
+            await asyncio.sleep(0.1)
+            fake = [0.0]
+            peers = [RemoteReplica(f"peer{i}", "127.0.0.1",
+                                   gws[i].port, stale_after_s=2.0,
+                                   clock=lambda: fake[0])
+                     for i in range(2)]
+            fe = FleetFrontend(peers, chunk_tokens=8, name="fedfe")
+            for p in peers:
+                p.stop()         # deterministic: manual refresh only
+                # refresh probes the gateways over HTTP — run it off
+                # the loop thread the gateways answer on
+                assert await asyncio.to_thread(p.refresh)
+            await fe.start()
+            # the federated doc over HTTP, per-replica labeled
+            st, payload = await _http(fe.port, "GET",
+                                      "/metricsz?window_s=60")
+            assert st == 200
+            doc = json.loads(payload)
+            assert set(doc["replicas"]) == {"peer0", "peer1"}
+            assert doc["live_peers"] == 2
+            for name, mz in doc["replicas"].items():
+                assert not mz["stale"]
+                assert mz["doc"]["enabled"]
+                assert any(k.startswith("gateway_tokens_total")
+                           for k in mz["doc"]["metrics"])
+            # totals: both peers' token rates summed — counting ONLY
+            # each peer's own gateway="<name>" variant. The two
+            # gateways share this process's registry, so each sampler
+            # carries the OTHER gateway's series too (pinned below);
+            # folding every variant would double-count the fleet.
+            assert doc["totals"]["tokens_per_sec"] > 0
+            expect = 0.0
+            for name, mz in doc["replicas"].items():
+                own = mz["doc"]["gateway"]
+                for full, view in mz["doc"]["metrics"].items():
+                    if (full.startswith("gateway_tokens_total")
+                            and f'gateway="{own}"' in full):
+                        expect += view["rate_per_s"]
+            assert doc["totals"]["tokens_per_sec"] == \
+                pytest.approx(expect, abs=1e-3)
+            assert any('gateway="fgw1"' in k for k in
+                       doc["replicas"]["peer0"]["doc"]["metrics"])
+            assert "burn_rate_max" in doc["totals"]
+            # staleness: advance the peers' injected clock past the
+            # bound WITHOUT refreshing — excluded from totals
+            fake[0] = 10.0
+            doc2 = fe.metricsz()
+            assert doc2["live_peers"] == 0
+            assert doc2["totals"]["tokens_per_sec"] == 0.0
+            assert all(mz["stale"]
+                       for mz in doc2["replicas"].values())
+            # one refresh brings a peer back
+            assert await asyncio.to_thread(peers[0].refresh)
+            doc3 = fe.metricsz()
+            assert doc3["live_peers"] == 1
+            await fe.drain()
+            for gw in gws:
+                await gw.drain()
+
+        _run(run())
+
+    def test_remote_metricsz_failure_does_not_evict(self):
+        """A peer without the endpoint (or with its sampler off) must
+        stay healthy: live metrics are a lens, not a liveness
+        signal."""
+        pt.seed(0)
+
+        async def run():
+            gw = Gateway(_engine(), sample_interval_s=None,
+                         slo_alerting=False)
+            await gw.start()
+            peer = RemoteReplica("p0", "127.0.0.1", gw.port)
+            assert await asyncio.to_thread(peer.refresh)
+            assert peer.healthy()
+            mz = peer.metricsz()
+            # cached doc exists but reports enabled=False
+            assert mz["doc"] == {"gateway": gw.name,
+                                 "enabled": False}
+            await gw.drain()
+
+        _run(run())
+
+    @pytest.mark.slow
+    def test_fleet_federation_multiproc_e2e(self, tmp_path):
+        """Real replica SUBPROCESSES behind a frontend: the federated
+        /metricsz shows every process's windowed metrics and the
+        CI-scaled burn windows ride --slo-window-scale through
+        replica_main; drained replicas leave series_<gw>.json in the
+        run dir."""
+        from paddle_tpu.serving.fleet import (FleetFrontend,
+                                              LocalProcessManager)
+
+        async def run():
+            fe = FleetFrontend([], chunk_tokens=8, name="mpfe")
+            manager = LocalProcessManager(
+                fe, model="stub", chunk_tokens=8,
+                probe_interval_s=0.1, stale_after_s=2.0,
+                extra_args=["--run-dir", str(tmp_path),
+                            "--slo-window-scale", "0.01"])
+            try:
+                for _ in range(2):
+                    manager.spawn()
+                await fe.start()
+                for i in range(8):
+                    st, _ = await _http(
+                        fe.port, "POST", "/v1/generate",
+                        json.dumps({"prompt": [1, 2, 3, 4, 5 + i],
+                                    "max_new_tokens": 4,
+                                    "stream": False}).encode())
+                    assert st == 200
+                await asyncio.sleep(0.6)   # a probe round + samples
+                st, payload = await _http(fe.port, "GET",
+                                          "/metricsz?window_s=60")
+                doc = json.loads(payload)
+                assert st == 200 and doc["live_peers"] == 2
+                assert doc["totals"]["tokens_per_sec"] > 0
+                for mz in doc["replicas"].values():
+                    assert mz["doc"]["enabled"]
+                    assert mz["doc"]["slo"]["window_scale"] == 0.01
+                await fe.drain()
+            finally:
+                manager.stop_all()
+
+        _run(run())
+        series = [p for p in os.listdir(tmp_path)
+                  if p.startswith("series_")]
+        assert len(series) >= 2           # one trajectory per process
+        for p in series:
+            with open(tmp_path / p) as f:
+                assert obs.validate_series_doc(json.load(f)) == []
+
+
+# ====================================================== windowed autoscale
+class _FakePeer:
+    def __init__(self):
+        self.sig = {}
+
+    def signals(self):
+        return dict(self.sig)
+
+
+class _FakeManager:
+    name = "t"
+
+    def __init__(self):
+        self.peers = [_FakePeer()]
+        self.ups = self.downs = 0
+
+    def replicas(self):
+        return self.peers
+
+    def pending(self):
+        return 0
+
+    def scale_up(self):
+        self.ups += 1
+
+    def scale_down(self):
+        self.downs += 1
+
+
+def _drive(mode, trace, **kw):
+    obs.reset()
+    mgr = _FakeManager()
+    base = dict(min_replicas=1, max_replicas=8, up_queue_depth=2.0,
+                down_load_frac=0.25, hold_s=0.5, hold_down_s=0.5,
+                cooldown_s=0.5, signal_mode=mode, signal_window_s=2.0,
+                clock=lambda: 0.0)
+    base.update(kw)
+    sc = FleetAutoscaler(mgr, **base)
+    actions = []
+    for i, (qd, used) in enumerate(trace):
+        mgr.peers[0].sig = {
+            "healthy": True, "queue_depth": qd,
+            "free_slots": 4 - used, "total_slots": 4,
+            "block_pool_free_frac": 0.5, "goodput_frac": 1.0,
+            "load": float(used)}
+        actions.append(sc.step(now=i * 0.25)["action"])
+    return mgr, actions, sc
+
+
+class TestWindowedAutoscaler:
+    def test_invalid_mode_raises(self):
+        with pytest.raises(ValueError):
+            FleetAutoscaler(_FakeManager(), signal_mode="psychic")
+
+    def test_parity_with_instant_on_steady_traffic(self):
+        """Constant signals make the window mean equal the instant
+        sample — decision-for-decision identical action traces."""
+        for qd, used in ((6, 4), (0, 0), (1, 2)):
+            trace = [(qd, used)] * 16
+            mi, ai, _ = _drive("instant", trace)
+            mw, aw, _ = _drive("windowed", trace)
+            assert ai == aw, (qd, used, ai, aw)
+            assert (mi.ups, mi.downs) == (mw.ups, mw.downs)
+
+    def test_strictly_fewer_scale_events_on_seeded_noisy_trace(self):
+        """The flap demonstration: a seeded oscillating trace (1s hot
+        with full slots + queue, 1s idle, jittered phase lengths)
+        makes the instant controller ride every swing while the
+        window mean sits in the hysteresis dead band."""
+        rng = random.Random(3)
+        trace = []
+        for _ in range(15):
+            trace += [(0, 0)] * (4 + rng.randrange(-1, 2))
+            trace += [(3, 4)] * (4 + rng.randrange(-1, 2))
+        mi, ai, _ = _drive("instant", trace)
+        mw, aw, sc = _drive("windowed", trace)
+        inst_events = mi.ups + mi.downs
+        wind_events = mw.ups + mw.downs
+        assert inst_events >= 5                  # instant flaps
+        assert wind_events < inst_events         # strictly fewer
+        assert sc.snapshot()["signal_mode"] == "windowed"
+        obs.reset()
+
+    def test_windowed_still_scales_on_sustained_pressure(self):
+        """Smoothing must not deafen the controller: a genuine
+        sustained overload scales up in BOTH modes."""
+        trace = [(0, 0)] * 8 + [(6, 4)] * 24
+        mi, _, _ = _drive("instant", trace)
+        mw, _, _ = _drive("windowed", trace)
+        assert mi.ups >= 1 and mw.ups >= 1
+        obs.reset()
+
+
+# ================================================================= loadgen
+def _load_loadgen():
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "serve_loadgen.py")
+    spec = importlib.util.spec_from_file_location("serve_loadgen2",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _loadgen_ns(**kw):
+    base = dict(requests=8, rate=60.0, share_frac=0.5, sys_tokens=8,
+                tail_tokens=4, max_new=6, interactive_frac=1.0,
+                ttft_slo_ms=5000.0, timeout_s=60.0, tenants=2,
+                replicas=1, policy="prefix", max_queue=256,
+                model="stub", seed=0, url=None, out="",
+                telemetry="on", slo_windows=0.02)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+class TestLoadgenTelemetry:
+    def test_rung_records_trajectory_and_burn_state(self):
+        """ISSUE 15 satellite: the rung banks the windowed tok/s
+        trajectory, the alert log and the peak burn rate — and
+        --telemetry off reproduces the bare rung."""
+        slg = _load_loadgen()
+        rung = asyncio.run(slg.run_loadgen(_loadgen_ns()))
+        assert rung["completed"] == 8
+        assert rung["telemetry"] == "on" \
+            and rung["slo_windows"] == 0.02
+        traj = rung["tok_s_trajectory"]
+        assert traj["points"] and traj["peak"] > 0
+        assert traj["peak"] >= traj["mean"]
+        assert isinstance(rung["alerts"], list)
+        assert rung["peak_burn_rate"] >= 0.0
+        off = asyncio.run(slg.run_loadgen(
+            _loadgen_ns(telemetry="off")))
+        assert off["completed"] == 8 and off["telemetry"] == "off"
+        assert "tok_s_trajectory" not in off
+        assert "alerts" not in off
+
+    @pytest.mark.slow
+    @pytest.mark.chaos
+    def test_chaos_alert_loadgen_e2e(self):
+        """THE ISSUE 15 acceptance run: a seeded chaos run (replica
+        hang mid-run — the watchdog's dispatch-to-drain stall is the
+        TTFT spike) deterministically fires a burn-rate alert naming
+        the interactive class, the alert lands in the rung AND the
+        flight recorder, the bitwise replay gate still passes, and
+        the same seeds with the plane disabled reproduce a clean
+        alert-free run."""
+        slg = _load_loadgen()
+        ns = _loadgen_ns(requests=24, rate=40.0, replicas=3,
+                         max_new=6, interactive_frac=0.7,
+                         chaos=True, chaos_kills=2,
+                         chaos_mode="hang", failover_budget=2,
+                         watchdog_timeout_s=0.5,
+                         goodput_floor=0.95, slo_windows=0.02)
+        obs.reset()
+        rung = asyncio.run(slg.run_loadgen(ns))
+        assert rung["chaos"]["ok"], rung["chaos"]
+        fired = [a for a in rung["alerts"] if a["kind"] == "fire"]
+        assert fired, "chaos hang did not fire a burn alert"
+        assert any(a["slo"] == "interactive" for a in fired)
+        assert rung["peak_burn_rate"] > 1.0
+        flight = [e for e in obs.recorder().snapshot()
+                  if e["kind"] == "alert_fire"]
+        assert flight and flight[0]["slo"] == "interactive"
+        # plane off: same seeds, same gate, no alert machinery
+        obs.reset()
+        off = asyncio.run(slg.run_loadgen(
+            _loadgen_ns(requests=24, rate=40.0, replicas=3,
+                        max_new=6, interactive_frac=0.7,
+                        chaos=True, chaos_kills=2,
+                        chaos_mode="hang", failover_budget=2,
+                        watchdog_timeout_s=0.5,
+                        goodput_floor=0.95, telemetry="off")))
+        assert off["chaos"]["ok"]
+        assert "alerts" not in off
+        assert not [e for e in obs.recorder().snapshot()
+                    if e["kind"].startswith("alert_")]
+        obs.reset()
+
+
+# ================================================================== dash
+class TestFleetDash:
+    def _load(self):
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "tools", "fleet_dash.py")
+        spec = importlib.util.spec_from_file_location("fleet_dash",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_render_series_doc_with_alert_markers(self):
+        dash = self._load()
+        reg = obs.MetricsRegistry()
+        c = reg.counter("gateway_tokens_total", gateway="gwD")
+        g = reg.gauge("gateway_queue_depth", gateway="gwD")
+        b = reg.gauge("slo_burn_rate", **{"class": "interactive",
+                                          "window": "5s"})
+        clk = [0.0]
+        ts = obs.MetricsTimeSeries(name="gwD", registry=reg,
+                                   capacity=128,
+                                   clock=lambda: clk[0])
+        for i in range(20):
+            clk[0] = float(i)
+            c.inc(10 if i < 10 else 40)
+            g.set(i % 4)
+            b.set(0.0 if i < 15 else 12.0)
+            ts.sample()
+        doc = json.loads(json.dumps(ts.to_doc(alerts=[
+            {"kind": "fire", "slo": "interactive", "rule": "page",
+             "t": 15.0, "burn_fast": 12.0}])))
+        docs = {"gwD": doc}
+        out = dash.render(docs, dash.collect_events(docs, []),
+                          width=40)
+        assert "gwD" in out and "tok/s" in out and "burn" in out
+        assert "alert_fire" in out and "!" in out
+        # the rate series really derives: peak tok/s ~40/s
+        pts = dash.counter_rate_points(
+            doc["metrics"]['gateway_tokens_total{gateway="gwD"}']
+            ["samples"])
+        assert max(r for _, r in pts) == pytest.approx(40.0)
+
+    def test_sparkline_and_resample(self):
+        dash = self._load()
+        assert len(dash.sparkline([1, 2, 3, None, 5])) == 5
+        assert dash.sparkline([0, 0, 0]) == "▁▁▁"
+        vals = dash.resample([(0.0, 1.0), (1.0, 3.0), (9.0, 5.0)],
+                             0.0, 10.0, 5)
+        assert vals[0] == 2.0 and vals[4] == 5.0
+        assert vals[2] is None

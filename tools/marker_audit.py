@@ -136,6 +136,16 @@ MUST_BE_SLOW = (
     # (tier-1 keeps the single-combination transition-matrix, scoped-
     # drain and upload-counter pins in test_delta_transitions.py)
     r"test_delta_transitions\.py.*parity_sweep",
+    # ISSUE 15: the multi-window burn-rate sweep (seeded outcome
+    # streams x window scales x thresholds), the multi-PROCESS fleet
+    # federation e2e (real replica subprocesses, cold jax import
+    # each), and the chaos-alert loadgen e2e (full chaos harness run
+    # + bitwise replay). Tier-1 keeps the injected-clock burn units,
+    # the in-process federation pin and the sampler-on/off bitwise
+    # stream pins in test_telemetry.py.
+    r"test_telemetry\.py.*burn_sweep",
+    r"test_telemetry\.py.*multiproc",
+    r"test_telemetry\.py.*chaos",
     r"test_vision_models\.py.*(forward_and_grad|bottleneck_variant"
     r"|grad_through_both_towers)",
     r"TestDeepseekV2Parity.*logits_match_torch",

@@ -44,6 +44,9 @@ TIMELINE_KINDS = (
     "crash", "prefetch_stall", "ckpt_save", "ckpt_restore",
     "ckpt_committed", "eval", "elastic_child_launch",
     "elastic_child_exit", "serve_reject", "serve_preempt",
+    # SLO burn-rate incidents (ISSUE 15): the flight recorder holds
+    # them beside the replica failures that caused them
+    "alert_fire", "alert_resolve",
 )
 
 
@@ -374,6 +377,69 @@ def self_check() -> int:
                and by_id["chk-slow"]["prefill_ms"] == 29.9
                and by_id["chk-slow"]["first_tick_ms"] == 40.0,
                "attribution decomposition wrong")
+
+        # time-series + alert-log document (ISSUE 15): write one with
+        # the library (injected clock — rate derivation is PINNED to
+        # exact values), round-trip through JSON, re-validate with the
+        # same checker fleet_dash's loader runs
+        from paddle_tpu.serving.slo import BurnRateEngine, BurnRule
+        from paddle_tpu.utils.observability import (MetricsTimeSeries,
+                                                    validate_series_doc)
+        sreg2 = obs.MetricsRegistry()
+        tok = sreg2.counter("toks_total")
+        q = sreg2.gauge("queue")
+        lat = sreg2.histogram("lat_ms", buckets=(1, 2, 5))
+        clk = [0.0]
+        ts = MetricsTimeSeries(name="chk", registry=sreg2,
+                               interval_s=1.0, capacity=4,
+                               clock=lambda: clk[0])
+        for i in range(6):
+            clk[0] = float(i)
+            tok.inc(5)
+            q.set(i)
+            lat.observe(1.5)
+            ts.sample()
+        expect(len(ts.series("toks_total")) == 4,
+               "series ring bound not enforced")
+        w = ts.window(3.0, now=5.0)
+        expect(w["toks_total"]["rate_per_s"] == 5.0,
+               "counter rate derivation drifted "
+               f"(got {w['toks_total']['rate_per_s']})")
+        expect(w["queue"]["mean"] == 3.5,
+               "gauge window mean drifted")
+        expect(w["lat_ms"]["p50"] == 1.5 and w["lat_ms"]["count"] == 3,
+               "windowed histogram quantile drifted")
+        bclk = [0.0]
+        beng = BurnRateEngine(targets={"interactive": 0.9},
+                              rules=(BurnRule("page", 5.0, 20.0,
+                                              2.0),),
+                              clock=lambda: bclk[0])
+        for i in range(20):
+            bclk[0] = float(i)
+            beng.observe("interactive", True)
+        for i in range(5):
+            bclk[0] = 20.0 + i
+            beng.observe("interactive", False)
+        for i in range(40):
+            bclk[0] = 26.0 + i
+            beng.observe("interactive", True)
+        kinds_seq = [a["kind"] for a in beng.alerts]
+        expect(kinds_seq == ["fire", "resolve"],
+               f"burn-rate fire/resolve sequence drifted: {kinds_seq}")
+        series_path = os.path.join(run, "series_chk.json")
+        ts.dump(series_path, alerts=beng.alerts)
+        with open(series_path) as f:
+            series_doc = json.load(f)
+        problems = validate_series_doc(series_doc)
+        expect(not problems,
+               f"time-series schema drift: {problems[:3]}")
+        expect(series_doc["alerts"][0]["slo"] == "interactive",
+               "alert log lost the SLO class")
+        broken = json.loads(json.dumps(series_doc))
+        broken["metrics"]["toks_total"]["samples"][0][1] = 1e9
+        expect(any("regressed" in p
+                   for p in validate_series_doc(broken)),
+               "counter regression not caught by the validator")
 
         s = summarize(run)
         expect(s["steps_recorded"] == 5, "step_end events lost")

@@ -31,6 +31,15 @@ fresh reference engine, and the run fails (nonzero exit) on any
 corrupted stream, on 5xx counts beyond the retry-budget bound, or on
 a completed fraction below ``--goodput-floor`` (docs/SERVING.md).
 
+Telemetry (ISSUE 15): the self-hosted gateways run the time-series
+sampler + SLO burn-rate alerting by default, and the rung banks the
+fired-alert log, the peak burn rate per class and the windowed tok/s
+trajectory summary — so bench.py trend lines capture SLO health, not
+just end-of-run throughput. ``--slo-windows 0.01`` scales the burn
+windows down so a CI-length run can fire (a chaos kill's TTFT spike
+deterministically trips the interactive class); ``--telemetry off``
+is the A/B reference reproducing the pre-plane gateway bitwise.
+
 ``--churn`` (ISSUE 14) swaps in a transition-heavy mix — short,
 staggered per-request budgets so replica slots finish and readmit
 every few ticks — and the rung records ``full_rebuilds`` /
@@ -223,6 +232,17 @@ def _build_gateway(ns):
         getattr(ns, "delta", "on") == "on"
 
     chaos = bool(getattr(ns, "chaos", False))
+    # telemetry plane (ISSUE 15): sampler + burn-rate alerting default
+    # ON (host-side, pinned harmless); --telemetry off is the A/B
+    # reference that reproduces the pre-plane gateway exactly.
+    # --slo-windows scales the burn windows so a CI-length run can
+    # fire (and resolve) real alerts.
+    if getattr(ns, "telemetry", "on") == "on":
+        gw_telemetry_kw = dict(
+            slo_window_scale=getattr(ns, "slo_windows", 1.0))
+    else:
+        gw_telemetry_kw = dict(sample_interval_s=None,
+                               slo_alerting=False)
 
     def engine_factory():
         eng = PagedEngine(_model(), **engine_kw)
@@ -240,7 +260,8 @@ def _build_gateway(ns):
         return eng
 
     engines = [engine_factory() for _ in range(ns.replicas)]
-    gw_kw = dict(routing=ns.policy, max_queue=ns.max_queue)
+    gw_kw = dict(routing=ns.policy, max_queue=ns.max_queue,
+                 **gw_telemetry_kw)
     if chaos:
         # fast-recovery supervision knobs sized for a short chaos run:
         # sub-second watchdog + breaker backoff so kills, failovers
@@ -284,8 +305,18 @@ def _build_fleet(ns):
     if trace_dir:
         # peer gateways dump their reqtrace rings here on SIGTERM
         # drain — the multi-run-dir input trace_report's fleet merge
-        # joins with the frontend's own ring by request id
+        # joins with the frontend's own ring by request id (ISSUE 15:
+        # their series_<gw>.json trajectories land beside them)
         extra += ["--run-dir", trace_dir]
+    if getattr(ns, "telemetry", "on") == "on":
+        # thread the CI-speed burn windows into the replica PROCESSES
+        # so their engines can fire alerts inside a short run; the
+        # frontend's federated /metricsz surfaces them (ISSUE 15)
+        scale = getattr(ns, "slo_windows", 1.0)
+        if scale != 1.0:
+            extra += ["--slo-window-scale", str(scale)]
+    else:
+        extra += ["--telemetry", "off"]
     manager = LocalProcessManager(
         fe, model=ns.model if ns.model in ("stub", "tiny") else "stub",
         chunk_tokens=chunk, extra_args=extra,
@@ -301,12 +332,44 @@ def _build_fleet(ns):
                                  max(ns.fleet, 2)),
             up_queue_depth=1.0, hold_s=0.3, hold_down_s=1.5,
             cooldown_s=getattr(ns, "autoscale_cooldown_s", 3.0),
-            interval_s=0.1)
+            interval_s=0.1,
+            signal_mode=getattr(ns, "autoscale_mode", "windowed"),
+            signal_window_s=getattr(ns, "autoscale_window_s", 1.0))
         fe.attach_autoscaler(scaler)
     return fe, manager, scaler
 
 
 # ------------------------------------------------------------------- run
+def _tok_trajectory(sampler, base="gateway_tokens_total",
+                    max_points=24):
+    """Windowed tok/s trajectory summary (ISSUE 15 satellite): the
+    sampled cumulative token counters (summed across label variants)
+    differenced into a rate series, downsampled to <= max_points —
+    the shape bench.py trend lines can carry so a rung records HOW
+    the run served, not just its end-of-run mean."""
+    import math as _math
+    by_t = {}
+    for name in sampler.names():
+        if name.split("{", 1)[0] != base:
+            continue
+        for t, v in sampler.series(name):
+            by_t[t] = by_t.get(t, 0.0) + v
+    pts = sorted(by_t.items())
+    rates = [(b[0], (b[1] - a[1]) / (b[0] - a[0]))
+             for a, b in zip(pts, pts[1:]) if b[0] > a[0]]
+    if not rates:
+        return None
+    t0 = pts[0][0]
+    stride = max(1, _math.ceil(len(rates) / max_points))
+    return {
+        "points": [[round(t - t0, 2), round(r, 1)]
+                   for t, r in rates[::stride]],
+        "peak": round(max(r for _, r in rates), 1),
+        "mean": round(sum(r for _, r in rates) / len(rates), 1),
+        "samples": len(rates),
+    }
+
+
 def _pct(sorted_vals, q):
     if not sorted_vals:
         return 0.0
@@ -350,6 +413,15 @@ async def run_loadgen(ns) -> dict:
         gw, engines, engine_factory = _build_gateway(ns)
         await gw.start()
         targets = [(gw.host, gw.port)]
+    # fleet-mode trajectory (ISSUE 15): the frontend's own proxied-
+    # token counter lives in THIS process's registry — a local sampler
+    # over it yields the fleet tok/s series the rung banks (replica-
+    # side series land in --trace-dir as series_<gw>.json on drain)
+    local_sampler = None
+    if fe is not None and getattr(ns, "telemetry", "on") == "on":
+        from paddle_tpu.utils import observability as obs
+        local_sampler = obs.MetricsTimeSeries(
+            name="loadgen", interval_s=0.2, capacity=1024).start()
     host, port = targets[0]
     # chaos schedule (ISSUE 12): seeded kill/hang points spread evenly
     # over the request stream — deterministic per (--seed,
@@ -539,7 +611,23 @@ async def run_loadgen(ns) -> dict:
         "churn": bool(getattr(ns, "churn", False)),
         "targets": len(targets),
         "diurnal": bool(getattr(ns, "diurnal", False)),
+        "telemetry": getattr(ns, "telemetry", "on"),
+        "slo_windows": getattr(ns, "slo_windows", 1.0),
     }
+    # SLO health in the rung (ISSUE 15 satellite): fired alerts, peak
+    # burn and the windowed tok/s trajectory, so bench.py trend lines
+    # capture how the run served — not just its end-of-run throughput
+    if gw is not None and gw.sampler is not None:
+        traj = _tok_trajectory(gw.sampler)
+        if traj is not None:
+            rung["tok_s_trajectory"] = traj
+    if gw is not None and gw._slo is not None:
+        snap = gw._slo.snapshot()
+        rung["alerts"] = list(gw._slo.alerts)
+        rung["alerts_fired"] = snap["fires_total"]
+        rung["peak_burn_rate"] = max(
+            snap["peak_burn"].values(), default=0.0)
+        rung["peak_burn_by_class"] = snap["peak_burn"]
     if engines is not None and getattr(ns, "ring", "on") == "on":
         rung["ring_drains"] = sum(e.ring_drains for e in engines)
         rung["ring_blocking_drains"] = sum(e.ring_blocking_drains
@@ -620,11 +708,40 @@ async def run_loadgen(ns) -> dict:
                 "scale_downs": snap["scale_downs"],
                 "min_replicas": snap["min_replicas"],
                 "max_replicas": snap["max_replicas"],
+                "signal_mode": snap["signal_mode"],
+                "signal_window_s": snap["signal_window_s"],
                 "events": snap["events"],
             }
         trace_dir = getattr(ns, "trace_dir", None)
         if trace_dir:
             rung["trace_rings"] = fe.dump_traces(trace_dir)
+        if local_sampler is not None:
+            # fleet SLO health (ISSUE 15): the frontend-side tok/s
+            # trajectory plus the peers' federated burn/alert state,
+            # read off the SAME probe caches /metricsz serves
+            local_sampler.stop()
+            traj = _tok_trajectory(local_sampler,
+                                   base="fleet_proxied_tokens_total")
+            if traj is not None:
+                rung["tok_s_trajectory"] = traj
+            recent = []
+            peak = {}
+            total_fires = 0
+            for peer, cache in fe.metricsz()["replicas"].items():
+                slo = (cache.get("doc") or {}).get("slo") or {}
+                recent += [dict(a, peer=peer)
+                           for a in slo.get("alerts", ())]
+                # fires_total is the UNTRUNCATED count — the peers'
+                # snapshot "alerts" field is only the recent tail, so
+                # counting fires off it would undercount alert-heavy
+                # runs (and disagree with single-gateway mode)
+                total_fires += int(slo.get("fires_total", 0))
+                for cls, v in (slo.get("peak_burn") or {}).items():
+                    peak[cls] = max(peak.get(cls, 0.0), v)
+            rung["alerts"] = recent
+            rung["alerts_fired"] = total_fires
+            rung["peak_burn_rate"] = max(peak.values(), default=0.0)
+            rung["peak_burn_by_class"] = peak
         if ns.model == "stub":
             rung["fleet_gate"] = _verify_fleet(ns, hz, records,
                                                fleet_kill_events)
@@ -781,6 +898,17 @@ def main(argv=None) -> int:
     ap.add_argument("--goodput-floor", type=float, default=0.95,
                     help="minimum completed-request fraction the "
                          "chaos run must clear")
+    ap.add_argument("--slo-windows", type=float, default=1.0,
+                    help="scale the burn-rate alert windows (ISSUE "
+                         "15): 1.0 = production-shaped (60s/300s "
+                         "page pair), 0.01 lets a CI-length run fire "
+                         "and resolve real alerts")
+    ap.add_argument("--telemetry", default="on",
+                    choices=("on", "off"),
+                    help="time-series sampler + burn-rate alerting "
+                         "on the gateways (off = the pre-ISSUE-15 "
+                         "snapshot-only stack, the bitwise A/B "
+                         "reference)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--url", action="append", default=None,
                     help="attach to HOST:PORT instead of self-hosting "
@@ -808,6 +936,12 @@ def main(argv=None) -> int:
     ap.add_argument("--autoscale-min", type=int, default=1)
     ap.add_argument("--autoscale-max", type=int, default=4)
     ap.add_argument("--autoscale-cooldown-s", type=float, default=3.0)
+    ap.add_argument("--autoscale-mode", default="windowed",
+                    choices=("windowed", "instant"),
+                    help="decision signals: windowed means over "
+                         "--autoscale-window-s (ISSUE 15 default) vs "
+                         "the single-sample instant reference")
+    ap.add_argument("--autoscale-window-s", type=float, default=1.0)
     ap.add_argument("--out", default=OUT_DEFAULT,
                     help="rung file bench.py auto-ingests "
                          "('' disables the write)")
