@@ -572,6 +572,10 @@ def main():
         _ingest_rung(result, probe, "SERVE_FLEET_r13.json", "fleet",
                      "fleet_profile",
                      ("fleet_tokens_per_sec", "goodput_per_replica"))
+        _ingest_rung(result, probe, "FLEET_SIM_r16.json", "fleet_sim",
+                     "fleet_sim_profile",
+                     ("sim_decisions_per_sec", "alert_precision",
+                      "alert_recall"))
 
     # (c) always emit exactly one JSON line.
     if result is not None:

@@ -16,17 +16,32 @@ single-process gateway cannot reach (ROADMAP item 2).
   processes under SIGTERM-drain semantics.
 - :mod:`.manager` — :class:`LocalProcessManager`: the process backend
   (spawn ``replica_main`` subprocesses, SIGTERM drains, SIGKILL
-  chaos).
+  chaos); accepts a LIST of frontends (ISSUE 16 HA) — every sibling
+  gets its own adapter per spawned process.
+- :mod:`.ha` — :class:`FrontendLink`/:func:`link_frontends`:
+  leaderless frontend-to-frontend gossip (prefix digests, breaker
+  states, sticky assignments) so a frontend death loses no routing
+  state and a client retry against the survivor resumes mid-stream.
+- :mod:`.sim` — :class:`FleetSim`: the trace-driven chaos simulator
+  that runs THESE real objects (frontend, router, autoscaler, burn
+  engine, breakers) against thousands of in-process replica stubs on
+  a simulated clock (``tools/fleet_sim.py``).
 
 See ``docs/SERVING.md`` ("Fleet serving") and
-``docs/FAULT_TOLERANCE.md`` §4c (remote failure model).
+``docs/FAULT_TOLERANCE.md`` (remote + frontend failure models).
 """
 from .autoscaler import FleetAutoscaler
 from .frontend import FleetFrontend
+from .ha import FrontendLink, link_frontends
 from .manager import LocalProcessManager
 from .remote import RemoteReplica, prefix_digest_chain
+from .sim import (SCENARIOS, FleetSim, SimClock, SimProcess,
+                  SimReplica, build_scenario)
 
 __all__ = [
     "FleetAutoscaler", "FleetFrontend", "LocalProcessManager",
     "RemoteReplica", "prefix_digest_chain",
+    "FrontendLink", "link_frontends",
+    "FleetSim", "SimClock", "SimProcess", "SimReplica",
+    "SCENARIOS", "build_scenario",
 ]

@@ -75,6 +75,7 @@ class FleetAutoscaler:
                  interval_s: float = 0.25,
                  signal_mode: str = "windowed",
                  signal_window_s: float = 2.0,
+                 outage_freeze_frac: float = 0.5,
                  clock: Callable[[], float] = time.monotonic):
         """``signal_mode`` (ISSUE 15): ``"windowed"`` (default) bases
         every pressure comparison on the MEAN of each signal over the
@@ -104,6 +105,13 @@ class FleetAutoscaler:
         self.hold_down_s = float(hold_down_s)
         self.cooldown_s = float(cooldown_s)
         self.interval_s = float(interval_s)
+        # correlated mass-outage guard (ISSUE 16): when live peers
+        # drop to <= replicas * (1 - frac) the loop FREEZES instead of
+        # acting — survivors' low aggregate load during an outage is
+        # an artifact of excluded stale signals, and scaling down on
+        # it is the classic SRE failure. <= 0 disables the guard.
+        self.outage_freeze_frac = float(outage_freeze_frac)
+        self._frozen = False
         self._clock = clock
         self._up_since: Optional[float] = None
         self._down_since: Optional[float] = None
@@ -119,6 +127,8 @@ class FleetAutoscaler:
                                      **labels)
         self._c_up = reg.counter("fleet_scale_ups_total", **labels)
         self._c_down = reg.counter("fleet_scale_downs_total", **labels)
+        self._c_freeze = reg.counter("fleet_autoscale_freezes_total",
+                                     **labels)
 
     # ------------------------------------------------------------- signals
     def aggregate(self) -> Dict[str, Any]:
@@ -134,6 +144,7 @@ class FleetAutoscaler:
         return {
             "replicas": len(sigs),
             "live": n,
+            "stale": sum(1 for s in sigs if s.get("stale")),
             "pending": int(self.manager.pending()),
             "queue_depth": qd,
             "queue_depth_per_replica": qd / max(n, 1),
@@ -192,6 +203,31 @@ class FleetAutoscaler:
         n_eff = agg["live"] + agg["pending"]
         self._g_replicas.set(n_eff)
         action = None
+        # mass-outage freeze (ISSUE 16): a majority of peers stale at
+        # once is an OUTAGE, not low demand — the survivors' aggregate
+        # (stale peers excluded) would read as idle and trigger the
+        # classic scale-down-during-the-incident. Freeze every action,
+        # fire the alert event, and let recovery (or the operator)
+        # thaw the loop; hold windows reset so post-thaw decisions
+        # start from honest signals.
+        frozen = (self.outage_freeze_frac > 0.0
+                  and agg["replicas"] >= 2
+                  and agg["live"] <= agg["replicas"]
+                  * (1.0 - self.outage_freeze_frac))
+        if frozen != self._frozen:
+            self._frozen = frozen
+            ev = {"t": round(now, 3),
+                  "action": "freeze" if frozen else "thaw",
+                  "replicas_before": n_eff,
+                  "replicas": agg["replicas"], "live": agg["live"],
+                  "stale": agg.get("stale", 0)}
+            self.events.append(ev)
+            obs.record_event("fleet_autoscale_freeze", **ev)
+            if frozen:
+                self._c_freeze.inc()
+        if frozen:
+            self._up_since = self._down_since = None
+            return dict(agg, action=None, frozen=True)
         pressure_up = (
             agg["live"] > 0
             and (agg["queue_depth_per_replica"] > self.up_queue_depth
@@ -275,6 +311,9 @@ class FleetAutoscaler:
             "max_replicas": self.max_replicas,
             "scale_ups": int(self._c_up.value),
             "scale_downs": int(self._c_down.value),
+            "freezes": int(self._c_freeze.value),
+            "frozen": self._frozen,
+            "outage_freeze_frac": self.outage_freeze_frac,
             "replica_seconds": round(self.replica_seconds, 3),
             "cooldown_s": self.cooldown_s,
             "signal_mode": self.signal_mode,

@@ -112,7 +112,8 @@ class FleetFrontend:
                  breaker_backoff_max_s: float = 30.0,
                  breaker_probes: int = 1,
                  name: Optional[str] = None,
-                 trace: bool = True, trace_capacity: int = 512):
+                 trace: bool = True, trace_capacity: int = 512,
+                 clock=time.monotonic):
         self.name = name or f"fleet{next(_frontend_ids)}"
         self.host, self.port = host, port
         self.chunk_tokens = chunk_tokens
@@ -120,12 +121,21 @@ class FleetFrontend:
         self._peer_read_timeout_s = float(peer_read_timeout_s)
         self._peer_connect_timeout_s = float(peer_connect_timeout_s)
         self._breakers = bool(breakers)
+        # the whole control plane is clock-injectable (ISSUE 16): the
+        # fleet sim drives this frontend's breakers — and everything
+        # downstream of them — on a simulated clock
+        self._clock = clock
         self._breaker_kw = dict(backoff_s=breaker_backoff_s,
                                 backoff_max_s=breaker_backoff_max_s,
-                                probes_to_close=breaker_probes)
+                                probes_to_close=breaker_probes,
+                                clock=clock)
         self._draining = False
+        self._killed = False
         self._server: Optional[asyncio.AbstractServer] = None
         self._active = 0
+        # live client writers, tracked so kill() can sever them
+        # mid-stream (the in-process stand-in for a frontend SIGKILL)
+        self._writers: set = set()
         self.peers: List[RemoteReplica] = []
         self._labels = {"gateway": self.name}
         reg = obs.registry()
@@ -225,6 +235,30 @@ class FleetFrontend:
                 pass
             self._server = None
         obs.record_event("fleet_drain", fleet=self.name)
+
+    def kill(self):
+        """In-process stand-in for ``SIGKILL`` of this frontend
+        (ISSUE 16 HA tests): abort the listener and sever every live
+        client stream mid-flight WITHOUT draining — in-flight requests
+        die exactly as they would when the process dies, and clients
+        must recover by retrying against a surviving sibling frontend
+        with their committed prefix as ``resume_tokens``. Also stops
+        the probers and the autoscaler so the corpse stops probing."""
+        self._killed = True
+        self._draining = True
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        for w in list(self._writers):
+            try:
+                w.transport.abort()
+            except Exception:
+                pass
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        for p in list(self.peers):
+            p.stop()
+        obs.record_event("fleet_kill", fleet=self.name)
 
     def dump_traces(self, directory: str) -> List[str]:
         """Write the frontend's own request-trace ring (the fleet's
@@ -338,9 +372,58 @@ class FleetFrontend:
             },
         }
 
+    # ----------------------------------------------------- frontend HA
+    def gossipz(self) -> Dict[str, Any]:
+        """What a SIBLING frontend may adopt from us (ISSUE 16
+        leaderless HA; served at ``GET /gossipz`` over the same HTTP
+        transport the probers already ride). Three kinds of state:
+
+        - per-peer prefix digest sets + the PEER's generation counter
+          (authoritative — comparable across frontends, so the fresher
+          view always wins regardless of who probed last);
+        - sticky routing assignments as ``{digest: peer name}`` (a
+          sibling adopts only digests it has no opinion on);
+        - health + breaker state per peer as HINTS only — every
+          frontend re-derives liveness from its OWN probes (trusting a
+          sibling's verdict would let one partitioned frontend blind
+          the whole tier)."""
+        return {
+            "fleet": self.name,
+            "draining": self._draining,
+            "peers": {p.name: p.gossip_view() for p in self.peers},
+            "sticky": self._router.export_sticky(),
+        }
+
+    def apply_gossip(self, doc: Dict[str, Any]) -> Dict[str, int]:
+        """Merge a sibling's :meth:`gossipz` doc. Only ever ADDS
+        knowledge: digest sets move forward by generation guard,
+        sticky entries fill local gaps, and nothing a local probe or
+        route decision established is overridden. Unknown peer names
+        are skipped — membership changes travel through the manager,
+        not through gossip."""
+        by_name = {p.name: p for p in self.peers}
+        adopted_digests = 0
+        for name, view in (doc.get("peers") or {}).items():
+            peer = by_name.get(name)
+            if peer is None or not isinstance(view, dict):
+                continue
+            if peer.adopt_digests(view.get("digests") or (),
+                                  view.get("generation", -1)):
+                adopted_digests += 1
+        adopted_sticky = self._router.merge_sticky(
+            doc.get("sticky") or {}, by_name)
+        if adopted_digests or adopted_sticky:
+            obs.record_event("fleet_gossip_merge", fleet=self.name,
+                             source=doc.get("fleet", "?"),
+                             digest_sets=adopted_digests,
+                             sticky=adopted_sticky)
+        return {"digest_sets": adopted_digests,
+                "sticky": adopted_sticky}
+
     # ---------------------------------------------------------------- HTTP
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter):
+        self._writers.add(writer)
         try:
             line = await asyncio.wait_for(reader.readline(), 30)
             parts = line.decode("latin1").split()
@@ -383,6 +466,9 @@ class FleetFrontend:
                     200, obs.registry().prometheus_text().encode(),
                     ctype="text/plain; version=0.0.4"))
                 await writer.drain()
+            elif method == "GET" and path == "/gossipz":
+                writer.write(_json_response(200, self.gossipz()))
+                await writer.drain()
             elif method == "POST" and path == "/v1/generate":
                 self._active += 1
                 try:
@@ -397,6 +483,7 @@ class FleetFrontend:
                 ConnectionError, OSError):
             pass
         finally:
+            self._writers.discard(writer)
             try:
                 writer.close()
                 await writer.wait_closed()
@@ -750,6 +837,18 @@ class FleetFrontend:
                 seen += 1
                 if seen <= skip:
                     continue        # committed prefix replay: dedupe
+                if faults.inject("frontend_conn_drop",
+                                 frontend=self.name,
+                                 replica=replica.name):
+                    # the FRONTEND dies mid-stream (ISSUE 16 HA): the
+                    # client's connection is severed with the unit
+                    # unforwarded — the client holds only its committed
+                    # prefix and must resume against a sibling frontend
+                    try:
+                        writer.transport.abort()
+                    except Exception:
+                        pass
+                    return "client_gone"
                 if faults.inject("peer_conn_drop",
                                  replica=replica.name):
                     # sever the peer leg BEFORE forwarding: the unit

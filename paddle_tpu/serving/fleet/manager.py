@@ -46,8 +46,16 @@ class LocalProcessManager:
                  extra_args: Optional[List[str]] = None,
                  env: Optional[Dict[str, str]] = None,
                  log_dir: Optional[str] = None):
-        self.frontend = frontend
-        self.name = getattr(frontend, "name", "fleet")
+        # ISSUE 16 frontend HA: ``frontend`` may be a LIST — every
+        # frontend gets its OWN RemoteReplica adapter per spawned
+        # process (own probe thread, own breaker, own staleness
+        # clock), under the SAME peer name so gossiped sticky/digest
+        # state resolves across siblings. The first frontend is the
+        # primary: the autoscaler duck type reads its peer list.
+        self.frontends = list(frontend) if isinstance(
+            frontend, (list, tuple)) else [frontend]
+        self.frontend = self.frontends[0]
+        self.name = getattr(self.frontend, "name", "fleet")
         self.model = model
         self.chunk_tokens = int(chunk_tokens)
         self.engines_per_replica = int(engines_per_replica)
@@ -98,13 +106,21 @@ class LocalProcessManager:
         if not peers:
             return
         peer = min(peers, key=lambda p: p.load())
-        self.frontend.remove_peer(peer)
+        self._remove_everywhere(peer.name)
         proc = self.procs.pop(peer.name, None)
         obs.record_event("fleet_scale_down", fleet=self.name,
                          peer=peer.name)
         if proc is not None:
             threading.Thread(target=self._reap, args=(proc,),
                              daemon=True).start()
+
+    def _remove_everywhere(self, peer_name: str):
+        """Drop the named peer's adapter from EVERY frontend (each
+        holds its own object for the same process)."""
+        for fe in self.frontends:
+            for p in list(fe.peers):
+                if p.name == peer_name:
+                    fe.remove_peer(p)
 
     @staticmethod
     def _reap(proc: subprocess.Popen, grace_s: float = 30.0):
@@ -166,15 +182,20 @@ class LocalProcessManager:
         # keep draining the child's stdout so its pipe never fills
         threading.Thread(target=self._drain_stdout, args=(proc,),
                          daemon=True).start()
-        peer = RemoteReplica(name, "127.0.0.1", port,
-                             probe_interval_s=self.probe_interval_s,
-                             stale_after_s=self.stale_after_s)
-        peer.refresh()            # first snapshot before rotation
         self.procs[name] = proc
-        self.frontend.add_peer(peer)
+        first = None
+        for fe in self.frontends:
+            peer = RemoteReplica(
+                name, "127.0.0.1", port,
+                probe_interval_s=self.probe_interval_s,
+                stale_after_s=self.stale_after_s)
+            peer.refresh()        # first snapshot before rotation
+            fe.add_peer(peer)
+            if first is None:
+                first = peer
         obs.record_event("fleet_spawn", fleet=self.name, peer=name,
                          port=port)
-        return peer
+        return first
 
     @staticmethod
     def _drain_stdout(proc: subprocess.Popen):

@@ -51,6 +51,7 @@ from __future__ import annotations
 import hashlib
 import http.client
 import json
+import random
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -60,7 +61,41 @@ import numpy as np
 from ...utils import faults
 from ...utils import observability as obs
 
-__all__ = ["RemoteReplica", "prefix_digest_chain"]
+__all__ = ["RemoteReplica", "prefix_digest_chain", "probe_phase",
+           "probe_delay"]
+
+
+# -------------------------------------------------- probe round scheduling
+# ISSUE 16 satellite: every peer used to probe on the same fixed
+# interval, so N frontends x M peers synchronize into one thundering
+# herd of /healthz+/debugz+/metricsz rounds (the storm the fleet sim
+# flags). The schedule is now seeded per peer: a start PHASE spreads
+# round 0 across the interval, and per-round JITTER keeps rounds from
+# re-synchronizing over time. Both are pure functions of
+# (seed, name, round) — deterministic across runs, shared verbatim by
+# the live prober thread AND the simulator's probe events, so what the
+# sim measures about storm behavior is the schedule production runs.
+
+def probe_phase(name: str, interval_s: float, seed: int = 0) -> float:
+    """Deterministic per-peer start offset in ``[0, interval_s)``."""
+    u = random.Random(f"probe-phase:{seed}:{name}").random()
+    return float(interval_s) * u
+
+
+def probe_delay(name: str, interval_s: float, round_idx: int, *,
+                jitter_frac: float = 0.2, seed: int = 0) -> float:
+    """Wait before probe round ``round_idx``: ``interval * (1 +-
+    jitter_frac)``, seeded per (peer, round). The ``peer_storm`` fault
+    site collapses the delay to 0 — every armed peer's next round
+    fires NOW, re-creating the synchronized herd on purpose (what the
+    sim's probe-storm schedule and the storm tests arm)."""
+    if faults.inject("peer_storm", replica=name, round=round_idx):
+        return 0.0
+    if jitter_frac <= 0.0:
+        return float(interval_s)
+    u = random.Random(f"probe:{seed}:{name}:{round_idx}").random()
+    return float(interval_s) * (1.0 + float(jitter_frac)
+                                * (2.0 * u - 1.0))
 
 
 def prefix_digest_chain(input_ids, chunk_tokens: int,
@@ -109,6 +144,9 @@ class RemoteReplica:
                  stale_after_s: float = 2.0,
                  fail_threshold: int = 2,
                  metrics_window_s: float = 5.0,
+                 jitter_frac: float = 0.2,
+                 metrics_every_rounds: int = 1,
+                 seed: int = 0,
                  clock=time.monotonic):
         self.name = name
         self.host = host
@@ -118,6 +156,15 @@ class RemoteReplica:
         self.stale_after_s = float(stale_after_s)
         self.fail_threshold = max(int(fail_threshold), 1)
         self.metrics_window_s = float(metrics_window_s)
+        # ISSUE 16: seeded probe-schedule decorrelation (phase +
+        # per-round jitter) and optional round-batching of the
+        # best-effort /metricsz fetch (every k-th round; the health +
+        # gossip legs run every round — they are the liveness and
+        # routing signal, metrics are a lens)
+        self.jitter_frac = float(jitter_frac)
+        self.metrics_every_rounds = max(int(metrics_every_rounds), 1)
+        self.seed = int(seed)
+        self._round = 0
         self._clock = clock
         self.breaker = None           # attached by the fleet frontend
         self._lock = threading.Lock()
@@ -208,6 +255,14 @@ class RemoteReplica:
         with self._lock:
             self._snap = snap
             self._snap_t = now
+            self._round += 1
+            rnd = self._round
+        if faults.inject("gossip_partition", replica=self.name):
+            # a partition of the GOSSIP channel only (ISSUE 16): the
+            # peer stays healthy and routable, but its digest set and
+            # metrics caches age toward the staleness bound — warm
+            # routing degrades to least-loaded, never to an eviction
+            return
         # gossip: skip the digest list when the peer's generation
         # still matches what we hold (the cheap-poll satellite)
         doc = self._get_json(
@@ -225,7 +280,12 @@ class RemoteReplica:
         # round's, and the frontend's fleet /metricsz reads the cache.
         # Best-effort: a peer without the endpoint (older build) or
         # with its sampler off must not read as unhealthy — health is
-        # /healthz's verdict alone.
+        # /healthz's verdict alone. ISSUE 16 batches the fetch to
+        # every k-th round (metrics_every_rounds) — at 1000 peers the
+        # metrics leg is the expensive one, and a k-round-old window
+        # is still a window.
+        if (rnd - 1) % self.metrics_every_rounds != 0:
+            return
         try:
             mz = self._get_json(
                 f"/metricsz?window_s={self.metrics_window_s:g}")
@@ -288,12 +348,25 @@ class RemoteReplica:
             t.join(timeout)
 
     def _probe_loop(self):
-        while not self._halt.wait(self.probe_interval_s):
+        # seeded phase + per-round jitter (ISSUE 16): the same
+        # schedule functions the fleet sim replays, so decorrelation
+        # behavior measured in-sim is the live thread's behavior
+        if self._halt.wait(probe_phase(self.name,
+                                       self.probe_interval_s,
+                                       seed=self.seed)):
+            return
+        rnd = 0
+        while True:
             try:
                 self.refresh()
             except Exception as e:  # the prober must outlive any bug
                 obs.record_event("fleet_probe_error", peer=self.name,
                                  err=repr(e))
+            rnd += 1
+            if self._halt.wait(probe_delay(
+                    self.name, self.probe_interval_s, rnd,
+                    jitter_frac=self.jitter_frac, seed=self.seed)):
+                return
 
     # ------------------------------------------------------ the router seam
     def _fresh(self) -> bool:
@@ -360,6 +433,41 @@ class RemoteReplica:
             self._healthy = False
         if self.breaker is not None:
             self.breaker.record_failure()
+
+    # ------------------------------------------------- frontend HA gossip
+    def adopt_digests(self, digests, generation: int) -> bool:
+        """Adopt a SIBLING FRONTEND's fresher view of this peer's
+        prefix-digest set (ISSUE 16 HA gossip). Generation-guarded:
+        only a strictly newer generation wins — our own probe loop is
+        the authority whenever it is at least as current, so gossip can
+        only ever move a frontend FORWARD in time, never roll it back.
+        Returns True when adopted."""
+        gen = int(generation)
+        with self._lock:
+            if gen <= self._digest_gen:
+                return False
+            self._digests = frozenset(digests or ())
+            self._digest_gen = gen
+            self._digest_t = self._clock()
+            return True
+
+    def gossip_view(self) -> Dict[str, Any]:
+        """What a sibling frontend may adopt about this peer: the
+        gossiped digest set + its generation (authoritative: the PEER's
+        own counter, comparable across frontends), plus health and
+        breaker state as HINTS (each frontend re-derives those from its
+        own probes; hints only pre-warm a cold sibling)."""
+        with self._lock:
+            out = {
+                "digests": sorted(self._digests),
+                "generation": self._digest_gen,
+                "healthy": self._healthy and self._fresh()
+                and not self._snap.get("draining", False),
+            }
+        b = self.breaker
+        if b is not None:
+            out["breaker"] = b.snapshot().get("state")
+        return out
 
     # ------------------------------------------------------------- exports
     def signals(self) -> Dict[str, Any]:

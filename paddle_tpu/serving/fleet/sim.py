@@ -1,0 +1,1250 @@
+"""Trace-driven fleet chaos simulator (ISSUE 16 tentpole; reference:
+discrete-event cluster simulators production control planes are
+rehearsed in — Borg/Omega trace replay, Jepsen-style fault schedules —
+restated in-process over THIS repo's real serving control plane).
+
+Every hardening question at real fleet scale — probe storms at
+hundreds of peers, gossip fan-out across N frontends, burn-rate alert
+precision during a correlated AZ-style outage, scale-controller
+behavior when a majority of signals goes stale — is untestable by a
+CPU loadgen run. But the whole frontend stack was built model-free and
+clock-injectable, so the sim instantiates the REAL objects:
+
+- :class:`~.frontend.FleetFrontend` (its real
+  :class:`~...router.PrefixAffinityRouter` makes every routing
+  decision; its real :class:`~...supervisor.CircuitBreaker` instances
+  run probation on the simulated clock),
+- :class:`~.autoscaler.FleetAutoscaler` (``step(now)`` on the sim
+  clock over a :class:`SimManager`),
+- :class:`~...slo.BurnRateEngine` (batched outcome intake via
+  ``observe_many`` — the alerts scored against injected incidents are
+  produced by the production alerting math),
+- the real probe schedule (:func:`~.remote.probe_phase` /
+  :func:`~.remote.probe_delay` — shared verbatim with the live prober
+  thread, so storm behavior measured in-sim IS the production
+  schedule).
+
+Only the replica itself is a stub: :class:`SimReplica` duck-types the
+RemoteReplica seam (``healthy``/``load``/``has_prefix``/``signals``/
+``metricsz``/``note_proxy_failure``/``adopt_digests``/``gossip_view``)
+over a scriptable :class:`SimProcess` (latency, slots, prefix
+distribution, up/down). ``real_objects()`` asserts the control-plane
+classes are the production ones by identity — the sim cannot silently
+fork the logic it claims to rehearse.
+
+**Probe capacity model.** Probe rounds draw from a per-time-bin
+execution budget (the frontend's finite probe concurrency). A round
+that cannot find a free bin within ``probe_timeout_s`` FAILS like a
+real timed-out probe — consecutive failures evict and open breakers.
+A seeded, jittered schedule spreads demand and fits the budget; the
+``peer_storm`` fault site collapses the jitter so every peer's round
+fires at once, and the resulting timeout->eviction->page cascade is
+exactly what the probe-storm schedule must detect (and what the
+jittered clean twin must NOT).
+
+**Scoring.** Chaos schedules carry ground-truth incident windows.
+Page-rule fires inside an incident window (+ the slow-window grace)
+are true positives; fires outside any window are false pages.
+``precision`` / ``recall`` land in the banked rung beside routing
+decisions/sec and scale-event counts.
+
+**Frontend HA.** With ``n_frontends >= 2`` each frontend holds its
+own adapter views over the shared processes (the real multi-frontend
+topology), gossip flows through real :class:`~.ha.FrontendLink`
+rounds, and :meth:`FleetSim.kill_frontend` severs one frontend
+mid-run: every in-flight stream's client retries against a survivor
+carrying its committed prefix through the ``resume_tokens`` seam —
+the sim asserts zero lost and zero duplicated committed tokens.
+"""
+from __future__ import annotations
+
+import heapq
+import json
+import math
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ...utils import faults
+from ...utils import observability as obs
+from ..router import NoReplicaError, PrefixAffinityRouter
+from ..slo import BurnRateEngine
+from ..supervisor import CircuitBreaker
+from .autoscaler import FleetAutoscaler
+from .frontend import FleetFrontend
+from .ha import FrontendLink
+from .remote import probe_delay, probe_phase
+
+__all__ = ["SimClock", "SimProcess", "SimReplica", "SimManager",
+           "Incident", "FleetSim", "SCENARIOS", "build_scenario",
+           "arrivals_from_series", "arrivals_from_reqtrace"]
+
+
+class SimClock:
+    """Deterministic simulated monotonic clock. Injected everywhere a
+    control-plane object accepts ``clock=`` (breakers via the
+    frontend, autoscaler, burn engine, series sampler, stubs)."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = float(start)
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, to: float):
+        if to < self.now:
+            raise ValueError(f"clock moved backwards: {to} < {self.now}")
+        self.now = float(to)
+
+
+class SimProcess:
+    """The underlying replica gateway process: ground-truth state the
+    per-frontend :class:`SimReplica` views observe through probes.
+    Scriptable: ``service_s`` (base stream duration), ``slow_mult``
+    (brownout), ``up`` (outage), ``slots`` (concurrency before
+    queueing/shedding)."""
+
+    def __init__(self, name: str, *, slots: int = 4,
+                 service_s: float = 1.0):
+        self.name = name
+        self.up = True
+        self.retired = False
+        self.slots = max(int(slots), 1)
+        self.service_s = float(service_s)
+        self.slow_mult = 1.0
+        self.active = 0
+        self.completed = 0
+        self.tokens = 0
+        self.digests: set = set()
+        self.digest_gen = 0
+        # probe connections landing on this process (sliding 1s
+        # window): health checks run ON the serving process, so a
+        # synchronized herd steals decode cycles — the coupling that
+        # turns a probe storm into a latency incident
+        self._probe_hits: List[float] = []
+
+    def add_digest(self, d: str):
+        if d not in self.digests:
+            self.digests.add(d)
+            self.digest_gen += 1
+
+    def note_probe(self, now: float):
+        hits = self._probe_hits
+        hits.append(now)
+        if len(hits) > 8 and hits[0] < now - 1.0:
+            self._probe_hits = [t for t in hits if t >= now - 1.0]
+
+    def probe_rate(self, now: float) -> float:
+        return float(sum(1 for t in self._probe_hits
+                         if t >= now - 1.0))
+
+    def latency_s(self, rng: random.Random, now: float,
+                  probe_load_cost: float = 0.0) -> float:
+        """Stream duration for one request admitted NOW: base service
+        time x brownout multiplier x a queueing factor once the
+        process runs past its slot budget x the probe-pressure tax,
+        +-10% seeded noise."""
+        queue_factor = 1.0 + max(self.active - self.slots, 0) \
+            / self.slots
+        probe_factor = 1.0 + probe_load_cost * self.probe_rate(now)
+        return self.service_s * self.slow_mult * queue_factor \
+            * probe_factor * (0.9 + 0.2 * rng.random())
+
+
+class SimReplica:
+    """Per-frontend adapter view over one :class:`SimProcess`,
+    duck-typed to the RemoteReplica seam the router/autoscaler/
+    frontend read. Probe rounds (driven by the sim's event loop on
+    the REAL seeded schedule) refresh the snapshot; the same
+    staleness bound, failure latch, breaker-mediated rejoin and
+    generation-guarded gossip adoption semantics as the live
+    adapter."""
+
+    def __init__(self, proc: SimProcess, clock: SimClock, *,
+                 stale_after_s: float = 2.5, fail_threshold: int = 2):
+        self.proc = proc
+        self.name = proc.name
+        self.host, self.port = "sim", 0
+        self._clock = clock
+        self.stale_after_s = float(stale_after_s)
+        self.fail_threshold = max(int(fail_threshold), 1)
+        self.breaker: Optional[CircuitBreaker] = None
+        self._healthy = True
+        self._fails = 0
+        self._snap_t: Optional[float] = None
+        self._load = 0.0
+        self._queue_depth = 0
+        self._free_slots = self._total_slots = 0
+        self._digests: frozenset = frozenset()
+        self._digest_gen = -1
+        self._digest_t: Optional[float] = None
+        self.probes_total = 0
+        self.probe_failures_total = 0
+
+    # ---------------------------------------------------- probe (sim-driven)
+    def probe(self) -> bool:
+        """One probe round landing NOW (the sim's stand-in for
+        ``RemoteReplica.refresh``): success refreshes the snapshot
+        (and, partition permitting, the gossiped digest set); failure
+        counts toward the eviction latch exactly like the live
+        adapter."""
+        self.probes_total += 1
+        if not self.proc.up:
+            return self.probe_fail("down")
+        now = self._clock()
+        self._snap_t = now
+        self._load = float(self.proc.active)
+        self._queue_depth = max(self.proc.active - self.proc.slots, 0)
+        self._free_slots = max(self.proc.slots - self.proc.active, 0)
+        self._total_slots = self.proc.slots
+        if not faults.inject("gossip_partition", replica=self.name):
+            if self.proc.digest_gen != self._digest_gen:
+                self._digests = frozenset(self.proc.digests)
+                self._digest_gen = self.proc.digest_gen
+            self._digest_t = now
+        self._fails = 0
+        if not self._healthy and self.breaker is None:
+            self._healthy = True
+        return True
+
+    def probe_fail(self, reason: str) -> bool:
+        self.probe_failures_total += 1
+        self._fails += 1
+        if self._fails >= self.fail_threshold and self._healthy:
+            self._healthy = False
+            obs.record_event("fleet_peer_down", peer=self.name,
+                             fails=self._fails, reason=reason)
+            if self.breaker is not None:
+                self.breaker.record_failure()
+        return False
+
+    # ------------------------------------------------------ the router seam
+    def _fresh(self) -> bool:
+        return self._snap_t is not None \
+            and self._clock() - self._snap_t <= self.stale_after_s
+
+    def healthy(self) -> bool:
+        return self._healthy and self._fresh()
+
+    def mark(self, healthy: bool):
+        self._healthy = bool(healthy)
+
+    def load(self) -> float:
+        return self._load
+
+    def has_prefix(self, digest: str) -> bool:
+        if self._digest_t is None \
+                or self._clock() - self._digest_t > self.stale_after_s:
+            return False
+        return digest in self._digests
+
+    def note_proxy_failure(self):
+        self._healthy = False
+        if self.breaker is not None:
+            self.breaker.record_failure()
+
+    def start(self):
+        pass                    # the sim's event loop IS the prober
+
+    def stop(self, timeout: float = 0.0):
+        pass
+
+    def refresh(self) -> bool:
+        return self.probe()
+
+    def set_metrics_window(self, window_s: float):
+        pass
+
+    def signals(self) -> Dict[str, Any]:
+        return {
+            "peer": self.name,
+            "healthy": self.healthy(),
+            "stale": not self._fresh(),
+            "load": self._load,
+            "queue_depth": self._queue_depth,
+            "free_slots": self._free_slots,
+            "total_slots": self._total_slots,
+            "block_pool_free_frac": 1.0,
+            "goodput_frac": 1.0,
+        }
+
+    def metricsz(self) -> Dict[str, Any]:
+        age = None if self._snap_t is None \
+            else self._clock() - self._snap_t
+        return {"peer": self.name,
+                "age_s": age,
+                "stale": age is None or age > self.stale_after_s,
+                "doc": {"enabled": True, "gateway": self.name,
+                        "metrics": {}, "slo": {}}}
+
+    def snapshot(self) -> Dict[str, Any]:
+        out = {"peer": self.name, "healthy": self.healthy(),
+               "stale": not self._fresh(),
+               "probes": self.probes_total,
+               "probe_failures": self.probe_failures_total,
+               "gossip": {"digests": len(self._digests),
+                          "generation": self._digest_gen}}
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.snapshot()
+        return out
+
+    # ------------------------------------------------- frontend HA gossip
+    def adopt_digests(self, digests, generation: int) -> bool:
+        gen = int(generation)
+        if gen <= self._digest_gen:
+            return False
+        self._digests = frozenset(digests or ())
+        self._digest_gen = gen
+        self._digest_t = self._clock()
+        return True
+
+    def gossip_view(self) -> Dict[str, Any]:
+        out = {"digests": sorted(self._digests),
+               "generation": self._digest_gen,
+               "healthy": self.healthy()}
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.state
+        return out
+
+
+class SimManager:
+    """The autoscaler's manager duck type over the sim fleet: spawns
+    complete after ``cold_start_s`` of simulated time (a pending spawn
+    counts toward the target, like the process manager's)."""
+
+    def __init__(self, sim: "FleetSim", cold_start_s: float = 5.0):
+        self.sim = sim
+        self.name = "sim"
+        self.cold_start_s = float(cold_start_s)
+        self._pending = 0
+        self.spawns = 0
+        self.retires = 0
+
+    def replicas(self) -> List[SimReplica]:
+        return list(self.sim.frontends[0].peers)
+
+    def pending(self) -> int:
+        return self._pending
+
+    def scale_up(self):
+        self._pending += 1
+        self.spawns += 1
+        sim = self.sim
+        name = f"sim{len(sim.procs)}"
+
+        def _spawned():
+            self._pending -= 1
+            sim.add_process(SimProcess(
+                name, slots=sim.slots, service_s=sim.service_s))
+        sim.schedule(sim.clock.now + self.cold_start_s, _spawned)
+
+    def scale_down(self):
+        sim = self.sim
+        for proc in reversed(sim.procs):
+            if proc.up and not proc.retired:
+                self.retires += 1
+                sim.retire_process(proc)
+                return
+
+
+class _FleetRegistryView:
+    """Registry facade that exposes only the fleet/SLO/fault metrics
+    to the sim's sampler. The frontend registers its counters in the
+    PROCESS registry (same code path as live serving), so a sim run
+    inside a process that previously served real traffic — one pytest
+    session, a notebook — would otherwise sample that unrelated
+    history into its ``series`` dump and fleet_dash would classify
+    the doc as a gateway doc instead of a sim doc."""
+
+    _PREFIXES = ("fleet_", "slo_", "fault_")
+
+    def _items(self):
+        for item in obs.registry()._items():
+            if item[0].startswith(self._PREFIXES):
+                yield item
+
+
+class Incident:
+    """One ground-truth chaos window: ``apply(sim)`` at ``t0``,
+    ``revert(sim)`` at ``t1``. ``page=True`` marks windows the page
+    alert MUST detect (recall) — fires outside every window are false
+    pages (precision)."""
+
+    def __init__(self, kind: str, t0: float, t1: float, *,
+                 page: bool, apply: Callable, revert: Callable):
+        self.kind = kind
+        self.t0, self.t1 = float(t0), float(t1)
+        self.page = bool(page)
+        self.apply, self.revert = apply, revert
+
+
+class FleetSim:
+    """Discrete-event fleet simulator over the real control plane.
+
+    ``rate_fn(t) -> requests/s`` drives open-loop arrivals (seeded
+    exponential inter-arrivals); ``arrival_times`` replays a recorded
+    trace instead. ``incidents`` are ground-truth chaos windows."""
+
+    def __init__(self, *, n_replicas: int = 100, n_frontends: int = 1,
+                 duration_s: float = 300.0, seed: int = 0,
+                 rate_fn: Optional[Callable[[float], float]] = None,
+                 base_rate: float = 20.0, rate_amp: float = 0.0,
+                 rate_cycles: float = 1.0,
+                 arrival_times: Optional[List[float]] = None,
+                 slots: int = 4, service_s: float = 1.0,
+                 spill_margin: Optional[float] = None,
+                 slo_latency_s: Optional[float] = None,
+                 prefix_pool: int = 32, prefix_alpha: float = 1.2,
+                 tokens_per_request: int = 32,
+                 probe_interval_s: float = 1.0,
+                 stale_after_s: float = 2.5,
+                 jitter_frac: float = 0.2,
+                 probe_bin_s: float = 0.05,
+                 probe_capacity_per_bin: Optional[int] = None,
+                 probe_timeout_s: float = 0.3,
+                 probe_load_cost: float = 0.15,
+                 fe_pressure_cost: float = 0.5,
+                 gossip_interval_s: float = 1.0,
+                 autoscale: bool = False,
+                 scaler_kw: Optional[Dict[str, Any]] = None,
+                 window_scale: float = 0.2,
+                 failover_budget: int = 2,
+                 slo_tick_s: float = 1.0,
+                 incidents: Tuple[Incident, ...] = (),
+                 kill_frontend_at: Optional[float] = None,
+                 sample_interval_s: float = 2.0):
+        self.seed = int(seed)
+        self.rng = random.Random(f"fleet-sim:{seed}")
+        self.clock = SimClock()
+        self.duration_s = float(duration_s)
+        self.slots, self.service_s = int(slots), float(service_s)
+        # spill before the shed cliff: a warm pick running past its
+        # slot budget must lose to a cold idle peer (the live margin
+        # of 8 is sized for 8-slot gateways; scale it to the stubs')
+        self.spill_margin = float(spill_margin) \
+            if spill_margin is not None else float(self.slots)
+        self.slo_latency_s = float(slo_latency_s) \
+            if slo_latency_s is not None else 3.0 * self.service_s
+        self.prefix_pool = int(prefix_pool)
+        self.prefix_alpha = float(prefix_alpha)
+        self.tokens_per_request = int(tokens_per_request)
+        self.probe_interval_s = float(probe_interval_s)
+        self.stale_after_s = float(stale_after_s)
+        self.jitter_frac = float(jitter_frac)
+        self.probe_bin_s = float(probe_bin_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.probe_load_cost = float(probe_load_cost)
+        self.fe_pressure_cost = float(fe_pressure_cost)
+        self.gossip_interval_s = float(gossip_interval_s)
+        self.failover_budget = int(failover_budget)
+        self.slo_tick_s = float(slo_tick_s)
+        self.sample_interval_s = float(sample_interval_s)
+        self.incidents = tuple(incidents)
+        self.kill_frontend_at = kill_frontend_at
+        # arrivals: replayed trace or rate-driven open loop
+        if arrival_times is not None:
+            self._arrivals = sorted(float(t) for t in arrival_times
+                                    if 0.0 <= float(t) <= duration_s)
+            self.rate_fn = None
+        else:
+            self._arrivals = None
+            self.rate_fn = rate_fn or (
+                lambda t: base_rate * (1.0 + rate_amp * math.sin(
+                    2.0 * math.pi * rate_cycles * t / duration_s)))
+        # probe budget PER FRONTEND (each frontend runs its own
+        # prober threads off its own event loop/GIL): sized so the
+        # JITTERED schedule fits with ~50% headroom; a storm-collapsed
+        # schedule overflows it
+        self.probe_capacity_per_bin = int(probe_capacity_per_bin) \
+            if probe_capacity_per_bin is not None else max(
+                4, int(1.5 * int(n_replicas) * self.probe_bin_s
+                       / self.probe_interval_s))
+        # ------------------------------------------------------ event loop
+        self._heap: List[Tuple[float, int, Callable]] = []
+        self._seq = 0
+        # (frontend idx, time bin) -> executed / attempted probe counts
+        self._bins: Dict[Tuple[int, int], int] = {}
+        self._attempts: Dict[Tuple[int, int], int] = {}
+        self._inflight: Dict[int, Dict[str, Any]] = {}
+        self._req_seq = 0
+        self._rr = 0
+        self._outcomes: List[Tuple[float, bool]] = []
+        self.flight: List[Dict[str, Any]] = []
+        # tallies
+        self.decisions = 0
+        self.verdicts: Dict[str, int] = {}
+        self.requests = self.completed = self.failed = 0
+        self.shed = self.no_replica = 0
+        self.probe_rounds = self.probe_deferred = 0
+        self.probe_timeouts = 0
+        self.ha = {"severed_streams": 0, "resumed_streams": 0,
+                   "synthesized_streams": 0, "corrupted_streams": 0,
+                   "committed_tokens_preserved": 0,
+                   "tokens_lost": 0, "tokens_duplicated": 0}
+        self._wall_cpu: Optional[float] = None
+        # ---------------------------------------------- the REAL objects
+        self.procs: List[SimProcess] = []
+        self.frontends: List[FleetFrontend] = []
+        self.fe_alive: List[bool] = []
+        for i in range(int(n_frontends)):
+            fe = FleetFrontend(
+                [], chunk_tokens=None, routing="prefix",
+                spill_margin=self.spill_margin,
+                failover_budget=self.failover_budget,
+                breaker_backoff_s=1.0,
+                name=f"simfe{i}", trace=False, clock=self.clock)
+            self.frontends.append(fe)
+            self.fe_alive.append(True)
+        for i in range(int(n_replicas)):
+            self.add_process(SimProcess(f"sim{i}", slots=self.slots,
+                                        service_s=self.service_s),
+                            initial=True)
+        self.links: List[FrontendLink] = []
+        for fe in self.frontends:
+            for sib in self.frontends:
+                if sib is not fe:
+                    self.links.append(FrontendLink(
+                        fe, sib, interval_s=self.gossip_interval_s,
+                        jitter_frac=self.jitter_frac, seed=self.seed))
+        self.engine = BurnRateEngine(window_scale=float(window_scale),
+                                     min_window_events=24,
+                                     max_events=65536,
+                                     labels={"fleet": "sim"},
+                                     clock=self.clock)
+        self.manager = SimManager(self)
+        self.scaler = None
+        if autoscale:
+            kw = dict(min_replicas=1,
+                      max_replicas=max(2 * int(n_replicas), 4),
+                      interval_s=1.0, clock=self.clock)
+            kw.update(scaler_kw or {})
+            self.scaler = FleetAutoscaler(self.manager, **kw)
+            self.frontends[0].attach_autoscaler(self.scaler)
+        self.series = obs.MetricsTimeSeries(
+            name=f"sim{self.seed}", registry=_FleetRegistryView(),
+            interval_s=self.sample_interval_s,
+            capacity=2048, clock=self.clock)
+
+    # ---------------------------------------------------------- membership
+    def add_process(self, proc: SimProcess, initial: bool = False):
+        self.procs.append(proc)
+        for fe in self.frontends:
+            view = SimReplica(proc, self.clock,
+                              stale_after_s=self.stale_after_s)
+            fe.add_peer(view)       # REAL membership path: breaker
+            #                         attach (clock-injected) + router
+            # first probe lands immediately (the manager's
+            # spawn-then-refresh), then the seeded schedule
+            view.probe()
+            self._schedule_probe_chain(fe, view)
+
+    def retire_process(self, proc: SimProcess):
+        """Graceful scale-down: out of rotation everywhere, in-flight
+        streams finish on their own (the live manager's drain)."""
+        proc.retired = True
+        for fe in self.frontends:
+            for view in list(fe.peers):
+                if view.proc is proc:
+                    fe.remove_peer(view)
+
+    # ------------------------------------------------------------ schedule
+    def schedule(self, t: float, fn: Callable):
+        self._seq += 1
+        heapq.heappush(self._heap, (float(t), self._seq, fn))
+
+    def _event(self, kind: str, **fields):
+        self.flight.append({"t": round(self.clock.now, 3),
+                            "kind": kind, **fields})
+
+    # -------------------------------------------------------------- probes
+    def _schedule_probe_chain(self, fe: FleetFrontend,
+                              view: SimReplica):
+        key = f"{fe.name}:{view.name}"
+        t0 = self.clock.now + probe_phase(
+            key, self.probe_interval_s, seed=self.seed)
+        self.schedule(t0, lambda: self._probe_round(fe, view, 0, t0))
+
+    def _probe_round(self, fe: FleetFrontend, view: SimReplica,
+                     rnd: int, t_req: float):
+        fi = self.frontends.index(fe)
+        if view not in fe.peers or not self.fe_alive[fi]:
+            return                    # retired peer / dead frontend
+        self.probe_rounds += 1
+        b0 = int(t_req / self.probe_bin_s)
+        self._attempts[(fi, b0)] = self._attempts.get((fi, b0), 0) + 1
+        # capacity: claim the earliest bin with budget left inside the
+        # timeout horizon; none -> the executor rejects the round
+        # (fail-fast, never reaches the replica) and it counts as a
+        # probe FAILURE
+        b = b0
+        horizon = b0 + max(int(self.probe_timeout_s
+                               / self.probe_bin_s), 1)
+        placed = None
+        while b <= horizon:
+            if self._bins.get((fi, b), 0) \
+                    < self.probe_capacity_per_bin:
+                self._bins[(fi, b)] = self._bins.get((fi, b), 0) + 1
+                placed = b
+                break
+            b += 1
+        if placed is None:
+            self.probe_timeouts += 1
+            view.probe_fail("probe_timeout")
+        else:
+            if placed > b0:
+                self.probe_deferred += 1
+            # an EXECUTED probe opens a connection against the serving
+            # process: the probe tax that turns a monopolized storm
+            # schedule into a latency incident on the winners' procs
+            view.proc.note_probe(t_req)
+            view.probe()
+        # next round on the REAL seeded schedule (peer_storm collapses
+        # the delay to 0 — the synchronized herd); floored at one bin,
+        # the live prober's reconnect floor
+        key = f"{fe.name}:{view.name}"
+        dt = probe_delay(key, self.probe_interval_s, rnd + 1,
+                         jitter_frac=self.jitter_frac, seed=self.seed)
+        t_next = self.clock.now + max(dt, self.probe_bin_s)
+        self.schedule(t_next,
+                      lambda: self._probe_round(fe, view, rnd + 1,
+                                                t_next))
+
+    # -------------------------------------------------------------- gossip
+    def _gossip_round(self, link: FrontendLink, rnd: int):
+        i = self.frontends.index(link.frontend)
+        j = self.frontends.index(link.sibling) \
+            if link.sibling in self.frontends else -1
+        if self.fe_alive[i] and (j < 0 or self.fe_alive[j]):
+            link.exchange()           # REAL merge path (+ the
+            #                           gossip_partition fault site)
+        dt = probe_delay(link.name, self.gossip_interval_s, rnd + 1,
+                         jitter_frac=self.jitter_frac, seed=self.seed)
+        self.schedule(self.clock.now + max(dt, self.probe_bin_s),
+                      lambda: self._gossip_round(link, rnd + 1))
+
+    # ------------------------------------------------------------ requests
+    def _pick_prefix(self) -> List[str]:
+        """Zipf-ish draw over the shared-prefix pool (hot prefixes are
+        the affinity routing signal)."""
+        u = self.rng.random()
+        k = int(self.prefix_pool * (u ** self.prefix_alpha))
+        return [f"pfx{min(k, self.prefix_pool - 1)}"]
+
+    def _live_frontend(self) -> Optional[FleetFrontend]:
+        """Client-side LB: round-robin over frontends it can reach."""
+        n = len(self.frontends)
+        for _ in range(n):
+            fe = self.frontends[self._rr % n]
+            self._rr += 1
+            if self.fe_alive[self.frontends.index(fe)]:
+                return fe
+        return None
+
+    def _arrival(self):
+        self.requests += 1
+        self._req_seq += 1
+        rid = self._req_seq
+        fe = self._live_frontend()
+        if fe is None:
+            self._finish_outcome(False)
+            return
+        # tick the frontend's REAL request counter (the sim bypasses
+        # its HTTP listener): the dumped series doc must show the
+        # offered load, and arrivals_from_series must round-trip it
+        fe._c_requests.inc()
+        self._dispatch(rid, fe, self._pick_prefix(), hops=0,
+                       resume_from=0, t_accept=self.clock.now)
+
+    def _dispatch(self, rid: int, fe: FleetFrontend,
+                  digests: List[str], *, hops: int, resume_from: int,
+                  t_accept: float):
+        """Route (REAL router ladder) + admit one stream attempt."""
+        meta: Dict[str, Any] = {}
+        try:
+            view = fe._router.route(digests, allow_probe=hops == 0,
+                                    meta=meta)
+        except NoReplicaError:
+            self.no_replica += 1
+            self._finish_outcome(False)
+            return
+        self.decisions += 1
+        v = meta.get("verdict", "?")
+        self.verdicts[v] = self.verdicts.get(v, 0) + 1
+        proc = view.proc
+        probe = v == "probe"
+        if not proc.up:
+            # routed onto a corpse the staleness bound hasn't caught
+            # yet: the proxy fails, the peer is evicted, the failover
+            # loop retries — the frontend's own ladder semantics
+            view.note_proxy_failure()
+            fe._router.evict_unhealthy()
+            if probe and view.breaker is not None:
+                view.breaker.probe_done(False)
+            self._failover(rid, fe, digests, hops, resume_from,
+                           t_accept)
+            return
+        if proc.active >= 2 * proc.slots:
+            # overloaded peer sheds (429): terminal, bad for the SLO,
+            # no eviction, no budget charge
+            if probe and view.breaker is not None:
+                view.breaker.probe_done(None)
+            self.shed += 1
+            self._finish_outcome(False)
+            return
+        proc.add_digest(digests[0])   # prefill registers the prefix
+        proc.active += 1
+        latency = proc.latency_s(self.rng, self.clock.now,
+                                 self.probe_load_cost) \
+            * self._fe_pressure_factor(fe)
+        self._inflight[rid] = {
+            "fe": fe, "view": view, "proc": proc, "probe": probe,
+            "digests": digests, "hops": hops,
+            "resume_from": resume_from, "t_start": self.clock.now,
+            "t_accept": t_accept, "latency": latency,
+            "cancelled": False,
+        }
+        self.schedule(self.clock.now + latency,
+                      lambda: self._complete(rid))
+
+    def _fe_pressure_factor(self, fe: FleetFrontend) -> float:
+        """Frontend executor overflow tax on PROXIED STREAMS: probe
+        demand past the executor budget starves the same event loop
+        that forwards tokens, so every stream through an overloaded
+        frontend slows. At or under budget (any jittered schedule)
+        the factor is 1.0; a storm-collapsed schedule at N× demand
+        inflates fleet-wide latency — the page the storm schedule
+        must produce at ANY fleet size."""
+        if self.fe_pressure_cost <= 0.0:
+            return 1.0
+        fi = self.frontends.index(fe)
+        b = int(self.clock.now / self.probe_bin_s)
+        nb = max(int(1.0 / self.probe_bin_s), 1)
+        demand = sum(self._attempts.get((fi, k), 0)
+                     for k in range(b - nb, b))
+        cap = self.probe_capacity_per_bin * nb
+        pressure = demand / max(cap, 1)
+        return 1.0 + self.fe_pressure_cost * max(pressure - 1.0, 0.0)
+
+    def _failover(self, rid: int, fe: FleetFrontend,
+                  digests: List[str], hops: int, resume_from: int,
+                  t_accept: float):
+        hops += 1
+        if hops > self.failover_budget:
+            fe._c_exhausted.inc()
+            self.failed += 1
+            self._finish_outcome(False)
+            return
+        fe._c_failovers.inc()
+        self._dispatch(rid, fe, digests, hops=hops,
+                       resume_from=resume_from, t_accept=t_accept)
+
+    def _complete(self, rid: int):
+        req = self._inflight.pop(rid, None)
+        if req is None or req["cancelled"]:
+            return
+        proc, view = req["proc"], req["view"]
+        proc.active = max(proc.active - 1, 0)
+        if not proc.up:
+            # died mid-stream: committed prefix survives with the
+            # client; failover resubmits the remainder
+            view.note_proxy_failure()
+            req["fe"]._router.evict_unhealthy()
+            if req["probe"] and view.breaker is not None:
+                view.breaker.probe_done(False)
+            committed = req["resume_from"] + int(
+                (self.tokens_per_request - req["resume_from"])
+                * min((self.clock.now - req["t_start"])
+                      / max(req["latency"], 1e-9), 1.0))
+            self._failover(rid, req["fe"], req["digests"],
+                           req["hops"], committed, req["t_accept"])
+            return
+        proc.completed += 1
+        emitted = self.tokens_per_request - req["resume_from"]
+        proc.tokens += emitted
+        req["fe"]._c_tokens.inc(emitted)
+        req["fe"]._h_ttft.observe(
+            (req["t_start"] - req["t_accept"]
+             + req["latency"] / max(self.tokens_per_request, 1))
+            * 1000.0)
+        if req["probe"] and view.breaker is not None:
+            view.breaker.probe_done(True)
+        total_latency = self.clock.now - req["t_accept"]
+        self.completed += 1
+        self._finish_outcome(total_latency <= self.slo_latency_s)
+
+    def _finish_outcome(self, ok: bool):
+        self._outcomes.append((self.clock.now, bool(ok)))
+
+    # ----------------------------------------------------- frontend HA kill
+    def kill_frontend(self, idx: int):
+        """SIGKILL stand-in for frontend ``idx`` mid-run: the real
+        :meth:`FleetFrontend.kill` severs its listener/streams; every
+        in-flight request through it loses its uncommitted tail and
+        the CLIENT retries against a survivor carrying the committed
+        prefix through the resume seam (fully-committed streams are
+        synthesized client-side, never retried — the ISSUE 12 rule,
+        one tier up)."""
+        fe = self.frontends[idx]
+        self.fe_alive[idx] = False
+        fe.kill()
+        self._event("frontend_kill", frontend=fe.name)
+        for rid, req in list(self._inflight.items()):
+            if req["fe"] is not fe or req["cancelled"]:
+                continue
+            req["cancelled"] = True
+            del self._inflight[rid]
+            req["proc"].active = max(req["proc"].active - 1, 0)
+            self.ha["severed_streams"] += 1
+            committed = req["resume_from"] + int(
+                (self.tokens_per_request - req["resume_from"])
+                * min((self.clock.now - req["t_start"])
+                      / max(req["latency"], 1e-9), 1.0))
+            committed_ids = list(range(committed))
+            survivor = self._live_frontend()
+            if survivor is None:
+                self.ha["corrupted_streams"] += 1
+                self._finish_outcome(False)
+                continue
+            if committed >= self.tokens_per_request:
+                # client holds every token: synthesize, don't retry
+                self.ha["synthesized_streams"] += 1
+                self._check_stream(committed_ids, [])
+                self._finish_outcome(True)
+                continue
+            self.ha["resumed_streams"] += 1
+            self._resume_on(survivor, req, committed_ids)
+
+    def _resume_on(self, survivor: FleetFrontend,
+                   req: Dict[str, Any], committed_ids: List[int]):
+        """Client retry against the survivor: resume_tokens carries
+        the committed prefix; the survivor's REAL router places the
+        remainder (warm/sticky state it gossiped from the dead
+        sibling makes this a hit, not a cold miss)."""
+        rid = self._req_seq = self._req_seq + 1
+        resume_from = len(committed_ids)
+        survivor._c_requests.inc()   # the retry is a new request
+        self._dispatch(rid, survivor, req["digests"], hops=0,
+                       resume_from=resume_from,
+                       t_accept=req["t_accept"])
+        live = self._inflight.get(rid)
+        if live is None:
+            self.ha["corrupted_streams"] += 1
+            return
+        # the remainder the survivor will emit, validated at once (the
+        # sim's streams are deterministic ranges — emission content
+        # does not depend on which peer serves it, like greedy decode)
+        resumed_ids = list(range(resume_from,
+                                 self.tokens_per_request))
+        self._check_stream(committed_ids, resumed_ids)
+
+    def _check_stream(self, committed_ids: List[int],
+                      resumed_ids: List[int]):
+        """The client-observed contract: committed + resumed must be
+        exactly the uninterrupted stream — zero lost, zero duplicated
+        committed tokens."""
+        final = committed_ids + resumed_ids
+        want = list(range(self.tokens_per_request)) \
+            if resumed_ids else committed_ids
+        dup = len(final) - len(set(final))
+        lost = len(want) - len(final) if not dup else 0
+        if final != want:
+            self.ha["corrupted_streams"] += 1
+            self.ha["tokens_duplicated"] += max(dup, 0)
+            self.ha["tokens_lost"] += max(lost, 0)
+        else:
+            self.ha["committed_tokens_preserved"] += len(committed_ids)
+
+    # ------------------------------------------------------------ main loop
+    def _prime(self):
+        # arrivals
+        if self._arrivals is not None:
+            for t in self._arrivals:
+                self.schedule(t, self._arrival)
+            self.requests_planned = len(self._arrivals)
+        else:
+            t = 0.0
+            n = 0
+            while t < self.duration_s:
+                rate = max(self.rate_fn(t), 1e-6)
+                t += self.rng.expovariate(rate)
+                if t < self.duration_s:
+                    self.schedule(t, self._arrival)
+                    n += 1
+            self.requests_planned = n
+        # incidents
+        for inc in self.incidents:
+            self.schedule(inc.t0, lambda inc=inc: (
+                self._event("incident_start", incident=inc.kind,
+                            page_expected=inc.page),
+                inc.apply(self)))
+            self.schedule(inc.t1, lambda inc=inc: (
+                self._event("incident_end", incident=inc.kind),
+                inc.revert(self)))
+        # periodic control loops
+        if self.scaler is not None:
+            def _scale_tick():
+                self.scaler.step(self.clock.now)
+                self.schedule(self.clock.now + self.scaler.interval_s,
+                              _scale_tick)
+            self.schedule(self.scaler.interval_s, _scale_tick)
+
+        def _slo_tick():
+            if self._outcomes:
+                batch, self._outcomes = self._outcomes, []
+                for ev in self.engine.observe_many(
+                        "interactive", batch, now=self.clock.now):
+                    self._event(f"alert_{ev['kind']}",
+                                rule=ev["rule"], slo=ev["slo"],
+                                burn_fast=ev["burn_fast"])
+            self.schedule(self.clock.now + self.slo_tick_s, _slo_tick)
+        self.schedule(self.slo_tick_s, _slo_tick)
+
+        def _sample_tick():
+            self.series.sample(self.clock.now)
+            self.schedule(self.clock.now + self.sample_interval_s,
+                          _sample_tick)
+        self.schedule(self.sample_interval_s, _sample_tick)
+        # gossip links
+        for link in self.links:
+            self.schedule(
+                probe_phase(link.name, self.gossip_interval_s,
+                            seed=self.seed),
+                lambda link=link: self._gossip_round(link, 0))
+        # frontend kill
+        if self.kill_frontend_at is not None:
+            self.schedule(float(self.kill_frontend_at),
+                          lambda: self.kill_frontend(
+                              len(self.frontends) - 1))
+
+    def run(self) -> Dict[str, Any]:
+        self.real_objects(check=True)
+        self._prime()
+        cpu0 = time.process_time()
+        drain_until = self.duration_s + 10.0 * self.service_s
+        while self._heap:
+            t, _, fn = heapq.heappop(self._heap)
+            if t > drain_until:
+                break
+            self.clock.advance(max(t, self.clock.now))
+            fn()
+        # flush the outcome tail through the alert engine
+        if self._outcomes:
+            batch, self._outcomes = self._outcomes, []
+            self.engine.observe_many("interactive", batch,
+                                     now=self.clock.now)
+        self._wall_cpu = time.process_time() - cpu0
+        return self.result()
+
+    # -------------------------------------------------------------- results
+    def real_objects(self, check: bool = False) -> Dict[str, str]:
+        """Identity report (and assertion): the control plane under
+        sim IS the production code, not a fork."""
+        fe = self.frontends[0]
+        view = fe.peers[0] if fe.peers else None
+        objs = {
+            "frontend": type(fe),
+            "router": type(fe._router),
+            "burn_engine": type(self.engine),
+            "probe_schedule": probe_delay,
+        }
+        if self.scaler is not None:
+            objs["autoscaler"] = type(self.scaler)
+        if view is not None and view.breaker is not None:
+            objs["breaker"] = type(view.breaker)
+        if check:
+            assert objs["frontend"] is FleetFrontend
+            assert objs["router"] is PrefixAffinityRouter
+            assert objs["burn_engine"] is BurnRateEngine
+            if "autoscaler" in objs:
+                assert objs["autoscaler"] is FleetAutoscaler
+            if "breaker" in objs:
+                assert objs["breaker"] is CircuitBreaker
+            from . import remote as _remote
+            assert probe_delay is _remote.probe_delay
+        return {k: f"{v.__module__}.{v.__qualname__}"
+                if hasattr(v, "__qualname__")
+                else f"{v.__module__}.{type(v).__name__}"
+                for k, v in objs.items()}
+
+    def score_alerts(self, grace_s: Optional[float] = None
+                     ) -> Dict[str, Any]:
+        """Precision/recall of page fires against ground-truth
+        incident windows (+ slow-window grace: a burn alert may
+        legitimately confirm shortly after the incident clears)."""
+        if grace_s is None:
+            grace_s = max((r.slow_s for r in self.engine.rules),
+                          default=60.0)
+        fires = [a for a in self.engine.alerts
+                 if a["kind"] == "fire" and a["rule"] == "page"]
+        windows = [(i.t0, i.t1 + grace_s) for i in self.incidents
+                   if i.page]
+        matched = [a for a in fires
+                   if any(lo <= a["t"] <= hi for lo, hi in windows)]
+        detected = [1 for lo, hi in windows
+                    if any(lo <= a["t"] <= hi for a in fires)]
+        return {
+            "page_fires": len(fires),
+            "false_pages": len(fires) - len(matched),
+            "incidents_paged_expected": len(windows),
+            "incidents_detected": sum(detected),
+            "precision": len(matched) / len(fires) if fires else 1.0,
+            "recall": sum(detected) / len(windows)
+            if windows else 1.0,
+            "ticket_fires": sum(
+                1 for a in self.engine.alerts
+                if a["kind"] == "fire" and a["rule"] == "ticket"),
+        }
+
+    def result(self) -> Dict[str, Any]:
+        wall = self._wall_cpu or 1e-9
+        out = {
+            "sim": {
+                "replicas": len(self.procs),
+                "frontends": len(self.frontends),
+                "duration_s": self.duration_s,
+                "seed": self.seed,
+                "probe_interval_s": self.probe_interval_s,
+                "probe_capacity_per_bin":
+                    self.probe_capacity_per_bin,
+                "incidents": [{"kind": i.kind, "t0": i.t0,
+                               "t1": i.t1, "page": i.page}
+                              for i in self.incidents],
+            },
+            "real_objects": self.real_objects(),
+            "cpu_s": round(wall, 3),
+            "decisions_total": self.decisions,
+            "decisions_per_sec": round(self.decisions / wall, 1),
+            "verdicts": dict(sorted(self.verdicts.items())),
+            "requests": self.requests,
+            "completed": self.completed,
+            "shed": self.shed,
+            "no_replica": self.no_replica,
+            "probe": {
+                "rounds": self.probe_rounds,
+                "deferred": self.probe_deferred,
+                "timeouts": self.probe_timeouts,
+            },
+            "alerts": self.score_alerts(),
+            "gossip": [ln.snapshot() for ln in self.links],
+        }
+        if self.scaler is not None:
+            # count from the per-INSTANCE event log (the registry
+            # counters are process-global and would leak across sims)
+            evs = self.scaler.events
+            out["scale"] = {
+                "ups": sum(1 for e in evs if e["action"] == "up"),
+                "downs": sum(1 for e in evs if e["action"] == "down"),
+                "freezes": sum(1 for e in evs
+                               if e["action"] == "freeze"),
+                "frozen": self.scaler.snapshot()["frozen"],
+                "events": evs[-32:],
+                "replica_seconds": round(
+                    self.scaler.replica_seconds, 3),
+            }
+        if self.kill_frontend_at is not None \
+                or len(self.frontends) > 1:
+            out["ha"] = dict(self.ha)
+        return out
+
+    # --------------------------------------------------------------- dumps
+    def dump_series(self, path: str) -> str:
+        """The sim's telemetry history as a standard ``series/1`` doc
+        (same writer, same validator, same ``fleet_dash`` renderer as
+        live runs) with the alert log attached."""
+        return self.series.dump(path, alerts=self.engine.alerts)
+
+    def dump_flight(self, path: str) -> str:
+        """The sim's incident/alert/kill timeline as a flight-recorder
+        doc. Sim events carry simulated ``t``; ``wall`` is synthesized
+        as ``dumped_wall - (clock_now - t)`` so ``fleet_dash`` puts an
+        injected incident and its alert on one shared wall axis."""
+        dumped_wall = time.time()
+        now = self.clock.now
+        merged = list(self.flight)
+        if self.scaler is not None:
+            # the scaler keeps its own per-instance event log; merge
+            # it in as the same ``fleet_autoscale`` events a live
+            # flight recorder carries, so fleet_dash marks them
+            fleet = getattr(self.frontends[0], "name", "fleet")
+            merged += [{"kind": "fleet_autoscale", "fleet": fleet,
+                        **ev} for ev in self.scaler.events]
+        merged.sort(key=lambda ev: ev["t"])
+        events = [dict(ev, wall=dumped_wall - (now - ev["t"]))
+                  for ev in merged]
+        doc = {"run_id": f"fleet_sim_seed{self.seed}", "attempt": 0,
+               "reason": "sim_end", "dumped_wall": dumped_wall,
+               "clock_now": now, "capacity": len(events),
+               "total_events": len(events), "events": events}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        import os
+        os.replace(tmp, path)
+        return path
+
+
+# ------------------------------------------------------- chaos schedules
+def _outage(t0: float, t1: float, frac: float) -> Incident:
+    killed: List[SimProcess] = []
+
+    def apply(sim: FleetSim):
+        n = max(int(len(sim.procs) * frac), 1)
+        for proc in sim.procs[:n]:
+            if proc.up:
+                proc.up = False
+                killed.append(proc)
+
+    def revert(sim: FleetSim):
+        for proc in killed:
+            proc.up = True
+        killed.clear()
+    return Incident("correlated_outage", t0, t1, page=True,
+                    apply=apply, revert=revert)
+
+
+def _storm(t0: float, t1: float) -> Incident:
+    def apply(sim: FleetSim):
+        # arm the REAL fault site probe_delay checks: every armed
+        # round's jitter collapses to zero — the synchronized herd
+        faults.configure("peer_storm~1.0")
+
+    def revert(sim: FleetSim):
+        faults.configure(None)
+    return Incident("probe_storm", t0, t1, page=True,
+                    apply=apply, revert=revert)
+
+
+def _partition(t0: float, t1: float) -> Incident:
+    def apply(sim: FleetSim):
+        faults.configure("gossip_partition~1.0")
+
+    def revert(sim: FleetSim):
+        faults.configure(None)
+    return Incident("gossip_partition", t0, t1, page=False,
+                    apply=apply, revert=revert)
+
+
+def _brownout(t0: float, t1: float, frac: float,
+              mult: float) -> Incident:
+    slowed: List[SimProcess] = []
+
+    def apply(sim: FleetSim):
+        n = max(int(len(sim.procs) * frac), 1)
+        for proc in sim.procs[:n]:
+            proc.slow_mult = mult
+            slowed.append(proc)
+
+    def revert(sim: FleetSim):
+        for proc in slowed:
+            proc.slow_mult = 1.0
+        slowed.clear()
+    return Incident("slow_peer_brownout", t0, t1, page=True,
+                    apply=apply, revert=revert)
+
+
+SCENARIOS = ("clean", "outage", "storm", "partition", "brownout",
+             "diurnal", "ha")
+
+
+def build_scenario(name: str, *, n_replicas: int = 100,
+                   n_frontends: int = 1, duration_s: float = 300.0,
+                   seed: int = 0, base_rate: float = 20.0,
+                   **overrides) -> FleetSim:
+    """Seeded chaos schedules over a common fleet shape. ``clean`` is
+    the incident-free twin every chaos scenario is scored against —
+    identical seed, arrivals and fleet, zero injected incidents, so
+    any page it raises is a false page by construction."""
+    T = float(duration_s)
+    kw: Dict[str, Any] = dict(
+        n_replicas=n_replicas, n_frontends=n_frontends,
+        duration_s=T, seed=seed, base_rate=base_rate)
+    if name == "clean":
+        pass
+    elif name == "outage":
+        # kill down to ~half the capacity the offered load needs —
+        # a fixed fraction of a lightly-utilized big fleet leaves
+        # survivors with headroom and (correctly) no page
+        service = float(overrides.get("service_s", 1.0))
+        slots = int(overrides.get("slots", 4))
+        survivors = max(int(0.4 * base_rate * service / slots), 1)
+        frac = 1.0 - min(survivors / max(n_replicas, 1), 0.5)
+        kw["incidents"] = (_outage(0.4 * T, 0.7 * T, frac),)
+        # pinned floor: the scale story here is the mass-outage FREEZE
+        # (survivors' low load must not read as scale-down pressure),
+        # not routine capacity tracking
+        kw.update(autoscale=True,
+                  scaler_kw=dict(min_replicas=n_replicas,
+                                 max_replicas=2 * n_replicas))
+    elif name == "storm":
+        kw["incidents"] = (_storm(0.4 * T, 0.6 * T),)
+    elif name == "partition":
+        kw["incidents"] = (_partition(0.4 * T, 0.7 * T),)
+    elif name == "brownout":
+        # fleet-WIDE slowdown (thermal throttle / noisy neighbor
+        # across an AZ): a minority brownout is absorbed by the
+        # load-aware ladder — measured, not assumed: at frac 0.3 the
+        # router routes around it and the fleet stays in SLO
+        kw["incidents"] = (_brownout(0.4 * T, 0.7 * T, 0.9, 8.0),)
+    elif name == "diurnal":
+        # start the fleet at trough size so the peak genuinely forces
+        # scale-ups (and the falling edge, scale-downs)
+        # fresher probes: at peak, 1s-stale load lets the warm/sticky
+        # ladder pile bursts onto one replica past its shed cliff —
+        # the small diurnal fleet needs the 0.5s cadence to stay clean
+        kw.update(rate_amp=0.8, rate_cycles=1.0, autoscale=True,
+                  probe_interval_s=0.5,
+                  n_replicas=max(n_replicas // 4, 2),
+                  scaler_kw=dict(min_replicas=max(n_replicas // 4, 2),
+                                 max_replicas=4 * n_replicas,
+                                 hold_s=1.0, hold_down_s=8.0,
+                                 cooldown_s=4.0))
+    elif name == "ha":
+        kw.update(n_frontends=max(n_frontends, 2),
+                  kill_frontend_at=0.5 * T)
+    else:
+        raise ValueError(f"unknown scenario {name!r}; "
+                         f"known: {SCENARIOS}")
+    kw.update(overrides)
+    return FleetSim(**kw)
+
+
+# --------------------------------------------------------- trace replay
+def arrivals_from_series(doc: Dict[str, Any],
+                         metric: str = "gateway_requests_total",
+                         scale: float = 1.0) -> List[float]:
+    """Recover request arrival times from a recorded ``series_*.json``
+    doc: walk the cumulative request-counter samples, spread each
+    inter-sample delta uniformly across its interval, shift t to 0.
+    ``scale`` multiplies the replayed rate."""
+    out: List[float] = []
+    for full, view in (doc.get("metrics") or {}).items():
+        if full.split("{", 1)[0] != metric:
+            continue
+        samples = view.get("samples") or []
+        prev_t = prev_v = None
+        for s in samples:
+            t, v = float(s[0]), float(s[1])
+            if prev_t is not None and v > prev_v and t > prev_t:
+                n = int(round((v - prev_v) * scale))
+                for k in range(n):
+                    out.append(prev_t + (t - prev_t) * (k + 0.5) / n)
+            prev_t, prev_v = t, v
+    if not out:
+        raise ValueError(f"no {metric!r} rate recoverable from "
+                         "series doc")
+    t0 = min(out)
+    return sorted(t - t0 for t in out)
+
+
+def arrivals_from_reqtrace(doc: Dict[str, Any],
+                           scale: float = 1.0) -> List[float]:
+    """Arrival offsets from a dumped reqtrace ring (per-entry
+    ``wall_accept``), shifted to 0. ``scale`` compresses (>1) or
+    stretches (<1) the replayed timeline."""
+    walls = [float(e["wall_accept"])
+             for e in (doc.get("entries") or [])
+             if e.get("wall_accept") is not None]
+    if not walls:
+        raise ValueError("no wall_accept entries in reqtrace doc")
+    t0 = min(walls)
+    return sorted((w - t0) / float(scale) for w in walls)

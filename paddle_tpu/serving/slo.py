@@ -77,9 +77,18 @@ class BurnRateEngine:
     def __init__(self, targets: Optional[Dict[str, float]] = None,
                  rules=None, *, window_scale: float = 1.0,
                  resolve_frac: float = 0.5,
+                 min_window_events: int = 0,
                  max_events: int = 8192, max_alerts: int = 512,
                  labels: Optional[Dict[str, str]] = None,
                  clock=time.monotonic):
+        """``min_window_events`` (ISSUE 16): a rule may only FIRE when
+        its slow window holds at least this many outcomes — a burn
+        ratio over single-digit samples is noise, and the fleet sim's
+        cold-start showed exactly that false page (3 sheds in an
+        almost-empty bootstrap window pages at burn 18). 0 (the
+        default) keeps the historical fire-on-any-traffic behavior;
+        resolves are never gated, so an active alert can always
+        clear."""
         self.targets = dict(DEFAULT_TARGETS)
         self.targets.update(targets or {})
         self.window_scale = float(window_scale)
@@ -90,6 +99,7 @@ class BurnRateEngine:
         if not self.rules:
             raise ValueError("at least one burn rule required")
         self.resolve_frac = float(resolve_frac)
+        self.min_window_events = max(int(min_window_events), 0)
         self.max_events = int(max_events)
         self.max_alerts = int(max_alerts)
         self.labels = {k: str(v) for k, v in (labels or {}).items()}
@@ -126,6 +136,33 @@ class BurnRateEngine:
                 dq.popleft()
         return self.evaluate(now)
 
+    def observe_many(self, slo: str, outcomes,
+                     now: Optional[float] = None) -> List[dict]:
+        """Batched intake (ISSUE 16: the fleet sim replays thousands
+        of trace outcomes per simulated tick): one lock acquisition
+        and ONE evaluation for the whole batch. ``outcomes`` is an
+        iterable of ``(t, ok)`` pairs, ascending in ``t``; ``now``
+        defaults to the last outcome's time. Decision-equivalent to
+        per-outcome :meth:`observe` calls evaluated at the batch end —
+        only intermediate evaluations (which the sim's tick cadence
+        would skip anyway) are elided."""
+        slo = str(slo)
+        last = None
+        with self._lock:
+            dq = self._events.get(slo)
+            if dq is None:
+                dq = self._events[slo] = deque(maxlen=self.max_events)
+                self.targets.setdefault(slo, DEFAULT_TARGET)
+            for t, ok in outcomes:
+                last = float(t)
+                dq.append((last, not ok))
+            if last is not None:
+                while dq and dq[0][0] < last - self._horizon:
+                    dq.popleft()
+        if now is None:
+            now = self._clock() if last is None else last
+        return self.evaluate(float(now))
+
     # ----------------------------------------------------------- the math
     def burn_rate(self, slo: str, window_s: float,
                   now: Optional[float] = None) -> float:
@@ -146,15 +183,17 @@ class BurnRateEngine:
                      1e-9)
         return (bad / n) / budget
 
-    def _class_burns(self, slo: str, now: float) -> Dict[float, float]:
-        """Every rule window's burn for one class in ONE pass — ONE
-        lock acquisition and one event walk, where per-window
-        ``burn_rate()`` calls would re-lock and re-scan 2×rules times.
-        ``evaluate()`` runs on every request finish, so this is the
-        hot shape. Same per-event comparison as :meth:`burn_rate`
-        (``t >= now - w``), so results are bit-identical: each event
-        charges its SMALLEST containing window, then a running suffix
-        sum folds it into every larger one."""
+    def _class_burns(self, slo: str, now: float
+                     ) -> Tuple[Dict[float, float], Dict[float, int]]:
+        """Every rule window's (burn, event count) for one class in
+        ONE pass — ONE lock acquisition and one event walk, where
+        per-window ``burn_rate()`` calls would re-lock and re-scan
+        2×rules times. ``evaluate()`` runs on every request finish, so
+        this is the hot shape. Same per-event comparison as
+        :meth:`burn_rate` (``t >= now - w``), so results are
+        bit-identical: each event charges its SMALLEST containing
+        window, then a running suffix sum folds it into every larger
+        one."""
         windows = self._windows
         with self._lock:
             events = list(self._events.get(slo, ()))
@@ -170,12 +209,14 @@ class BurnRateEngine:
                     first_bad[i] += b
                     break
         out: Dict[float, float] = {}
+        counts: Dict[float, int] = {}
         cn = cb = 0
         for i, w in enumerate(windows):
             cn += first_n[i]
             cb += first_bad[i]
             out[w] = (cb / cn) / budget if cn else 0.0
-        return out
+            counts[w] = cn
+        return out, counts
 
     def _gauge(self, slo: str, window_s: float):
         key = (slo, f"{window_s:g}s")
@@ -200,7 +241,7 @@ class BurnRateEngine:
         for slo in classes:
             budget = max(1.0 - self.targets.get(slo, DEFAULT_TARGET),
                          1e-9)
-            burns = self._class_burns(slo, now)
+            burns, counts = self._class_burns(slo, now)
             for w, b in burns.items():
                 self._gauge(slo, w).set(b)
             for rule in self.rules:
@@ -213,7 +254,9 @@ class BurnRateEngine:
                     active = key in self._active
                 ev = None
                 if not active and bf >= rule.threshold \
-                        and bs >= rule.threshold:
+                        and bs >= rule.threshold \
+                        and counts[rule.slow_s] \
+                        >= self.min_window_events:
                     ev = self._transition(
                         "fire", slo, rule, bf, bs, budget, now)
                 elif active and bf <= rule.threshold \
@@ -285,8 +328,8 @@ class BurnRateEngine:
             "window_scale": self.window_scale,
             "rules": [r._asdict() for r in self.rules],
             "burn": {slo: {f"{w:g}s": round(b, 3)
-                           for w, b in self._class_burns(slo,
-                                                         now).items()}
+                           for w, b in self._class_burns(
+                               slo, now)[0].items()}
                      for slo in classes},
             "active": self.active(),
             "fires_total": self.fires_total,

@@ -48,6 +48,9 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 ENV_VAR = "PADDLE_TPU_FAULTS"
+# per-site stderr/flight-event verbosity cap (ISSUE 16: storm-class
+# sites fire thousands of times per armed window)
+VERBOSE_FIRES_PER_SITE = 8
 SEED_ENV_VAR = "PADDLE_TPU_FAULT_SEED"
 HANG_ENV_VAR = "PADDLE_TPU_FAULT_HANG_S"
 PREFETCH_STALL_ENV_VAR = "PADDLE_TPU_FAULT_PREFETCH_STALL_S"
@@ -161,6 +164,31 @@ SITES: Dict[str, Tuple[str, str]] = {
         "replica's health/gossip probe (congested peer stand-in; the "
         "staleness bound must evict a peer whose probes stop landing, "
         "never wedge the router)"),
+    # --- frontend HA chaos (ISSUE 16): the frontend tier's own
+    # failure modes, exercised by the fleet sim's chaos schedules and
+    # the --frontend-kill loadgen.
+    "frontend_conn_drop": (
+        "paddle_tpu/serving/fleet/frontend.py:"
+        "FleetFrontend._proxy_stream",
+        "sever the CLIENT->frontend leg of an in-flight proxied "
+        "stream (frontend process death stand-in; the client holds "
+        "only its committed prefix and must resume against a "
+        "surviving sibling frontend via resume_tokens — zero lost, "
+        "zero duplicated committed tokens)"),
+    "gossip_partition": (
+        "paddle_tpu/serving/fleet/remote.py:RemoteReplica._probe_once",
+        "partition the GOSSIP channel only: the health leg lands but "
+        "digest/metrics fetches are dropped (also severs "
+        "frontend<->frontend /gossipz links in serving/fleet/ha.py); "
+        "peers stay routable while warm routing degrades toward "
+        "least-loaded — a partition must never read as an outage"),
+    "peer_storm": (
+        "paddle_tpu/serving/fleet/remote.py:probe_delay",
+        "collapse the seeded probe-round jitter to zero delay so "
+        "every armed peer's next round fires NOW (thundering-herd "
+        "stand-in at N frontends x M peers; the fleet sim's "
+        "probe-storm schedule arms it and must page, while the "
+        "jittered clean twin stays quiet)"),
 }
 
 
@@ -207,18 +235,26 @@ class FaultPlan:
         for r in rules:
             self.rules.setdefault(r.site, []).append(r)
         self._occ: Dict[str, int] = {}
+        self._fires: Dict[str, int] = {}
         self._rng: Dict[str, random.Random] = {
             s: random.Random(f"{seed}:{s}") for s in self.rules}
         self._lock = threading.Lock()
 
-    def should_fire(self, site: str) -> Tuple[bool, int]:
+    def should_fire(self, site: str) -> Tuple[bool, int, int]:
+        """Returns (fired, occurrence index, fire index). The fire
+        index drives per-site verbosity capping — high-frequency sites
+        (``peer_storm`` fires every armed probe round; the fleet sim
+        arms it at thousands of rounds) must not flood stderr or evict
+        the flight-recorder window."""
         with self._lock:
             occ = self._occ.get(site, 0)
             self._occ[site] = occ + 1
             for rule in self.rules.get(site, ()):
                 if rule.matches(occ, self._rng[site]):
-                    return True, occ
-        return False, occ
+                    n = self._fires.get(site, 0)
+                    self._fires[site] = n + 1
+                    return True, occ, n
+        return False, occ, self._fires.get(site, 0)
 
     def occurrences(self, site: str) -> int:
         with self._lock:
@@ -295,20 +331,31 @@ def inject(site: str, **ctx) -> bool:
     plan = _active_plan()
     if plan is None:
         return False
-    fired, occ = plan.should_fire(site)
+    fired, occ, nth = plan.should_fire(site)
     if fired:
-        info = " ".join(f"{k}={v}" for k, v in sorted(ctx.items()))
-        print(f"[faults] firing {site} (occurrence {occ})"
-              + (f" {info}" if info else ""),
-              file=sys.stderr, flush=True)
-        # observability: every fire lands in the flight recorder (the
-        # postmortem window must show WHICH chaos preceded the crash)
-        # and in a per-site counter. Imported lazily on the rare fired
-        # path; the unarmed hot path stays a dict lookup + env read.
+        # verbose for the first few fires per site, then one suppression
+        # notice: a storm-class site fires thousands of times per armed
+        # window and must not flood stderr or evict the flight window
+        # (the counter keeps the full tally either way)
+        if nth < VERBOSE_FIRES_PER_SITE:
+            info = " ".join(f"{k}={v}" for k, v in sorted(ctx.items()))
+            print(f"[faults] firing {site} (occurrence {occ})"
+                  + (f" {info}" if info else ""),
+                  file=sys.stderr, flush=True)
+        elif nth == VERBOSE_FIRES_PER_SITE:
+            print(f"[faults] {site} keeps firing; further fires "
+                  f"logged only to fault_fires_total",
+                  file=sys.stderr, flush=True)
+        # observability: the early fires land in the flight recorder
+        # (the postmortem window must show WHICH chaos preceded the
+        # crash) and every fire in a per-site counter. Imported lazily
+        # on the fired path; the unarmed hot path stays a dict lookup
+        # + env read.
         try:
             from . import observability as obs
-            obs.record_event("fault_fire", site=site, occurrence=occ,
-                             **ctx)
+            if nth <= VERBOSE_FIRES_PER_SITE:
+                obs.record_event("fault_fire", site=site,
+                                 occurrence=occ, **ctx)
             obs.counter("fault_fires_total", site=site).inc()
         except Exception:
             pass      # telemetry must never break the chaos experiment

@@ -455,9 +455,10 @@ class _FakeSignals:
         self.queue_depth = 0
         self.free_slots = 4
         self.total_slots = 4
+        self.up = True        # a SIGKILLed peer: unhealthy AND stale
 
     def signals(self):
-        return {"healthy": True, "stale": False,
+        return {"healthy": self.up, "stale": not self.up,
                 "load": self.total_slots - self.free_slots,
                 "queue_depth": self.queue_depth,
                 "free_slots": self.free_slots,
@@ -542,6 +543,145 @@ def test_autoscaler_pending_spawns_and_replica_seconds():
     # replica-seconds integrate (live + pending) at step boundaries
     assert sc.replica_seconds == pytest.approx(
         (1.0 - 0.0) * (1 + 3) + (2.0 - 1.0) * (2 + 4), abs=1e-6)
+
+
+def test_autoscaler_mass_outage_freeze_and_thaw():
+    """ISSUE 16: a correlated outage takes most peers stale at once —
+    the survivors' aggregate (stale peers excluded) reads idle, and
+    the classic failure is scaling DOWN during the incident. The loop
+    must FREEZE instead (no action either way, one freeze event),
+    then thaw and resume normal decisions when peers return."""
+    t = [0.0]
+    m = _FakeManager(4)
+    sc = FleetAutoscaler(m, min_replicas=1, max_replicas=8,
+                         hold_s=0.5, hold_down_s=0.5, cooldown_s=0.0,
+                         signal_mode="instant",
+                         outage_freeze_frac=0.5,
+                         clock=lambda: t[0])
+    assert sc.step()["action"] is None
+    # 3 of 4 peers go dark: live (1) <= replicas (4) * (1 - 0.5)
+    for r in m.reps[1:]:
+        r.up = False
+    t[0] = 1.0
+    agg = sc.step()
+    assert agg["frozen"] and agg["action"] is None
+    assert sc.events[-1]["action"] == "freeze"
+    assert sc.events[-1]["stale"] == 3
+    # idle survivors held across the whole incident: never a down
+    for dt in (1.5, 2.0, 2.5, 3.0):
+        t[0] = dt
+        assert sc.step()["action"] is None
+    assert m.downs == 0 and m.ups == 0
+    # recovery thaws the loop; hold windows restart from the thaw
+    for r in m.reps:
+        r.up = True
+    t[0] = 4.0
+    agg = sc.step()
+    assert not agg.get("frozen") and agg["action"] is None
+    assert sc.events[-1]["action"] == "thaw"
+    # post-thaw the normal idle scale-down path works again
+    t[0] = 5.0
+    assert sc.step()["action"] == "down" and m.downs == 1
+    assert sc.snapshot()["freezes"] == 1
+
+
+# ========================================================== tie rotation
+def test_router_least_loaded_rotates_ties():
+    """Probe-quantized load ties at fleet scale: first-minimum herds
+    every miss onto the lowest-index replica. The router must rotate
+    among tied minima (the 1000-replica sim measured ~6% of a light
+    clean load shed off the herd target before this)."""
+    class _R:
+        def __init__(self, name):
+            self.name = name
+
+        def healthy(self):
+            return True
+
+        def has_prefix(self, d):
+            return False
+
+        def load(self):
+            return 0.0
+
+    reps = [_R(f"r{i}") for i in range(3)]
+    router = PrefixAffinityRouter(reps)
+    picks = [router.route() for _ in range(6)]
+    assert set(p.name for p in picks) == {"r0", "r1", "r2"}
+    # a strict minimum still wins outright
+    reps[0].load = lambda: 1.0
+    reps[1].load = lambda: 1.0
+    assert all(router.route() is reps[2] for _ in range(3))
+
+
+# ====================================================== burn bootstrap
+def test_burn_engine_min_window_events_gates_bootstrap():
+    """A burn ratio over single-digit samples is noise: with
+    ``min_window_events`` set, a hot ratio in an almost-empty
+    bootstrap window does NOT page; the same ratio over a populated
+    window does. Resolves are never gated."""
+    from paddle_tpu.serving import BurnRateEngine
+    eng = BurnRateEngine(window_scale=0.2, min_window_events=10,
+                         labels={"fleet": "t-minwin"}, clock=lambda: 0)
+    # 3 outcomes, all bad: burn is sky-high but the window is empty
+    assert eng.observe_many("interactive",
+                            [(1.0, False), (1.5, False),
+                             (2.0, False)], now=2.0) == []
+    assert eng.fires_total == 0
+    # the ungated twin pages on exactly that noise
+    loose = BurnRateEngine(window_scale=0.2, min_window_events=0,
+                           labels={"fleet": "t-minwin0"},
+                           clock=lambda: 0)
+    evs = loose.observe_many("interactive",
+                             [(1.0, False), (1.5, False),
+                              (2.0, False)], now=2.0)
+    assert any(e["kind"] == "fire" for e in evs)
+    # populate past the floor: the gated engine now fires too
+    outcomes = [(3.0 + 0.1 * i, False) for i in range(12)]
+    evs = eng.observe_many("interactive", outcomes, now=4.2)
+    assert any(e["kind"] == "fire" and e["rule"] == "page"
+               for e in evs)
+
+
+# ======================================================= frontend gossip
+def test_frontend_gossip_link_merges_digests_and_sticky():
+    """One FrontendLink round moves sibling state the right way:
+    digest sets adopt only FORWARD by the peer's own generation,
+    sticky entries fill only local gaps (resolved through the local
+    adapter objects), and a partitioned round changes nothing."""
+    from paddle_tpu.serving.fleet import FrontendLink
+
+    def make(name):
+        fe = FleetFrontend([], chunk_tokens=None, name=name,
+                           trace=False)
+        rep = RemoteReplica("p0", "127.0.0.1", 1)
+        fe.add_peer(rep)
+        return fe, rep
+
+    fe_a, rep_a = make("t-gsp-a")
+    fe_b, rep_b = make("t-gsp-b")
+    assert rep_b.adopt_digests(["d1", "d2"], 5)
+    assert fe_b._router.merge_sticky({"d1": "p0"}, {"p0": rep_b}) == 1
+    link = FrontendLink(fe_a, fe_b, seed=3)
+    # partition first: the armed fault site severs the round cleanly
+    with faults.scoped("gossip_partition"):
+        assert not link.exchange()
+    assert link.partitioned_total == 1
+    assert rep_a.gossip_view()["generation"] == -1   # untouched
+    # clean round: digests + sticky cross; generation follows the peer
+    assert link.exchange()
+    assert link.snapshot()["adopted_digest_sets"] == 1
+    assert link.snapshot()["adopted_sticky"] == 1
+    view = rep_a.gossip_view()
+    assert view["digests"] == ["d1", "d2"] and view["generation"] == 5
+    assert fe_a._router.export_sticky() == {"d1": "p0"}
+    # idempotent: an unchanged sibling adopts nothing more
+    assert link.exchange()
+    assert link.snapshot()["adopted_digest_sets"] == 1
+    assert link.snapshot()["adopted_sticky"] == 1
+    # a STALER sibling view can never roll the local one back
+    assert not rep_a.adopt_digests(["old"], 4)
+    assert rep_a.gossip_view()["digests"] == ["d1", "d2"]
 
 
 # ================================================================ diurnal
@@ -723,3 +863,31 @@ def test_fleet_multiproc_autoscale_diurnal():
     assert rung["goodput_per_replica"] > 0
     assert rung["replica_seconds"] > 0
     assert rung["mean_replicas"] >= 1.0
+
+
+@pytest.mark.slow
+def test_fleet_multiproc_frontend_ha_kill():
+    """The ISSUE 16 live acceptance, small: TWO gossip-linked
+    frontends over one replica-process fleet, one frontend SIGKILLed
+    mid-run — every in-flight client retries against the surviving
+    sibling carrying its committed prefix (resume seam, one tier up),
+    zero corrupted streams, zero client/server resume mismatches, all
+    requests complete."""
+    slg = _load_loadgen()
+    ns = _loadgen_ns(requests=16, rate=15.0, max_new=8, seed=7,
+                     fleet=2, frontends=2, frontend_kill=1,
+                     fleet_kill=0, failover_budget=2,
+                     goodput_floor=0.95, autoscale=False,
+                     diurnal=False)
+    rung = asyncio.run(slg.run_loadgen(ns))
+    gate = rung["fleet_gate"]
+    assert gate["ok"], gate
+    assert gate["frontend_kills"] == 1
+    assert gate["corrupted_streams"] == 0
+    assert gate["resume_mismatches"] == 0
+    assert rung["completed"] == 16
+    ha = rung["frontend_ha"]
+    assert ha["frontends"] == 2 and len(ha["frontend_kills"]) == 1
+    assert ha["resumed_failed"] == 0
+    # the mesh actually gossiped before (and after) the kill
+    assert sum(g["rounds"] for g in ha["gossip"]) > 0

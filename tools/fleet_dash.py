@@ -9,11 +9,17 @@ loadgen, dump rings, join offline" debugging loop with one look.
     python tools/fleet_dash.py series_gw0.json [...]  # specific files
     python tools/fleet_dash.py --url HOST:PORT        # live fleet
     python tools/fleet_dash.py --url HOST:PORT --watch 30
+    python tools/fleet_dash.py SIM_DUMP_DIR           # fleet_sim runs
 
 File mode reads the ``series_<name>.json`` documents a drained
 gateway (or ``observability.reset()``) flushes — each file becomes
 one replica row — plus any ``flight_*.json`` beside them for
-``fleet_autoscale`` events. Live mode polls a gateway's or fleet
+``fleet_autoscale`` events. ``tools/fleet_sim.py --dump-dir`` writes
+the SAME two document shapes (``sim_*_series.json`` /
+``sim_*_flight.json``, frontend-level ``fleet_*`` metrics, injected
+incidents and frontend kills in the flight log), so a rehearsed
+1000-replica incident renders on the identical timeline axis as a
+live run — that is the point of sharing the writer (ISSUE 16). Live mode polls a gateway's or fleet
 frontend's ``GET /metricsz`` (the frontend federates every peer's
 cached windowed doc, so one URL shows the whole fleet) and redraws
 until ``--watch`` seconds elapse.
@@ -111,11 +117,34 @@ def doc_time_range(docs: Dict[str, dict]) -> Tuple[float, float]:
     return min(ts), max(ts)
 
 
+def _flight_event(ev: dict, t: float) -> Optional[dict]:
+    """One flight-recorder event → one timeline marker (or None for
+    kinds the dashboard doesn't chart). Covers both the live
+    recorder's ``fleet_autoscale`` and the simulator's injected
+    ``incident_*`` / ``frontend_kill`` chaos events."""
+    kind = ev.get("kind")
+    if kind == "fleet_autoscale":
+        return {"t": t, "kind": f"scale_{ev.get('action')}",
+                "who": ev.get("fleet", "fleet"),
+                "what": f"replicas_before="
+                        f"{ev.get('replicas_before')}"}
+    if kind in ("incident_start", "incident_end"):
+        return {"t": t, "kind": kind,
+                "who": ev.get("incident", "incident"),
+                "what": "page expected"
+                if ev.get("page_expected") else ""}
+    if kind == "frontend_kill":
+        return {"t": t, "kind": "frontend_kill",
+                "who": ev.get("frontend", "frontend"),
+                "what": "SIGKILL (leaderless failover)"}
+    return None
+
+
 def collect_events(docs: Dict[str, dict],
                    flights: List[dict]) -> List[dict]:
-    """Alerts from the series docs + autoscaler actions from flight
-    dumps, mapped onto the series' monotonic axis via each doc's
-    ``dumped_wall``/``clock_now`` offset."""
+    """Alerts from the series docs + autoscaler actions / injected
+    chaos from flight dumps, mapped onto the series' monotonic axis
+    via each doc's ``dumped_wall``/``clock_now`` offset."""
     events = []
     for name, d in docs.items():
         off = None
@@ -130,13 +159,12 @@ def collect_events(docs: Dict[str, dict],
                                    f"burn={a.get('burn_fast')}"})
         for fl in flights:
             for ev in fl.get("events", ()):
-                if ev.get("kind") != "fleet_autoscale" or off is None:
+                if off is None:
                     continue
-                events.append({"t": ev.get("wall", 0.0) - off,
-                               "kind": f"scale_{ev.get('action')}",
-                               "who": ev.get("fleet", "fleet"),
-                               "what": f"replicas_before="
-                                       f"{ev.get('replicas_before')}"})
+                mapped = _flight_event(ev,
+                                       ev.get("wall", 0.0) - off)
+                if mapped is not None:
+                    events.append(mapped)
         flights = []   # flight events mapped once, via the first doc
     seen = set()
     out = []
@@ -146,6 +174,28 @@ def collect_events(docs: Dict[str, dict],
             seen.add(key)
             out.append(ev)
     return out
+
+
+def _doc_rows(d: dict) -> tuple:
+    """Pick the three sparkline rows by what the doc actually holds:
+    a gateway series doc carries ``gateway_*`` metrics, a fleet_sim
+    (or frontend-level) doc carries the frontend's ``fleet_*``
+    counters — same renderer either way."""
+    bases = {full.split("{", 1)[0]
+             for full in (d.get("metrics") or {})}
+    if "gateway_tokens_total" not in bases \
+            and "fleet_requests_total" in bases:
+        return (
+            ("req/s", _metric_points(d, "fleet_requests_total")),
+            ("tok/s", _metric_points(d,
+                                     "fleet_proxied_tokens_total")),
+            ("burn", _metric_points(d, "slo_burn_rate", agg=max)),
+        )
+    return (
+        ("tok/s", _metric_points(d, "gateway_tokens_total")),
+        ("queue", _metric_points(d, "gateway_queue_depth")),
+        ("burn", _metric_points(d, "slo_burn_rate", agg=max)),
+    )
 
 
 def render(docs: Dict[str, dict], events: Optional[List[dict]] = None,
@@ -161,11 +211,7 @@ def render(docs: Dict[str, dict], events: Optional[List[dict]] = None,
     lines.append(f"{'':<12s} {axis}")
     for name in sorted(docs):
         d = docs[name]
-        rows = (
-            ("tok/s", _metric_points(d, "gateway_tokens_total")),
-            ("queue", _metric_points(d, "gateway_queue_depth")),
-            ("burn", _metric_points(d, "slo_burn_rate", agg=max)),
-        )
+        rows = _doc_rows(d)
         for label, pts in rows:
             vals = resample(pts, t0, t1, width)
             present = [v for v in vals if v is not None]
@@ -183,9 +229,12 @@ def render(docs: Dict[str, dict], events: Optional[List[dict]] = None,
             i = int((t - t0) / max(t1 - t0, 1e-9) * (width - 1))
             row[max(0, min(i, width - 1))] = \
                 "!" if ev["kind"].startswith("alert_fire") else \
-                "." if ev["kind"].startswith("alert") else "^"
+                "." if ev["kind"].startswith("alert") else \
+                "#" if ev["kind"].startswith("incident") else \
+                "x" if ev["kind"] == "frontend_kill" else "^"
         lines.append(f"{'events':<12s} {''.join(row)} "
-                     f"(! fire  . resolve  ^ scale)")
+                     f"(! fire  . resolve  ^ scale  # incident  "
+                     f"x fe-kill)")
         for ev in marks[-12:]:
             t = ev.get("t")
             lines.append(f"  t={t - t0:7.1f}s  {ev['kind']:<14s} "
@@ -299,8 +348,13 @@ def load_docs(paths: List[str]) -> Tuple[Dict[str, dict],
         if os.path.isdir(p):
             files += sorted(glob.glob(os.path.join(p,
                                                    "series_*.json")))
-            for fp in sorted(glob.glob(os.path.join(p,
-                                                    "flight_*.json"))):
+            # fleet_sim --dump-dir naming (same document schema)
+            files += sorted(glob.glob(os.path.join(
+                p, "sim_*_series.json")))
+            for fp in sorted(
+                    glob.glob(os.path.join(p, "flight_*.json"))
+                    + glob.glob(os.path.join(p,
+                                             "sim_*_flight.json"))):
                 try:
                     with open(fp) as f:
                         flights.append(json.load(f))

@@ -1,0 +1,316 @@
+#!/usr/bin/env python
+"""Fleet-scale chaos simulator CLI (ISSUE 16) — rehearse the
+1000-replica incidents without 1000 processes.
+
+Thin driver over :mod:`paddle_tpu.serving.fleet.sim`: instantiates the
+REAL control plane (FleetFrontend + PrefixAffinityRouter +
+FleetAutoscaler + BurnRateEngine + CircuitBreaker — ``run()`` asserts
+their identity) against in-process SimReplica stubs on a simulated
+clock, replays seeded chaos schedules (``--scenario``) or recorded
+traces (``--replay-series`` / ``--replay-reqtrace``), and scores the
+alerting plane against the injected ground truth.
+
+Outputs:
+
+- one ``SIM_JSON {...}`` line per run (full ``FleetSim.result()``);
+- the ``FLEET_SIM_r16.json`` rung next to ``bench.py`` (decisions/s,
+  aggregate alert precision/recall over the chaos scenarios, scale
+  events, HA stream accounting) — auto-ingested by bench.py with the
+  same device+freshness gate as the loadgen rungs;
+- with ``--dump-dir``: a ``series/1`` telemetry doc + flight-recorder
+  doc per run, rendered by ``tools/fleet_dash.py`` on the same
+  timeline axis as live runs.
+
+``--check`` runs a small pinned matrix (clean twin must stay silent,
+outage + storm must each page exactly once, the frontend-kill drill
+must lose zero committed tokens) and exits nonzero on any violation —
+cheap enough for tier-1.
+"""
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from paddle_tpu.serving.fleet.sim import (  # noqa: E402
+    SCENARIOS, arrivals_from_reqtrace, arrivals_from_series,
+    build_scenario)
+from paddle_tpu.utils import faults  # noqa: E402
+
+OUT_RUNG = os.path.join(ROOT, "FLEET_SIM_r16.json")
+
+
+def _device_kind() -> str:
+    """Same provenance field as the loadgen rungs so bench.py's
+    same-device promote gate treats sim numbers consistently; the sim
+    itself never touches an accelerator."""
+    try:
+        import jax
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "cpu"
+
+
+def _run_one(name, ns, seed, arrivals=None):
+    """One scenario run: fresh fault plan, build, run, optional dumps.
+    Returns the full result dict (plus scenario/seed tags)."""
+    faults.reset()
+    overrides = {}
+    for flag, key in (("slots", "slots"), ("service_s", "service_s"),
+                      ("tokens", "tokens_per_request"),
+                      ("probe_interval_s", "probe_interval_s"),
+                      ("gossip_interval_s", "gossip_interval_s")):
+        v = getattr(ns, flag)
+        if v is not None:
+            overrides[key] = v
+    if arrivals is not None:
+        overrides["arrival_times"] = arrivals
+    try:
+        sim = build_scenario(name, n_replicas=ns.replicas,
+                             n_frontends=ns.frontends,
+                             duration_s=ns.duration, seed=seed,
+                             base_rate=ns.rate, **overrides)
+        res = sim.run()
+        res["scenario"], res["seed"] = name, seed
+        if ns.dump_dir:
+            os.makedirs(ns.dump_dir, exist_ok=True)
+            stem = os.path.join(ns.dump_dir, f"sim_{name}_s{seed}")
+            res["dumps"] = {
+                "series": sim.dump_series(stem + "_series.json"),
+                "flight": sim.dump_flight(stem + "_flight.json"),
+            }
+        return res
+    finally:
+        faults.reset()
+
+
+def _aggregate(results):
+    """Micro-aggregate alert quality over every run that HAD injected
+    incidents (the clean twin contributes its false-page count only)
+    — one precision/recall pair for the rung, not a per-scenario
+    forest bench.py would have to interpret."""
+    fires = false = expected = detected = 0
+    for r in results:
+        a = r["alerts"]
+        fires += a["page_fires"]
+        false += a["false_pages"]
+        expected += a["incidents_paged_expected"]
+        detected += a["incidents_detected"]
+    return {
+        "page_fires": fires, "false_pages": false,
+        "incidents_expected": expected, "incidents_detected": detected,
+        "alert_precision": (fires - false) / fires if fires else 1.0,
+        "alert_recall": detected / expected if expected else 1.0,
+    }
+
+
+def _write_rung(results, ns):
+    import time
+    agg = _aggregate(results)
+    section = {
+        # headline: routing throughput of the REAL ladder under sim
+        # load — max over runs (the biggest fleet dominates)
+        "sim_decisions_per_sec": max(r["decisions_per_sec"]
+                                     for r in results),
+        "sim_replicas": max(r["sim"]["replicas"] for r in results),
+        "sim_frontends": max(r["sim"]["frontends"] for r in results),
+        "sim_cpu_s": round(sum(r["cpu_s"] for r in results), 3),
+        "scenarios": sorted({r["scenario"] for r in results}),
+        "seeds": sorted({r["seed"] for r in results}),
+        **agg,
+        "scale_ups": sum(r.get("scale", {}).get("ups", 0)
+                         for r in results),
+        "scale_downs": sum(r.get("scale", {}).get("downs", 0)
+                           for r in results),
+        "scale_freezes": sum(r.get("scale", {}).get("freezes", 0)
+                             for r in results),
+    }
+    ha_runs = [r for r in results if "ha" in r]
+    if ha_runs:
+        ha = {k: sum(r["ha"][k] for r in ha_runs)
+              for k in ha_runs[0]["ha"]}
+        section["ha"] = ha
+    doc = {"started": time.strftime("%Y-%m-%d %H:%M:%S"),
+           "device": _device_kind(), "argv": sys.argv[1:],
+           "fleet_sim": section}
+    tmp = ns.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, ns.out)
+    return doc
+
+
+# ------------------------------------------------------------------ check
+def check(ns) -> int:
+    """Pinned self-test: tiny fleet, fixed seed, four scenarios, hard
+    assertions on alert precision/recall and HA stream accounting.
+    This is the tier-1 gate for the whole sim stack — it exercises
+    probe scheduling, routing, breakers, burn-rate paging, the
+    autoscaler freeze and the leaderless frontend failover in ~2s."""
+    kw = dict(replicas=16, frontends=1, duration=80.0, rate=8.0,
+              slots=None, service_s=None, tokens=None,
+              probe_interval_s=None, gossip_interval_s=None,
+              dump_dir=None)
+    ns2 = argparse.Namespace(**kw)
+    bad = []
+
+    def expect(cond, what):
+        if not cond:
+            bad.append(what)
+
+    r = _run_one("clean", ns2, 1)
+    a = r["alerts"]
+    expect(a["page_fires"] == 0, f"clean twin paged: {a}")
+    expect(r["shed"] == 0, f"clean twin shed {r['shed']}")
+    expect(r["completed"] == r["requests"],
+           f"clean twin dropped requests: {r['completed']}"
+           f"/{r['requests']}")
+
+    r = _run_one("outage", ns2, 1)
+    a = r["alerts"]
+    expect(a["recall"] >= 1.0 and a["false_pages"] == 0,
+           f"outage alert quality: {a}")
+    expect(r["scale"]["freezes"] >= 1,
+           f"mass outage did not freeze the autoscaler: {r['scale']}")
+
+    r = _run_one("storm", ns2, 1)
+    a = r["alerts"]
+    expect(a["recall"] >= 1.0 and a["false_pages"] == 0,
+           f"storm alert quality: {a}")
+    expect(r["probe"]["timeouts"] > 0,
+           "storm produced no probe-capacity overflow")
+
+    ns2.frontends = 2
+    r = _run_one("ha", ns2, 1)
+    ha, a = r["ha"], r["alerts"]
+    expect(a["false_pages"] == 0, f"ha drill paged: {a}")
+    expect(ha["severed_streams"] >= 1,
+           f"frontend kill severed no streams: {ha}")
+    expect(ha["severed_streams"] == ha["resumed_streams"]
+           + ha["synthesized_streams"],
+           f"severed streams unaccounted for: {ha}")
+    expect(ha["corrupted_streams"] == 0 and ha["tokens_lost"] == 0
+           and ha["tokens_duplicated"] == 0,
+           f"frontend kill corrupted streams: {ha}")
+
+    if bad:
+        for line in bad:
+            print(f"FLEET_SIM CHECK FAIL: {line}", file=sys.stderr)
+        return 1
+    print("fleet_sim check ok: clean twin silent, outage+storm each "
+          "paged with freeze, frontend kill lost zero committed "
+          "tokens")
+    return 0
+
+
+# ------------------------------------------------------------------- main
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="pinned self-test matrix; nonzero exit on "
+                         "any alert/HA violation")
+    ap.add_argument("--scenario", action="append", default=None,
+                    choices=SCENARIOS + ("all",),
+                    help="repeatable; default: all seeded schedules")
+    ap.add_argument("--replicas", type=int, default=100)
+    ap.add_argument("--frontends", type=int, default=1,
+                    help="HA: >=2 shares routing state via gossip; "
+                         "the ha scenario forces 2")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="simulated seconds (not wall time); default "
+                         "300, or the replayed trace's span — chaos "
+                         "windows are placed relative to this, so it "
+                         "must cover the arrivals")
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="offered load, requests/s")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--seeds", type=int, default=1,
+                    help="run seeds seed..seed+N-1")
+    ap.add_argument("--slots", type=int, default=None)
+    ap.add_argument("--service-s", type=float, default=None,
+                    dest="service_s")
+    ap.add_argument("--tokens", type=int, default=None,
+                    help="tokens per request")
+    ap.add_argument("--probe-interval-s", type=float, default=None,
+                    dest="probe_interval_s")
+    ap.add_argument("--gossip-interval-s", type=float, default=None,
+                    dest="gossip_interval_s")
+    ap.add_argument("--replay-series", default=None, metavar="PATH",
+                    help="replay arrivals from a series_*.json doc "
+                         "instead of the seeded open loop")
+    ap.add_argument("--replay-reqtrace", default=None, metavar="PATH",
+                    help="replay arrivals from a dumped reqtrace ring")
+    ap.add_argument("--replay-scale", type=float, default=1.0,
+                    help="rate multiplier applied to the replayed "
+                         "trace")
+    ap.add_argument("--replay-metric",
+                    default="gateway_requests_total",
+                    help="request counter to recover arrivals from "
+                         "(a sim-produced series doc uses "
+                         "fleet_requests_total)")
+    ap.add_argument("--dump-dir", default=None,
+                    help="write per-run series + flight docs here "
+                         "(fleet_dash renders them)")
+    ap.add_argument("--out", default=OUT_RUNG,
+                    help="rung JSON path (bench.py ingests the "
+                         "default)")
+    ap.add_argument("--no-rung", action="store_true",
+                    help="skip writing the rung file")
+    ns = ap.parse_args(argv)
+
+    if ns.check:
+        return check(ns)
+
+    arrivals = None
+    if ns.replay_series and ns.replay_reqtrace:
+        ap.error("--replay-series and --replay-reqtrace are "
+                 "exclusive")
+    if ns.replay_series:
+        with open(ns.replay_series) as f:
+            arrivals = arrivals_from_series(json.load(f),
+                                            metric=ns.replay_metric,
+                                            scale=ns.replay_scale)
+    elif ns.replay_reqtrace:
+        with open(ns.replay_reqtrace) as f:
+            arrivals = arrivals_from_reqtrace(json.load(f),
+                                              scale=ns.replay_scale)
+    if ns.duration is None:
+        # the replayed trace defines the timeline (chaos windows are
+        # fractions of it); a hair past the last arrival so every
+        # replayed request drains
+        ns.duration = arrivals[-1] + 1.0 if arrivals is not None \
+            else 300.0
+
+    names = ns.scenario or ["all"]
+    if "all" in names:
+        names = list(SCENARIOS)
+    results = []
+    for name in names:
+        for seed in range(ns.seed, ns.seed + max(ns.seeds, 1)):
+            res = _run_one(name, ns, seed, arrivals=arrivals)
+            results.append(res)
+            a = res["alerts"]
+            print(f"# {name} seed={seed}: "
+                  f"decisions/s={res['decisions_per_sec']} "
+                  f"completed={res['completed']}/{res['requests']} "
+                  f"shed={res['shed']} pages={a['page_fires']} "
+                  f"false={a['false_pages']} "
+                  f"recall={a['recall']:.2f} cpu={res['cpu_s']}s",
+                  file=sys.stderr)
+            print("SIM_JSON " + json.dumps(res))
+    if not ns.no_rung:
+        doc = _write_rung(results, ns)
+        print(f"# rung -> {ns.out}: "
+              + json.dumps({k: doc["fleet_sim"][k] for k in
+                            ("sim_decisions_per_sec",
+                             "alert_precision", "alert_recall")}),
+          file=sys.stderr)
+    agg = _aggregate(results)
+    return 0 if agg["false_pages"] == 0 \
+        and agg["alert_recall"] >= 1.0 else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

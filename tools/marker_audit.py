@@ -126,6 +126,12 @@ MUST_BE_SLOW = (
     # units in test_fleet.py: proxy parity, peer-kill bitwise resume,
     # breaker rejoin, scaler hysteresis)
     r"test_fleet\.py.*multiproc",
+    # ISSUE 16: the 1000-stub fleet-sim acceptance runs (tens of
+    # seconds of discrete-event CPU each; tier-1 keeps the small
+    # 12-16 replica scenario pins in test_fleet_sim.py) and the live
+    # two-frontend HA kill e2e (real replica subprocesses + sibling
+    # frontends — matched by the multiproc pattern above)
+    r"test_fleet_sim\.py.*thousand",
     # ISSUE 11: the seeded sampled-spec distribution sweep (~190s of
     # engine runs; tier-1 keeps the residual-resample marginal unit +
     # the decisive-logits exact pin), and the ISSUE-11 tier-budget

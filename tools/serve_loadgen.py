@@ -107,15 +107,24 @@ def _force_platform():
 # ------------------------------------------------------------------ client
 async def sse_generate(host: str, port: int, payload: dict,
                        timeout_s: float = 120.0,
-                       request_id: str = None):
+                       request_id: str = None, skip: int = 0,
+                       ha: bool = False):
     """One SSE request; returns a per-request record with wire-level
     TTFT/TPOT timings (measured at the CLIENT, queueing included).
     ``request_id`` (ISSUE 10) is the CLIENT-minted trace id, sent as
     the ``X-Request-Id`` header the gateway honors — the join key
-    ``tools/trace_report.py`` matches client and server views on."""
-    rec = {"status": 0, "tokens": [], "ttft_ms": None, "tpot_ms": None,
-           "finish_reason": None, "retry_after": None,
-           "request_id": request_id}
+    ``tools/trace_report.py`` matches client and server views on.
+
+    ISSUE 16 HA: ``skip`` drops the first N token events (a resumed
+    stream re-emits the committed prefix first — dedupe by count, the
+    frontend's own rule one tier down); ``ha=True`` converts a
+    MID-STREAM connection loss (frontend SIGKILL) into a returned
+    record with ``finish_reason="severed"`` carrying the committed
+    tokens/lps, instead of raising them away — the caller retries
+    against a sibling with that prefix as ``resume_tokens``."""
+    rec = {"status": 0, "tokens": [], "lps": [], "ttft_ms": None,
+           "tpot_ms": None, "finish_reason": None,
+           "retry_after": None, "request_id": request_id}
     t0 = time.perf_counter()
     reader, writer = await asyncio.open_connection(host, port)
     try:
@@ -145,25 +154,49 @@ async def sse_generate(host: str, port: int, payload: dict,
             rec["finish_reason"] = "rejected"
             return rec
         t_first = t_last = None
-        while True:
-            ln = await asyncio.wait_for(reader.readline(), timeout_s)
-            if not ln:
-                break
-            ln = ln.strip()
-            if not ln.startswith(b"data: "):
-                continue
-            ev = json.loads(ln[6:])
-            if ev.get("done"):
-                rec["finish_reason"] = ev.get(
-                    "finish_reason", "error" if "error" in ev else None)
-                rec["tokens"] = ev.get("tokens", rec["tokens"])
-                break
-            now = time.perf_counter()
-            t_last = now
-            if t_first is None:
-                t_first = now
-                rec["ttft_ms"] = (now - t0) * 1e3
-            rec["tokens"].append(ev["token"])
+        seen = 0
+        try:
+            while True:
+                ln = await asyncio.wait_for(reader.readline(),
+                                            timeout_s)
+                if not ln:
+                    if ha:
+                        rec["finish_reason"] = "severed"
+                    break
+                ln = ln.strip()
+                if not ln.startswith(b"data: "):
+                    continue
+                ev = json.loads(ln[6:])
+                if ev.get("done"):
+                    rec["finish_reason"] = ev.get(
+                        "finish_reason",
+                        "error" if "error" in ev else None)
+                    if skip == 0:
+                        rec["tokens"] = ev.get("tokens", rec["tokens"])
+                    else:
+                        # resumed stream: keep the streamed NEW tokens
+                        # authoritative for the caller's merge; the
+                        # server's full list rides along for the
+                        # bitwise cross-check
+                        rec["final_tokens"] = ev.get("tokens")
+                    break
+                seen += 1
+                if seen <= skip:
+                    continue    # committed-prefix replay: dedupe
+                now = time.perf_counter()
+                t_last = now
+                if t_first is None:
+                    t_first = now
+                    rec["ttft_ms"] = (now - t0) * 1e3
+                rec["tokens"].append(ev["token"])
+                rec["lps"].append(ev.get("lp"))
+        except (ConnectionError, OSError) as e:
+            # mid-stream sever (the frontend died under us): the
+            # committed prefix in rec is the client's resume state
+            if not ha:
+                raise
+            rec["finish_reason"] = "severed"
+            rec["error"] = repr(e)[:80]
         n = len(rec["tokens"])
         if t_first is not None and t_last is not None and n >= 2:
             rec["tpot_ms"] = (t_last - t_first) / (n - 1) * 1e3
@@ -174,6 +207,71 @@ async def sse_generate(host: str, port: int, payload: dict,
             await writer.wait_closed()
         except Exception:
             pass
+
+
+async def sse_generate_ha(targets, start: int, payload: dict,
+                          timeout_s: float = 120.0,
+                          request_id: str = None, resumes: int = 2):
+    """Leaderless-HA client (ISSUE 16): one logical request across up
+    to ``resumes`` frontend failovers. A severed stream (frontend
+    SIGKILL mid-flight) is retried against the NEXT frontend with the
+    committed prefix as ``resume_tokens``/``resume_lps`` — the same
+    resubmit the frontend itself performs one tier down when a PEER
+    dies — so the client sees every token exactly once and a greedy
+    stream stays bitwise the uninterrupted run's."""
+    orig_prompt = list(payload["prompt"])
+    orig_max = int(payload["max_new_tokens"])
+    committed, lps = [], []
+    first_ttft = None
+    rec = None
+    for attempt in range(resumes + 1):
+        h, p = targets[(start + attempt) % len(targets)]
+        if committed:
+            spec = dict(payload,
+                        prompt=orig_prompt + committed,
+                        resume_tokens=list(committed),
+                        resume_lps=list(lps),
+                        max_new_tokens=orig_max - len(committed))
+        else:
+            spec = payload
+        try:
+            rec = await sse_generate(h, p, spec, timeout_s,
+                                     request_id=request_id,
+                                     skip=len(committed), ha=True)
+        except (ConnectionError, OSError) as e:
+            # refused/reset before any response (corpse still in the
+            # client's rotation): nothing new committed, next sibling
+            rec = {"status": 0, "tokens": [], "lps": [],
+                   "ttft_ms": None, "tpot_ms": None,
+                   "finish_reason": "severed", "retry_after": None,
+                   "request_id": request_id, "error": repr(e)[:80]}
+        if rec["ttft_ms"] is not None and first_ttft is None:
+            first_ttft = rec["ttft_ms"]
+        if rec["finish_reason"] == "severed":
+            committed += rec["tokens"]
+            lps += rec["lps"]
+            continue
+        # terminal (done / rejected / error): merge the resume chain
+        rec["resumes"] = attempt
+        if committed:
+            full = committed + rec["tokens"]
+            ft = rec.pop("final_tokens", None)
+            if ft is not None and ft != full:
+                # the server's authoritative list disagrees with the
+                # client's merge: a real token was lost or duplicated
+                # across the failover — surface it, don't paper over
+                rec["resume_mismatch"] = {"client": len(full),
+                                          "server": len(ft)}
+            rec["tokens"] = full
+            rec["lps"] = lps + rec["lps"]
+            rec["ttft_ms"] = first_ttft
+        return rec
+    # every attempt severed: report the request as a conn_error with
+    # whatever prefix was committed (the gate counts it against the
+    # goodput floor)
+    rec = dict(rec, tokens=committed + rec["tokens"],
+               finish_reason="conn_error", resumes=resumes)
+    return rec
 
 
 # ----------------------------------------------------------------- fleet
@@ -294,12 +392,27 @@ def _build_fleet(ns):
     _force_platform()
     from paddle_tpu.serving.fleet import (FleetAutoscaler,
                                           FleetFrontend,
-                                          LocalProcessManager)
+                                          LocalProcessManager,
+                                          link_frontends)
     chunk = ns.sys_tokens or 8
-    fe = FleetFrontend([], chunk_tokens=chunk, routing=ns.policy,
-                       failover_budget=getattr(ns, "failover_budget",
-                                               2),
-                       breaker_backoff_s=0.2, name="fleet")
+    n_fe = max(int(getattr(ns, "frontends", 1) or 1), 1)
+    fes = []
+    for i in range(n_fe):
+        # the single-frontend name stays "fleet" (metric labels and
+        # rung fields downstream key on it); HA siblings are fleet0..
+        name = "fleet" if n_fe == 1 else f"fleet{i}"
+        fes.append(FleetFrontend(
+            [], chunk_tokens=chunk, routing=ns.policy,
+            failover_budget=getattr(ns, "failover_budget", 2),
+            breaker_backoff_s=0.2, name=name))
+    fe = fes[0]
+    links = []
+    if n_fe > 1:
+        # leaderless HA (ISSUE 16): full-mesh gossip of prefix
+        # digests, breaker states and sticky assignments — a fast
+        # cadence so a CI-length run converges before the kill
+        links = link_frontends(fes, interval_s=0.25,
+                               seed=getattr(ns, "seed", 0))
     extra = []
     trace_dir = getattr(ns, "trace_dir", None)
     if trace_dir:
@@ -318,7 +431,8 @@ def _build_fleet(ns):
     else:
         extra += ["--telemetry", "off"]
     manager = LocalProcessManager(
-        fe, model=ns.model if ns.model in ("stub", "tiny") else "stub",
+        fes, model=ns.model if ns.model in ("stub", "tiny")
+        else "stub",
         chunk_tokens=chunk, extra_args=extra,
         probe_interval_s=0.1, stale_after_s=1.5)
     for _ in range(ns.fleet):
@@ -336,7 +450,7 @@ def _build_fleet(ns):
             signal_mode=getattr(ns, "autoscale_mode", "windowed"),
             signal_window_s=getattr(ns, "autoscale_window_s", 1.0))
         fe.attach_autoscaler(scaler)
-    return fe, manager, scaler
+    return fes, manager, scaler, links
 
 
 # ------------------------------------------------------------------- run
@@ -381,6 +495,7 @@ async def run_loadgen(ns) -> dict:
     rng = random.Random(ns.seed)
     gw = engines = engine_factory = None
     fe = manager = scaler = None
+    fes, fe_links = [], []
     chaos = bool(getattr(ns, "chaos", False))
     fleet = int(getattr(ns, "fleet", 0) or 0)
     urls = ns.url if isinstance(ns.url, list) \
@@ -393,6 +508,9 @@ async def run_loadgen(ns) -> dict:
         raise SystemExit("--delta off requires in-process replicas "
                          "(no --fleet / --url): fleet peers and "
                          "external servers don't receive it")
+    if int(getattr(ns, "frontends", 1) or 1) > 1 and not fleet:
+        raise SystemExit("--frontends needs --fleet: sibling "
+                         "frontends share one replica-process fleet")
     if urls:
         if chaos or fleet:
             raise SystemExit("--chaos/--fleet require self-hosted "
@@ -406,9 +524,11 @@ async def run_loadgen(ns) -> dict:
         if chaos:
             raise SystemExit("--chaos is the single-process harness; "
                              "the fleet analogue is --fleet-kill")
-        fe, manager, scaler = _build_fleet(ns)
-        await fe.start()
-        targets = [(fe.host, fe.port)]
+        fes, manager, scaler, fe_links = _build_fleet(ns)
+        fe = fes[0]
+        for f in fes:
+            await f.start()
+        targets = [(f.host, f.port) for f in fes]
     else:
         gw, engines, engine_factory = _build_gateway(ns)
         await gw.start()
@@ -474,6 +594,28 @@ async def run_loadgen(ns) -> dict:
                       f"--fleet-kill points fit", file=sys.stderr)
                 break
             fleet_kill_plan.add(pt)
+    # frontend SIGKILL schedule (ISSUE 16 HA): sever a FRONTEND
+    # mid-run — the last single point of failure. Clients recover by
+    # resuming against a surviving sibling; requires >= 2 frontends.
+    fe_kill_plan = set()
+    fe_kill_events = []
+    fe_dead = set()
+    n_fe_kills = int(getattr(ns, "frontend_kill", 0) or 0)
+    if n_fe_kills > 0:
+        if len(fes) < 2:
+            raise SystemExit("--frontend-kill needs --frontends >= 2: "
+                             "clients must have a survivor to resume "
+                             "against")
+        if n_fe_kills >= len(fes):
+            raise SystemExit(f"--frontend-kill {n_fe_kills} would "
+                             f"leave no survivor of {len(fes)} "
+                             "frontends")
+        for j in range(n_fe_kills):
+            pt = max(1, round((j + 1) * ns.requests
+                              / (n_fe_kills + 1)))
+            while pt in fe_kill_plan and pt < ns.requests - 1:
+                pt += 1
+            fe_kill_plan.add(pt)
     krng = random.Random(ns.seed + 2)
     # seeded diurnal phase: the trace is deterministic per --seed
     phase = random.Random(ns.seed + 3).uniform(0, 2 * math.pi)
@@ -516,8 +658,17 @@ async def run_loadgen(ns) -> dict:
         # 13 satellite: several --url targets, or the one frontend)
         th, tp = targets[i % len(targets)]
         try:
-            rec = await sse_generate(th, tp, payload,
-                                     request_id=rid)
+            if len(fes) > 1:
+                # HA client (ISSUE 16): round-robin over the sibling
+                # frontends, resuming a severed stream on the next
+                # one with the committed prefix
+                rec = await sse_generate_ha(
+                    targets, i % len(targets), payload,
+                    request_id=rid,
+                    resumes=max(2, len(targets)))
+            else:
+                rec = await sse_generate(th, tp, payload,
+                                         request_id=rid)
         except (ConnectionError, OSError, asyncio.TimeoutError) as e:
             # one dropped connection (external gateway restarting,
             # request timeout) must not discard the whole run's rung
@@ -552,6 +703,18 @@ async def run_loadgen(ns) -> dict:
         name = manager.kill(names[krng.randrange(len(names))])
         fleet_kill_events.append({"at_request": i, "peer": name})
 
+    def _fire_frontend_kill(i):
+        live = [j for j in range(len(fes)) if j not in fe_dead]
+        if len(live) < 2:
+            return               # never kill the last survivor
+        victim = live[krng.randrange(len(live))]
+        fe_dead.add(victim)
+        fes[victim].kill()
+        fe_kill_events.append({"at_request": i,
+                               "frontend": fes[victim].name})
+        print(f"# frontend kill: {fes[victim].name} at request {i}",
+              file=sys.stderr)
+
     t0 = time.perf_counter()
     tasks = []
     for i in range(ns.requests):
@@ -560,6 +723,8 @@ async def run_loadgen(ns) -> dict:
             _fire_chaos(i)
         if i in fleet_kill_plan:
             _fire_fleet_kill(i)
+        if i in fe_kill_plan:
+            _fire_frontend_kill(i)
         if i < ns.requests - 1:
             # open-loop Poisson arrivals: exponential gaps at the
             # offered rate, slept regardless of completions. --diurnal
@@ -691,9 +856,29 @@ async def run_loadgen(ns) -> dict:
         rung["metric"] = "fleet_serving"
         rung["fleet_tokens_per_sec"] = round(total_tokens / wall, 1)
         rung["fleet_replicas"] = fleet
-        rung["fleet_peer_failovers"] = hz["peer_failovers"]
+        rung["fleet_peer_failovers"] = sum(
+            f.healthz()["peer_failovers"] for f in fes) \
+            if len(fes) > 1 else hz["peer_failovers"]
         rung["fleet_retry_budget_exhausted"] = \
             hz["retry_budget_exhausted"]
+        if len(fes) > 1:
+            # frontend HA accounting (ISSUE 16): the client-observed
+            # failover story — severed streams must all be resumed
+            # with the committed prefix intact
+            resumed = [r for r in records if r.get("resumes", 0) > 0]
+            rung["frontend_ha"] = {
+                "frontends": len(fes),
+                "frontend_kills": fe_kill_events,
+                "resumed_streams": sum(
+                    1 for r in resumed
+                    if r["finish_reason"] == "stop"),
+                "resumed_failed": sum(
+                    1 for r in resumed
+                    if r["finish_reason"] != "stop"),
+                "resume_mismatches": sum(
+                    1 for r in records if r.get("resume_mismatch")),
+                "gossip": [ln.snapshot() for ln in fe_links],
+            }
         rung["replica_seconds"] = round(rep_secs, 2)
         rung["mean_replicas"] = round(rep_secs / max(wall, 1e-9), 2)
         rung["goodput_per_replica"] = round(
@@ -743,14 +928,21 @@ async def run_loadgen(ns) -> dict:
             rung["peak_burn_rate"] = max(peak.values(), default=0.0)
             rung["peak_burn_by_class"] = peak
         if ns.model == "stub":
-            rung["fleet_gate"] = _verify_fleet(ns, hz, records,
-                                               fleet_kill_events)
-        await fe.drain()
+            rung["fleet_gate"] = _verify_fleet(
+                ns, hz, records, fleet_kill_events,
+                frontend_kills=fe_kill_events)
+        for ln in fe_links:
+            ln.stop()
+        for j, f in enumerate(fes if fes else [fe]):
+            if j in fe_dead:
+                continue          # a killed frontend has no streams
+            await f.drain()
         manager.stop_all()
     return rung
 
 
-def _verify_fleet(ns, fleet_health, records, kill_events):
+def _verify_fleet(ns, fleet_health, records, kill_events,
+                  frontend_kills=()):
     """The fleet acceptance gate (ISSUE 13): replay every COMPLETED
     greedy stream on a fresh single-engine reference (same stub
     geometry the replica processes run — ``replica_main.py`` is the
@@ -758,7 +950,13 @@ def _verify_fleet(ns, fleet_health, records, kill_events):
     cross-process failover that duplicated, dropped or rewrote a token
     shows up as a corrupted stream. Error counts must stay within the
     retry-budget bound (process kills <= budget ==> zero 5xx) and the
-    completed fraction must clear ``--goodput-floor``."""
+    completed fraction must clear ``--goodput-floor``.
+
+    ISSUE 16: a stream that crossed a FRONTEND kill reaches here as
+    its client-side merge (committed prefix + survivor's remainder) —
+    the same bitwise replay proves the resume dropped and duplicated
+    nothing; ``resume_mismatches`` (client merge vs the survivor's
+    authoritative final list) must be zero too."""
     from paddle_tpu.generation.paged import PagedEngine
     from paddle_tpu.generation.stub import TickStubModel
     from paddle_tpu.serving.fleet.replica_main import stub_engine_kw
@@ -778,19 +976,25 @@ def _verify_fleet(ns, fleet_health, records, kill_events):
     floor = float(getattr(ns, "goodput_floor", 0.95))
     error_bound = 0 if len(kill_events) <= budget else ns.requests
     completed_frac = len(done) / max(ns.requests, 1)
+    mismatches = sum(1 for r in records if r.get("resume_mismatch"))
+    resumed_ok = sum(1 for r in done if r.get("resumes", 0) > 0)
     gate = {
         "kills": len(kill_events),
+        "frontend_kills": len(frontend_kills),
         "failover_budget": budget,
         "peer_failovers": int(fleet_health["peer_failovers"]),
         "replays_checked": len(done),
+        "resumed_streams_checked": resumed_ok,
         "corrupted_streams": len(corrupted),
         "corrupted_ids": corrupted[:8],
+        "resume_mismatches": mismatches,
         "errors_5xx": errors,
         "error_bound": error_bound,
         "completed_frac": round(completed_frac, 3),
         "goodput_floor": floor,
     }
-    gate["ok"] = (not corrupted and errors <= error_bound
+    gate["ok"] = (not corrupted and not mismatches
+                  and errors <= error_bound
                   and completed_frac >= floor)
     return gate
 
@@ -930,6 +1134,17 @@ def main(argv=None) -> int:
                     help="SIGKILL this many replica processes at "
                          "seeded mid-run points (fleet chaos: bitwise "
                          "replay gate + goodput floor apply)")
+    ap.add_argument("--frontends", type=int, default=1,
+                    help="run N sibling FleetFrontends over the same "
+                         "replica fleet, gossip-linked (leaderless "
+                         "frontend HA, ISSUE 16); clients round-robin "
+                         "and resume severed streams on a sibling")
+    ap.add_argument("--frontend-kill", type=int, default=0,
+                    help="kill this many FRONTENDS at seeded mid-run "
+                         "points (needs --frontends >= 2 and must "
+                         "leave a survivor); the fleet gate then also "
+                         "demands zero dropped/duplicated committed "
+                         "tokens across the client-side resumes")
     ap.add_argument("--autoscale", action="store_true",
                     help="run the closed-loop FleetAutoscaler over "
                          "the run (pair with --diurnal)")
