@@ -568,7 +568,8 @@ def main():
                       "paged_churn_tokens_per_sec"))
         _ingest_rung(result, probe, "SERVE_LOADGEN_r07.json", "gateway",
                      "gateway_profile",
-                     ("gateway_tokens_per_sec", "gateway_p99_ttft_ms"))
+                     ("gateway_tokens_per_sec", "gateway_p99_ttft_ms",
+                      "kv_spill_hit_frac", "kv_spill_restored_tokens"))
         _ingest_rung(result, probe, "SERVE_FLEET_r13.json", "fleet",
                      "fleet_profile",
                      ("fleet_tokens_per_sec", "goodput_per_replica"))

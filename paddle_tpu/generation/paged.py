@@ -378,6 +378,11 @@ class PagedEngine:
         self.prefix_generation = 0
         self.block_refs: Dict[int, int] = {}         # live owner count
         self.cached_free: Dict[int, None] = {}       # LRU, insertion order
+        # host-RAM spill tier (ISSUE 17): a KVSpillArena attached by the
+        # gateway via attach_spill(). Deliberately NOT constructed here —
+        # the arena outlives the engine (supervisor rebuilds re-attach
+        # it), which is what makes a crashed replica come back warm.
+        self._spill = None
         L = cfg.num_hidden_layers
         kvh, d = cfg.num_key_value_heads, cfg.head_dim
         self.pools = [(jnp.zeros((self.P, self.B, kvh, d), cfg.dtype),
@@ -432,7 +437,10 @@ class PagedEngine:
                       "cancellations", "rejected",
                       "spec_proposed", "spec_accepted",
                       "full_rebuilds", "delta_patches",
-                      "h2d_upload_bytes")}
+                      "h2d_upload_bytes",
+                      "spill_spans", "spill_restores",
+                      "spill_restored_tokens",
+                      "spill_restore_failures")}
         self._h_decode = reg.histogram("paged_decode_step_ms",
                                        buckets=obs.SERVING_MS_BUCKETS,
                                        **self._obs_labels)
@@ -467,6 +475,13 @@ class PagedEngine:
                                     static_argnames=("bucket",))
         self._chunk_jit = jax.jit(self._chunk_prefill, donate_argnums=(1,),
                                   static_argnames=("bucket",))
+        # spill_reupload_program (ISSUE 17): one batched H2D scatter
+        # landing a restored span's KV into freshly allocated blocks.
+        # Pools are donated (alias-in-place like the decode scatters);
+        # block indices are padded to a power-of-two bucket with the
+        # garbage block 0, so restore sizes share compiled shapes.
+        self._spill_upload_jit = jax.jit(self._spill_upload,
+                                         donate_argnums=(0,))
         # --- device-resident fused tick (ISSUE 6 tentpole) ------------
         # fused_tick=True keeps block tables / seq lens / sampling params
         # / PRNG keys / done-bookkeeping ON DEVICE as engine state
@@ -1309,6 +1324,9 @@ class PagedEngine:
             b = self.free_blocks.pop()
         elif self.cached_free:
             b = next(iter(self.cached_free))
+            # spill-before-evict (ISSUE 17): the dying spans' KV goes
+            # D2H into the arena first, so the digests stay restorable
+            self._spill_evicted(b)
             self._evict_registered(b)
             # the cascade moves co-members — possibly b itself — to the
             # free list as their registrations die; track b either way
@@ -1354,6 +1372,183 @@ class PagedEngine:
             self.cached_free[b] = None
         else:
             self.free_blocks.append(b)
+
+    # ------------------------------------------------ host-RAM spill tier
+    def attach_spill(self, arena):
+        """Attach (or detach with None) a
+        :class:`~..serving.kvspill.KVSpillArena`. Called by the owner of
+        the arena — the gateway worker — at engine construction AND
+        after every supervisor rebuild, which is the whole point: the
+        arena's spans outlive this engine."""
+        self._spill = arena
+
+    def _spill_geometry(self) -> tuple:
+        """The layout tuple a spilled payload is only valid under. Any
+        skew (different model depth/heads/dims, block size, dtype, or
+        chunk grid) makes the bytes meaningless — the arena refuses the
+        restore and the request re-prefills."""
+        kp = self.pools[0][0]
+        _, B, kvh, d = kp.shape
+        return (len(self.pools), int(B), int(kvh), int(d),
+                str(kp.dtype), self.chunk)
+
+    def _spill_fetch(self, entry) -> bytes:
+        """D2H gather of a span's KV: every layer's K and V rows for
+        ``entry``'s blocks, packed as one ``(2L, n, B, kvh, d)`` buffer
+        (layer-major, K before V) — the byte layout ``_arena_restore``
+        reverses."""
+        idx = np.asarray(entry, np.int32)
+        stacked = jnp.stack([p[idx] for pair in self.pools
+                             for p in pair])
+        return np.asarray(jax.device_get(stacked)).tobytes()
+
+    def _spill_evicted(self, b: int):
+        """Bank every registered span that dies with block ``b`` before
+        ``_evict_registered`` drops it. Failures are the arena's
+        problem (counted drops) — eviction proceeds regardless."""
+        if self._spill is None:
+            return
+        spans = [(key, entry) for key in self._prefix_rev.get(b, ())
+                 for entry in (self.prefix_cache.get(key),)
+                 if entry is not None]
+        if not spans:
+            return
+        # live sub-spans of a dying span ride along: their KV is a
+        # block-prefix of the dying payload, so the arena indexes them
+        # as aliases with NO extra D2H — this is what keeps a HOT
+        # shared prefix restorable after a crash, even though only its
+        # cold long descendants ever face eviction themselves
+        dying_keys = {k for k, _ in spans}
+        dying_entries = [e for _, e in spans]
+        for key, entry in list(self.prefix_cache.items()):
+            if key in dying_keys:
+                continue
+            if any(len(e) > len(entry) and e[:len(entry)] == entry
+                   for e in dying_entries):
+                spans.append((key, tuple(entry)))
+        n = self._spill.spill(spans, self._spill_fetch,
+                              self._spill_geometry(),
+                              self.prefix_generation)
+        self._count("spill_spans", n)
+
+    def spill_parked(self) -> int:
+        """Bank EVERY live prefix-cache span into the arena (gateway
+        drain / SIGTERM: the device pool is about to die, the arena is
+        what survives). Returns payload records stored."""
+        if self._spill is None or not self.prefix_cache:
+            return 0
+        spans = list(self.prefix_cache.items())
+        n = self._spill.spill(spans, self._spill_fetch,
+                              self._spill_geometry(),
+                              self.prefix_generation)
+        self._count("spill_spans", n)
+        return n
+
+    def _spill_upload(self, pools, idx, data):
+        """spill_reupload_program: scatter a restored span's packed KV
+        ``(2L, npad, B, kvh, d)`` into block rows ``idx`` of every
+        layer's pools. Pad rows target the garbage block 0."""
+        out = []
+        for l, (kp, vp) in enumerate(pools):
+            out.append((kp.at[idx].set(data[2 * l]),
+                        vp.at[idx].set(data[2 * l + 1])))
+        return out
+
+    def _arena_restore(self, ids: List[int]):
+        """Admission-side arena probe: if the arena holds a strictly
+        longer span of ``ids`` than the device cache does, re-upload it
+        into fresh blocks and register it — the normal
+        ``_prefix_lookup`` adoption path then hits it like any warm
+        span (``prefix_hit_tokens`` counts it; the skipped prefill is
+        the win). Every failure mode — checksum, truncation, geometry
+        skew, no block headroom — is counted and falls through to
+        plain re-prefill."""
+        if self._spill is None or not self.prefix_caching:
+            return
+        chain = self._chunk_digests(ids, len(ids) - 1)
+        if not chain:
+            return
+        live = 0
+        for i, d in enumerate(chain):
+            if d in self.prefix_cache:
+                live = i + 1
+        for i in range(len(chain) - 1, live - 1, -1):
+            if self._spill.probe(chain[i]) is None:
+                continue
+            if self._restore_span(chain, i):
+                return
+            # failed take evicted that record; shorter spans may live
+            # in OTHER records — keep probing down the chain
+
+    def _restore_span(self, chain: List[bytes], i: int) -> bool:
+        C = self.chunk
+        n_blocks = (i + 1) * C // self.B
+        if len(self.free_blocks) + len(self.cached_free) < n_blocks:
+            self._count("spill_restore_failures")
+            return False
+        got = self._spill.take(chain[i], self._spill_geometry())
+        if got is None:
+            self._count("spill_restore_failures")
+            return False
+        payload, rec_tokens = got
+        kp = self.pools[0][0]
+        _, B, kvh, d = kp.shape
+        L = len(self.pools)
+        rec_blocks = rec_tokens // B
+        expect = 2 * L * rec_blocks * B * kvh * d * kp.dtype.itemsize
+        if len(payload) != expect or rec_blocks < n_blocks:
+            self._count("spill_restore_failures")  # tokens/geometry skew
+            return False
+        data = np.frombuffer(payload, dtype=kp.dtype).reshape(
+            2 * L, rec_blocks, B, kvh, d)[:, :n_blocks]
+        blocks: List[int] = []
+        for _ in range(n_blocks):
+            b = self._alloc_block()      # may cascade-spill more spans
+            if b is None:
+                for ob in blocks:
+                    self._release_block(ob)
+                self._count("spill_restore_failures")
+                return False
+            blocks.append(b)
+        npad = 1
+        while npad < n_blocks:
+            npad *= 2
+        idx = np.zeros((npad,), np.int32)          # pad -> garbage block
+        idx[:n_blocks] = blocks
+        padded = np.zeros((2 * L, npad, B, kvh, d), kp.dtype)
+        padded[:, :n_blocks] = data
+        self.dispatch_count += 1
+        self.h2d_uploads += 1
+        self.h2d_upload_bytes += padded.nbytes
+        self._count("h2d_upload_bytes", padded.nbytes)
+        self._h_bytes.observe(padded.nbytes)
+        self.pools = self._spill_upload_jit(self.pools,
+                                            jnp.asarray(idx),
+                                            jnp.asarray(padded))
+        # register every sub-span over the restored blocks (mirror of
+        # _register_prefix), then park them: the caller's normal
+        # _prefix_lookup adoption does the rest
+        for j in range(i + 1):
+            key = chain[j]
+            entry = tuple(blocks[:(j + 1) * C // self.B])
+            old = self.prefix_cache.get(key)
+            if old == entry:
+                continue
+            if old is not None:
+                self._unhook(key, old)
+            self.prefix_cache[key] = entry
+            self.prefix_generation += 1
+            for b in entry:
+                self._prefix_rev.setdefault(b, set()).add(key)
+        for b in blocks:
+            self._release_block(b)       # registered: parks in cached_free
+        tokens = (i + 1) * C
+        self._count("spill_restores")
+        self._count("spill_restored_tokens", tokens)
+        obs.record_event("kv_spill_restore",
+                         engine=self._obs_labels["engine"],
+                         tokens=tokens, blocks=n_blocks)
+        return True
 
     def _chunk_digests(self, ids: List[int], max_tokens: int):
         """SHA-256 chain digest per chunk-grid prefix span (digest_k =
@@ -1413,13 +1608,22 @@ class PagedEngine:
     def has_prefix(self, digest: str) -> bool:
         """True when ``digest`` (hex, as returned by
         ``prefix_digest``) currently has live blocks in the prefix
-        cache — the router's "is this replica warm" probe."""
+        cache — the router's "is this replica warm" probe. An attached
+        spill arena extends the warm tier: a span restorable from host
+        RAM costs one H2D scatter, not a re-prefill, so a rebuilt
+        replica advertises (and receives) shared-prefix traffic the
+        moment it re-attaches — that routing is what actually pulls
+        the restore through ``_arena_restore`` at admission."""
         if not self.prefix_caching or not digest:
             return False
         try:
-            return bytes.fromhex(digest) in self.prefix_cache
+            raw = bytes.fromhex(digest)
         except ValueError:
             return False
+        if raw in self.prefix_cache:
+            return True
+        return (self._spill is not None
+                and self._spill.probe(raw) is not None)
 
     def _prefix_lookup(self, ids: List[int]):
         """Longest chunk-grid prefix of ``ids`` with a live cache entry,
@@ -1465,6 +1669,10 @@ class PagedEngine:
         except ValueError:
             return False
         ids = req.prompt
+        if self._spill is not None:
+            # warm-miss probe of the host spill tier: a restored span
+            # registers itself and the normal lookup below adopts it
+            self._arena_restore(ids)
         cached, adopted = self._prefix_lookup(ids)
         need = self._blocks_needed(len(ids) + 1)
         fresh = need - len(adopted)
@@ -1795,6 +2003,7 @@ class PagedEngine:
             free_blocks=len(self.free_blocks),
             cached_free_blocks=len(self.cached_free),
             total_blocks=self.P - 1,
+            spill_attached=self._spill is not None,
             results_pending=len(self.results),
             aborted=len(self.cancelled))
         return snap
@@ -1856,6 +2065,17 @@ class PagedEngine:
             },
             "prefix_cache": {"entries": n_entries, "digests": digests,
                              "generation": self.prefix_generation},
+            "spill": {
+                "attached": self._spill is not None,
+                "restores": int(
+                    self._counters["spill_restores"].value),
+                "restored_tokens": int(
+                    self._counters["spill_restored_tokens"].value),
+                "restore_failures": int(
+                    self._counters["spill_restore_failures"].value),
+                "spilled_spans": int(
+                    self._counters["spill_spans"].value),
+            },
             "queued": [str(r.request_id)
                        for r in list(self.queue)[:max_digests]],
             "spec": {"enabled": bool(self._spec_k), "k": self._spec_k,

@@ -15,6 +15,7 @@ See ``docs/SERVING.md`` for the API schema, SLO classes, drain
 semantics and the load-generator reading guide.
 """
 from .gateway import Gateway
+from .kvspill import KVSpillArena
 from .reqtrace import RequestTrace, RequestTraceRing
 from .router import EngineReplica, NoReplicaError, PrefixAffinityRouter
 from .scheduler import (SLO_BATCH, SLO_INTERACTIVE, ServeRequest,
@@ -23,7 +24,7 @@ from .slo import BurnRateEngine, BurnRule
 from .supervisor import CircuitBreaker, ReplicaSupervisor
 
 __all__ = [
-    "Gateway",
+    "Gateway", "KVSpillArena",
     "BurnRateEngine", "BurnRule",
     "CircuitBreaker", "ReplicaSupervisor",
     "EngineReplica", "NoReplicaError", "PrefixAffinityRouter",

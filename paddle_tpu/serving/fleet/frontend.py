@@ -408,7 +408,8 @@ class FleetFrontend:
             if peer is None or not isinstance(view, dict):
                 continue
             if peer.adopt_digests(view.get("digests") or (),
-                                  view.get("generation", -1)):
+                                  view.get("generation", -1),
+                                  spilled=view.get("spilled") or ()):
                 adopted_digests += 1
         adopted_sticky = self._router.merge_sticky(
             doc.get("sticky") or {}, by_name)

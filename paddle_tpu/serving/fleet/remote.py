@@ -175,6 +175,11 @@ class RemoteReplica:
         # gossiped digest set (ISSUE 13): hex digests + the peer's
         # generation counter the conditional fetch keys on
         self._digests: frozenset = frozenset()
+        # spilled tier (ISSUE 17): digests the peer holds only in its
+        # host-RAM spill arena — cheaper than device-live (a restore
+        # beats a re-prefill, a live hit beats both) but still warm
+        # for routing purposes
+        self._spilled: frozenset = frozenset()
         self._digest_gen = -1
         self._digest_t: Optional[float] = None
         self.probes_total = 0
@@ -273,6 +278,7 @@ class RemoteReplica:
                 self.gossip_unchanged_total += 1
             else:
                 self._digests = frozenset(doc.get("digests") or ())
+                self._spilled = frozenset(doc.get("spilled") or ())
                 self._digest_gen = int(doc.get("generation", -1))
             self._digest_t = self._clock()
         # federated metrics (ISSUE 15): cache the peer's windowed view
@@ -401,7 +407,9 @@ class RemoteReplica:
                     or self._clock() - self._digest_t \
                     > self.stale_after_s:
                 return False
-            return digest in self._digests
+            # the spilled tier counts as warm: a restore on the peer
+            # still skips the span's prefill (ISSUE 17)
+            return digest in self._digests or digest in self._spilled
 
     def set_metrics_window(self, window_s: float):
         """Change the window the NEXT probe rounds fetch (the
@@ -435,7 +443,8 @@ class RemoteReplica:
             self.breaker.record_failure()
 
     # ------------------------------------------------- frontend HA gossip
-    def adopt_digests(self, digests, generation: int) -> bool:
+    def adopt_digests(self, digests, generation: int,
+                      spilled=()) -> bool:
         """Adopt a SIBLING FRONTEND's fresher view of this peer's
         prefix-digest set (ISSUE 16 HA gossip). Generation-guarded:
         only a strictly newer generation wins — our own probe loop is
@@ -447,6 +456,7 @@ class RemoteReplica:
             if gen <= self._digest_gen:
                 return False
             self._digests = frozenset(digests or ())
+            self._spilled = frozenset(spilled or ())
             self._digest_gen = gen
             self._digest_t = self._clock()
             return True
@@ -460,6 +470,7 @@ class RemoteReplica:
         with self._lock:
             out = {
                 "digests": sorted(self._digests),
+                "spilled": sorted(self._spilled),
                 "generation": self._digest_gen,
                 "healthy": self._healthy and self._fresh()
                 and not self._snap.get("draining", False),
@@ -505,6 +516,7 @@ class RemoteReplica:
                 "snap": snap,
                 "gossip": {
                     "digests": len(self._digests),
+                    "spilled": len(self._spilled),
                     "generation": self._digest_gen,
                     "fetches": self.gossip_fetches_total,
                     "unchanged_skips": self.gossip_unchanged_total,

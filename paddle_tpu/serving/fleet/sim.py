@@ -116,6 +116,10 @@ class SimProcess:
         self.completed = 0
         self.tokens = 0
         self.digests: set = set()
+        # spilled tier (ISSUE 17): digests surviving only in the
+        # process's host-RAM arena — populated by restart(spill=True),
+        # promoted back to device-live by the next request that lands
+        self.spilled: set = set()
         self.digest_gen = 0
         # probe connections landing on this process (sliding 1s
         # window): health checks run ON the serving process, so a
@@ -126,7 +130,20 @@ class SimProcess:
     def add_digest(self, d: str):
         if d not in self.digests:
             self.digests.add(d)
+            self.spilled.discard(d)   # promoted back to device-live
             self.digest_gen += 1
+
+    def restart(self, spill: bool = False):
+        """Crash/rebuild the process's engine: device-live digests die
+        with the pools. With a spill arena (``spill=True``) they move
+        to the spilled tier instead — restorable, still routable —
+        which is exactly the warm-restart contract of ISSUE 17."""
+        if spill:
+            self.spilled |= self.digests
+        else:
+            self.spilled.clear()
+        self.digests.clear()
+        self.digest_gen += 1
 
     def note_probe(self, now: float):
         hits = self._probe_hits
@@ -176,6 +193,7 @@ class SimReplica:
         self._queue_depth = 0
         self._free_slots = self._total_slots = 0
         self._digests: frozenset = frozenset()
+        self._spilled: frozenset = frozenset()
         self._digest_gen = -1
         self._digest_t: Optional[float] = None
         self.probes_total = 0
@@ -200,6 +218,7 @@ class SimReplica:
         if not faults.inject("gossip_partition", replica=self.name):
             if self.proc.digest_gen != self._digest_gen:
                 self._digests = frozenset(self.proc.digests)
+                self._spilled = frozenset(self.proc.spilled)
                 self._digest_gen = self.proc.digest_gen
             self._digest_t = now
         self._fails = 0
@@ -236,7 +255,8 @@ class SimReplica:
         if self._digest_t is None \
                 or self._clock() - self._digest_t > self.stale_after_s:
             return False
-        return digest in self._digests
+        # spilled tier counts as warm, mirroring the live adapter
+        return digest in self._digests or digest in self._spilled
 
     def note_proxy_failure(self):
         self._healthy = False
@@ -283,23 +303,27 @@ class SimReplica:
                "probes": self.probes_total,
                "probe_failures": self.probe_failures_total,
                "gossip": {"digests": len(self._digests),
+                          "spilled": len(self._spilled),
                           "generation": self._digest_gen}}
         if self.breaker is not None:
             out["breaker"] = self.breaker.snapshot()
         return out
 
     # ------------------------------------------------- frontend HA gossip
-    def adopt_digests(self, digests, generation: int) -> bool:
+    def adopt_digests(self, digests, generation: int,
+                      spilled=()) -> bool:
         gen = int(generation)
         if gen <= self._digest_gen:
             return False
         self._digests = frozenset(digests or ())
+        self._spilled = frozenset(spilled or ())
         self._digest_gen = gen
         self._digest_t = self._clock()
         return True
 
     def gossip_view(self) -> Dict[str, Any]:
         out = {"digests": sorted(self._digests),
+               "spilled": sorted(self._spilled),
                "generation": self._digest_gen,
                "healthy": self.healthy()}
         if self.breaker is not None:
@@ -1142,8 +1166,26 @@ def _brownout(t0: float, t1: float, frac: float,
                     apply=apply, revert=revert)
 
 
+def _spill_restart(t: float, frac: float, spill: bool) -> Incident:
+    """One-shot mass engine rebuild at ``t`` (supervisor rebuild /
+    rolling restart across a slice of the fleet): the affected
+    processes stay UP but their device-live digests die — with a
+    spill arena they move to the spilled tier and stay routable
+    (ISSUE 17 warm-restart); without, the fleet re-earns every prefix
+    cold."""
+    def apply(sim: FleetSim):
+        n = max(int(len(sim.procs) * frac), 1)
+        for proc in sim.procs[:n]:
+            proc.restart(spill=spill)
+
+    def revert(sim: FleetSim):
+        pass                      # a restart has no un-restart
+    return Incident("spill_restart", t, t + 1e-9, page=False,
+                    apply=apply, revert=revert)
+
+
 SCENARIOS = ("clean", "outage", "storm", "partition", "brownout",
-             "diurnal", "ha")
+             "brownout_spill", "diurnal", "ha")
 
 
 def build_scenario(name: str, *, n_replicas: int = 100,
@@ -1185,6 +1227,17 @@ def build_scenario(name: str, *, n_replicas: int = 100,
         # load-aware ladder — measured, not assumed: at frac 0.3 the
         # router routes around it and the fleet stays in SLO
         kw["incidents"] = (_brownout(0.4 * T, 0.7 * T, 0.9, 8.0),)
+    elif name == "brownout_spill":
+        # brownout + mid-incident mass engine rebuild (ISSUE 17): the
+        # throttled slice's supervisors rebuild their engines while the
+        # fleet is already degraded. spill=True (the default) keeps the
+        # rebuilt processes' digests routable through the spilled tier,
+        # so warm routing survives the double hit; spill=False is the
+        # cold twin the A/B compares against (override via
+        # ``spill_restart=False``).
+        spill = bool(overrides.pop("spill_restart", True))
+        kw["incidents"] = (_brownout(0.4 * T, 0.7 * T, 0.5, 6.0),
+                           _spill_restart(0.55 * T, 0.5, spill))
     elif name == "diurnal":
         # start the fleet at trough size so the peak genuinely forces
         # scale-ups (and the falling edge, scale-downs)

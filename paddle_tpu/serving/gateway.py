@@ -617,6 +617,7 @@ class Gateway:
                  slow_ttft_ms: Optional[float] = None,
                  supervise: bool = True,
                  engine_factory=None,
+                 spill_arena=None,
                  failover_budget: int = 2,
                  watchdog_timeout_s: float = 30.0,
                  watchdog_interval_s: float = 0.05,
@@ -702,6 +703,14 @@ class Gateway:
         # the supervisor/crash paths share. _fo_lock serializes the
         # per-worker failure latch and the worker-list swap.
         self._engine_factory = engine_factory
+        # host-RAM KV spill tier (ISSUE 17): the gateway OWNS the arena
+        # precisely because engines don't survive supervisor rebuilds —
+        # _make_worker re-attaches it to whatever engine a replica
+        # currently runs, so a crashed replica comes back warm. One
+        # shared arena per gateway: digests are content-addressed over
+        # the token chain, so a span spilled by one replica restores
+        # bit-exactly into any sibling with the same geometry.
+        self._spill_arena = spill_arena
         self._failover_budget = int(failover_budget)
         self._fo_lock = threading.Lock()
         self._c_failovers = reg.counter("gateway_failovers_total",
@@ -780,6 +789,11 @@ class Gateway:
             self._model_locks = {k: v for k, v in
                                  self._model_locks.items()
                                  if k in live}
+        if self._spill_arena is not None \
+                and hasattr(replica.engine, "attach_spill"):
+            # covers initial build AND supervisor rebuilds: the arena
+            # outlives the engine, which is what makes restarts warm
+            replica.engine.attach_spill(self._spill_arena)
         w = _ReplicaWorker(self, replica, sched, lock, ring=ring)
         if self._slo is not None and w.ring is not None \
                 and self._slo_observe not in w.ring.observers:
@@ -1063,6 +1077,16 @@ class Gateway:
                 # a terminal answer here instead of a hung client
                 w.flush_queue(503, "draining: not admitting new "
                                    "requests")
+        if self._spill_arena is not None:
+            # the device pools are about to die with the process; the
+            # arena (host RAM, handed to the replacement gateway) is
+            # what carries the warm spans across the restart (ISSUE 17)
+            for w in self._workers:
+                try:
+                    if hasattr(w.engine, "spill_parked"):
+                        w.engine.spill_parked()
+                except Exception:
+                    pass        # a failed drain spill only costs warmth
         obs.record_event("gateway_drain", gateway=self.name)
         if self.sampler is not None:
             # stop the sampler thread and leave the trajectory on disk
@@ -1159,12 +1183,28 @@ class Gateway:
                                list(getattr(eng, "prefix_cache", {})))
             except RuntimeError:    # resized mid-iteration: torn read
                 pass                # is fine — the next poll catches up
+        spilled: List[str] = []
+        if self._spill_arena is not None:
+            # spill tier (ISSUE 17): advertise arena-resident digests
+            # under a separate, cheaper key — a peer router treats them
+            # as warm (a restore beats a re-prefill) without confusing
+            # them with device-live spans. The arena's own monotonic
+            # generation folds into the ratcheted counter so an if_gen
+            # poller sees spill-tier changes too.
+            gen += int(self._spill_arena.generation)
+            live = digests
+            spilled = [h for h in self._spill_arena.digest_hexes()
+                       if h not in live]
         if gen < self._prefix_gen_last:
             self._prefix_gen_base += self._prefix_gen_last - gen + 1
         self._prefix_gen_last = gen
-        return {"generation": self._prefix_gen_base + gen,
-                "entries": len(digests),
-                "digests": sorted(digests)}
+        doc = {"generation": self._prefix_gen_base + gen,
+               "entries": len(digests),
+               "digests": sorted(digests)}
+        if self._spill_arena is not None:
+            doc["spilled"] = spilled
+            doc["spilled_entries"] = len(spilled)
+        return doc
 
     def metricsz(self, window_s: Optional[float] = None
                  ) -> Dict[str, Any]:
@@ -1248,6 +1288,8 @@ class Gateway:
             "router": self._router.snapshot(),
             "replicas": reps,
             "prefix_digest_set": self.prefix_digest_summary(),
+            "kv_spill": (self._spill_arena.snapshot()
+                         if self._spill_arena is not None else None),
             # telemetry plane (ISSUE 15)
             "telemetry": {
                 "sampler": None if self.sampler is None else {

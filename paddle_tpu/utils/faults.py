@@ -58,13 +58,14 @@ DISPATCH_HANG_ENV_VAR = "PADDLE_TPU_FAULT_DISPATCH_HANG_S"
 STREAM_STALL_ENV_VAR = "PADDLE_TPU_FAULT_STREAM_STALL_S"
 SLOW_REPLICA_ENV_VAR = "PADDLE_TPU_FAULT_SLOW_REPLICA_S"
 PEER_SLOW_ENV_VAR = "PADDLE_TPU_FAULT_PEER_SLOW_S"
+SPILL_SLOW_ENV_VAR = "PADDLE_TPU_FAULT_SPILL_SLOW_S"
 
 __all__ = [
     "SITES", "inject", "scoped", "configure", "reset", "parse_spec",
     "retry_with_backoff", "BackpressureError", "RequestTimeoutError",
     "hang_seconds", "prefetch_stall_seconds", "dispatch_hang_seconds",
     "stream_stall_seconds", "slow_replica_seconds",
-    "peer_slow_seconds", "main",
+    "peer_slow_seconds", "spill_slow_seconds", "main",
 ]
 
 # ------------------------------------------------------------- inventory
@@ -189,6 +190,30 @@ SITES: Dict[str, Tuple[str, str]] = {
         "stand-in at N frontends x M peers; the fleet sim's "
         "probe-storm schedule arms it and must page, while the "
         "jittered clean twin stays quiet)"),
+    # --- KV spill tier chaos (ISSUE 17): the host-RAM arena's own
+    # failure modes. All wired inside KVSpillArena so EVERY producer
+    # (eviction spill, drain spill) and consumer (warm-miss restore)
+    # inherits them.
+    "spill_corrupt": (
+        "paddle_tpu/serving/kvspill.py:KVSpillArena.spill",
+        "flip one byte of a span's host payload AFTER its crc32 is "
+        "banked (silent host-RAM bit rot stand-in; the take-side "
+        "checksum must catch it, drop the record, count "
+        "kv_spill_checksum_failures_total, and fall back to re-prefill "
+        "with the greedy stream bitwise identical to spill-off)"),
+    "spill_slow": (
+        "paddle_tpu/serving/kvspill.py:KVSpillArena.take",
+        "sleep PADDLE_TPU_FAULT_SPILL_SLOW_S (default 0.05) in the "
+        "arena's D2H spill / H2D restore path (host memory-bandwidth "
+        "contention stand-in; a slow arena must only delay the one "
+        "admission, never wedge the engine tick loop or corrupt "
+        "restored spans)"),
+    "spill_drop": (
+        "paddle_tpu/serving/kvspill.py:KVSpillArena.spill",
+        "refuse a span's store outright (arena allocation failure / "
+        "capacity-pressure stand-in; the span is counted in "
+        "kv_spill_drops_total and its next warm miss re-prefills "
+        "normally — a lost spill costs latency, never tokens)"),
 }
 
 
@@ -424,6 +449,11 @@ def slow_replica_seconds() -> float:
 def peer_slow_seconds() -> float:
     """Per-probe delay of a fired ``peer_slow`` site."""
     return float(os.environ.get(PEER_SLOW_ENV_VAR, "0.05"))
+
+
+def spill_slow_seconds() -> float:
+    """Per-copy delay of a fired ``spill_slow`` site."""
+    return float(os.environ.get(SPILL_SLOW_ENV_VAR, "0.05"))
 
 
 # ---------------------------------------------------------------- retry

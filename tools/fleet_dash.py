@@ -191,11 +191,16 @@ def _doc_rows(d: dict) -> tuple:
                                      "fleet_proxied_tokens_total")),
             ("burn", _metric_points(d, "slo_burn_rate", agg=max)),
         )
-    return (
+    rows = (
         ("tok/s", _metric_points(d, "gateway_tokens_total")),
         ("queue", _metric_points(d, "gateway_queue_depth")),
         ("burn", _metric_points(d, "slo_burn_rate", agg=max)),
     )
+    if "kv_spill_hits_total" in bases:
+        # spill-tier restores (ISSUE 17) — only gateways running with
+        # an attached arena export the series, so the row is opt-in
+        rows += (("spill", _metric_points(d, "kv_spill_hits_total")),)
+    return rows
 
 
 def render(docs: Dict[str, dict], events: Optional[List[dict]] = None,

@@ -182,6 +182,13 @@ def check(ns) -> int:
     expect(r["probe"]["timeouts"] > 0,
            "storm produced no probe-capacity overflow")
 
+    r = _run_one("brownout_spill", ns2, 1)
+    a = r["alerts"]
+    expect(a["false_pages"] == 0,
+           f"brownout_spill false-paged: {a}")
+    expect(r["completed"] + r["shed"] == r["requests"],
+           f"brownout_spill dropped requests: {r}")
+
     ns2.frontends = 2
     r = _run_one("ha", ns2, 1)
     ha, a = r["ha"], r["alerts"]

@@ -40,6 +40,18 @@ windows down so a CI-length run can fire (a chaos kill's TTFT spike
 deterministically trips the interactive class); ``--telemetry off``
 is the A/B reference reproducing the pre-plane gateway bitwise.
 
+``--spill on`` (ISSUE 17) hands the self-hosted replicas one shared
+host-RAM :class:`KVSpillArena`: spans evicted under block pressure
+(and everything parked at drain) are checksummed D2H into the arena,
+and a warm miss — including on a supervisor-REBUILT replica after a
+chaos kill — restores them with one batched H2D scatter instead of
+re-prefilling. The rung banks ``kv_spill_hit_frac`` (share of
+prefix-hit tokens the host tier supplied) and
+``kv_spill_restored_tokens`` (re-prefill tokens saved); ``--spill
+off`` (default) is the A/B reference every greedy stream must match
+bitwise. Composes with ``--chaos``: the replay gate must stay at
+zero corrupted streams with the tier on.
+
 ``--churn`` (ISSUE 14) swaps in a transition-heavy mix — short,
 staggered per-request budgets so replica slots finish and readmit
 every few ticks — and the rung records ``full_rebuilds`` /
@@ -330,6 +342,17 @@ def _build_gateway(ns):
         getattr(ns, "delta", "on") == "on"
 
     chaos = bool(getattr(ns, "chaos", False))
+    # host-RAM KV spill tier (ISSUE 17 A/B): --spill on hands every
+    # replica (and every supervisor REBUILD) one shared arena, so
+    # evicted/killed warm prefixes restore instead of re-prefilling;
+    # --spill off (default) is the reference the bitwise gate and the
+    # kv_spill_hit_frac rung compare against
+    spill_arena = None
+    if getattr(ns, "spill", "off") == "on":
+        from paddle_tpu.serving.kvspill import KVSpillArena
+        spill_arena = KVSpillArena(
+            int(getattr(ns, "spill_mb", 256)) << 20,
+            name="loadgen")
     # telemetry plane (ISSUE 15): sampler + burn-rate alerting default
     # ON (host-side, pinned harmless); --telemetry off is the A/B
     # reference that reproduces the pre-plane gateway exactly.
@@ -359,7 +382,7 @@ def _build_gateway(ns):
 
     engines = [engine_factory() for _ in range(ns.replicas)]
     gw_kw = dict(routing=ns.policy, max_queue=ns.max_queue,
-                 **gw_telemetry_kw)
+                 spill_arena=spill_arena, **gw_telemetry_kw)
     if chaos:
         # fast-recovery supervision knobs sized for a short chaos run:
         # sub-second watchdog + breaker backoff so kills, failovers
@@ -809,6 +832,27 @@ async def run_loadgen(ns) -> dict:
         router = gw.health()["router"]
         rung["prefix_route_hits"] = router["prefix_route_hits"]
         rung["prefix_route_misses"] = router["prefix_route_misses"]
+        # KV spill tier A/B (ISSUE 17): re-prefill tokens saved + the
+        # fraction of prefix-hit tokens the HOST tier supplied (0.0
+        # with --spill off — the regression-gated number). Summed over
+        # the LIVE workers, not the launch list: rebuilt engines are
+        # where crash-recovery restores land
+        rung["spill"] = getattr(ns, "spill", "off")
+        engs = [w.engine for w in gw._workers] if gw is not None \
+            else list(engines)
+        restored = sum(e.stats.get("spill_restored_tokens", 0)
+                       for e in engs)
+        hit_all = sum(e.stats.get("prefix_hit_tokens", 0)
+                      for e in engs)
+        rung["kv_spill_restored_tokens"] = restored
+        rung["kv_spill_hit_frac"] = round(
+            restored / hit_all, 4) if hit_all else 0.0
+        rung["kv_spill_restores"] = sum(
+            e.stats.get("spill_restores", 0) for e in engs)
+        rung["kv_spill_restore_failures"] = sum(
+            e.stats.get("spill_restore_failures", 0) for e in engs)
+        if gw is not None and gw._spill_arena is not None:
+            rung["kv_spill_arena"] = gw._spill_arena.snapshot()
     # per-request JSONL (ISSUE 10 satellite): the CLIENT side of the
     # trace join — request id, tenant, SLO class, wire TTFT/TPOT and
     # outcome, one line per request, keyed by the X-Request-Id the
@@ -1078,6 +1122,17 @@ def main(argv=None) -> int:
                          "short staggered max-new budgets so slots "
                          "finish + readmit every few ticks; the rung "
                          "records full_rebuilds/delta_patches")
+    ap.add_argument("--spill", default="off", choices=("on", "off"),
+                    help="host-RAM KV spill tier (ISSUE 17): one "
+                         "shared KVSpillArena across the replicas "
+                         "(and every supervisor rebuild), so evicted "
+                         "or crash-killed warm prefixes restore via "
+                         "one H2D scatter instead of re-prefilling; "
+                         "the rung banks kv_spill_hit_frac + "
+                         "kv_spill_restored_tokens (off = the "
+                         "bitwise A/B reference)")
+    ap.add_argument("--spill-mb", type=int, default=256,
+                    help="arena capacity in MiB under --spill on")
     ap.add_argument("--chaos", action="store_true",
                     help="seeded chaos harness (ISSUE 12): kill/hang "
                          "replicas mid-run, then assert zero "

@@ -242,6 +242,37 @@ def delta_patch_program():
     assert eng.full_rebuilds == fr0, (eng.full_rebuilds, fr0)
 check("delta_patch_program", delta_patch_program)
 
+def spill_reupload_program():
+    # ISSUE 17: the spill re-upload program — one batched H2D scatter
+    # of a host-RAM arena span into freshly allocated blocks (donated
+    # pools, pad rows onto garbage block 0) — must compile on hardware
+    # and restore BITWISE: a fresh engine re-attached to the arena
+    # serves the spilled prefix without re-prefilling it.
+    from paddle_tpu.generation.paged import PagedEngine
+    from paddle_tpu.generation.stub import TickStubModel
+    from paddle_tpu.serving.kvspill import KVSpillArena
+    arena = KVSpillArena(8 << 20, name="validate")
+
+    def eng():
+        e = PagedEngine(TickStubModel(), max_slots=4, num_blocks=32,
+                        block_size=8, max_blocks_per_seq=8,
+                        prefill_buckets=(8,), chunk_prefill_tokens=8,
+                        enable_prefix_cache=True)
+        e.attach_spill(arena)
+        return e
+    prompt = np.arange(1, 17)[None]
+    e0 = eng()
+    e0.submit("a", prompt, max_new_tokens=8)
+    ref = e0.run()["a"]
+    assert e0.spill_parked() > 0         # drain-spill the parked span
+    e1 = eng()                           # fresh pools, same arena
+    e1.submit("b", prompt, max_new_tokens=8)
+    res = e1.run()["b"]
+    assert res == ref, (res, ref)
+    assert e1.stats["spill_restores"] > 0, e1.stats
+    assert e1.stats["prefix_hit_tokens"] > 0, e1.stats
+check("spill_reupload_program", spill_reupload_program)
+
 def prefill_flash():
     # the generate() prefill branch: flash at cache_index==0 must match
     # the masked-dense-over-cache path it replaced (llama.py)
