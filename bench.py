@@ -569,7 +569,8 @@ def main():
         _ingest_rung(result, probe, "SERVE_LOADGEN_r07.json", "gateway",
                      "gateway_profile",
                      ("gateway_tokens_per_sec", "gateway_p99_ttft_ms",
-                      "kv_spill_hit_frac", "kv_spill_restored_tokens"))
+                      "kv_spill_hit_frac", "kv_spill_restored_tokens",
+                      "kv_xfer_hit_frac", "recompute_tokens_saved"))
         _ingest_rung(result, probe, "SERVE_FLEET_r13.json", "fleet",
                      "fleet_profile",
                      ("fleet_tokens_per_sec", "goodput_per_replica"))

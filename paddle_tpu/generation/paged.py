@@ -1444,6 +1444,47 @@ class PagedEngine:
         self._count("spill_spans", n)
         return n
 
+    def spill_live(self) -> int:
+        """Bank every ACTIVE slot's computed KV span into the arena
+        (drain migration / crash salvage, ISSUE 18). For each live
+        request the exportable span is the chunk-grid prefix of
+        ``prompt + generated`` whose KV the device has actually
+        written (``seq_lens`` is host-authoritative) — exactly what a
+        survivor restores through ``_arena_restore`` instead of
+        re-prefilling prompt+committed. Whole sub-span chains go in
+        one call so shorter digests alias the one D2H payload.
+        Returns payload records stored; any per-slot failure skips
+        that slot (its stream just re-prefills)."""
+        if self._spill is None or not self.prefix_caching:
+            return 0
+        spans = []
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            try:
+                ids = list(req.prompt) + [int(t) for t in req.tokens]
+                n_kv = min(int(self.seq_lens[i]), len(ids))
+                n_full = (n_kv // self.chunk) * self.chunk
+                if n_full <= 0:
+                    continue
+                blocks = tuple(int(b)
+                               for b in req.blocks[:n_full // self.B])
+                if len(blocks) * self.B < n_full:
+                    continue
+                for k, dkey in enumerate(
+                        self._chunk_digests(ids, n_full)):
+                    nb = (k + 1) * self.chunk // self.B
+                    spans.append((dkey, blocks[:nb]))
+            except Exception:
+                continue
+        if not spans:
+            return 0
+        n = self._spill.spill(spans, self._spill_fetch,
+                              self._spill_geometry(),
+                              self.prefix_generation)
+        self._count("spill_spans", n)
+        return n
+
     def _spill_upload(self, pools, idx, data):
         """spill_reupload_program: scatter a restored span's packed KV
         ``(2L, npad, B, kvh, d)`` into block rows ``idx`` of every

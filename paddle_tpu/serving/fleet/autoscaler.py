@@ -76,6 +76,7 @@ class FleetAutoscaler:
                  signal_mode: str = "windowed",
                  signal_window_s: float = 2.0,
                  outage_freeze_frac: float = 0.5,
+                 migrate_on_scale_down: bool = True,
                  clock: Callable[[], float] = time.monotonic):
         """``signal_mode`` (ISSUE 15): ``"windowed"`` (default) bases
         every pressure comparison on the MEAN of each signal over the
@@ -111,6 +112,13 @@ class FleetAutoscaler:
         # an artifact of excluded stale signals, and scaling down on
         # it is the classic SRE failure. <= 0 disables the guard.
         self.outage_freeze_frac = float(outage_freeze_frac)
+        # planned scale-down is the BEST-case migration trigger
+        # (ISSUE 18): the retiring replica is healthy and has the
+        # whole drain window to cut live requests over — a manager
+        # whose scale_down accepts migrate= gets the flag, others
+        # keep their SIGTERM semantics (the gateway's own
+        # migrate_on_drain still decides what SIGTERM does)
+        self.migrate_on_scale_down = bool(migrate_on_scale_down)
         self._frozen = False
         self._clock = clock
         self._up_since: Optional[float] = None
@@ -265,7 +273,12 @@ class FleetAutoscaler:
                 and now - self._down_since >= self.hold_down_s
                 and cooled and agg["pending"] == 0
                 and agg["live"] > self.min_replicas):
-            self.manager.scale_down()
+            try:
+                self.manager.scale_down(
+                    migrate=self.migrate_on_scale_down)
+            except TypeError:
+                # pre-ISSUE-18 manager duck type: no migrate kwarg
+                self.manager.scale_down()
             self._c_down.inc()
             action = "down"
         if action is not None:
@@ -274,6 +287,8 @@ class FleetAutoscaler:
             ev = {"t": round(now, 3), "action": action,
                   "replicas_before": n_eff,
                   "signal_mode": self.signal_mode,
+                  "migrate": (self.migrate_on_scale_down
+                              if action == "down" else None),
                   "queue_depth_per_replica":
                       round(agg["queue_depth_per_replica"], 2),
                   "free_slot_frac": round(agg["free_slot_frac"], 3),

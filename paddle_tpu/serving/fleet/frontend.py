@@ -51,6 +51,7 @@ run on a 2-vCPU edge box in front of a pod of accelerator hosts.
 from __future__ import annotations
 
 import asyncio
+import base64
 import itertools
 import json
 import time
@@ -81,7 +82,8 @@ class _ProxyState:
     but dropped by a fault/crash before forwarding is NOT committed —
     the client never saw it)."""
 
-    __slots__ = ("tokens", "lps", "head_sent", "final", "t_first")
+    __slots__ = ("tokens", "lps", "head_sent", "final", "t_first",
+                 "migrated")
 
     def __init__(self):
         self.tokens: List[int] = []
@@ -89,6 +91,10 @@ class _ProxyState:
         self.head_sent = False
         self.final: Optional[Dict[str, Any]] = None
         self.t_first: Optional[float] = None
+        # the intercepted terminal "migrated" event of a draining peer
+        # (ISSUE 18): committed stream + resume_kv digest, never
+        # forwarded to the client
+        self.migrated: Optional[Dict[str, Any]] = None
 
 
 class FleetFrontend:
@@ -107,6 +113,8 @@ class FleetFrontend:
                  failover_budget: int = 2,
                  peer_read_timeout_s: float = 30.0,
                  peer_connect_timeout_s: float = 5.0,
+                 migrate: bool = True,
+                 xfer_timeout_s: float = 2.0,
                  breakers: bool = True,
                  breaker_backoff_s: float = 1.0,
                  breaker_backoff_max_s: float = 30.0,
@@ -120,6 +128,13 @@ class FleetFrontend:
         self._failover_budget = int(failover_budget)
         self._peer_read_timeout_s = float(peer_read_timeout_s)
         self._peer_connect_timeout_s = float(peer_connect_timeout_s)
+        # cross-replica KV transfer (ISSUE 18): with migrate on, a
+        # draining peer's migrated streams resubmit with an inline
+        # resume_kv blob fetched over /kvz (bounded by xfer_timeout_s)
+        # so the survivor restores instead of re-prefilling; off, the
+        # same cutover just rides today's resume_tokens re-prefill.
+        self._migrate = bool(migrate)
+        self._xfer_timeout_s = float(xfer_timeout_s)
         self._breakers = bool(breakers)
         # the whole control plane is clock-injectable (ISSUE 16): the
         # fleet sim drives this frontend's breakers — and everything
@@ -149,6 +164,8 @@ class FleetFrontend:
             "fleet_retry_budget_exhausted_total", **self._labels)
         self._c_disconnects = reg.counter("fleet_disconnects_total",
                                           **self._labels)
+        self._c_migrated = reg.counter("fleet_migrated_requests_total",
+                                       **self._labels)
         self._g_replicas = reg.gauge("fleet_replicas", **self._labels)
         self._h_ttft = reg.histogram("fleet_ttft_ms",
                                      buckets=obs.SERVING_MS_BUCKETS,
@@ -279,6 +296,7 @@ class FleetFrontend:
             "requests": int(self._c_requests.value),
             "proxied_tokens": int(self._c_tokens.value),
             "peer_failovers": int(self._c_failovers.value),
+            "migrated_requests": int(self._c_migrated.value),
             "retry_budget_exhausted": int(self._c_exhausted.value),
             "disconnects": int(self._c_disconnects.value),
             "failover_budget": self._failover_budget,
@@ -598,31 +616,80 @@ class FleetFrontend:
                     "failover resubmit shed: fleet overloaded",
                     outcome="shed")
                 return
-            # ----------------------------------------------- peer failed
-            self._c_failovers.inc()
-            replica.note_proxy_failure()
-            self._router.evict_unhealthy()
-            self._probe_done(replica, probe, False)
-            if trace is not None:
-                trace.ev("peer_fail", replica=replica.name,
-                         reason=outcome)
-                if replica.breaker is not None:
-                    trace.ev("breaker_open", replica=replica.name)
-            obs.record_event("fleet_peer_fail", fleet=self.name,
-                             peer=replica.name, reason=outcome,
-                             request_id=rid)
+            # ------------------------------------ peer failed / migrated
+            migrated = outcome == "peer_migrated"
+            mig = (st.migrated or {}) if migrated else {}
+            st.migrated = None
+            resume_toks = list(st.tokens)
+            resume_lps = list(st.lps)
+            if migrated:
+                # planned drain cutover (ISSUE 18): the peer is
+                # draining, not broken — no eviction, no breaker
+                # charge, but the hop still counts against the budget.
+                # Adopt the event's committed stream when it extends
+                # what we relayed (it includes tokens the peer held
+                # back from emission): the skip-count dedupe forwards
+                # the extension as the survivor re-emits it.
+                self._probe_done(replica, probe, None)
+                self._c_migrated.inc()
+                # exclude the origin from the resubmit route NOW —
+                # its healthz already answers draining:True but the
+                # cached probe snapshot may not have observed it yet,
+                # and a hop bounced off its 503 would both charge the
+                # budget and drop the resume_kv we are about to attach
+                replica.mark(False)
+                toks = mig.get("tokens")
+                if isinstance(toks, list) \
+                        and len(toks) >= len(st.tokens) \
+                        and [int(t) for t in
+                             toks[:len(st.tokens)]] == st.tokens:
+                    resume_toks = [int(t) for t in toks]
+                    lps = mig.get("logprobs") or []
+                    resume_lps = (list(lps) + [None] * len(toks)
+                                  )[:len(toks)]
+                if trace is not None:
+                    trace.ev("peer_migrated", replica=replica.name,
+                             committed=len(resume_toks),
+                             resume_kv=str(mig.get("resume_kv")
+                                           or "")[:12])
+                obs.record_event("fleet_peer_migrated",
+                                 fleet=self.name, peer=replica.name,
+                                 request_id=rid,
+                                 committed=len(resume_toks))
+            else:
+                self._c_failovers.inc()
+                replica.note_proxy_failure()
+                self._router.evict_unhealthy()
+                self._probe_done(replica, probe, False)
+                if trace is not None:
+                    trace.ev("peer_fail", replica=replica.name,
+                             reason=outcome)
+                    if replica.breaker is not None:
+                        trace.ev("breaker_open", replica=replica.name)
+                obs.record_event("fleet_peer_fail", fleet=self.name,
+                                 peer=replica.name, reason=outcome,
+                                 request_id=rid)
             hops += 1
-            remaining = orig_max_new - len(st.tokens)
+            remaining = orig_max_new - len(resume_toks)
             # checked BEFORE the retry budget (the ISSUE 12 rule): a
             # result the client already fully holds is never errored
-            if st.tokens and remaining <= 0:
-                # fully committed at the kill boundary: the client has
-                # every token — synthesize the final event instead of
-                # re-running anything (never 503 a complete result)
-                st.final = {"tokens": list(st.tokens),
-                            "logprobs": [v for v in st.lps],
-                            "finish_reason": "stop", "done": True}
+            if resume_toks and remaining <= 0:
+                # fully committed at the kill/cutover boundary:
+                # forward any committed-but-unrelayed tail (tokens a
+                # migrated event carried past what the peer streamed),
+                # then synthesize the final event — never re-run or
+                # 503 a complete result
                 try:
+                    for i in range(len(st.tokens), len(resume_toks)):
+                        writer.write(b"data: " + json.dumps(
+                            {"token": resume_toks[i],
+                             "lp": resume_lps[i]}).encode() + b"\n\n")
+                        self._c_tokens.inc()
+                    st.tokens = list(resume_toks)
+                    st.lps = list(resume_lps)
+                    st.final = {"tokens": list(resume_toks),
+                                "logprobs": [v for v in resume_lps],
+                                "finish_reason": "stop", "done": True}
                     writer.write(b"data: "
                                  + json.dumps(st.final).encode()
                                  + b"\n\n")
@@ -638,15 +705,25 @@ class FleetFrontend:
                     f"failover budget exhausted after "
                     f"{self._failover_budget} peer failures")
                 return
-            if st.tokens:
+            spec.pop("resume_kv", None)
+            if resume_toks:
                 # the HTTP face of the ISSUE 12 resume seam: re-prefill
                 # prompt+committed on the survivor and skip the
                 # re-emitted committed prefix while relaying
                 spec = dict(spec,
-                            prompt=orig_prompt + list(st.tokens),
-                            resume_tokens=list(st.tokens),
-                            resume_lps=list(st.lps),
+                            prompt=orig_prompt + list(resume_toks),
+                            resume_tokens=list(resume_toks),
+                            resume_lps=list(resume_lps),
                             max_new_tokens=remaining)
+                if migrated and self._migrate:
+                    # ISSUE 18: resolve the migrated span to an inline
+                    # wire blob the survivor injects — restore instead
+                    # of re-prefill; any failure just leaves the
+                    # re-prefill resume above (bitwise identical)
+                    ref = await self._fetch_resume_kv(
+                        replica, str(mig.get("resume_kv") or ""))
+                    if ref:
+                        spec = dict(spec, resume_kv=ref)
             if orig_seed is not None:
                 # sampled streams re-derive a per-hop seed: the dead
                 # peer consumed an unknown amount of the original
@@ -656,7 +733,7 @@ class FleetFrontend:
             if trace is not None:
                 trace.ev("resubmit", to_replica="", attempt=hops)
                 trace.ev("resume_offset", offset=len(st.tokens),
-                         committed=len(st.tokens))
+                         committed=len(resume_toks))
 
     def _probe_done(self, replica, probe: bool,
                     success: Optional[bool]):
@@ -684,6 +761,35 @@ class FleetFrontend:
         except (ConnectionError, OSError):
             pass
         self._finish_trace(trace, outcome, st)
+
+    async def _fetch_resume_kv(self, origin: RemoteReplica,
+                               digest: str) -> str:
+        """Resolve a migrated span to an inline ``resume_kv`` blob
+        (``b64:`` wire record) the survivor can inject without a
+        fleet round-trip of its own. The drained origin is tried
+        first — its arena provably holds the span and it keeps
+        answering ``/kvz`` through the drain window — then any peer
+        whose gossiped spilled tier claims the digest. Every failure
+        (timeout, refused, corrupt, no digest) returns ``""``: the
+        caller just resubmits on the re-prefill path, which is
+        bitwise identical anyway."""
+        if not digest:
+            return ""
+        cand = [origin] + [p for p in self.peers
+                           if p is not origin and p.has_prefix(digest)]
+        loop = asyncio.get_running_loop()
+        for peer in cand:
+            try:
+                blob = await asyncio.wait_for(
+                    loop.run_in_executor(
+                        None, peer.fetch_kv, digest,
+                        self._xfer_timeout_s),
+                    self._xfer_timeout_s + 0.5)
+            except (asyncio.TimeoutError, OSError, RuntimeError):
+                continue
+            if blob:
+                return "b64:" + base64.b64encode(blob).decode("ascii")
+        return ""
 
     # --------------------------------------------------------------- proxy
     async def _proxy_stream(self, replica: RemoteReplica,
@@ -821,6 +927,14 @@ class FleetFrontend:
                 except ValueError:
                     return "peer_error"
                 if ev.get("done"):
+                    if ev.get("finish_reason") == "migrated":
+                        # planned drain cutover (ISSUE 18): NEVER
+                        # forwarded — the caller resubmits to a
+                        # survivor carrying the event's committed
+                        # stream and resume_kv reference; the client
+                        # just sees the stream continue
+                        st.migrated = ev
+                        return "peer_migrated"
                     if faults.inject("peer_conn_drop",
                                      replica=replica.name):
                         # severed between the last token and the done

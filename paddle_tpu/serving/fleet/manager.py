@@ -97,11 +97,15 @@ class LocalProcessManager:
             with self._lock:
                 self._pending -= 1
 
-    def scale_down(self):
+    def scale_down(self, migrate: bool = False):
         """Drain the least-loaded live peer: leave rotation first (no
         new traffic), then SIGTERM — ``run_until_shutdown`` finishes
         in-flight work and exits. A reaper escalates to SIGKILL only
-        past the drain grace."""
+        past the drain grace. ``migrate`` records the autoscaler's
+        intent in the scale-down event; whether SIGTERM actually cuts
+        live requests over is the replica's own ``--migrate`` flag
+        (argv is the only channel the manager speaks, and migration
+        semantics belong to the process being drained)."""
         peers = [p for p in self.frontend.peers if p.name in self.procs]
         if not peers:
             return
@@ -109,7 +113,7 @@ class LocalProcessManager:
         self._remove_everywhere(peer.name)
         proc = self.procs.pop(peer.name, None)
         obs.record_event("fleet_scale_down", fleet=self.name,
-                         peer=peer.name)
+                         peer=peer.name, migrate=bool(migrate))
         if proc is not None:
             threading.Thread(target=self._reap, args=(proc,),
                              daemon=True).start()

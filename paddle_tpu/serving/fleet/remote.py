@@ -211,6 +211,32 @@ class RemoteReplica:
         finally:
             conn.close()
 
+    def fetch_kv(self, digest_hex: str,
+                 timeout_s: Optional[float] = None) -> Optional[bytes]:
+        """``GET /kvz?digest=`` on this peer: one spill-arena span as
+        a kvxfer wire record, on the same bounded transport the probes
+        use (ISSUE 18 peer fetch). Returns the raw blob — the CALLER
+        runs the decode ladder against its own geometry — or None on
+        any miss/timeout/error; never raises. ``timeout_s`` overrides
+        the probe timeout (the fetch side's ``xfer_timeout_s`` bound:
+        a slow transfer is a counted re-prefill fallback, not a stall).
+        """
+        conn = http.client.HTTPConnection(
+            self.host, self.port,
+            timeout=float(timeout_s) if timeout_s is not None
+            else self.probe_timeout_s)
+        try:
+            conn.request("GET", f"/kvz?digest={digest_hex}")
+            resp = conn.getresponse()
+            payload = resp.read()
+            if resp.status != 200:
+                return None
+            return payload
+        except (OSError, http.client.HTTPException):
+            return None
+        finally:
+            conn.close()
+
     @staticmethod
     def _fold_health(doc: Dict[str, Any]) -> Dict[str, Any]:
         """Collapse a peer /healthz doc into the numbers the router and

@@ -102,6 +102,17 @@ def main(argv=None) -> int:
                     help="off = no sampler, no burn-rate alerting "
                          "(the pre-ISSUE-15 gateway, the A/B "
                          "reference)")
+    ap.add_argument("--spill-mb", type=int, default=0,
+                    help="host-RAM KV spill arena capacity (MiB); "
+                         "0 = no arena (ISSUE 17). An arena also "
+                         "makes this replica's spilled spans "
+                         "fleet-fetchable over GET /kvz (ISSUE 18)")
+    ap.add_argument("--migrate", default="off",
+                    choices=("on", "off"),
+                    help="on = SIGTERM drain CUTS live requests over "
+                         "to the fleet (terminal migrated events + "
+                         "resume_kv spans) instead of finishing "
+                         "them here; requires --spill-mb > 0")
     ns = ap.parse_args(argv)
 
     plat = os.environ.get("PADDLE_TPU_BENCH_PLATFORM")
@@ -124,11 +135,17 @@ def main(argv=None) -> int:
     telemetry_kw = dict(slo_window_scale=ns.slo_window_scale) \
         if ns.telemetry == "on" else \
         dict(sample_interval_s=None, slo_alerting=False)
+    spill_kw: Dict[str, Any] = {}
+    if ns.spill_mb > 0:
+        from paddle_tpu.serving.kvspill import KVSpillArena
+        spill_kw["spill_arena"] = KVSpillArena(
+            ns.spill_mb << 20, name=ns.name or "replica")
+        spill_kw["migrate_on_drain"] = ns.migrate == "on"
     gw = Gateway(engines, host=ns.host, port=ns.port,
                  max_queue=ns.max_queue, name=ns.name,
                  engine_factory=factory,
                  watchdog_timeout_s=ns.watchdog_timeout_s,
-                 **telemetry_kw)
+                 **spill_kw, **telemetry_kw)
 
     async def serve():
         await gw.start()

@@ -420,6 +420,7 @@ class FleetSim:
                  slo_latency_s: Optional[float] = None,
                  prefix_pool: int = 32, prefix_alpha: float = 1.2,
                  tokens_per_request: int = 32,
+                 prompt_tokens: int = 128,
                  probe_interval_s: float = 1.0,
                  stale_after_s: float = 2.5,
                  jitter_frac: float = 0.2,
@@ -452,6 +453,7 @@ class FleetSim:
         self.prefix_pool = int(prefix_pool)
         self.prefix_alpha = float(prefix_alpha)
         self.tokens_per_request = int(tokens_per_request)
+        self.prompt_tokens = int(prompt_tokens)
         self.probe_interval_s = float(probe_interval_s)
         self.stale_after_s = float(stale_after_s)
         self.jitter_frac = float(jitter_frac)
@@ -505,6 +507,13 @@ class FleetSim:
                    "synthesized_streams": 0, "corrupted_streams": 0,
                    "committed_tokens_preserved": 0,
                    "tokens_lost": 0, "tokens_duplicated": 0}
+        # cross-replica KV transfer tier (ISSUE 18): spans a migrating
+        # drain exported become fleet-fetchable (the /kvz wire path);
+        # recompute_tokens counts every prefill token a drain forced a
+        # survivor to re-run — the quantity migration exists to zero
+        self.fleet_spill: set = set()
+        self.xfer = {"drained_procs": 0, "migrated_requests": 0,
+                     "xfer_hits": 0, "recompute_tokens": 0}
         self._wall_cpu: Optional[float] = None
         # ---------------------------------------------- the REAL objects
         self.procs: List[SimProcess] = []
@@ -570,6 +579,62 @@ class FleetSim:
             for view in list(fe.peers):
                 if view.proc is proc:
                     fe.remove_peer(view)
+
+    def drain_one(self, migrate: bool):
+        """One scale-down wave step: retire the MOST-loaded live proc
+        — the worst case for a drain, maximum in-flight cut-overs."""
+        cands = [p for p in self.procs if p.up and not p.retired]
+        if len(cands) <= 1:
+            return
+        self.drain_process(max(cands, key=lambda p: p.active),
+                           migrate=migrate)
+
+    def drain_process(self, proc: SimProcess, migrate: bool):
+        """Scale-down drain of ``proc`` (ISSUE 18). With ``migrate``
+        the retiring replica exports each live request's KV span to
+        the fleet spill tier (the live stack's terminal ``migrated``
+        event + ``/kvz`` wire path) and the request cuts over to a
+        survivor that RESTORES the span — zero re-prefill. Without,
+        the requests resubmit on the classic resume seam and the
+        survivor re-prefills prompt+committed: exactly the recompute
+        the migration exists to eliminate, scored per token in
+        ``xfer["recompute_tokens"]``."""
+        self.xfer["drained_procs"] += 1
+        live = [rid for rid, req in self._inflight.items()
+                if req["proc"] is proc and not req["cancelled"]]
+        self._event("drain", proc=proc.name, migrate=migrate,
+                    live=len(live))
+        self.retire_process(proc)
+        if migrate:
+            # the replica's whole arena becomes fleet-fetchable —
+            # the gossiped spilled tier, now served over the wire
+            self.fleet_spill |= proc.digests | proc.spilled
+        for rid in live:
+            req = self._inflight.pop(rid)
+            req["cancelled"] = True
+            proc.active = max(proc.active - 1, 0)
+            committed = req["resume_from"] + int(
+                (self.tokens_per_request - req["resume_from"])
+                * min((self.clock.now - req["t_start"])
+                      / max(req["latency"], 1e-9), 1.0))
+            if migrate:
+                self.fleet_spill.add(f"req{rid}")
+                self.xfer["migrated_requests"] += 1
+                self.xfer["xfer_hits"] += 1
+                # one D2H export + one H2D scatter on the survivor;
+                # sub-chunk tail recompute is noise, scored as zero
+            else:
+                self.xfer["recompute_tokens"] += \
+                    self.prompt_tokens + committed
+            fe = req["fe"]
+            if not self.fe_alive[self.frontends.index(fe)]:
+                fe = self._live_frontend()
+                if fe is None:
+                    self._finish_outcome(False)
+                    continue
+            self._dispatch(rid, fe, req["digests"], hops=0,
+                           resume_from=committed,
+                           t_accept=req["t_accept"])
 
     # ------------------------------------------------------------ schedule
     def schedule(self, t: float, fn: Callable):
@@ -1068,6 +1133,9 @@ class FleetSim:
         if self.kill_frontend_at is not None \
                 or len(self.frontends) > 1:
             out["ha"] = dict(self.ha)
+        if self.xfer["drained_procs"]:
+            out["xfer"] = dict(self.xfer,
+                               fleet_spill_spans=len(self.fleet_spill))
         return out
 
     # --------------------------------------------------------------- dumps
@@ -1184,8 +1252,24 @@ def _spill_restart(t: float, frac: float, spill: bool) -> Incident:
                     apply=apply, revert=revert)
 
 
+def _drain_wave(times: Tuple[float, ...],
+                migrate: bool) -> Tuple[Incident, ...]:
+    """One scale-down drain per listed time (ISSUE 18): each retires
+    the most-loaded live proc, cutting its in-flight requests over
+    (``migrate=True``) or resubmitting them cold. page=False — a
+    planned drain must never page."""
+    kind = "drain_migrate" if migrate else "drain_reprefill"
+
+    def mk(t: float) -> Incident:
+        return Incident(kind, t, t + 1e-9, page=False,
+                        apply=lambda sim: sim.drain_one(migrate),
+                        revert=lambda sim: None)
+    return tuple(mk(t) for t in times)
+
+
 SCENARIOS = ("clean", "outage", "storm", "partition", "brownout",
-             "brownout_spill", "diurnal", "ha")
+             "brownout_spill", "diurnal", "ha", "drain_migrate",
+             "drain_reprefill")
 
 
 def build_scenario(name: str, *, n_replicas: int = 100,
@@ -1254,6 +1338,22 @@ def build_scenario(name: str, *, n_replicas: int = 100,
     elif name == "ha":
         kw.update(n_frontends=max(n_frontends, 2),
                   kill_frontend_at=0.5 * T)
+    elif name in ("drain_migrate", "drain_reprefill"):
+        # scale-down wave mid-traffic: ~1/3 of the fleet retires one
+        # replica at a time, each drain hitting the busiest survivor
+        # candidate. drain_migrate cuts live requests over through
+        # the fleet spill tier (recompute ~0); drain_reprefill is the
+        # control twin — identical seed/arrivals/wave times, requests
+        # resubmit cold and the survivors re-prefill prompt+committed.
+        # The recompute-amplification bound (>= 10x) is scored across
+        # the pair.
+        migrate = bool(overrides.pop("migrate",
+                                     name == "drain_migrate"))
+        waves = max(int(overrides.pop("drain_waves",
+                                      max(n_replicas // 3, 1))), 1)
+        kw["incidents"] = _drain_wave(
+            tuple(T * (0.3 + 0.5 * k / waves) for k in range(waves)),
+            migrate)
     else:
         raise ValueError(f"unknown scenario {name!r}; "
                          f"known: {SCENARIOS}")

@@ -73,6 +73,7 @@ from ..utils import faults
 from ..utils import observability as obs
 from ..utils.faults import BackpressureError
 from ..utils.shutdown import GracefulShutdown
+from . import kvxfer
 from .reqtrace import RequestTrace, RequestTraceRing
 from .router import EngineReplica, NoReplicaError, PrefixAffinityRouter
 from .scheduler import (SLO_BATCH, SLO_INTERACTIVE, ServeRequest,
@@ -618,6 +619,8 @@ class Gateway:
                  supervise: bool = True,
                  engine_factory=None,
                  spill_arena=None,
+                 migrate_on_drain: bool = False,
+                 xfer_grace_s: float = 0.5,
                  failover_budget: int = 2,
                  watchdog_timeout_s: float = 30.0,
                  watchdog_interval_s: float = 0.05,
@@ -711,12 +714,25 @@ class Gateway:
         # the token chain, so a span spilled by one replica restores
         # bit-exactly into any sibling with the same geometry.
         self._spill_arena = spill_arena
+        # cross-replica KV transfer (ISSUE 18): with migration on, a
+        # drain cuts live requests over to a survivor as terminal
+        # "migrated" SSE events carrying the committed stream + a
+        # resume_kv digest the fleet frontend resolves against /kvz —
+        # the resubmit restores the span instead of re-prefilling.
+        # _xfer_fetch is the fleet-tier hook _resubmit consults on a
+        # local arena miss (settable by an embedding frontend/test):
+        # digest hex -> wire blob bytes or None.
+        self._migrate_on_drain = bool(migrate_on_drain)
+        self._xfer_grace_s = float(xfer_grace_s)
+        self._xfer_fetch = None
         self._failover_budget = int(failover_budget)
         self._fo_lock = threading.Lock()
         self._c_failovers = reg.counter("gateway_failovers_total",
                                         **self._labels)
         self._c_fo_exhausted = reg.counter(
             "gateway_retry_budget_exhausted_total", **self._labels)
+        self._c_migrated = reg.counter(
+            "gateway_migrated_requests_total", **self._labels)
         # telemetry plane (ISSUE 15): the windowed time-series sampler
         # behind /metricsz + the SLO burn-rate engine over the trace
         # rings' outcome stream. Built BEFORE the workers so
@@ -878,6 +894,21 @@ class Gateway:
         finally:
             if locked:
                 worker._io_lock.release()
+        # crash fast-path (ISSUE 18): the tick thread died but the
+        # process — and the device pools — did not. Bank every live
+        # request's computed span into the shared arena BEFORE the
+        # resubmits below, so the survivor's admission restores them
+        # through one H2D scatter instead of re-prefilling
+        # prompt+committed. A wedged thread ("hang") may still be
+        # inside a dispatch touching the pools, so only provably idle
+        # engines are salvaged; any failure here costs exactly one
+        # re-prefill, never a token.
+        if self._spill_arena is not None and reason != "hang" \
+                and hasattr(worker.engine, "spill_live"):
+            try:
+                worker.engine.spill_live()
+            except Exception:
+                pass
         breaker = getattr(worker.replica, "breaker", None)
         if breaker is not None:
             breaker.record_failure()
@@ -964,6 +995,12 @@ class Gateway:
             # attach BEFORE any enqueue: the target's tick thread may
             # pop the request the moment it lands
             req.resume = desc
+            # fleet spill-tier fast-path (ISSUE 18): make the stream's
+            # longest span arena-resident (peer /kvz fetch if needed)
+            # before the survivor admits it — the resume then restores
+            # instead of re-prefilling
+            if self._spill_arena is not None:
+                self._xfer_restore(req, desc)
         cands = sorted(
             (w for w in self._workers
              if w is not from_worker and not w.failed
@@ -1002,6 +1039,43 @@ class Gateway:
             return
         self._fail_request(req, from_worker, 503,
                            "replica failed; no surviving replica")
+
+    def _xfer_restore(self, req: ServeRequest, desc: Dict):
+        """Fleet-tier consult before a failover hop re-prefills
+        (ISSUE 18 path 3): walk the resumed stream's digest chain
+        longest-first; a span already arena-resident means the
+        survivor's admission will restore it — done. Otherwise ask the
+        fleet through the ``_xfer_fetch`` hook (peer ``GET /kvz``) and
+        inject the wire blob. Every failure — no hook, no peer, any
+        decode-ladder rung, over-capacity refusal — leaves the normal
+        re-prefill path untouched."""
+        eng = self._ref
+        if not getattr(eng, "prefix_caching", False):
+            return
+        try:
+            ids = [int(t) for t in desc["prompt"]]
+            geo = eng._spill_geometry()
+            chain = eng._chunk_digests(ids, len(ids) - 1)
+        except Exception:
+            return
+        for i in range(len(chain) - 1, -1, -1):
+            raw = chain[i]
+            if self._spill_arena.probe(raw) is not None:
+                return                       # already fleet/local warm
+            if self._xfer_fetch is None:
+                return
+            try:
+                blob = self._xfer_fetch(raw.hex())
+            except Exception:
+                blob = None
+            if blob is None:
+                continue                     # peer may hold a shorter span
+            if kvxfer.inject_span(self._spill_arena, blob, geo,
+                                  gateway=self.name) is not None:
+                if req.trace is not None:
+                    req.trace.ev("kv_xfer_restore",
+                                 digest=raw.hex()[:12])
+                return
 
     def _fail_request(self, req: ServeRequest,
                       worker: _ReplicaWorker, status: int, msg: str):
@@ -1048,9 +1122,16 @@ class Gateway:
                          replicas=len(self._workers))
         return self
 
-    async def drain(self, timeout: float = 30.0):
+    async def drain(self, timeout: float = 30.0,
+                    migrate: Optional[bool] = None):
         """Stop admitting, finish in-flight, flush metrics, close the
-        listener (the SIGTERM rolling-restart path)."""
+        listener (the SIGTERM rolling-restart path). With migration on
+        (``migrate_on_drain`` or the override), live requests are CUT
+        OVER instead of finished here: each stream ends with a
+        terminal ``migrated`` event carrying the committed tokens and
+        a ``resume_kv`` digest whose KV span was just banked in the
+        arena — the fleet frontend resubmits to a survivor that
+        restores the span instead of re-prefilling (ISSUE 18)."""
         if self._draining and self._server is None:
             return
         self._draining = True
@@ -1063,6 +1144,32 @@ class Gateway:
         for w in self._workers:
             w.draining = True
             w.wake()
+        if migrate is None:
+            migrate = self._migrate_on_drain
+        mig_before = int(self._c_migrated.value)
+        if migrate and self._spill_arena is not None:
+            # migrate-out runs ON each tick thread (posted op): the
+            # D2H span export and the live-request cut must be ordered
+            # against that thread's own dispatch
+            flags = []
+            for w in self._workers:
+                if not w.is_alive():
+                    continue
+                ev = threading.Event()
+
+                def _mig(w=w, ev=ev):
+                    try:
+                        self._migrate_out(w)
+                    finally:
+                        ev.set()
+
+                w.post(_mig)
+                flags.append(ev)
+            mig_deadline = time.monotonic() + min(timeout, 10.0)
+            for ev in flags:
+                while not ev.is_set() \
+                        and time.monotonic() < mig_deadline:
+                    await asyncio.sleep(0.005)
         deadline = time.monotonic() + timeout
         for w in self._workers:
             # an abandoned (hung) worker never exits on its own; its
@@ -1085,6 +1192,12 @@ class Gateway:
                 try:
                     if hasattr(w.engine, "spill_parked"):
                         w.engine.spill_parked()
+                    if hasattr(w.engine, "spill_live"):
+                        # requests that outlived the drain deadline
+                        # still bank their computed spans — a peer
+                        # /kvz fetch can finish what this replica
+                        # couldn't (ISSUE 18)
+                        w.engine.spill_live()
                 except Exception:
                     pass        # a failed drain spill only costs warmth
         obs.record_event("gateway_drain", gateway=self.name)
@@ -1105,6 +1218,14 @@ class Gateway:
                 self.dump_traces(obs.run_dir())
             except Exception:
                 pass
+        if int(self._c_migrated.value) > mig_before \
+                and self._xfer_grace_s > 0:
+            # hold the listener open past the cut-over so the fleet
+            # frontend's /kvz fetch of the migrated spans lands —
+            # closing immediately would race the survivor's restore
+            # (it would still finish correctly via re-prefill, but
+            # the whole point of migrating is skipping that)
+            await asyncio.sleep(self._xfer_grace_s)
         if self._server is not None:
             self._server.close()
             try:
@@ -1112,6 +1233,65 @@ class Gateway:
             except Exception:
                 pass
             self._server = None
+
+    def _migrate_out(self, worker: _ReplicaWorker):
+        """Cut one replica's live requests over to the fleet (drain
+        migration, ISSUE 18; runs on the tick thread via ``post``).
+        Banks each request's computed KV span into the shared arena
+        (``spill_live``), then ends its stream with a terminal
+        ``migrated`` event: the committed tokens/logprobs, the
+        remaining budget, and the longest arena-resident span digest
+        as ``resume_kv``. The resubmitted stream restores that span —
+        greedy continuation is bitwise the uninterrupted stream; every
+        failure here just means the resubmit re-prefills instead."""
+        eng = worker.engine
+        try:
+            eng.spill_live()
+        except Exception:
+            pass            # a failed export only costs a re-prefill
+        try:
+            desc = eng.export_resumable()
+        except Exception:
+            desc = {}
+        for rid, req in list(worker._live.items()):
+            d = desc.get(rid)
+            if d is None:
+                continue
+            digest = ""
+            try:
+                ids = [int(t) for t in d["prompt"]]
+                chain = eng._chunk_digests(ids, len(ids) - 1)
+                for raw in reversed(chain):
+                    if self._spill_arena.probe(raw) is not None:
+                        digest = raw.hex()
+                        break
+            except Exception:
+                digest = ""
+            payload = {
+                "tokens": [int(t) for t in d["committed"]],
+                "logprobs": [float(v) for v in d["committed_lps"]],
+                "finish_reason": "migrated",
+                "resume_kv": digest,
+                "remaining": int(d["remaining"]),
+            }
+            worker._emit(req, ("done", payload))
+            if req.trace is not None:
+                req.trace.ev("migrate_out", digest=digest[:12],
+                             committed=len(payload["tokens"]),
+                             remaining=payload["remaining"])
+            worker._trace_finish(req, "migrated")
+            try:
+                eng.cancel(rid)
+                eng.cancelled.pop(rid, None)
+                eng.results.pop(rid, None)
+                eng.logprobs.pop(rid, None)
+            except Exception:
+                pass
+            worker._live.pop(rid, None)
+            self._c_migrated.inc()
+        obs.record_event("gateway_migrate_out", gateway=self.name,
+                         replica=worker.replica.name,
+                         moved=int(self._c_migrated.value))
 
     async def run_until_shutdown(self, poll_s: float = 0.05):
         """Serve until the GracefulShutdown latch fires (SIGTERM /
@@ -1290,6 +1470,11 @@ class Gateway:
             "prefix_digest_set": self.prefix_digest_summary(),
             "kv_spill": (self._spill_arena.snapshot()
                          if self._spill_arena is not None else None),
+            # cross-replica transfer plane (ISSUE 18)
+            "kv_xfer": dict(
+                kvxfer.counters_snapshot(self.name),
+                migrate_on_drain=self._migrate_on_drain,
+                migrated_requests=int(self._c_migrated.value)),
             # telemetry plane (ISSUE 15)
             "telemetry": {
                 "sampler": None if self.sampler is None else {
@@ -1415,10 +1600,47 @@ class Gateway:
             writer.write(_json_response(200, self.metricsz(window_s)))
             await writer.drain()
             return
+        if method == "GET" and path == "/kvz":
+            await self._serve_kvz(query, writer)
+            return
         if method == "POST" and path == "/v1/generate":
             await self._generate(body, headers, reader, writer)
             return
         writer.write(_json_response(404, {"error": f"no route {path}"}))
+        await writer.drain()
+
+    async def _serve_kvz(self, query: str, writer):
+        """``GET /kvz?digest=<hex>``: one spill-arena span as a kvxfer
+        wire record (ISSUE 18 peer fetch — the fleet-fetchable face of
+        the gossip ``spilled`` tier; a rebuilt or different replica
+        pulls a dead peer's spans instead of re-prefilling). 404 for
+        anything not restorable. Chaos: ``xfer_slow`` delays the body
+        here (the fetch side bounds it with ``xfer_timeout_s``); the
+        encoder's ``xfer_corrupt``/``xfer_trunc`` sites damage it —
+        the fetcher's decode ladder turns every one into a counted
+        re-prefill fallback, never a token."""
+        digest = _query_param(query, "digest", str)
+        if self._spill_arena is None or not digest:
+            writer.write(_json_response(
+                404, {"error": "no spill arena" if
+                      self._spill_arena is None else "missing digest"}))
+            await writer.drain()
+            return
+        if faults.inject("xfer_slow", gateway=self.name,
+                         digest=str(digest)[:12]):
+            await asyncio.sleep(faults.xfer_slow_seconds())
+        try:
+            blob = kvxfer.export_span(
+                self._spill_arena, str(digest),
+                self._ref._spill_geometry(), gateway=self.name)
+        except Exception:
+            blob = None
+        if blob is None:
+            writer.write(_json_response(
+                404, {"error": "span not restorable"}))
+        else:
+            writer.write(_http_response(
+                200, blob, ctype="application/octet-stream"))
         await writer.drain()
 
     # ------------------------------------------------------------ generate
@@ -1468,6 +1690,17 @@ class Gateway:
                                      "floats")
                 gen["resume_lps"] = [float("nan") if v is None
                                      else float(v) for v in rl]
+        # cross-replica KV transfer (ISSUE 18): optional reference to
+        # the resumed stream's KV span — "b64:<wire record>" carries
+        # the blob inline (drain migration resubmit), a bare digest
+        # hex consults the local arena then the fleet fetch hook.
+        # Strictly best-effort: any failure is a counted fallback and
+        # the resume re-prefills; never a client-visible error.
+        if spec.get("resume_kv"):
+            try:
+                self._consume_resume_kv(str(spec["resume_kv"]))
+            except Exception:
+                pass
         timeout_s = spec.get("timeout_s")
         deadline = (time.monotonic() + float(timeout_s)
                     if timeout_s is not None else None)
@@ -1487,6 +1720,44 @@ class Gateway:
             priority=int(spec.get("priority", 0)),
             deadline=deadline, digest=digest,
             sink=asyncio.Queue(), stream=bool(spec.get("stream", True)))
+
+    def _consume_resume_kv(self, ref: str):
+        """Make a ``resume_kv`` span arena-resident BEFORE admission,
+        so the engine's ``_arena_restore`` turns the resume's
+        prompt+committed re-prefill into one H2D scatter.
+        ``b64:<base64 wire record>`` runs the inline blob through the
+        kvxfer decode ladder; a bare digest hex checks residency and,
+        on a miss, the fleet ``_xfer_fetch`` hook (peer ``GET /kvz``).
+        Every failure mode — bad encoding, any ladder rung, no peer,
+        over-capacity — leaves admission exactly as it was: the stream
+        re-prefills, bitwise identical."""
+        if self._spill_arena is None:
+            return
+        geo = self._ref._spill_geometry()
+        if ref.startswith("b64:"):
+            import base64
+            import binascii
+            try:
+                blob = base64.b64decode(ref[4:], validate=True)
+            except (binascii.Error, ValueError):
+                return
+            kvxfer.inject_span(self._spill_arena, blob, geo,
+                               gateway=self.name)
+            return
+        try:
+            raw = bytes.fromhex(ref)
+        except ValueError:
+            return
+        if self._spill_arena.probe(raw) is not None \
+                or self._xfer_fetch is None:
+            return
+        try:
+            blob = self._xfer_fetch(ref)
+        except Exception:
+            blob = None
+        if blob is not None:
+            kvxfer.inject_span(self._spill_arena, blob, geo,
+                               gateway=self.name)
 
     async def _generate(self, body, headers, reader, writer):
         if self.draining:

@@ -228,6 +228,44 @@ class KVSpillArena:
             self._set_gauges()
         return stored
 
+    def put(self, digest: bytes, payload: bytes, tokens: int,
+            geometry: tuple, prefix_generation: int = 0) -> bool:
+        """Insert one payload already in hand — the wire-receive side
+        of cross-replica transfer (``kvxfer.inject_span``). Mirrors
+        ``spill()``'s capacity ladder: a payload that can never fit is
+        refused (False — the caller counts the fallback and
+        re-prefills), LRU records are evicted to make room, and the
+        crc is banked over the bytes as received. A digest already
+        resident just refreshes LRU (content-addressed: same digest
+        => byte-identical KV)."""
+        digest = bytes(digest)
+        payload = bytes(payload)
+        with self._lock:
+            ent = self._index.get(digest)
+            if ent is not None:
+                rec = self._records.pop(ent[0], None)
+                if rec is not None:
+                    self._records[ent[0]] = rec
+                return True
+            if len(payload) > self.capacity_bytes:
+                self._c_drops.inc()          # can never fit: refuse
+                return False
+            while self._occupancy + len(payload) \
+                    > self.capacity_bytes:
+                old = next(iter(self._records))
+                self._evict_record(old)
+                self.lru_evictions += 1
+            rec = _Record(payload, zlib.crc32(payload), tokens,
+                          tuple(geometry), prefix_generation)
+            self._records[digest] = rec
+            self._index[digest] = (digest, rec.tokens)
+            self._occupancy += rec.nbytes
+            self._c_spans.inc()
+            self._c_bytes.inc(rec.nbytes)
+            self._gen += 1
+            self._set_gauges()
+            return True
+
     # -------------------------------------------------------------- probe
     def probe(self, digest: bytes) -> Optional[int]:
         """Token count of the span stored under ``digest`` (record or

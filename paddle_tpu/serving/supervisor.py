@@ -336,6 +336,21 @@ class ReplicaSupervisor(threading.Thread):
             return          # retried next pass until the thread dies
         obs.registry().counter("replica_restarts_total",
                                reason=reason, **gw._labels).inc()
+        if gw._spill_arena is not None and reason != "hang":
+            # the dying engine's device pools still live in THIS
+            # process: salvage its parked and live spans into the
+            # host arena before the factory/hard_reset discards them
+            # — the rebuilt worker (or a /kvz peer fetch, ISSUE 18)
+            # restores instead of re-prefilling. A hung worker is
+            # skipped: its wedged thread may still be touching the
+            # pools mid-step.
+            try:
+                if hasattr(worker.engine, "spill_parked"):
+                    worker.engine.spill_parked()
+                if hasattr(worker.engine, "spill_live"):
+                    worker.engine.spill_live()
+            except Exception:
+                pass        # salvage only costs warmth, never safety
         try:
             if gw._engine_factory is not None:
                 engine = gw._engine_factory()

@@ -59,13 +59,15 @@ STREAM_STALL_ENV_VAR = "PADDLE_TPU_FAULT_STREAM_STALL_S"
 SLOW_REPLICA_ENV_VAR = "PADDLE_TPU_FAULT_SLOW_REPLICA_S"
 PEER_SLOW_ENV_VAR = "PADDLE_TPU_FAULT_PEER_SLOW_S"
 SPILL_SLOW_ENV_VAR = "PADDLE_TPU_FAULT_SPILL_SLOW_S"
+XFER_SLOW_ENV_VAR = "PADDLE_TPU_FAULT_XFER_SLOW_S"
 
 __all__ = [
     "SITES", "inject", "scoped", "configure", "reset", "parse_spec",
     "retry_with_backoff", "BackpressureError", "RequestTimeoutError",
     "hang_seconds", "prefetch_stall_seconds", "dispatch_hang_seconds",
     "stream_stall_seconds", "slow_replica_seconds",
-    "peer_slow_seconds", "spill_slow_seconds", "main",
+    "peer_slow_seconds", "spill_slow_seconds", "xfer_slow_seconds",
+    "main",
 ]
 
 # ------------------------------------------------------------- inventory
@@ -214,6 +216,31 @@ SITES: Dict[str, Tuple[str, str]] = {
         "capacity-pressure stand-in; the span is counted in "
         "kv_spill_drops_total and its next warm miss re-prefills "
         "normally — a lost spill costs latency, never tokens)"),
+    # --- cross-replica KV transfer chaos (ISSUE 18): the wire between
+    # gateway arenas. corrupt/trunc live in the kvxfer encoder so every
+    # sender (the /kvz endpoint, drain migration blobs) inherits them;
+    # slow lives in the gateway handler, bounded by the fetch side's
+    # xfer_timeout_s.
+    "xfer_corrupt": (
+        "paddle_tpu/serving/kvxfer.py:encode_span",
+        "flip one payload byte of a wire record AFTER its header crc32 "
+        "is banked (wire bit rot stand-in; the receiver's decode ladder "
+        "must catch it, count kv_xfer_checksum_failures_total, and fall "
+        "back to re-prefill — a corrupted transfer never emits a "
+        "token)"),
+    "xfer_trunc": (
+        "paddle_tpu/serving/kvxfer.py:encode_span",
+        "cut a wire record to half its length (transfer severed "
+        "mid-body; the receiver's byte-count rung refuses it, counts "
+        "kv_xfer_fallbacks_total, and the stream re-prefills bitwise "
+        "identically)"),
+    "xfer_slow": (
+        "paddle_tpu/serving/gateway.py:Gateway._dispatch_http",
+        "sleep PADDLE_TPU_FAULT_XFER_SLOW_S (default 0.05) before "
+        "serving a GET /kvz span (congested inter-replica link "
+        "stand-in; the fetch side bounds the wait with xfer_timeout_s "
+        "and falls back to re-prefill on expiry — a slow transfer "
+        "costs latency, never tokens)"),
 }
 
 
@@ -303,8 +330,12 @@ def parse_spec(spec: str, seed: Optional[int] = None) -> FaultPlan:
             prob = float(p)
         times = None
         if "x" in entry:
-            entry, t = entry.split("x", 1)
-            times = int(t)
+            # the times suffix is "<site>x<N>": split on the LAST "x"
+            # and only when an integer follows, so site names that
+            # themselves contain an "x" (xfer_corrupt, ...) parse
+            head, t = entry.rsplit("x", 1)
+            if t.isdigit():
+                entry, times = head, int(t)
         lo, hi = 0, None
         if "@" in entry:
             entry, when = entry.split("@", 1)
@@ -454,6 +485,11 @@ def peer_slow_seconds() -> float:
 def spill_slow_seconds() -> float:
     """Per-copy delay of a fired ``spill_slow`` site."""
     return float(os.environ.get(SPILL_SLOW_ENV_VAR, "0.05"))
+
+
+def xfer_slow_seconds() -> float:
+    """Per-span delay of a fired ``xfer_slow`` site."""
+    return float(os.environ.get(XFER_SLOW_ENV_VAR, "0.05"))
 
 
 # ---------------------------------------------------------------- retry

@@ -238,7 +238,7 @@ def test_sim_dumps_validate_and_render_through_fleet_dash(tmp_path):
 def test_scenario_registry_is_closed():
     assert set(SCENARIOS) == {"clean", "outage", "storm", "partition",
                               "brownout", "brownout_spill", "diurnal",
-                              "ha"}
+                              "ha", "drain_migrate", "drain_reprefill"}
     with pytest.raises(ValueError):
         build_scenario("nope")
 

@@ -1,0 +1,385 @@
+"""ISSUE 18: cross-replica KV transfer (``serving/kvxfer.py``).
+
+Contracts pinned here:
+
+- WIRE: ``encode_span``/``decode_span`` round-trip the self-describing
+  ``KVX1`` record (digest, token count, geometry, crc32 banked before
+  the bytes touch the wire) and count ``kv_xfer_{spans,bytes}_total``
+  per gateway label.
+- LADDER: every decode rung — truncation (short record, cut header,
+  payload/nbytes mismatch), unparseable header, geometry skew, crc32
+  mismatch — raises :class:`XferError` naming its rung and NEVER
+  returns bytes; the checksum rung also counts
+  ``kv_xfer_checksum_failures_total``.
+- FAULTS: the ``xfer_corrupt`` / ``xfer_trunc`` chaos sites damage the
+  record AFTER the crc is banked, exactly like wire bit rot — the
+  decode ladder catches both.
+- ARENA SEAM: ``export_span`` lifts a record out of one arena,
+  ``inject_span`` lands it in a peer's (counted as a hit) where
+  ``take`` serves it verbatim; an over-capacity receiver or a
+  corrupted blob is a counted fallback that leaves the arena clean.
+- MIGRATION: ``spill_live`` + wire + cross-arena restore is bitwise
+  (tokens AND logprobs) vs the re-prefill control, token-exact vs the
+  uninterrupted stream, and raises ``prefix_hit_tokens`` over the
+  control — the survivor restored, it didn't recompute.
+- CORRUPTION: a span corrupted in transit never lands and never
+  emits — the survivor falls back to re-prefill with the stream still
+  exact. A corrupted transfer may cost a prefill, never a token.
+- FLEET DRAIN: a mid-stream ``drain(migrate=True)`` on the origin
+  ends the proxied stream with a terminal ``migrated`` event the
+  frontend INTERCEPTS — no failover charged — and resumes on the
+  survivor via ``resume_kv`` with ``spill_restores`` advancing; the
+  client sees one uninterrupted greedy stream.
+- CHAOS (slow): the ``serve_loadgen --chaos --spill on --migrate on``
+  harness — seeded mid-run kills plus the two-gateway drain-migration
+  A/B probe — finishes with zero corrupted streams, bitwise A/B
+  parity, and a recompute-amplification ratio >= the ISSUE 18 floor
+  of 10x (``tools/marker_audit.py`` chaos patterns).
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.generation.paged import PagedEngine
+from paddle_tpu.models import LlamaForCausalLM
+from paddle_tpu.models.llama import llama_tiny
+from paddle_tpu.serving import Gateway
+from paddle_tpu.serving.fleet import FleetFrontend, RemoteReplica
+from paddle_tpu.serving.kvspill import KVSpillArena
+from paddle_tpu.serving import kvxfer
+from paddle_tpu.utils import faults
+
+from test_gateway import _engine as _stub_engine
+from test_gateway import _load_loadgen, _poll, _sse
+from test_kvspill import _chaos_spill_ns
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    return LlamaForCausalLM(llama_tiny())
+
+
+def _engine(model, arena=None, **kw):
+    base = dict(max_slots=2, num_blocks=16, block_size=8,
+                max_blocks_per_seq=8, prefill_buckets=(16, 32),
+                chunk_prefill_tokens=16, enable_prefix_cache=True)
+    base.update(kw)
+    eng = PagedEngine(model, **base)
+    if arena is not None:
+        eng.attach_spill(arena)
+    return eng
+
+
+# =================================================================== wire
+GEO = (2, 8, 1, 4, "float32", 16)   # (L, B, kvh, d, dtype, chunk)
+
+
+def _payload(n_blocks, fill=7.0):
+    L, B, kvh, d = GEO[0], GEO[1], GEO[2], GEO[3]
+    return np.full((2 * L, n_blocks, B, kvh, d), fill,
+                   np.float32).tobytes()
+
+
+class TestWire:
+    def test_roundtrip_and_counters(self):
+        pay = _payload(2)
+        before = kvxfer.counters_snapshot("u_rt")
+        blob = kvxfer.encode_span("ab" * 32, 16, GEO, pay,
+                                  gateway="u_rt")
+        assert kvxfer.decode_span(blob, GEO) == ("ab" * 32, 16, pay)
+        after = kvxfer.counters_snapshot("u_rt")
+        assert after["kv_xfer_spans_total"] \
+            == before["kv_xfer_spans_total"] + 1
+        assert after["kv_xfer_bytes_total"] \
+            == before["kv_xfer_bytes_total"] + len(blob)
+
+    def test_decode_ladder_names_every_rung(self):
+        pay = _payload(2)
+        blob = kvxfer.encode_span("ab" * 32, 16, GEO, pay)
+        # short / unmagical record
+        with pytest.raises(kvxfer.XferError) as e:
+            kvxfer.decode_span(blob[:10], GEO)
+        assert e.value.rung == "truncated"
+        # record cut inside its header
+        with pytest.raises(kvxfer.XferError) as e:
+            kvxfer.decode_span(blob[:len(kvxfer.MAGIC) + 6], GEO)
+        assert e.value.rung == "truncated"
+        # unparseable header json
+        bad_hdr = kvxfer.MAGIC + kvxfer._HEAD.pack(5) + b"notjs"
+        with pytest.raises(kvxfer.XferError) as e:
+            kvxfer.decode_span(bad_hdr, GEO)
+        assert e.value.rung == "header"
+        # geometry skew (receiver's geometry wins, refused pre-arena)
+        with pytest.raises(kvxfer.XferError) as e:
+            kvxfer.decode_span(blob, (9,) + GEO[1:])
+        assert e.value.rung == "geometry"
+        # payload shorter than the header declared
+        with pytest.raises(kvxfer.XferError) as e:
+            kvxfer.decode_span(blob[:-2], GEO)
+        assert e.value.rung == "truncated"
+        # one flipped payload byte -> crc32, and the counter advances
+        before = kvxfer.counters_snapshot("u_crc")
+        flipped = (blob[:-3] + bytes([blob[-3] ^ 0xFF]) + blob[-2:])
+        with pytest.raises(kvxfer.XferError) as e:
+            kvxfer.decode_span(flipped, GEO, gateway="u_crc")
+        assert e.value.rung == "checksum"
+        after = kvxfer.counters_snapshot("u_crc")
+        assert after["kv_xfer_checksum_failures_total"] \
+            == before["kv_xfer_checksum_failures_total"] + 1
+
+    def test_fault_sites_damage_after_crc_banked(self):
+        pay = _payload(2)
+        with faults.scoped("xfer_corrupt"):
+            corrupt = kvxfer.encode_span("cd" * 32, 16, GEO, pay)
+        with pytest.raises(kvxfer.XferError) as e:
+            kvxfer.decode_span(corrupt, GEO)
+        assert e.value.rung == "checksum"
+        with faults.scoped("xfer_trunc"):
+            cut = kvxfer.encode_span("ef" * 32, 16, GEO, pay)
+        with pytest.raises(kvxfer.XferError) as e:
+            kvxfer.decode_span(cut, GEO)
+        assert e.value.rung == "truncated"
+
+
+# ============================================================= arena seam
+class TestArenaSeam:
+    def test_export_inject_peer_roundtrip(self):
+        pay = _payload(2)
+        a1 = KVSpillArena(1 << 20, name="x_src")
+        a2 = KVSpillArena(1 << 20, name="x_dst")
+        assert a1.spill([(b"d" * 32, (1, 2))], lambda e: pay, GEO) == 1
+        before = kvxfer.counters_snapshot("u_peer")
+        blob = kvxfer.export_span(a1, (b"d" * 32).hex(), GEO,
+                                  gateway="u_peer")
+        assert blob is not None
+        got = kvxfer.inject_span(a2, blob, GEO, gateway="u_peer")
+        assert got == ((b"d" * 32).hex(), 16)
+        assert a2.take(b"d" * 32, GEO) == (pay, 16)
+        after = kvxfer.counters_snapshot("u_peer")
+        assert after["kv_xfer_hits_total"] \
+            == before["kv_xfer_hits_total"] + 1
+
+    def test_export_unknown_digest_is_counted_fallback(self):
+        a1 = KVSpillArena(1 << 20, name="x_miss")
+        before = kvxfer.counters_snapshot("u_miss")
+        assert kvxfer.export_span(a1, "00" * 32, GEO,
+                                  gateway="u_miss") is None
+        assert kvxfer.export_span(a1, "not-hex", GEO,
+                                  gateway="u_miss") is None
+        after = kvxfer.counters_snapshot("u_miss")
+        assert after["kv_xfer_fallbacks_total"] \
+            == before["kv_xfer_fallbacks_total"] + 1
+
+    def test_inject_refusals_leave_arena_clean(self):
+        pay = _payload(2)
+        a1 = KVSpillArena(1 << 20, name="x_ok")
+        assert a1.spill([(b"d" * 32, (1, 2))], lambda e: pay, GEO) == 1
+        blob = kvxfer.export_span(a1, (b"d" * 32).hex(), GEO,
+                                  gateway="u_ref")
+        # over-capacity receiver: counted fallback, nothing stored
+        tiny = KVSpillArena(8, name="x_tiny")
+        before = kvxfer.counters_snapshot("u_ref")
+        assert kvxfer.inject_span(tiny, blob, GEO,
+                                  gateway="u_ref") is None
+        assert len(tiny) == 0
+        # corrupted-in-transit blob: ladder catches it pre-arena
+        a2 = KVSpillArena(1 << 20, name="x_dirty")
+        flipped = (blob[:-3] + bytes([blob[-3] ^ 0xFF]) + blob[-2:])
+        assert kvxfer.inject_span(a2, flipped, GEO,
+                                  gateway="u_ref") is None
+        assert len(a2) == 0
+        after = kvxfer.counters_snapshot("u_ref")
+        assert after["kv_xfer_fallbacks_total"] \
+            == before["kv_xfer_fallbacks_total"] + 2
+
+
+# ============================================================== migration
+@pytest.fixture(scope="module")
+def mig(model):
+    """One partial run, spilled live and shipped to a peer arena —
+    shared by the parity and corruption pins. ``take`` is
+    non-destructive so both tests can export the same record."""
+    arena = KVSpillArena(64 << 20, name="mig_src")
+    e0 = _engine(model, arena, num_blocks=32)
+    rs = np.random.RandomState(7)
+    prompt = np.asarray([rs.randint(1, 256, 40)])
+    eref = _engine(model, num_blocks=32)
+    eref.submit("r", prompt, max_new_tokens=8)
+    ref = np.asarray(eref.run()["r"])
+    ref_lps = np.asarray(eref.logprobs["r"])
+    e0.submit("a", prompt, max_new_tokens=8)
+    for _ in range(6):
+        e0.step()
+    desc = e0.export_resumable()["a"]
+    assert e0.spill_live() > 0
+    ids = [int(t) for t in desc["prompt"]]
+    chain = e0._chunk_digests(ids, len(ids) - 1)
+    resident = [c for c in chain if arena.probe(c) is not None]
+    assert resident, "spill_live banked no chain digest"
+    return dict(arena=arena, geo=e0._spill_geometry(), ids=ids,
+                desc=desc, digest=resident[-1].hex(), ref=ref,
+                ref_lps=ref_lps)
+
+
+def _survivor(model, arena, mig):
+    """Resume ``mig``'s stream on a fresh engine (the survivor): with
+    an arena holding the transferred span it restores, without one it
+    re-prefills — the A/B twin."""
+    e = _engine(model, arena, num_blocks=32)
+    h0 = e.stats.get("prefix_hit_tokens", 0)
+    desc = mig["desc"]
+    e.submit("b", np.asarray([mig["ids"]]),
+             max_new_tokens=desc["remaining"],
+             resume_tokens=list(desc["committed"]),
+             resume_lps=list(desc["committed_lps"]))
+    out = e.run()
+    return (e, np.asarray(out["b"]), np.asarray(e.logprobs["b"]),
+            e.stats["prefix_hit_tokens"] - h0)
+
+
+class TestMigration:
+    def test_live_span_migrates_bitwise_vs_reprefill_control(
+            self, model, mig):
+        blob = kvxfer.export_span(mig["arena"], mig["digest"],
+                                  mig["geo"], gateway="mig_par")
+        assert blob is not None
+        peer = KVSpillArena(64 << 20, name="mig_peer")
+        assert kvxfer.inject_span(peer, blob, mig["geo"],
+                                  gateway="mig_par") is not None
+        e_on, on, on_lps, hit_on = _survivor(model, peer, mig)
+        e_off, off, off_lps, hit_off = _survivor(model, None, mig)
+        # migration-on vs re-prefill control: bitwise, tokens AND lps
+        np.testing.assert_array_equal(on, off)
+        np.testing.assert_allclose(on_lps, off_lps, rtol=0, atol=0)
+        # vs the uninterrupted stream: token-exact, lps to float tol
+        # (prefill- vs decode-computed KV differ in the last ulp —
+        # the existing resume contract)
+        np.testing.assert_array_equal(on, mig["ref"])
+        assert np.allclose(on_lps, mig["ref_lps"],
+                           rtol=1e-5, atol=1e-6)
+        # and the parity came from a RESTORE, not a quiet re-prefill
+        assert e_on.stats["spill_restores"] >= 1
+        assert e_off.stats["spill_restores"] == 0
+        assert hit_on > hit_off
+
+    def test_corrupted_transfer_never_lands_never_emits(
+            self, model, mig):
+        with faults.scoped("xfer_corrupt"):
+            blob = kvxfer.export_span(mig["arena"], mig["digest"],
+                                      mig["geo"], gateway="mig_cor")
+        assert blob is not None
+        peer = KVSpillArena(64 << 20, name="mig_cor_peer")
+        assert kvxfer.inject_span(peer, blob, mig["geo"],
+                                  gateway="mig_cor") is None
+        assert len(peer) == 0
+        # the survivor re-prefills off the clean arena and the stream
+        # is still exact: a corrupted transfer cost a prefill, never
+        # a token
+        e, toks, lps, _hits = _survivor(model, peer, mig)
+        np.testing.assert_array_equal(toks, mig["ref"])
+        assert np.allclose(lps, mig["ref_lps"], rtol=1e-5, atol=1e-6)
+        assert e.stats["spill_restores"] == 0
+        snap = kvxfer.counters_snapshot("mig_cor")
+        assert snap["kv_xfer_fallbacks_total"] >= 1
+
+
+# ============================================================ fleet drain
+def test_fleet_drain_migrates_stream_without_failover():
+    """Mid-stream ``drain(migrate=True)`` on the origin: the frontend
+    intercepts the terminal ``migrated`` event (no failover charged,
+    no breaker), fetches the span over ``/kvz`` inside the drain
+    grace, and resumes on the survivor via ``resume_kv`` — the client
+    sees one uninterrupted greedy stream and the survivor's engine
+    counts a spill restore, not a re-prefill."""
+    prompt = list(range(1, 20))
+    max_new = 24
+    eng = _stub_engine()
+    eng.submit("ref", [prompt], max_new_tokens=max_new,
+               temperature=0.0)
+    eng.run()
+    ref_toks = eng.results["ref"]
+    ref_lps = eng.logprobs["ref"]
+
+    async def run():
+        gws = [Gateway(_stub_engine(), name=f"t-xmg{j}",
+                       spill_arena=KVSpillArena(64 << 20,
+                                                name=f"xmg{j}"),
+                       migrate_on_drain=True)
+               for j in range(2)]
+        for gw in gws:
+            await gw.start()
+        reps = [RemoteReplica(gw.name, "127.0.0.1", gw.port,
+                              probe_interval_s=0.05) for gw in gws]
+        fe = FleetFrontend(reps, chunk_tokens=8, name="t-xmg-fe",
+                           migrate=True, breaker_backoff_s=60.0)
+        await fe.start()
+        assert await _poll(lambda: all(r.healthy() for r in reps), 10)
+        drain = {}
+
+        async def on_first():
+            target = next(g for g in gws
+                          if any(w._live for w in g._workers))
+            drain["gw"] = target
+            drain["t"] = asyncio.ensure_future(
+                target.drain(migrate=True))
+
+        status, _hdr, toks, fin = await _sse(
+            fe.port, {"prompt": prompt, "max_new_tokens": max_new,
+                      "temperature": 0.0}, on_first=on_first)
+        assert status == 200 and drain, "drain never triggered"
+        await drain["t"]
+        hz = fe.healthz()
+        survivor = next(g for g in gws if g is not drain["gw"])
+        restores = survivor._workers[0].engine.stats.get(
+            "spill_restores", 0)
+        xfer = kvxfer.counters_snapshot(drain["gw"].name)
+        await fe.drain()
+        for gw in gws:
+            await gw.drain()
+        return toks, fin, hz, restores, xfer
+
+    toks, fin, hz, restores, xfer = asyncio.run(run())
+    assert toks == ref_toks
+    assert fin["finish_reason"] == "stop"
+    assert fin["tokens"] == ref_toks
+    assert np.allclose(fin["logprobs"], ref_lps, rtol=1e-5, atol=1e-6)
+    assert hz.get("migrated_requests", 0) >= 1
+    assert hz["peer_failovers"] == 0, "migration must not count failover"
+    assert restores >= 1, "survivor re-prefilled instead of restoring"
+    assert xfer["kv_xfer_spans_total"] >= 1
+    assert xfer["kv_xfer_checksum_failures_total"] == 0
+
+
+# ================================================================== chaos
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_migrate_chaos_kill_and_probe_replay_clean():
+    """The ISSUE 18 acceptance run: the ISSUE 17 chaos config (3
+    replicas, 3 seeded mid-run kills, shared arena) with ``--migrate
+    on``, which additionally runs the two-gateway drain-migration A/B
+    probe. Gates: chaos replay clean (zero corrupted streams), probe
+    bitwise parity migrate vs re-prefill control with zero errors, at
+    least one real migration, and the recompute-amplification bound —
+    re-prefill burns >= 10x the prefill tokens migration does."""
+    slg = _load_loadgen()
+    ns = _chaos_spill_ns(migrate="on", migrate_requests=6)
+    rung = asyncio.run(slg.run_loadgen(ns))
+    ch = rung["chaos"]
+    assert ch["corrupted_streams"] == 0, ch
+    assert ch["errors_5xx"] == 0, ch
+    assert ch["ok"], ch
+    assert rung["kv_xfer"]["kv_xfer_checksum_failures_total"] == 0
+    mp = rung["migrate_probe"]
+    assert mp["ok"], mp
+    assert mp["parity_ok"], mp
+    assert mp["lps_max_abs_diff"] < 1e-5, mp
+    on, off = mp["modes"]["on"], mp["modes"]["off"]
+    assert on["migrated"] >= 1, on
+    assert on["corrupted_streams"] == 0 and off["corrupted_streams"] == 0
+    assert on["restored_tokens"] > 0, on
+    assert rung["kv_xfer_hit_frac"] > 0, rung
+    assert rung["recompute_tokens_saved"] > 0, rung
+    assert rung["recompute_amplification"] >= 10.0, rung

@@ -200,6 +200,10 @@ def _doc_rows(d: dict) -> tuple:
         # spill-tier restores (ISSUE 17) — only gateways running with
         # an attached arena export the series, so the row is opt-in
         rows += (("spill", _metric_points(d, "kv_spill_hits_total")),)
+    if "kv_xfer_hits_total" in bases:
+        # cross-replica KV transfers landed (ISSUE 18) — exported only
+        # by gateways that injected at least one migrated/peer span
+        rows += (("xfer", _metric_points(d, "kv_xfer_hits_total")),)
     return rows
 
 

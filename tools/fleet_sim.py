@@ -132,6 +132,20 @@ def _write_rung(results, ns):
         ha = {k: sum(r["ha"][k] for r in ha_runs)
               for k in ha_runs[0]["ha"]}
         section["ha"] = ha
+    xfer_runs = [r for r in results if "xfer" in r]
+    if xfer_runs:
+        section["xfer"] = {k: sum(r["xfer"][k] for r in xfer_runs)
+                           for k in xfer_runs[0]["xfer"]}
+        mig = sum(r["xfer"]["recompute_tokens"] for r in xfer_runs
+                  if r["scenario"] == "drain_migrate")
+        ctl = sum(r["xfer"]["recompute_tokens"] for r in xfer_runs
+                  if r["scenario"] == "drain_reprefill")
+        if ctl:
+            # the recompute-amplification bound (ISSUE 18): prefill
+            # tokens the re-prefill control twin burned per token the
+            # migrating drain burned (same seed, arrivals, wave times)
+            section["xfer"]["recompute_amplification"] = round(
+                ctl / max(mig, 1), 2)
     doc = {"started": time.strftime("%Y-%m-%d %H:%M:%S"),
            "device": _device_kind(), "argv": sys.argv[1:],
            "fleet_sim": section}
@@ -188,6 +202,28 @@ def check(ns) -> int:
            f"brownout_spill false-paged: {a}")
     expect(r["completed"] + r["shed"] == r["requests"],
            f"brownout_spill dropped requests: {r}")
+
+    rm = _run_one("drain_migrate", ns2, 1)
+    rc = _run_one("drain_reprefill", ns2, 1)
+    xm, xc = rm["xfer"], rc["xfer"]
+    expect(rm["alerts"]["page_fires"] == 0,
+           f"planned drain paged: {rm['alerts']}")
+    expect(xm["migrated_requests"] >= 1,
+           f"drain wave cut no live requests over: {xm}")
+    expect(xm["recompute_tokens"] == 0,
+           f"migrating drain recomputed prefill: {xm}")
+    expect(xc["recompute_tokens"] > 0,
+           f"re-prefill control twin recomputed nothing: {xc}")
+    expect(rm["requests"] == rc["requests"],
+           f"drain twins diverged: {rm['requests']} != "
+           f"{rc['requests']}")
+    amp = xc["recompute_tokens"] / max(xm["recompute_tokens"], 1)
+    expect(amp >= 10.0,
+           f"recompute amplification {amp:.1f}x < 10x bound "
+           f"(migrate={xm}, control={xc})")
+    expect(rm["completed"] + rm["shed"] == rm["requests"],
+           f"drain_migrate dropped requests: {rm['completed']}"
+           f"/{rm['requests']} shed={rm['shed']}")
 
     ns2.frontends = 2
     r = _run_one("ha", ns2, 1)

@@ -120,7 +120,7 @@ def _force_platform():
 async def sse_generate(host: str, port: int, payload: dict,
                        timeout_s: float = 120.0,
                        request_id: str = None, skip: int = 0,
-                       ha: bool = False):
+                       ha: bool = False, on_token=None):
     """One SSE request; returns a per-request record with wire-level
     TTFT/TPOT timings (measured at the CLIENT, queueing included).
     ``request_id`` (ISSUE 10) is the CLIENT-minted trace id, sent as
@@ -202,6 +202,10 @@ async def sse_generate(host: str, port: int, payload: dict,
                     rec["ttft_ms"] = (now - t0) * 1e3
                 rec["tokens"].append(ev["token"])
                 rec["lps"].append(ev.get("lp"))
+                if on_token is not None:
+                    # --migrate probe hook: lets the caller fire a
+                    # mid-stream drain at a deterministic token count
+                    on_token(seen)
         except (ConnectionError, OSError) as e:
             # mid-stream sever (the frontend died under us): the
             # committed prefix in rec is the client's resume state
@@ -348,7 +352,10 @@ def _build_gateway(ns):
     # --spill off (default) is the reference the bitwise gate and the
     # kv_spill_hit_frac rung compare against
     spill_arena = None
-    if getattr(ns, "spill", "off") == "on":
+    migrate_on = getattr(ns, "migrate", "off") == "on"
+    if getattr(ns, "spill", "off") == "on" or migrate_on:
+        # --migrate on implies an arena: migration IS spill + wire
+        # (export_resumable descriptors serialized D2H, ISSUE 18)
         from paddle_tpu.serving.kvspill import KVSpillArena
         spill_arena = KVSpillArena(
             int(getattr(ns, "spill_mb", 256)) << 20,
@@ -383,6 +390,10 @@ def _build_gateway(ns):
     engines = [engine_factory() for _ in range(ns.replicas)]
     gw_kw = dict(routing=ns.policy, max_queue=ns.max_queue,
                  spill_arena=spill_arena, **gw_telemetry_kw)
+    if migrate_on:
+        # live requests at drain time cut over (terminal migrated
+        # events + resume_kv spans) instead of finishing here
+        gw_kw.update(migrate_on_drain=True)
     if chaos:
         # fast-recovery supervision knobs sized for a short chaos run:
         # sub-second watchdog + breaker backoff so kills, failovers
@@ -453,6 +464,14 @@ def _build_fleet(ns):
             extra += ["--slo-window-scale", str(scale)]
     else:
         extra += ["--telemetry", "off"]
+    if getattr(ns, "spill", "off") == "on" \
+            or getattr(ns, "migrate", "off") == "on":
+        # each replica PROCESS gets its own arena (host RAM dies with
+        # the process; migrated spans ship inline over /kvz during the
+        # drain grace window, so cross-process cutover still restores)
+        extra += ["--spill-mb", str(int(getattr(ns, "spill_mb", 256)))]
+        if getattr(ns, "migrate", "off") == "on":
+            extra += ["--migrate", "on"]
     manager = LocalProcessManager(
         fes, model=ns.model if ns.model in ("stub", "tiny")
         else "stub",
@@ -474,6 +493,174 @@ def _build_fleet(ns):
             signal_window_s=getattr(ns, "autoscale_window_s", 1.0))
         fe.attach_autoscaler(scaler)
     return fes, manager, scaler, links
+
+
+# ---------------------------------------------------- migrate A/B probe
+async def _migrate_probe(ns) -> dict:
+    """Drain-migration A/B (ISSUE 18): a dedicated two-gateway mini
+    fleet, SIGTERM-drained mid-stream, run twice — ``on`` resolves
+    each migrated stream's ``resume_kv`` span so the survivor RESTORES
+    the KV, ``off`` is the re-prefill control (identical cut-over, no
+    transfer). The drain point is deterministic (fired by the client
+    the moment every stream has its first token), prompts are UNIQUE
+    (survivor prefix hits can only come from the transfer), so
+    ``recompute = resubmitted prefill tokens - prefix-hit tokens`` is
+    measured, not modeled. Retries with fresh gateway names if the
+    race between drain and stream completion yields zero migrations.
+    """
+    import paddle_tpu as pt
+    from paddle_tpu.generation.paged import PagedEngine
+    from paddle_tpu.generation.stub import TickStubModel
+    from paddle_tpu.serving import Gateway
+    from paddle_tpu.serving import kvxfer
+    from paddle_tpu.serving.fleet import FleetFrontend, RemoteReplica
+    from paddle_tpu.serving.fleet.replica_main import stub_engine_kw
+    from paddle_tpu.serving.kvspill import KVSpillArena
+    from paddle_tpu.utils import observability as obs
+
+    reqs = max(int(getattr(ns, "migrate_requests", 6)), 2)
+    prompt_len, max_new = 64, 32
+    rng = random.Random(ns.seed + 11)
+    prompts = [[rng.randrange(1, 120) for _ in range(prompt_len)]
+               for _ in range(reqs)]
+
+    def _eng():
+        eng = PagedEngine(TickStubModel(), **stub_engine_kw(8))
+        eng.submit("warmup", list(range(1, 5)), max_new_tokens=4)
+        eng.run()
+        eng.results.pop("warmup", None)
+        eng.logprobs.pop("warmup", None)
+        return eng
+
+    # uninterrupted single-engine reference: the bitwise truth both
+    # modes (and every migrated stream) must reproduce
+    ref = PagedEngine(TickStubModel(), **stub_engine_kw(8))
+    for i in range(reqs):
+        ref.submit(f"migprobe-{i:03d}", prompts[i],
+                   max_new_tokens=max_new)
+    expect = ref.run()
+
+    async def _run_mode(mode: str, attempt: int):
+        pt.seed(0)
+        gws = []
+        for j in range(2):
+            # attempt-unique names: kvxfer counters key on the
+            # gateway name, and a retry must not inherit stale counts
+            name = f"migprobe{attempt}-{mode}{j}"
+            gw = Gateway([_eng()], name=name,
+                         spill_arena=KVSpillArena(64 << 20, name=name),
+                         migrate_on_drain=True)
+            await gw.start()
+            gws.append(gw)
+        fleet_name = f"migprobe{attempt}-{mode}"
+        reps = [RemoteReplica(g.name, g.host, g.port,
+                              probe_interval_s=0.05) for g in gws]
+        fe = FleetFrontend(reps, chunk_tokens=8, name=fleet_name,
+                           migrate=(mode == "on"),
+                           breaker_backoff_s=60.0)
+        await fe.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and not all(r.healthy() for r in reps):
+            await asyncio.sleep(0.02)
+
+        firsts = [False] * reqs
+        fired = []
+
+        def _on_token(i):
+            firsts[i] = True
+            if all(firsts) and not fired:
+                # every stream is live: drain gateway 0 — its
+                # in-flight requests cut over to gateway 1
+                fired.append(asyncio.ensure_future(
+                    gws[0].drain(migrate=True)))
+
+        async def _one(i):
+            rec = await sse_generate(
+                fe.host, fe.port,
+                {"prompt": prompts[i], "max_new_tokens": max_new,
+                 "temperature": 0.0, "stream": True,
+                 "timeout_s": 60.0},
+                request_id=f"migprobe-{i:03d}",
+                on_token=lambda n, i=i: _on_token(i))
+            return i, rec
+
+        done = await asyncio.gather(*[_one(i) for i in range(reqs)])
+        if fired:
+            await fired[0]
+        hz = fe.healthz()
+        mig_events = [e for e in obs.recorder().snapshot()
+                      if e.get("kind") == "fleet_peer_migrated"
+                      and e.get("fleet") == fleet_name]
+        resubmit_prefill = sum(prompt_len + int(e.get("committed", 0))
+                               for e in mig_events)
+        engs = [w.engine for g in gws for w in g._workers]
+        restored = sum(e.stats.get("spill_restored_tokens", 0)
+                       for e in engs)
+        hits = sum(e.stats.get("prefix_hit_tokens", 0) for e in engs)
+        xfer = {}
+        for g in gws:
+            for k, v in kvxfer.counters_snapshot(g.name).items():
+                xfer[k] = xfer.get(k, 0) + int(v)
+        await fe.drain()
+        for g in gws:
+            await g.drain()
+        toks = {i: list(r["tokens"]) for i, r in done}
+        lps = {i: list(r.get("lps", ())) for i, r in done}
+        res = {
+            "migrated": int(hz.get("migrated_requests", 0)),
+            "resubmit_prefill_tokens": resubmit_prefill,
+            "prefix_hit_tokens": hits,
+            "restored_tokens": restored,
+            "recompute_tokens": max(resubmit_prefill - hits, 0),
+            "errors": sum(1 for _, r in done
+                          if r["finish_reason"] != "stop"),
+            "corrupted_streams": sum(
+                1 for i, r in done
+                if r["finish_reason"] == "stop"
+                and r["tokens"] != expect[f"migprobe-{i:03d}"]),
+            "xfer": xfer,
+        }
+        return res, toks, lps
+
+    probe = {"requests": reqs, "prompt_tokens": prompt_len,
+             "max_new": max_new, "modes": {}}
+    toks_m, lps_m = {}, {}
+    for attempt in range(3):
+        for mode in ("on", "off"):
+            res, toks, lps = await _run_mode(mode, attempt)
+            probe["modes"][mode] = res
+            toks_m[mode], lps_m[mode] = toks, lps
+        probe["attempts"] = attempt + 1
+        if probe["modes"]["on"]["migrated"] >= 1:
+            break
+    on, off = probe["modes"]["on"], probe["modes"]["off"]
+    probe["kv_xfer_hit_frac"] = round(
+        on["restored_tokens"]
+        / max(on["resubmit_prefill_tokens"], 1), 4)
+    probe["recompute_tokens_saved"] = \
+        off["recompute_tokens"] - on["recompute_tokens"]
+    probe["recompute_amplification"] = round(
+        off["recompute_tokens"] / max(on["recompute_tokens"], 1), 2)
+    # bitwise A/B parity: migration must never change what a greedy
+    # client observes — tokens exactly, logprobs to float tolerance
+    # (prefill- vs decode-computed KV differ in the last ulp; the
+    # existing resume contract)
+    probe["parity_ok"] = all(
+        toks_m["on"].get(i) == toks_m["off"].get(i)
+        for i in range(reqs))
+    diff = 0.0
+    for i in range(reqs):
+        for a, b in zip(lps_m["on"].get(i) or (),
+                        lps_m["off"].get(i) or ()):
+            if a is not None and b is not None:
+                diff = max(diff, abs(float(a) - float(b)))
+    probe["lps_max_abs_diff"] = round(diff, 9)
+    probe["ok"] = bool(probe["parity_ok"]
+                       and on["corrupted_streams"] == 0
+                       and off["corrupted_streams"] == 0
+                       and on["errors"] == 0 and off["errors"] == 0)
+    return probe
 
 
 # ------------------------------------------------------------------- run
@@ -838,6 +1025,7 @@ async def run_loadgen(ns) -> dict:
         # the LIVE workers, not the launch list: rebuilt engines are
         # where crash-recovery restores land
         rung["spill"] = getattr(ns, "spill", "off")
+        rung["migrate"] = getattr(ns, "migrate", "off")
         engs = [w.engine for w in gw._workers] if gw is not None \
             else list(engines)
         restored = sum(e.stats.get("spill_restored_tokens", 0)
@@ -888,6 +1076,22 @@ async def run_loadgen(ns) -> dict:
     if chaos:
         rung["chaos"] = _verify_chaos(ns, gw, engine_factory, records,
                                       chaos_events)
+        if gw is not None:
+            from paddle_tpu.serving import kvxfer as _kvx
+            rung["kv_xfer"] = _kvx.counters_snapshot(gw.name)
+    if getattr(ns, "migrate", "off") == "on" and gw is not None:
+        # cross-replica KV transfer A/B (ISSUE 18): the dedicated
+        # two-gateway drain-migration probe — the main run's final
+        # drain has no in-flight work left to migrate, so the knob's
+        # regression-gated numbers come from a mid-stream drain pair
+        # (migrate vs re-prefill control) on the same workload
+        probe = await _migrate_probe(ns)
+        rung["migrate_probe"] = probe
+        rung["kv_xfer_hit_frac"] = probe["kv_xfer_hit_frac"]
+        rung["recompute_tokens_saved"] = \
+            probe["recompute_tokens_saved"]
+        rung["recompute_amplification"] = \
+            probe["recompute_amplification"]
     if fe is not None:
         # fleet rung (ISSUE 13): fleet_tokens_per_sec is the headline
         # bench.py promotes; goodput-per-replica divides the good
@@ -1133,6 +1337,22 @@ def main(argv=None) -> int:
                          "bitwise A/B reference)")
     ap.add_argument("--spill-mb", type=int, default=256,
                     help="arena capacity in MiB under --spill on")
+    ap.add_argument("--migrate", default="off", choices=("on", "off"),
+                    help="cross-replica KV transfer (ISSUE 18): the "
+                         "gateway cuts live requests over on drain "
+                         "(terminal migrated events + resume_kv "
+                         "spans; implies a spill arena) and the run "
+                         "appends a two-gateway drain-migration A/B "
+                         "probe — migrate vs re-prefill control — "
+                         "banking kv_xfer_hit_frac, "
+                         "recompute_tokens_saved and the "
+                         "amplification ratio in the rung; under "
+                         "--fleet the replica processes get "
+                         "--spill-mb/--migrate so SIGTERM scale-downs "
+                         "migrate instead of finishing in place")
+    ap.add_argument("--migrate-requests", type=int, default=6,
+                    help="in-flight streams the migrate probe drains "
+                         "mid-run (per A/B side)")
     ap.add_argument("--chaos", action="store_true",
                     help="seeded chaos harness (ISSUE 12): kill/hang "
                          "replicas mid-run, then assert zero "
@@ -1246,6 +1466,16 @@ def main(argv=None) -> int:
               f"errors_5xx={ch['errors_5xx']} (bound "
               f"{ch['error_bound']}) completed_frac="
               f"{ch['completed_frac']} (floor {ch['goodput_floor']})",
+              file=sys.stderr)
+        return 1
+    mp = rung.get("migrate_probe")
+    if mp is not None and not mp["ok"]:
+        on, off = mp["modes"]["on"], mp["modes"]["off"]
+        print("MIGRATE PROBE FAILED: "
+              f"parity_ok={mp['parity_ok']} "
+              f"corrupted on/off={on['corrupted_streams']}/"
+              f"{off['corrupted_streams']} "
+              f"errors on/off={on['errors']}/{off['errors']}",
               file=sys.stderr)
         return 1
     fg = rung.get("fleet_gate")

@@ -273,6 +273,52 @@ def spill_reupload_program():
     assert e1.stats["prefix_hit_tokens"] > 0, e1.stats
 check("spill_reupload_program", spill_reupload_program)
 
+def kv_xfer_restore_program():
+    # ISSUE 18: the cross-replica restore program — a spilled span
+    # serialized to the wire format (crc32 + geometry header),
+    # injected into a DIFFERENT replica's arena, must compile the
+    # same batched H2D scatter on hardware and restore BITWISE on the
+    # receiving engine (live migration / peer fetch is this program
+    # behind HTTP).
+    from paddle_tpu.generation.paged import PagedEngine
+    from paddle_tpu.generation.stub import TickStubModel
+    from paddle_tpu.serving import kvxfer
+    from paddle_tpu.serving.kvspill import KVSpillArena
+
+    def eng(arena):
+        e = PagedEngine(TickStubModel(), max_slots=4, num_blocks=32,
+                        block_size=8, max_blocks_per_seq=8,
+                        prefill_buckets=(8,), chunk_prefill_tokens=8,
+                        enable_prefix_cache=True)
+        e.attach_spill(arena)
+        return e
+    src = KVSpillArena(8 << 20, name="validate-xfer-src")
+    dst = KVSpillArena(8 << 20, name="validate-xfer-dst")
+    prompt = np.arange(1, 17)[None]
+    e0 = eng(src)
+    e0.submit("a", prompt, max_new_tokens=8)
+    ref = e0.run()["a"]
+    assert e0.spill_parked() > 0
+    geo = e0._spill_geometry()
+    ids = list(range(1, 17))
+    chain = [c for c in e0._chunk_digests(ids, len(ids) - 1)
+             if src.probe(c) is not None]
+    assert chain, "no resident chain digest after spill"
+    blob = kvxfer.export_span(src, chain[-1].hex(), geo,
+                              gateway="validate")
+    assert blob is not None
+    assert kvxfer.inject_span(dst, blob, geo,
+                              gateway="validate") is not None
+    e1 = eng(dst)                       # fresh pools, PEER arena
+    e1.submit("b", prompt, max_new_tokens=8)
+    res = e1.run()["b"]
+    assert res == ref, (res, ref)
+    assert e1.stats["spill_restores"] > 0, e1.stats
+    snap = kvxfer.counters_snapshot("validate")
+    assert snap["kv_xfer_hits_total"] >= 1, snap
+    assert snap["kv_xfer_checksum_failures_total"] == 0, snap
+check("kv_xfer_restore_program", kv_xfer_restore_program)
+
 def prefill_flash():
     # the generate() prefill branch: flash at cache_index==0 must match
     # the masked-dense-over-cache path it replaced (llama.py)
