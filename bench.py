@@ -565,7 +565,8 @@ def main():
                      ("paged_tokens_per_sec",
                       "paged_spec_tokens_per_sec",
                       "paged_sampled_spec_tokens_per_sec",
-                      "paged_churn_tokens_per_sec"))
+                      "paged_churn_tokens_per_sec",
+                      "paged_churn_fused_tokens_per_sec"))
         _ingest_rung(result, probe, "SERVE_LOADGEN_r07.json", "gateway",
                      "gateway_profile",
                      ("gateway_tokens_per_sec", "gateway_p99_ttft_ms",

@@ -335,7 +335,9 @@ class PagedEngine:
                  spec_ngram: int = 2,
                  ring_mode: Optional[bool] = None,
                  ring_len: Optional[int] = None,
-                 delta_transitions: Optional[bool] = None):
+                 delta_transitions: Optional[bool] = None,
+                 patch_fuse: Optional[bool] = None,
+                 patch_queue_len: Optional[int] = None):
         cfg = model.config
         self.model = model
         self.fn, self.params = model.functional()
@@ -438,6 +440,9 @@ class PagedEngine:
                       "spec_proposed", "spec_accepted",
                       "full_rebuilds", "delta_patches",
                       "h2d_upload_bytes",
+                      "dispatches", "patches_fused",
+                      "patch_queue_overflows",
+                      "ring_cursor_rollovers",
                       "spill_spans", "spill_restores",
                       "spill_restored_tokens",
                       "spill_restore_failures")}
@@ -507,6 +512,9 @@ class PagedEngine:
         self.h2d_upload_bytes = 0
         self.full_rebuilds = 0
         self.delta_patches = 0
+        self.patches_fused = 0
+        self.patch_queue_overflows = 0
+        self.ring_cursor_rollovers = 0
         # NOTE: the small state dict is NOT donated — donating leaves
         # that pass through unchanged (tables, temps, ...) makes XLA
         # emit input->output aliases for them, and executables
@@ -625,6 +633,26 @@ class PagedEngine:
             (self.M * self.B + self._spec_k + 1) if self._spec_k else 0)
         if self._delta:
             self._patch_jit = jax.jit(self._apply_patch)
+        # --- fused patch+tick program (ISSUE 19 tentpole) -------------
+        # patch_fuse=True (the default whenever delta transitions are
+        # on): pending descriptors are STAGED into a bounded
+        # device-resident queue ([Q, desc_len] int32 + count, carried
+        # in the tick state) by a plain H2D upload — no dispatch — and
+        # the NEXT tick's program applies them all in a masked batched
+        # scatter before computing. One executable, one dispatch,
+        # whether the tick carries 0 or R transitions; the standalone
+        # ``_apply_patch`` program survives only as the queue-overflow
+        # fallback (impossible at the default queue length Q=R, since
+        # descriptors coalesce per slot). False keeps the PR 12
+        # one-patch-one-dispatch path as a parity reference.
+        self._fuse_patches = self._delta if patch_fuse is None \
+            else bool(patch_fuse)
+        if self._fuse_patches and not self._delta:
+            raise ValueError(
+                "patch_fuse requires delta_transitions=True: the fused "
+                "queue stages the delta path's descriptors")
+        self._pq_len = self.R if patch_queue_len is None \
+            else max(1, int(patch_queue_len))
 
     @property
     def stats(self) -> Dict[str, int]:
@@ -715,8 +743,11 @@ class PagedEngine:
         attention (ragged paged kernel when gated) → repetition penalty
         → per-row sampling → done flags + device-state advance. Key
         splits follow `_decode_step` exactly (all rows split), so
-        sampled streams are bit-identical to the host-tick path."""
+        sampled streams are bit-identical to the host-tick path. The
+        fused patch stage (ISSUE 19) applies any staged transition
+        descriptors first — same program, zero extra dispatches."""
         from .sampling import repetition_penalty_rows, sample_token_rows
+        st = self._apply_patch_queue(st)
         caches = self._paged_caches(pools, st["tables"], st["lens"])
         logits, new_caches = self.fn(params, st["last"][:, None],
                                      kv_caches=caches,
@@ -733,8 +764,10 @@ class PagedEngine:
         """Argmax-only fused tick (same specialization contract as
         `_decode_step_greedy`: chosen when every ACTIVE row is greedy;
         keys pass through untouched, exactly like the host path's
-        no-split greedy executable)."""
+        no-split greedy executable). Opens with the same fused patch
+        stage as `_fused_tick`."""
         from .sampling import repetition_penalty_rows
+        st = self._apply_patch_queue(st)
         caches = self._paged_caches(pools, st["tables"], st["lens"])
         logits, new_caches = self.fn(params, st["last"][:, None],
                                      kv_caches=caches,
@@ -757,7 +790,12 @@ class PagedEngine:
         tokens. Rows that finish (eos/budget) mid-scan deactivate via
         the device active mask and stop advancing; their later (nxt,
         lps) slots are garbage the host never reads past the first done
-        flag. Returns (nxt[K,R], lps[K,R], done[K,R], seen, pools, st)."""
+        flag. Returns (nxt[K,R], lps[K,R], done[K,R], seen, pools, st).
+
+        The fused patch stage rides the tick core: iteration 0 applies
+        the staged queue and zeroes ``pqn`` in the carry, so iterations
+        1..K-1 re-trace the stage as an all-dropped (bitwise no-op)
+        scatter — staged transitions land exactly once per dispatch."""
         tick = self._fused_tick_greedy if greedy else self._fused_tick
 
         def body(carry, _):
@@ -816,6 +854,7 @@ class PagedEngine:
         from .prompt_lookup import mask_drafts, propose_ngram_rows
         from .sampling import (fold_in_rows, repetition_penalty_rows,
                                residual_resample_rows, split_key_rows)
+        st = self._apply_patch_queue(st)   # fused patch stage (ISSUE 19)
         k = self._spec_k
         T = k + 1
         lens, active, temps = st["lens"], st["active"], st["temps"]
@@ -1017,40 +1056,130 @@ class PagedEngine:
         new["tks"] = st["tks"].at[r].set(desc[8])
         new["tps"] = st["tps"].at[r].set(f32(desc[9]))
         new["reps"] = st["reps"].at[r].set(f32(desc[10]))
+        from .sampling import override_key_rows
         key = jax.lax.bitcast_convert_type(desc[11:13], jnp.uint32)
-        new["keys"] = jnp.where(desc[6] != 0,
-                                st["keys"].at[r].set(key), st["keys"])
+        new["keys"] = override_key_rows(st["keys"], desc[0:1],
+                                        key[None], desc[6:7])
         if "toks" in st:
             new["toks"] = st["toks"].at[r].set(desc[15 + M:])
             new["ema"] = st["ema"].at[r].set(f32(desc[13]))
             new["tickc"] = st["tickc"].at[r].set(desc[14])
         return new
 
-    def _flush_patches(self):
-        """Apply every queued one-row patch (immediately before a
-        dispatch, after the step's drain — so host mirrors and device
-        state agree for every untouched row). Each patch is one
-        descriptor-sized H2D + one tiny compiled dispatch; the counters
-        are what the churn profiler and the delta tests pin.
+    def _apply_patch_queue(self, st):
+        """The fused patch stage (ISSUE 19): ONE masked batched scatter
+        applying every staged descriptor in ``st["pq"]`` (valid rows:
+        index < ``st["pqn"]``) to the device tick state, traced at the
+        TOP of every fused tick program — the queue drains in the same
+        dispatch that computes the tick, so a transition wave of any
+        size up to Q costs zero extra dispatches. Field ops mirror
+        ``_apply_patch`` one for one (same descriptor layout, same
+        ``override_key_rows`` key rule), so a queued patch lands
+        byte-identically to a standalone patch of the same descriptor.
+        Invalid queue entries are routed to the out-of-bounds row index
+        R and dropped (``mode="drop"``): a zero-count queue makes every
+        scatter a bitwise no-op, which is what lets the stage ride
+        steady ticks for free. Descriptor rows are unique (host
+        coalescing keys the pending set by slot), so scatter order
+        never matters. ``pqn`` resets to 0 in-program; the staged
+        ``pq`` array itself is replaced host-side at the next flush."""
+        if "pq" not in st:
+            return st
+        from .sampling import override_key_rows
+        pq, pqn = st["pq"], st["pqn"]
+        M = self.M
+        valid = jnp.arange(pq.shape[0]) < pqn
+        rows = jnp.where(valid, pq[:, 0], self.R)
 
-        A synchronized transition WAVE (all R slots admitted at once,
-        a preemption storm) pays R sequential patch dispatches where
-        one batched rebuild upload could be cheaper — deliberately NOT
-        special-cased here: an admit wave is normal steady churn, and
-        the zero-rebuild contract the tests pin must hold through it.
-        The real fix is ROADMAP item 4(a2): fuse pending patches into
-        the NEXT tick's program, one dispatch for any wave size."""
+        def f32(x):
+            return jax.lax.bitcast_convert_type(x, jnp.float32)
+
+        def scat(arr, vals):
+            return arr.at[rows].set(vals, mode="drop")
+
+        new = dict(st)
+        new["tables"] = scat(st["tables"], pq[:, 15:15 + M])
+        new["lens"] = scat(st["lens"], pq[:, 1])
+        new["last"] = scat(st["last"], pq[:, 2])
+        new["eos"] = scat(st["eos"], pq[:, 3])
+        new["rem"] = scat(st["rem"], pq[:, 4])
+        new["active"] = scat(st["active"], pq[:, 5] != 0)
+        new["temps"] = scat(st["temps"], f32(pq[:, 7]))
+        new["tks"] = scat(st["tks"], pq[:, 8])
+        new["tps"] = scat(st["tps"], f32(pq[:, 9]))
+        new["reps"] = scat(st["reps"], f32(pq[:, 10]))
+        keys = jax.lax.bitcast_convert_type(pq[:, 11:13], jnp.uint32)
+        new["keys"] = override_key_rows(st["keys"], pq[:, 0], keys,
+                                        valid & (pq[:, 6] != 0))
+        if "toks" in st:
+            new["toks"] = scat(st["toks"], pq[:, 15 + M:])
+            new["ema"] = scat(st["ema"], f32(pq[:, 13]))
+            new["tickc"] = scat(st["tickc"], pq[:, 14])
+        new["pqn"] = jnp.zeros_like(pqn)
+        return new
+
+    def _flush_patches(self):
+        """Hand every pending transition to the device (immediately
+        before a dispatch, after the step's drain — so host mirrors and
+        device state agree for every untouched row).
+
+        Fused mode (ISSUE 19, the default): the coalesced descriptors
+        are STAGED into the device-resident patch queue with one plain
+        H2D upload — no dispatch — and the imminent tick program's
+        ``_apply_patch_queue`` stage applies them all in its batched
+        scatter. One executable, one dispatch, whether the tick carries
+        0 or R transitions: the synchronized-wave trade-off the old
+        per-row path documented is gone. The standalone ``_apply_patch``
+        program survives only as the queue-overflow fallback below
+        (impossible at the default Q=R — descriptors coalesce per slot
+        — and counter-pinned rare when a smaller queue is configured).
+
+        Non-fused delta mode: each patch is one descriptor-sized H2D +
+        one tiny compiled dispatch, the PR 12 parity reference.
+
+        The caller contract that makes staging safe: `_sync_dev` is
+        only ever invoked by `_decode_fused`/`_decode_fused_spec`
+        immediately before their dispatch, so a staged queue is always
+        consumed by the very next program — key overrides can be
+        discarded at staging time exactly as the standalone patch path
+        discards them at patch time."""
         if self._ring and int(self._drained.max(initial=0)) > 2 ** 30:
             # int32 ring-cursor headroom guard: without periodic
             # rebuilds the device write cursors grow forever; force
-            # one rebuild (which zeroes them) long before wraparound
+            # one rebuild (which zeroes them) long before wraparound.
+            # Counted (ISSUE 19 satellite) so a long-lived replica's
+            # lone rebuild reads as cursor hygiene, not a bug.
+            self.ring_cursor_rollovers += 1
+            self._count("ring_cursor_rollovers")
             self._refresh_dev()
             return
-        for i in sorted(self._delta_rows):
+        rows = sorted(self._delta_rows)
+        if self._fuse_patches and len(rows) <= self._pq_len:
+            pq = np.zeros((self._pq_len, self._desc_len), np.int32)
+            for j, i in enumerate(rows):
+                pq[j] = self._pack_descriptor(i)
+                self._key_overrides.discard(i)
+            self._dev["pq"] = jnp.asarray(pq)
+            self._dev["pqn"] = jnp.asarray(np.int32(len(rows)))
+            nbytes = pq.nbytes + 4
+            self.h2d_uploads += 1
+            self.h2d_upload_bytes += nbytes
+            self.patches_fused += len(rows)
+            self._count("patches_fused", len(rows))
+            self._count("h2d_upload_bytes", nbytes)
+            self._h_bytes.observe(nbytes)
+            self._delta_rows.clear()
+            return
+        if self._fuse_patches:
+            self.patch_queue_overflows += 1
+            self._count("patch_queue_overflows")
+        for i in rows:
             desc = self._pack_descriptor(i)
             self.h2d_uploads += 1
             self.h2d_upload_bytes += desc.nbytes
             self.delta_patches += 1
+            self.dispatch_count += 1
+            self._count("dispatches")
             self._count("delta_patches")
             self._count("h2d_upload_bytes", desc.nbytes)
             self._h_bytes.observe(desc.nbytes)
@@ -1157,6 +1286,14 @@ class PagedEngine:
                     kprop_last=jnp.zeros((self.R,), jnp.int32),
                     macc_last=jnp.zeros((self.R,), jnp.int32))
             self._drained[:] = 0
+        if self._fuse_patches:
+            # empty staged-patch queue: a rebuild by definition leaves
+            # nothing pending (bytes not counted — zeros carry no
+            # host-side payload, and the tests pin the rebuild byte
+            # cost as the non-fused reference)
+            self._dev.update(
+                pq=jnp.zeros((self._pq_len, self._desc_len), jnp.int32),
+                pqn=jnp.zeros((), jnp.int32))
         self.h2d_upload_bytes += nbytes
         self._count("h2d_upload_bytes", nbytes)
         self._h_bytes.observe(nbytes)
@@ -1311,6 +1448,17 @@ class PagedEngine:
         if self.trace_sink is not None:
             self.trace_sink(request_id, "engine_queue",
                             queued=len(self.queue))
+        if self._fuse_patches and self.chunk is not None:
+            # ROADMAP 4(b), first rung: a warm replica admits eagerly
+            # at submit time. Chunked admission is dispatch-free — it
+            # claims a slot, allocates blocks and marks the row dirty;
+            # the descriptor then rides the staged patch queue into the
+            # next tick's program, so admission costs the replica zero
+            # extra dispatches (the tick it would have run anyway).
+            # Non-chunked admission runs a prefill dispatch inline and
+            # stays in the tick loop's _admit.
+            while self._try_admit():
+                pass
 
     def _blocks_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.B - 1) // self.B
@@ -1559,6 +1707,7 @@ class PagedEngine:
         padded = np.zeros((2 * L, npad, B, kvh, d), kp.dtype)
         padded[:, :n_blocks] = data
         self.dispatch_count += 1
+        self._count("dispatches")
         self.h2d_uploads += 1
         self.h2d_upload_bytes += padded.nbytes
         self._count("h2d_upload_bytes", padded.nbytes)
@@ -1775,6 +1924,7 @@ class PagedEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(ids)] = ids
         self.dispatch_count += 1
+        self._count("dispatches")
         nxt, lp, new_key, seen_row, self.pools = self._prefill_jit(
             self.params, self.pools, jnp.asarray(row),
             jnp.asarray(padded), np.int32(len(ids)),
@@ -1814,6 +1964,7 @@ class PagedEngine:
         row = self.block_tables[slot_id]
         self._mark_dirty(slot_id)    # lens/activation change this tick
         self.dispatch_count += 1
+        self._count("dispatches")
         nxt, lp, new_key, seen_mid, seen_fin, self.pools = self._chunk_jit(
             self.params, self.pools, jnp.asarray(row),
             jnp.asarray(padded), np.int32(start),
@@ -2036,6 +2187,13 @@ class PagedEngine:
         prop = snap.get("spec_proposed", 0)
         snap["spec_accept_rate"] = round(
             snap.get("spec_accepted", 0) / prop, 4) if prop else 0.0
+        # the one-dispatch-per-tick claim (ISSUE 19), observable
+        # fleet-wide: a steady fused replica reads ~1.0 plus the
+        # amortized prefill share; standalone patches and rebuilds
+        # push it above
+        ticks = snap.get("decode_steps", 0)
+        snap["dispatches_per_tick"] = round(
+            snap.get("dispatches", 0) / ticks, 4) if ticks else 0.0
         snap.update(
             queued=len(self.queue),
             queue_capacity=self.max_queue,
@@ -2132,11 +2290,21 @@ class PagedEngine:
             # and the H2D bytes either way
             "transitions": {
                 "delta_enabled": self._delta,
+                "patch_fuse_enabled": self._fuse_patches,
+                "patch_queue_len": self._pq_len,
                 "full_rebuilds": self.full_rebuilds,
                 "delta_patches": self.delta_patches,
+                "patches_fused": self.patches_fused,
+                "patch_queue_overflows": self.patch_queue_overflows,
+                "ring_cursor_rollovers": self.ring_cursor_rollovers,
                 "pending_patch_rows": pending,
                 "h2d_uploads": self.h2d_uploads,
                 "h2d_upload_bytes": self.h2d_upload_bytes,
+                "dispatches": self.dispatch_count,
+                "dispatches_per_tick": round(
+                    self.dispatch_count
+                    / max(int(self._counters["decode_steps"].value), 1),
+                    4),
             },
         }
 
@@ -2500,6 +2668,7 @@ class PagedEngine:
         act_mask = np.zeros((self.R,), bool)
         act_mask[active] = True
         self.dispatch_count += 1
+        self._count("dispatches")
         self.d2h_syncs += 1
         if np.all(self.temps[active] <= 0.0):
             # all-greedy tick: the argmax-only executable
@@ -2556,6 +2725,7 @@ class PagedEngine:
         self._sync_dev()
         t_decode = time.perf_counter()
         self.dispatch_count += 1
+        self._count("dispatches")
         greedy = np.all(self.temps[active] <= 0.0)
         if scan:
             fn = self._scan_greedy_jit if greedy else self._scan_jit
@@ -2634,6 +2804,7 @@ class PagedEngine:
         self._sync_dev()
         t_decode = time.perf_counter()
         self.dispatch_count += 1
+        self._count("dispatches")
         greedy = np.all(self.temps[active] <= 0.0)
         fn = self._tick_spec_greedy_jit if greedy else self._tick_spec_jit
         (nxt, lps, nacc, kprop, macc, done, self.seen, self.pools,

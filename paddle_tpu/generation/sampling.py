@@ -128,6 +128,28 @@ def seed_key_row(seed: int):
                       np.uint32)
 
 
+def override_key_rows(keys, rows, new_keys, flags):
+    """Scatter per-row PRNG key OVERRIDES into the [R, 2] uint32 key
+    state: row ``rows[j]`` takes ``new_keys[j]`` iff ``flags[j] != 0``;
+    every other row keeps its current (device) stream untouched. The
+    key-override rule of the delta-transition descriptors (ISSUE 14),
+    shared by the one-row patch program and the fused patch-queue
+    scatter (ISSUE 19) so the two transition paths cannot drift: a key
+    is authoritative only when the HOST re-keyed the row (fresh admit,
+    chunk-final) — for every other descriptor the device key stream,
+    possibly advanced by sampled ticks since the last upload, must
+    survive the patch. Non-override (and out-of-range padding) rows
+    are routed to the out-of-bounds index R and dropped by the
+    scatter, which also makes the all-masked case a bitwise no-op —
+    the property that lets the fused scatter ride EVERY tick."""
+    keys = jnp.asarray(keys, jnp.uint32)
+    R = keys.shape[0]
+    target = jnp.where(jnp.asarray(flags) != 0,
+                       jnp.asarray(rows, jnp.int32), R)
+    return keys.at[target].set(jnp.asarray(new_keys, jnp.uint32),
+                               mode="drop")
+
+
 def split_key_rows(keys):
     """Advance [R, 2] uint32 per-row PRNG states one split: returns
     (carry [R, 2], sub [R, 2]) raw key data. The carry chain is the

@@ -1438,9 +1438,14 @@ class Gateway:
                 if isinstance(rep["engine"], dict) else None
             rep["transitions"] = tr if tr is not None else {
                 "delta_enabled": getattr(w.engine, "_delta", None),
+                "patch_fuse_enabled": getattr(w.engine, "_fuse_patches",
+                                              None),
                 **{k: getattr(w.engine, k, None)
                    for k in ("full_rebuilds", "delta_patches",
-                             "h2d_uploads", "h2d_upload_bytes")}}
+                             "patches_fused", "patch_queue_overflows",
+                             "ring_cursor_rollovers",
+                             "h2d_uploads", "h2d_upload_bytes",
+                             "dispatch_count")}}
             try:
                 rep["scheduler"] = w.sched.debug_snapshot()
             except Exception as e:

@@ -1,22 +1,45 @@
-"""ISSUE 14: persistent decode program — in-program slot transitions
-with delta mirror patches.
+"""ISSUE 14 + 19: persistent decode program — in-program slot
+transitions, as delta mirror patches (ISSUE 14) fused into the tick
+program itself (ISSUE 19).
 
-Contracts, each pinned against the full-rebuild reference
-(``delta_transitions=False``, the pre-ISSUE-14 path kept verbatim):
+Three transition modes, pinned against each other:
+
+- REBUILD  (``delta_transitions=False``): full-state refresh per
+  transition, the pre-ISSUE-14 reference kept verbatim.
+- DELTA    (``patch_fuse=False``): each transition is a one-row
+  descriptor patch — its own tiny dispatch (the PR 12 path).
+- FUSED    (the default): descriptors are STAGED into a bounded
+  device-resident queue by a plain H2D upload and the NEXT tick's
+  program applies them all in one masked batched scatter — one
+  executable, one dispatch, whether a tick carries 0 or R
+  transitions.
+
+Contracts:
 
 - STREAM PARITY: greedy and seeded-sampled token/logprob streams are
-  BITWISE identical between delta mode and the rebuild reference
-  across every transition kind — admit, finish, chunked-prefill
-  advance, preempt, cancel, block growth — with the ring on and off.
+  BITWISE identical across all three modes and every transition kind
+  — admit, finish, chunked-prefill advance, preempt, cancel, block
+  growth — with the ring on and off.
+- ONE DISPATCH PER TICK (ISSUE 19 acceptance): steady churn in fused
+  mode runs N ticks in exactly N dispatches — 0 standalone patch
+  dispatches, 0 full rebuilds — including an R-row synchronized
+  finish wave; standalone ``_apply_patch`` survives only as the
+  queue-overflow fallback (explicit ``patch_queue_len < R``) and is
+  counter-pinned when it fires.
+- WARM ADMIT (ROADMAP 4(b) first rung): ``submit()`` on a warm
+  chunked fused engine claims the slot eagerly and issues ZERO
+  dispatches until the next tick.
 - SCOPED DRAIN: an out-of-band transition (cancel/expiry) consumes
   only the affected slot's pending ring entries; untouched siblings'
   pending tokens survive and land at the next step()'s normal drain.
-- UPLOAD ACCOUNTING: steady churn runs 0 full-state rebuilds in delta
-  mode (one-row patches carry every transition) and the byte counter
-  — the ISSUE 14 small-fix satellite — shows the patch path moving
-  far fewer H2D bytes than the rebuild path for the same workload.
+- UPLOAD ACCOUNTING: steady churn runs 0 full-state rebuilds in
+  delta/fused modes, and the byte counter — the ISSUE 14 small-fix
+  satellite — shows the one-row patch path moving far fewer H2D
+  bytes than the rebuild path for the same workload (pinned on
+  explicit delta mode: the fused queue trades a few padded bytes per
+  staging upload for the dispatch it eliminates).
 - FAILOVER: ``export_resumable()`` descriptors, read off host mirrors
-  that now advance via scoped drains, stay equal across modes, and a
+  that advance via scoped drains, stay equal across modes, and a
   resume from them continues the stream bitwise.
 """
 import numpy as np
@@ -35,6 +58,16 @@ def _engine(**kw):
                 max_blocks_per_seq=8, prefill_buckets=(16,))
     base.update(kw)
     return PagedEngine(TickStubModel(), **base)
+
+
+# the three transition modes as engine kwargs: the matrix every parity
+# test sweeps (fused is the default — {} — and must stay bitwise with
+# both ancestors)
+MODES = {
+    "rebuild": dict(delta_transitions=False),
+    "delta": dict(patch_fuse=False),
+    "fused": {},
+}
 
 
 def _drain(eng, submits):
@@ -59,10 +92,11 @@ class TestDeltaParity:
     @pytest.mark.parametrize("ring", [True, False])
     def test_transition_matrix_bitwise(self, ring):
         """Admit/finish/growth/stop/eos churn + a mid-run second wave
-        (admits into slots whose previous tenants finished): delta and
-        rebuild modes agree on every token and every logprob float."""
-        def run(delta):
-            eng = _engine(ring_mode=ring, delta_transitions=delta)
+        (admits into slots whose previous tenants finished): fused,
+        delta and rebuild modes agree on every token and every logprob
+        float."""
+        def run(mode):
+            eng = _engine(ring_mode=ring, **MODES[mode])
             res, lps = _drain(eng, MIXED_SUBS)
             # second wave: readmits into released rows (the ring
             # cursors continue where the previous tenant stopped)
@@ -75,13 +109,20 @@ class TestDeltaParity:
             lps.update(lps2)
             return eng, res, lps
 
-        er, rr, lr = run(delta=False)
-        ed, rd, ld = run(delta=True)
-        assert rr == rd
-        assert lr == ld
+        er, rr, lr = run("rebuild")
+        ed, rd, ld = run("delta")
+        ef, rf, lf = run("fused")
+        assert rr == rd == rf
+        assert lr == ld == lf
         assert er.full_rebuilds > 1          # reference churned rebuilds
         assert ed.full_rebuilds == 1         # delta paid the first only
         assert ed.delta_patches > 0
+        # fused: same zero-rebuild contract, but transitions rode the
+        # staged queue — no standalone patch program ever dispatched
+        assert ef.full_rebuilds == 1
+        assert ef.delta_patches == 0
+        assert ef.patches_fused > 0
+        assert ef.patch_queue_overflows == 0
 
     @pytest.mark.parametrize("ring", [True, False])
     def test_midstream_admit_interleave_exact(self, ring):
@@ -176,9 +217,9 @@ class TestDeltaParity:
         token row, accept EMA and probe counter, so greedy spec
         streams (draft-invariant by the argmax-prefix rule) stay
         bitwise across modes through admit/finish churn."""
-        def run(delta):
+        def run(mode):
             eng = _engine(prefill_buckets=(8,), spec_tokens=3,
-                          delta_transitions=delta)
+                          **MODES[mode])
             res, lps = _drain(eng, [
                 ("g", _cyc(6), dict(max_new_tokens=15)),
                 ("h", _cyc(8, 2), dict(max_new_tokens=10)),
@@ -189,14 +230,23 @@ class TestDeltaParity:
             lps.update(lps2)
             return eng, res, lps
 
-        er, rr, lr = run(False)
-        ed, rd, ld = run(True)
-        assert rr == rd and lr == ld
+        er, rr, lr = run("rebuild")
+        ed, rd, ld = run("delta")
+        ef, rf, lf = run("fused")
+        assert rr == rd == rf and lr == ld == lf
         assert ed.full_rebuilds == 1 and ed.delta_patches > 0
+        assert ef.full_rebuilds == 1 and ef.delta_patches == 0
+        assert ef.patches_fused > 0
 
     def test_delta_requires_fused_tick(self):
         with pytest.raises(ValueError):
             _engine(fused_tick=False, delta_transitions=True)
+
+    def test_patch_fuse_requires_delta(self):
+        """The fused queue stages the delta path's descriptors — there
+        is nothing to stage in rebuild mode."""
+        with pytest.raises(ValueError):
+            _engine(delta_transitions=False, patch_fuse=True)
 
 
 class TestScopedDrain:
@@ -277,8 +327,8 @@ class TestUploadAccounting:
         full-state rebuilds after the first dispatch in delta mode —
         every transition rides a one-row patch — while the rebuild
         reference pays one full rebuild per churn tick."""
-        def churn(delta):
-            eng = _engine(delta_transitions=delta)
+        def churn(mode):
+            eng = _engine(**MODES[mode])
             eng.submit("w", _cyc(4), max_new_tokens=2)
             eng.run()                       # compile + first rebuild
             fr0, dp0 = eng.full_rebuilds, eng.delta_patches
@@ -289,11 +339,16 @@ class TestUploadAccounting:
             return (eng, eng.full_rebuilds - fr0,
                     eng.delta_patches - dp0, eng.h2d_upload_bytes - b0)
 
-        _, fr_d, dp_d, bytes_d = churn(True)
-        _, fr_r, dp_r, bytes_r = churn(False)
+        _, fr_d, dp_d, bytes_d = churn("delta")
+        _, fr_r, dp_r, bytes_r = churn("rebuild")
+        ef, fr_f, dp_f, _ = churn("fused")
         assert fr_d == 0 and dp_d > 0       # steady churn: patches only
         assert fr_r >= 6 and dp_r == 0      # reference: rebuild storm
-        # the small-fix satellite: bytes weigh what events hide
+        assert fr_f == 0 and dp_f == 0      # fused: staged queue only
+        assert ef.patches_fused > 0
+        # the small-fix satellite: bytes weigh what events hide.
+        # Pinned on explicit delta mode — the fused queue pads each
+        # staging upload to [Q, D] and buys back the dispatch instead
         assert 0 < bytes_d < bytes_r
 
     def test_steady_ticks_no_patches_no_bytes(self):
@@ -326,16 +381,128 @@ class TestUploadAccounting:
         assert st["full_rebuilds"] == eng.full_rebuilds == 1
         assert st["delta_patches"] == eng.delta_patches
         assert st["h2d_upload_bytes"] == eng.h2d_upload_bytes > 0
+        # the registry twin of dispatch_count (ISSUE 19): every
+        # dispatch site counts both, so /metricsz sees what tests pin
+        assert st["dispatches"] == eng.dispatch_count > 0
+        assert st["patches_fused"] == eng.patches_fused
+        assert st["patch_queue_overflows"] == 0
+        assert st["ring_cursor_rollovers"] == 0
         snap = eng.debug_snapshot()["transitions"]
         assert snap["delta_enabled"] is True
+        assert snap["patch_fuse_enabled"] is True
+        assert snap["patch_queue_len"] == eng.R
         assert snap["full_rebuilds"] == eng.full_rebuilds
         assert snap["delta_patches"] == eng.delta_patches
+        assert snap["patches_fused"] == eng.patches_fused
+        assert snap["patch_queue_overflows"] == 0
+        assert snap["ring_cursor_rollovers"] == 0
         assert snap["h2d_upload_bytes"] == eng.h2d_upload_bytes
+        assert snap["dispatches"] == eng.dispatch_count
+        assert snap["dispatches_per_tick"] > 0
         # the final finish's release patch coalesces until the next
         # dispatch would flush it — visible here as the pending row
         assert snap["pending_patch_rows"] == [0]
         h = eng.health()
         assert h["full_rebuilds"] == eng.full_rebuilds
+        assert h["dispatches_per_tick"] == pytest.approx(
+            eng.dispatch_count / h["decode_steps"], abs=1e-3)
+
+
+class TestFusedPatchQueue:
+    """ISSUE 19 acceptance pins: the staged patch queue makes churn
+    cost exactly one dispatch per tick."""
+
+    def test_steady_churn_one_dispatch_per_tick(self):
+        """THE acceptance counter: after warmup, N churny ticks
+        (staggered finishes, every transition staged) run in EXACTLY N
+        dispatches — 0 standalone patch dispatches, 0 full rebuilds."""
+        eng = _engine()
+        for i in range(4):
+            # consecutive budgets: once the shortest finishes, some
+            # slot transitions on (nearly) every remaining tick
+            eng.submit(f"r{i}", _cyc(6), max_new_tokens=5 + i)
+        eng.step()       # admits all 4 (prefills) + first tick/rebuild
+        assert eng.full_rebuilds == 1
+        d0 = eng.dispatch_count
+        t0 = eng.stats["decode_steps"]
+        eng.run()
+        ticks = eng.stats["decode_steps"] - t0
+        assert ticks > 0
+        assert eng.dispatch_count - d0 == ticks     # N ticks, N dispatches
+        assert eng.delta_patches == 0               # no standalone patches
+        assert eng.full_rebuilds == 1               # no churn rebuilds
+        assert eng.patches_fused >= 3               # staged waves carried it
+        assert eng.patch_queue_overflows == 0
+
+    def test_synchronized_wave_single_dispatch(self):
+        """R=8 simultaneous finishes — the wave the old per-row path
+        paid 8 standalone patch dispatches for — is absorbed by ONE
+        staged upload consumed in the next tick's program: the
+        follow-up request costs exactly 1 prefill + its ticks."""
+        eng = _engine(max_slots=8, num_blocks=64)
+        for i in range(8):
+            eng.submit(f"w{i}", _cyc(6), max_new_tokens=4)
+        eng.run()        # same budgets: all 8 rows finish the same tick
+        assert eng.delta_patches == 0
+        assert eng.patch_queue_overflows == 0
+        d0 = eng.dispatch_count
+        t0 = eng.stats["decode_steps"]
+        pf0 = eng.patches_fused
+        eng.submit("s", _cyc(5, 1), max_new_tokens=3)
+        eng.run()
+        ticks = eng.stats["decode_steps"] - t0
+        # 1 prefill + N ticks — the 8-row release wave plus s's admit
+        # rode one staged queue, zero standalone patch programs
+        assert eng.dispatch_count - d0 == ticks + 1
+        assert eng.delta_patches == 0
+        assert eng.full_rebuilds == 1
+        # all 8 releases + the admit coalesced into s's slot: >= 8 rows
+        assert eng.patches_fused - pf0 >= 8
+        assert eng.patch_queue_overflows == 0
+
+    def test_queue_overflow_falls_back_to_standalone_patches(self):
+        """An explicit patch_queue_len below the wave size takes the
+        standalone-patch fallback — counted, and still bitwise."""
+        def run(**kw):
+            eng = _engine(**kw)
+            res, lps = _drain(eng, [
+                (f"r{i}", _cyc(6), dict(max_new_tokens=3))
+                for i in range(4)])          # 4-row synchronized wave
+            res2, lps2 = _drain(eng, [
+                ("t", _cyc(5, 1), dict(max_new_tokens=4))])
+            res.update(res2)
+            lps.update(lps2)
+            return eng, res, lps
+
+        ef, rf, lf = run()
+        eo, ro, lo = run(patch_queue_len=2)
+        assert ro == rf and lo == lf         # fallback stays bitwise
+        assert ef.patch_queue_overflows == 0 and ef.delta_patches == 0
+        assert eo.patch_queue_overflows >= 1
+        assert eo.delta_patches > 0          # the wave went standalone
+        assert eo.full_rebuilds == 1         # but never forced a rebuild
+
+    def test_warm_admit_is_dispatch_free(self):
+        """ROADMAP 4(b) first rung: submit() on a warm (chunked, fused)
+        replica claims the slot eagerly and issues ZERO dispatches —
+        the admit descriptor rides the staged queue into the tick the
+        engine was going to run anyway."""
+        kw = dict(chunk_prefill_tokens=8, prefill_buckets=(8,))
+        eng = _engine(**kw)
+        eng.submit("w", _cyc(4), max_new_tokens=2)
+        eng.run()
+        d0, u0 = eng.dispatch_count, eng.h2d_uploads
+        eng.submit("a", _cyc(6), max_new_tokens=4)
+        assert eng.dispatch_count == d0      # zero-flush warm admit
+        assert eng.h2d_uploads == u0         # not even a staging upload
+        assert any(s is not None and s.request_id == "a"
+                   for s in eng.slots)       # ...but the slot is claimed
+        assert not eng.queue
+        ref = _engine(patch_fuse=False, **kw)
+        ref.submit("w", _cyc(4), max_new_tokens=2)
+        ref.run()
+        ref.submit("a", _cyc(6), max_new_tokens=4)
+        assert eng.run()["a"] == ref.run()["a"]
 
 
 class TestFailoverParity:
@@ -375,8 +542,8 @@ class TestDeltaSweep:
     @pytest.mark.parametrize("spec", [0, 3])
     def test_parity_sweep(self, ring, chunk, spec):
         """Heavy matrix: ring x chunked-prefill x speculative, longer
-        budgets, staggered second wave — delta vs rebuild bitwise.
-        (Tier-1 keeps the single-combination pins above.)"""
+        budgets, staggered second wave — fused vs delta vs rebuild
+        bitwise. (Tier-1 keeps the single-combination pins above.)"""
         if spec and chunk:
             kw = dict(chunk_prefill_tokens=chunk, spec_tokens=spec,
                       prefill_buckets=(8,))
@@ -396,15 +563,16 @@ class TestDeltaSweep:
                dict(temperature=0.7, seed=j, top_k=12))))
             for j in range(6)]
 
-        def run(delta):
-            eng = _engine(ring_mode=ring, delta_transitions=delta, **kw)
+        def run(mode):
+            eng = _engine(ring_mode=ring, **MODES[mode], **kw)
             res, lps = _drain(eng, subs[:4])
             res2, lps2 = _drain(eng, subs[4:])
             res.update(res2)
             lps.update(lps2)
             return res, lps
 
-        rr, lr = run(False)
-        rd, ld = run(True)
-        assert rr == rd
-        assert lr == ld
+        rr, lr = run("rebuild")
+        rd, ld = run("delta")
+        rf, lf = run("fused")
+        assert rr == rd == rf
+        assert lr == ld == lf

@@ -35,6 +35,12 @@ patch programs vs full mirror rebuild+re-upload per transition), with
 uploads/tick, upload BYTES/tick and rebuild/patch counts per row and
 the ``paged_churn_tokens_per_sec`` rung bench.py auto-ingests.
 
+Round 9 (ISSUE 19): §7b widens the churn A/B to three modes — fused
+(staged patch queue applied by the next tick's program, the engine
+default) vs delta vs full rebuild — with a dispatches/tick column
+pinning the one-dispatch-per-tick claim and the
+``paged_churn_fused_tokens_per_sec`` rung.
+
 Usage: timeout 2100 python tools/decode_profile.py
 (budget covers ~20 cold generate compiles across base/fused/int8/int4
 plus the attention and paged sections; every subsection banks as it
@@ -471,15 +477,21 @@ def main():
         sspec["error"] = repr(e)[:300]
         report["sampled_spec"] = sspec
         bank()
-    # --- 7) churn A/B (ISSUE 14): slot transitions under serving-like
-    # traffic — short requests queued deep, so a finish + admit lands
-    # every few ticks. delta_transitions=False pays a FULL host-mirror
-    # rebuild + re-upload per churn tick (the pre-ISSUE-14 path);
-    # delta mode pays one descriptor-sized patch per transition and
-    # keeps dispatching. Rows report uploads/tick, upload BYTES/tick
-    # (the satellite counter), rebuild/patch counts and tokens/s; the
+    # --- 7/7b) churn A/B/C (ISSUE 14 + 19): slot transitions under
+    # serving-like traffic — short requests queued deep, so a finish +
+    # admit lands every few ticks. Three transition modes:
+    #   full_rebuild: a FULL host-mirror rebuild + re-upload per churn
+    #     tick (the pre-ISSUE-14 path);
+    #   delta: one descriptor-sized patch per transition — its own
+    #     tiny dispatch (PR 12, kept as an explicit knob);
+    #   fused (the engine default): descriptors staged into the
+    #     device-resident queue by a plain upload and applied by the
+    #     NEXT tick's program — one dispatch per tick, churn or not.
+    # Rows report dispatches/tick (the ISSUE 19 claim), uploads/tick,
+    # upload BYTES/tick, rebuild/patch/fused counts and tokens/s; the
     # delta row's throughput is the ``paged_churn_tokens_per_sec``
-    # rung bench.py auto-ingests beside the other paged rungs.
+    # rung and the fused row's is ``paged_churn_fused_tokens_per_sec``,
+    # both auto-ingested by bench.py beside the other paged rungs.
     # The stub keeps this a TRANSITION-MACHINERY A/B (like §6b's
     # decisive-table stub: the absolute number only means anything
     # relative to the other row on the same stub — on real models the
@@ -516,6 +528,7 @@ def main():
             st0 = eng.stats
             u0, b0 = eng.h2d_uploads, eng.h2d_upload_bytes
             fr0, dp0 = eng.full_rebuilds, eng.delta_patches
+            pf0, dc0 = eng.patches_fused, eng.dispatch_count
             t0 = time.perf_counter()
             res = eng.run()
             dt = time.perf_counter() - t0
@@ -528,6 +541,9 @@ def main():
                 "decode_ticks": ticks,
                 "full_rebuilds": eng.full_rebuilds - fr0,
                 "delta_patches": eng.delta_patches - dp0,
+                "patches_fused": eng.patches_fused - pf0,
+                "dispatches_per_tick": round(
+                    (eng.dispatch_count - dc0) / ticks, 3),
                 "h2d_uploads_per_tick": round(
                     (eng.h2d_uploads - u0) / ticks, 3),
                 "h2d_upload_bytes_per_tick": round(
@@ -541,15 +557,29 @@ def main():
             return max(rows, key=lambda r: r["tokens_per_sec"])
 
         churn["full_rebuild"] = best(delta_transitions=False)
-        churn["delta"] = best()
+        churn["delta"] = best(patch_fuse=False)
+        churn["fused"] = best()
         churn["delta"]["speedup_vs_rebuild"] = round(
             churn["delta"]["tokens_per_sec"]
             / max(churn["full_rebuild"]["tokens_per_sec"], 1e-9), 2)
+        churn["fused"]["speedup_vs_rebuild"] = round(
+            churn["fused"]["tokens_per_sec"]
+            / max(churn["full_rebuild"]["tokens_per_sec"], 1e-9), 2)
+        churn["fused"]["speedup_vs_delta"] = round(
+            churn["fused"]["tokens_per_sec"]
+            / max(churn["delta"]["tokens_per_sec"], 1e-9), 2)
         # the ISSUE 14 acceptance row: steady churn, zero full rebuilds
         churn["delta_zero_rebuilds"] = \
             churn["delta"]["full_rebuilds"] == 0
+        # the ISSUE 19 acceptance rows: the fused run kept churn to
+        # ~one dispatch per tick with zero standalone patch programs
+        churn["fused_zero_standalone_patches"] = \
+            churn["fused"]["delta_patches"] == 0 \
+            and churn["fused"]["full_rebuilds"] == 0
         paged["paged_churn_tokens_per_sec"] = \
             churn["delta"]["tokens_per_sec"]
+        paged["paged_churn_fused_tokens_per_sec"] = \
+            churn["fused"]["tokens_per_sec"]
         report["churn"] = churn
         report["paged"] = paged
         bank()

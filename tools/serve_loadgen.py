@@ -58,6 +58,11 @@ every few ticks — and the rung records ``full_rebuilds`` /
 ``delta_patches`` / ``h2d_upload_bytes`` from the engines;
 ``--delta off`` keeps the full-rebuild transition path as the A/B
 reference (pair them to see what slot churn costs each way).
+``--patch-fuse off`` (ISSUE 19) keeps the standalone-patch-dispatch
+reference instead; the default fuses pending transition descriptors
+into the next tick's program, and the rung's ``patches_fused`` /
+``patch_queue_overflows`` / ``dispatches_per_tick`` fields show churn
+riding one dispatch per tick fleet-wide.
 
 Fleet mode (ISSUE 13): ``--url`` may repeat (client-side round-robin
 over several fleet front doors), ``--diurnal`` replaces the flat
@@ -344,6 +349,14 @@ def _build_gateway(ns):
     engine_kw["ring_mode"] = getattr(ns, "ring", "on") == "on"
     engine_kw["delta_transitions"] = \
         getattr(ns, "delta", "on") == "on"
+    # --patch-fuse off: the standalone-patch-dispatch reference
+    # (ISSUE 19 A/B — same descriptors, dispatched one tiny program
+    # per transition instead of staged into the tick). Only the "off"
+    # side is passed through: the default (None) lets the engine fuse
+    # whenever delta transitions are on.
+    if getattr(ns, "patch_fuse", "on") == "off" \
+            and engine_kw["delta_transitions"]:
+        engine_kw["patch_fuse"] = False
 
     chaos = bool(getattr(ns, "chaos", False))
     # host-RAM KV spill tier (ISSUE 17 A/B): --spill on hands every
@@ -718,6 +731,11 @@ async def run_loadgen(ns) -> dict:
         raise SystemExit("--delta off requires in-process replicas "
                          "(no --fleet / --url): fleet peers and "
                          "external servers don't receive it")
+    if (urls or fleet) and getattr(ns, "patch_fuse", "on") == "off":
+        # same mislabeling hazard as --delta off: the knob only
+        # reaches engines this process constructs
+        raise SystemExit("--patch-fuse off requires in-process "
+                         "replicas (no --fleet / --url)")
     if int(getattr(ns, "frontends", 1) or 1) > 1 and not fleet:
         raise SystemExit("--frontends needs --fleet: sibling "
                          "frontends share one replica-process fleet")
@@ -983,6 +1001,7 @@ async def run_loadgen(ns) -> dict:
         "model": ns.model if not urls else "external",
         "ring": getattr(ns, "ring", "on"),
         "delta": getattr(ns, "delta", "on"),
+        "patch_fuse": getattr(ns, "patch_fuse", "on"),
         "churn": bool(getattr(ns, "churn", False)),
         "targets": len(targets),
         "diurnal": bool(getattr(ns, "diurnal", False)),
@@ -1014,6 +1033,16 @@ async def run_loadgen(ns) -> dict:
         rung["delta_patches"] = sum(e.delta_patches for e in engines)
         rung["h2d_upload_bytes"] = sum(e.h2d_upload_bytes
                                        for e in engines)
+        # ISSUE 19: the fleet-level one-dispatch-per-tick evidence —
+        # staged rows carried the churn, dispatches/tick stays ~1 plus
+        # the run's prefill share
+        rung["patches_fused"] = sum(e.patches_fused for e in engines)
+        rung["patch_queue_overflows"] = sum(
+            e.patch_queue_overflows for e in engines)
+        ticks = sum(e.stats["decode_steps"] for e in engines)
+        rung["dispatches_per_tick"] = round(
+            sum(e.dispatch_count for e in engines) / ticks, 3) \
+            if ticks else 0.0
         rung["prefix_hit_tokens"] = sum(
             e.stats["prefix_hit_tokens"] for e in engines)
         router = gw.health()["router"]
@@ -1326,6 +1355,15 @@ def main(argv=None) -> int:
                          "short staggered max-new budgets so slots "
                          "finish + readmit every few ticks; the rung "
                          "records full_rebuilds/delta_patches")
+    ap.add_argument("--patch-fuse", dest="patch_fuse", default="on",
+                    choices=("on", "off"),
+                    help="fused patch+tick program (ISSUE 19): stage "
+                         "transition descriptors into the device "
+                         "queue the next tick applies in-program (off "
+                         "= one standalone patch dispatch per "
+                         "transition, the PR 12 A/B reference); the "
+                         "rung records patches_fused and "
+                         "dispatches_per_tick")
     ap.add_argument("--spill", default="off", choices=("on", "off"),
                     help="host-RAM KV spill tier (ISSUE 17): one "
                          "shared KVSpillArena across the replicas "

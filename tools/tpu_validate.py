@@ -224,11 +224,14 @@ def delta_patch_program():
     # requests (more requests than slots, budgets crossing the block
     # grid) force admit/finish/growth patches; after the first
     # dispatch's rebuild, every transition must ride a patch.
+    # patch_fuse=False pins the STANDALONE per-row program — since
+    # ISSUE 19 it is the fused queue's overflow fallback, so it must
+    # keep compiling on hardware even though the default never uses it.
     from paddle_tpu.generation.paged import PagedEngine
     from paddle_tpu.generation.stub import TickStubModel
     eng = PagedEngine(TickStubModel(), max_slots=4, num_blocks=64,
                       block_size=16, max_blocks_per_seq=16,
-                      prefill_buckets=(16,))
+                      prefill_buckets=(16,), patch_fuse=False)
     assert eng._delta
     eng.submit("w", np.arange(1, 6)[None], max_new_tokens=2)
     eng.run()
@@ -241,6 +244,38 @@ def delta_patch_program():
     assert eng.delta_patches > 0
     assert eng.full_rebuilds == fr0, (eng.full_rebuilds, fr0)
 check("delta_patch_program", delta_patch_program)
+
+def fused_patch_tick_program():
+    # ISSUE 19: the fused patch+tick program — the masked batched
+    # scatter stage prepended to the tick, fed by the device-resident
+    # [Q, D] descriptor queue — must compile as ONE executable on
+    # hardware at the same r05 geometry and absorb churn with zero
+    # post-warmup standalone patch dispatches and zero rebuilds: the
+    # dispatch counter must advance exactly once per tick + once per
+    # prefill across a churny run.
+    from paddle_tpu.generation.paged import PagedEngine
+    from paddle_tpu.generation.stub import TickStubModel
+    eng = PagedEngine(TickStubModel(), max_slots=4, num_blocks=64,
+                      block_size=16, max_blocks_per_seq=16,
+                      prefill_buckets=(16,))
+    assert eng._fuse_patches
+    eng.submit("w", np.arange(1, 6)[None], max_new_tokens=2)
+    eng.run()                      # warmup: compiles tick + prefill
+    fr0, d0 = eng.full_rebuilds, eng.dispatch_count
+    t0, p0 = eng.stats["decode_steps"], eng.stats["prefills"]
+    for i in range(8):
+        eng.submit(i, np.arange(1, 10)[None], max_new_tokens=24)
+    res = eng.run()
+    assert all(len(v) == 24 for k, v in res.items() if k != "w"), res
+    assert eng.patches_fused > 0
+    assert eng.delta_patches == 0, eng.delta_patches
+    assert eng.patch_queue_overflows == 0
+    assert eng.full_rebuilds == fr0, (eng.full_rebuilds, fr0)
+    ticks = eng.stats["decode_steps"] - t0
+    prefills = eng.stats["prefills"] - p0
+    assert eng.dispatch_count - d0 == ticks + prefills, \
+        (eng.dispatch_count - d0, ticks, prefills)
+check("fused_patch_tick_program", fused_patch_tick_program)
 
 def spill_reupload_program():
     # ISSUE 17: the spill re-upload program — one batched H2D scatter
