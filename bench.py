@@ -571,7 +571,8 @@ def main():
                      "gateway_profile",
                      ("gateway_tokens_per_sec", "gateway_p99_ttft_ms",
                       "kv_spill_hit_frac", "kv_spill_restored_tokens",
-                      "kv_xfer_hit_frac", "recompute_tokens_saved"))
+                      "kv_xfer_hit_frac", "recompute_tokens_saved",
+                      "phase_breakdown"))
         _ingest_rung(result, probe, "SERVE_FLEET_r13.json", "fleet",
                      "fleet_profile",
                      ("fleet_tokens_per_sec", "goodput_per_replica"))

@@ -98,7 +98,10 @@ block 0) so they can never corrupt a live block; it is never allocated.
 from __future__ import annotations
 
 import itertools
+import json
+import os
 import time
+from collections import deque
 from typing import Any, Dict, List, NamedTuple, Optional
 
 import jax
@@ -311,6 +314,134 @@ class _Request:
         self.spec_ema = 1.0
 
 
+class _TickPhaseProfile:
+    """Tick-phase accounting for the tick-phase profiler (ISSUE 20
+    tentpole): where does one scheduler tick's wall time go —
+
+    - ``h2d``      — mirror/patch-queue uploads (``jnp.asarray``)
+    - ``dispatch`` — the jitted tick program CALL (enqueue time in ring
+                     mode; enqueue+nothing-else either way — compute is
+                     NOT here)
+    - ``device``   — block-until-ready on the drain boundary: the
+                     program-bound wait the host actually ate
+    - ``drain``    — the D2H ``device_get`` after readiness
+    - ``host``     — the RESIDUAL: tick wall minus the four bracketed
+                     phases (scheduler bookkeeping, descriptor packing,
+                     stop matching, trace emission)
+
+    The residual construction makes the five phases sum to the tick
+    wall EXACTLY (pinned under an injected clock in
+    tests/test_tick_profile.py), which is what lets ``serve_loadgen``'s
+    ``phase_breakdown`` and ``obs_report phase_decompose`` split tok/s
+    into host/dispatch/device shares without an unexplained remainder.
+
+    Host-side bookkeeping only: phases land in registry histograms
+    (``paged_tick_phase_ms{phase=...}`` on the SERVING_MS_BUCKETS grid,
+    so the fleet sampler/dash pick them up for free) plus a bounded
+    per-tick ring of records (phase times, dispatches, uploads, bytes,
+    fused patches, active slots). Nothing here touches the device
+    beyond a ``block_until_ready`` on arrays the very next statement
+    would block on anyway — profile-on streams are pinned bitwise
+    identical to profile-off, and the steady-tick 1-dispatch/0-upload
+    contract is untouched.
+
+    ``clock`` is injectable (tests pin the phase math deterministically
+    the way ``MetricsTimeSeries(clock=...)`` does)."""
+
+    def __init__(self, labels: Dict[str, str], clock=None,
+                 capacity: int = 1024):
+        self.clock = clock if clock is not None else time.perf_counter
+        self.capacity = max(int(capacity), 1)
+        self.ring: deque = deque(maxlen=self.capacity)
+        self.totals = {p: 0.0 for p in obs.TICK_PHASES}
+        self.wall_total_ms = 0.0
+        self.ticks = 0
+        reg = obs.registry()
+        self._hists = {
+            p: reg.histogram("paged_tick_phase_ms",
+                             buckets=obs.SERVING_MS_BUCKETS,
+                             phase=p, **labels)
+            for p in obs.TICK_PHASES}
+        self._h_wall = reg.histogram("paged_tick_wall_ms",
+                                     buckets=obs.SERVING_MS_BUCKETS,
+                                     **labels)
+        self._acc: Optional[Dict[str, float]] = None
+        self._t0 = 0.0
+        self._last: Optional[Dict[str, float]] = None
+
+    def begin(self):
+        """Open a tick window (top of ``PagedEngine.step``)."""
+        self._acc = {p: 0.0 for p in obs.TICK_PHASES if p != "host"}
+        self._t0 = self.clock()
+
+    def add(self, phase: str, dt_ms: float):
+        """Accumulate one bracketed window. Out-of-tick windows (the
+        scoped drains a cancel/expiry runs between steps) feed the
+        totals and histograms but no tick record — there is no tick."""
+        dt_ms = max(float(dt_ms), 0.0)
+        if self._acc is None:
+            self.totals[phase] += dt_ms
+            self._hists[phase].observe(dt_ms)
+            return
+        self._acc[phase] += dt_ms
+
+    def acc(self, phase: str) -> float:
+        """Current tick's accumulated time for ``phase`` (0 outside a
+        tick) — lets a caller bracket a compound expression and deduct
+        the child uploads it already counted."""
+        return self._acc.get(phase, 0.0) if self._acc is not None \
+            else 0.0
+
+    def end(self, *, dispatches: int, uploads: int, nbytes: int,
+            patches: int, active: int):
+        """Close the tick: host = wall - bracketed phases (clamped at
+        0), observe histograms, append the ring record."""
+        t1 = self.clock()
+        wall = max((t1 - self._t0) * 1e3, 0.0)
+        acc = self._acc or {}
+        self._acc = None
+        host = max(wall - sum(acc.values()), 0.0)
+        phases = dict(acc)
+        phases["host"] = host
+        rec: Dict[str, Any] = {
+            "tick": self.ticks, "t": round(float(t1), 6),
+            "wall_ms": round(wall, 4),
+        }
+        for p in obs.TICK_PHASES:
+            v = phases.get(p, 0.0)
+            rec[f"{p}_ms"] = round(v, 4)
+            self.totals[p] += v
+            self._hists[p].observe(v)
+        rec.update(dispatches=int(dispatches), uploads=int(uploads),
+                   bytes=int(nbytes), patches=int(patches),
+                   active=int(active))
+        self._h_wall.observe(wall)
+        self.wall_total_ms += wall
+        self.ticks += 1
+        self.ring.append(rec)
+        self._last = {k: rec[k] for k in
+                      ("wall_ms",) + tuple(f"{p}_ms"
+                                           for p in obs.TICK_PHASES)}
+
+    def last_phases(self) -> Optional[Dict[str, float]]:
+        """Most recent COMPLETED tick's phase split — what a drained
+        tick trace event attaches as its per-request decode share
+        context (the drain commits tokens one dispatch behind)."""
+        return dict(self._last) if self._last is not None else None
+
+    def to_doc(self, engine: str) -> Dict[str, Any]:
+        """The ``tickphase/1`` document
+        (``obs.validate_tickphase_doc`` checks it)."""
+        return {"schema": obs.TICKPHASE_SCHEMA, "engine": engine,
+                "dumped_wall": time.time(),
+                "clock_now": float(self.clock()),
+                "capacity": self.capacity, "ticks": self.ticks,
+                "wall_total_ms": round(self.wall_total_ms, 4),
+                "phase_totals_ms": {p: round(v, 4) for p, v
+                                    in self.totals.items()},
+                "entries": list(self.ring)}
+
+
 class PagedEngine:
     """Continuous-batching serving engine for Llama-family CausalLMs.
 
@@ -337,7 +468,10 @@ class PagedEngine:
                  ring_len: Optional[int] = None,
                  delta_transitions: Optional[bool] = None,
                  patch_fuse: Optional[bool] = None,
-                 patch_queue_len: Optional[int] = None):
+                 patch_queue_len: Optional[int] = None,
+                 tick_profile: bool = False,
+                 profile_clock=None,
+                 profile_ring_len: int = 1024):
         cfg = model.config
         self.model = model
         self.fn, self.params = model.functional()
@@ -653,6 +787,83 @@ class PagedEngine:
                 "queue stages the delta path's descriptors")
         self._pq_len = self.R if patch_queue_len is None \
             else max(1, int(patch_queue_len))
+        # --- tick-phase profiler (ISSUE 20 tentpole) ------------------
+        # tick_profile=True times each tick's phases (host staging /
+        # H2D / dispatch / device wait / D2H drain) into per-phase
+        # registry histograms plus a bounded per-tick ring. OFF (the
+        # default) costs one None check per bracket and nothing else —
+        # the off path is bitwise the pre-profiler engine. ON changes
+        # nothing device-visible either (host clocks + one
+        # block_until_ready where the next statement blocks anyway):
+        # streams are pinned bitwise across the toggle and the
+        # steady-tick 1-dispatch/0-upload pins stay green with the
+        # profiler running (tests/test_tick_profile.py).
+        # profile_clock: injectable clock for deterministic phase-math
+        # tests (same idiom as MetricsTimeSeries(clock=...)).
+        self.tick_profile = bool(tick_profile)
+        self._prof: Optional[_TickPhaseProfile] = None
+        if self.tick_profile:
+            self._prof = _TickPhaseProfile(
+                self._obs_labels, clock=profile_clock,
+                capacity=profile_ring_len)
+            # the reset()-time flush (ISSUE 20 small fix): a SIGTERM'd
+            # replica leaves tickphase_<engine>.json in the run dir
+            # beside its series/reqtrace files
+            obs.register_flusher(self._flush_tick_profile)
+
+    # ------------------------------------------------------ tick profiler
+    @property
+    def tick_phase_totals(self) -> Optional[Dict[str, float]]:
+        """Cumulative per-phase milliseconds (None with the profiler
+        off) — what ``serve_loadgen`` sums into ``phase_breakdown``."""
+        return dict(self._prof.totals) if self._prof is not None \
+            else None
+
+    @property
+    def tick_wall_ms_total(self) -> float:
+        """Cumulative measured tick wall (ms; 0 with the profiler
+        off). By the residual construction,
+        ``sum(tick_phase_totals.values()) == tick_wall_ms_total`` up
+        to per-tick clamping."""
+        return self._prof.wall_total_ms if self._prof is not None \
+            else 0.0
+
+    def tick_profile_doc(self) -> Optional[Dict[str, Any]]:
+        """The ``tickphase/1`` ring document (None, profiler off)."""
+        if self._prof is None:
+            return None
+        return self._prof.to_doc(self._obs_labels["engine"])
+
+    def dump_tick_profile(self, path: str) -> Optional[str]:
+        """Atomic JSON dump of the tick-phase ring (the artifact
+        ``obs_report phase_decompose`` / ``trace_export`` ingest; the
+        gateway writes one per replica on drain and on a ``/profilez``
+        capture). No-op with the profiler off."""
+        doc = self.tick_profile_doc()
+        if doc is None:
+            return None
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+    def _flush_tick_profile(self) -> Optional[str]:
+        """reset()/drain-time flush into the configured run dir."""
+        d = obs.run_dir()
+        if d is None or self._prof is None:
+            return None
+        try:
+            return self.dump_tick_profile(os.path.join(
+                d, f"tickphase_{self._obs_labels['engine']}.json"))
+        except Exception:
+            return None
+
+    def _tick_phase_fields(self) -> Optional[Dict[str, float]]:
+        """Phase split attached to tick trace events (the most recent
+        COMPLETED tick's — ring drains commit one dispatch behind)."""
+        return self._prof.last_phases() if self._prof is not None \
+            else None
 
     @property
     def stats(self) -> Dict[str, int]:
@@ -1159,8 +1370,13 @@ class PagedEngine:
             for j, i in enumerate(rows):
                 pq[j] = self._pack_descriptor(i)
                 self._key_overrides.discard(i)
+            prof = self._prof
+            if prof is not None:
+                tp = prof.clock()
             self._dev["pq"] = jnp.asarray(pq)
             self._dev["pqn"] = jnp.asarray(np.int32(len(rows)))
+            if prof is not None:
+                prof.add("h2d", (prof.clock() - tp) * 1e3)
             nbytes = pq.nbytes + 4
             self.h2d_uploads += 1
             self.h2d_upload_bytes += nbytes
@@ -1239,6 +1455,9 @@ class PagedEngine:
                   + self.top_ks.nbytes + self.top_ps.nbytes
                   + self.reps.nbytes + eos.nbytes + rem.nbytes
                   + act.nbytes)
+        prof = self._prof
+        if prof is not None:
+            tp = prof.clock()
         self._dev = dict(
             tables=jnp.asarray(self.block_tables),
             lens=jnp.asarray(self.seq_lens),
@@ -1294,6 +1513,8 @@ class PagedEngine:
             self._dev.update(
                 pq=jnp.zeros((self._pq_len, self._desc_len), jnp.int32),
                 pqn=jnp.zeros((), jnp.int32))
+        if prof is not None:
+            prof.add("h2d", (prof.clock() - tp) * 1e3)
         self.h2d_upload_bytes += nbytes
         self._count("h2d_upload_bytes", nbytes)
         self._h_bytes.observe(nbytes)
@@ -2306,6 +2527,17 @@ class PagedEngine:
                     / max(int(self._counters["decode_steps"].value), 1),
                     4),
             },
+            # tick-phase profiler (ISSUE 20): where the last tick's
+            # wall time went + lifetime totals, when tick_profile is on
+            "tick_profile": {
+                "enabled": self._prof is not None,
+                "ticks": self._prof.ticks,
+                "wall_total_ms": round(self._prof.wall_total_ms, 3),
+                "phase_totals_ms": {
+                    p: round(v, 3)
+                    for p, v in self._prof.totals.items()},
+                "last_tick": self._prof.last_phases(),
+            } if self._prof is not None else {"enabled": False},
         }
 
     # ------------------------------------------------- fleet fault tolerance
@@ -2429,7 +2661,29 @@ class PagedEngine:
         expire overdue requests, admit EVERY queued request that fits
         (slots + blocks), advance one prefill chunk per prefilling
         slot, then one decode for all prefill-complete slots (ring
-        mode dispatches WITHOUT a readback and returns)."""
+        mode dispatches WITHOUT a readback and returns).
+
+        With ``tick_profile`` on, the whole tick runs inside one
+        profiler window: explicitly bracketed h2d/dispatch/device/drain
+        time plus the host residual land in the per-tick ring and the
+        phase histograms (ISSUE 20)."""
+        prof = self._prof
+        if prof is None:
+            return self._step_inner()
+        prof.begin()
+        d0, u0 = self.dispatch_count, self.h2d_uploads
+        b0, p0 = self.h2d_upload_bytes, self.patches_fused
+        try:
+            return self._step_inner()
+        finally:
+            prof.end(
+                dispatches=self.dispatch_count - d0,
+                uploads=self.h2d_uploads - u0,
+                nbytes=self.h2d_upload_bytes - b0,
+                patches=self.patches_fused - p0,
+                active=sum(1 for s in self.slots if s is not None))
+
+    def _step_inner(self):
         self._drain_pending()
         self._expire()
         while self._try_admit():
@@ -2497,11 +2751,26 @@ class PagedEngine:
                 self.d2h_syncs += 1
         except AttributeError:      # backend without is_ready probes
             pass
+        prof = self._prof
+        if prof is not None:
+            # device-wait vs D2H split (ISSUE 20): block-until-ready is
+            # the program-bound wait; the device_get after it is pure
+            # drain. Semantically free — device_get blocks on readiness
+            # anyway — so profile-on streams stay bitwise identical.
+            tp = prof.clock()
+            try:
+                jax.block_until_ready(arrs)
+            except Exception:
+                pass
+            tr = prof.clock()
+            prof.add("device", (tr - tp) * 1e3)
         t0 = time.perf_counter()
         vals = jax.device_get(arrs)
         # ring mode's decode-step histogram window is the drain wait —
         # the only host-visible program-bound time left on the path
         self._h_decode.observe((time.perf_counter() - t0) * 1e3)
+        if prof is not None:
+            prof.add("drain", (prof.clock() - tr) * 1e3)
         ring, rlps, wcur, act_now = vals[:4]
         kprop = macc = None
         if spec:
@@ -2551,6 +2820,13 @@ class PagedEngine:
             ev = dict(n=appended, ring_lag=lag)
             if self._spec_k:
                 ev.update(proposed=int(kp), accepted=int(ma))
+            if self._prof is not None:
+                # ring drains commit one dispatch behind — this is the
+                # LAST COMPLETED tick's split, the one whose tokens are
+                # being committed here
+                ph = self._prof.last_phases()
+                if ph is not None:
+                    ev["phase"] = ph
             self.trace_sink(slot.request_id, "tick", **ev)
         if finished or not bool(act_i):
             # host stop, or the device finish flag (eos/budget)
@@ -2592,11 +2868,25 @@ class PagedEngine:
                 self.d2h_syncs += 1
         except AttributeError:      # backend without is_ready probes
             pass
+        prof = self._prof
+        if prof is not None:
+            # same device/drain bracketing as the global drain; outside
+            # an open tick (cancel/expiry between steps) the windows
+            # feed totals + histograms only
+            tp = prof.clock()
+            try:
+                jax.block_until_ready(base_arrs)
+            except Exception:
+                pass
+            tr = prof.clock()
+            prof.add("device", (tr - tp) * 1e3)
         t0 = time.perf_counter()
         vals = jax.device_get([a[i] for a in base_arrs])
         # same histogram window as the global drain: in ring mode the
         # drain wait is the program-bound time, scoped drains included
         self._h_decode.observe((time.perf_counter() - t0) * 1e3)
+        if prof is not None:
+            prof.add("drain", (prof.clock() - tr) * 1e3)
         ring_i, rlps_i, wc, act_i = vals[:4]
         p["rows"].remove(i)
         if not p["rows"]:
@@ -2654,6 +2944,12 @@ class PagedEngine:
         self.h2d_upload_bytes += x.nbytes
         self._count("h2d_upload_bytes", x.nbytes)
         self._h_bytes.observe(x.nbytes)
+        prof = self._prof
+        if prof is not None:
+            t = prof.clock()
+            out = jnp.asarray(x)
+            prof.add("h2d", (prof.clock() - t) * 1e3)
+            return out
         return jnp.asarray(x)
 
     def _decode_host(self, active):
@@ -2670,6 +2966,13 @@ class PagedEngine:
         self.dispatch_count += 1
         self._count("dispatches")
         self.d2h_syncs += 1
+        prof = self._prof
+        if prof is not None:
+            # the jit-call expression below interleaves _up uploads
+            # with the dispatch; deduct the h2d time _up already
+            # counted so the two phases don't double-bill
+            tp = prof.clock()
+            h0 = prof.acc("h2d")
         if np.all(self.temps[active] <= 0.0):
             # all-greedy tick: the argmax-only executable
             nxt, lps, self.seen, self.pools = self._decode_greedy_jit(
@@ -2684,8 +2987,20 @@ class PagedEngine:
                 self._up(self.top_ks), self._up(self.top_ps),
                 self.seen, self._up(self.reps), self._up(act_mask))
             self.keys = np.array(new_keys)  # copy: jax views read-only
+        if prof is not None:
+            prof.add("dispatch", (prof.clock() - tp) * 1e3
+                     - (prof.acc("h2d") - h0))
+            tp = prof.clock()
+            try:
+                jax.block_until_ready((nxt, lps))
+            except Exception:
+                pass
+            tr = prof.clock()
+            prof.add("device", (tr - tp) * 1e3)
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)
+        if prof is not None:
+            prof.add("drain", (prof.clock() - tr) * 1e3)
         # the np.asarray above synced the device, so this is the REAL
         # per-tick latency (dispatch + compute), not just dispatch
         self._h_decode.observe((time.perf_counter() - t_decode) * 1e3)
@@ -2701,7 +3016,11 @@ class PagedEngine:
             slot.lps.append(float(lps[i]))
             slot.key = self.keys[i].copy()
             if sink is not None:
-                sink(slot.request_id, "tick", n=1)
+                ev = dict(n=1)
+                ph = self._tick_phase_fields()
+                if ph is not None:
+                    ev["phase"] = ph
+                sink(slot.request_id, "tick", **ev)
             done = self._stop_hit(slot) or \
                 len(slot.tokens) >= slot.max_new or \
                 (slot.eos is not None and tok == slot.eos)
@@ -2731,8 +3050,15 @@ class PagedEngine:
             fn = self._scan_greedy_jit if greedy else self._scan_jit
         else:
             fn = self._tick_greedy_jit if greedy else self._tick_jit
+        prof = self._prof
+        if prof is not None:
+            tp = prof.clock()
         nxt, lps, done, self.seen, self.pools, self._dev = fn(
             self.params, self.pools, self.seen, self._dev)
+        if prof is not None:
+            # dispatch = the program CALL (enqueue; async under ring
+            # mode) — compute lands in the drain boundary's device wait
+            prof.add("dispatch", (prof.clock() - tp) * 1e3)
         if not greedy:
             self._dev_keys_dirty = True
         if self._ring:
@@ -2747,7 +3073,17 @@ class PagedEngine:
             self._count("slot_steps", self.R * K)
             return True
         self.d2h_syncs += 1
+        if prof is not None:
+            tp = prof.clock()
+            try:
+                jax.block_until_ready((nxt, lps, done))
+            except Exception:
+                pass
+            tr = prof.clock()
+            prof.add("device", (tr - tp) * 1e3)
         nxt, lps, done = jax.device_get((nxt, lps, done))
+        if prof is not None:
+            prof.add("drain", (prof.clock() - tr) * 1e3)
         if not scan:                     # [R] -> [1, R]: one tick loop
             nxt, lps, done = nxt[None], lps[None], done[None]
         self._h_decode.observe((time.perf_counter() - t_decode) * 1e3)
@@ -2763,7 +3099,11 @@ class PagedEngine:
                 i, ((nxt[k, i], lps[k, i], bool(done[k, i]))
                     for k in range(K)))
             if sink is not None:
-                sink(slot.request_id, "tick", n=appended)
+                ev = dict(n=appended)
+                ph = self._tick_phase_fields()
+                if ph is not None:
+                    ev["phase"] = ph
+                sink(slot.request_id, "tick", **ev)
             if finished:
                 self._finish(i)
         return True
@@ -2807,8 +3147,13 @@ class PagedEngine:
         self._count("dispatches")
         greedy = np.all(self.temps[active] <= 0.0)
         fn = self._tick_spec_greedy_jit if greedy else self._tick_spec_jit
+        prof = self._prof
+        if prof is not None:
+            tp = prof.clock()
         (nxt, lps, nacc, kprop, macc, done, self.seen, self.pools,
          self._dev) = fn(self.params, self.pools, self.seen, self._dev)
+        if prof is not None:
+            prof.add("dispatch", (prof.clock() - tp) * 1e3)
         if not greedy:
             self._dev_keys_dirty = True
         if self._ring:
@@ -2821,8 +3166,19 @@ class PagedEngine:
             self._count("slot_steps", self.R)
             return True
         self.d2h_syncs += 1
+        if prof is not None:
+            tp = prof.clock()
+            try:
+                jax.block_until_ready((nxt, lps, nacc, kprop, macc,
+                                       done))
+            except Exception:
+                pass
+            tr = prof.clock()
+            prof.add("device", (tr - tp) * 1e3)
         nxt, lps, nacc, kprop, macc, done = jax.device_get(
             (nxt, lps, nacc, kprop, macc, done))
+        if prof is not None:
+            prof.add("drain", (prof.clock() - tr) * 1e3)
         self._h_decode.observe((time.perf_counter() - t_decode) * 1e3)
         self._count("decode_steps")
         self._count("slot_steps", self.R)
@@ -2846,8 +3202,12 @@ class PagedEngine:
             appended, finished = self._consume_row(
                 i, ((nxt[i, j], lps[i, j], False) for j in range(n)))
             if sink is not None:
-                sink(slot.request_id, "tick", n=appended,
-                     proposed=int(kprop[i]), accepted=int(macc[i]))
+                ev = dict(n=appended, proposed=int(kprop[i]),
+                          accepted=int(macc[i]))
+                ph = self._tick_phase_fields()
+                if ph is not None:
+                    ev["phase"] = ph
+                sink(slot.request_id, "tick", **ev)
             if finished or bool(done[i]):
                 # host stop, or the device finish flag (eos/budget)
                 self._finish(i)

@@ -412,6 +412,43 @@ class FleetFrontend:
             "sticky": self._router.export_sticky(),
         }
 
+    # ---------------------------------------------------- profilez federation
+    async def _serve_profilez(self, query: str, writer):
+        """``GET /profilez?duration_s=N&replica=<peer>`` (ISSUE 20):
+        federate the gateway capture — one call on the frontend
+        profiles a CHOSEN replica gateway (default: the first healthy
+        peer). The blocking peer fetch runs in a thread so the capture
+        window never stalls the frontend's event loop; the peer's own
+        report is returned verbatim under ``report``."""
+        dur = _query_param(query, "duration_s")
+        dur = 1.0 if dur is None else max(0.05, min(float(dur), 30.0))
+        want = _query_param(query, "replica", str)
+        peer = None
+        for p in self.peers:
+            if want is not None:
+                if p.name == want:
+                    peer = p
+                    break
+            elif p.healthy():
+                peer = p
+                break
+        if peer is None:
+            writer.write(_json_response(
+                404, {"error": f"no such replica {want!r}"
+                      if want is not None else "no healthy peer"}))
+            await writer.drain()
+            return
+        report = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: peer.fetch_profilez(dur))
+        if report is None:
+            writer.write(_json_response(
+                502, {"error": f"peer {peer.name} capture failed"}))
+        else:
+            writer.write(_json_response(200, {
+                "fleet": self.name, "replica": peer.name,
+                "report": report}))
+        await writer.drain()
+
     def apply_gossip(self, doc: Dict[str, Any]) -> Dict[str, int]:
         """Merge a sibling's :meth:`gossipz` doc. Only ever ADDS
         knowledge: digest sets move forward by generation guard,
@@ -488,6 +525,8 @@ class FleetFrontend:
             elif method == "GET" and path == "/gossipz":
                 writer.write(_json_response(200, self.gossipz()))
                 await writer.drain()
+            elif method == "GET" and path == "/profilez":
+                await self._serve_profilez(query, writer)
             elif method == "POST" and path == "/v1/generate":
                 self._active += 1
                 try:

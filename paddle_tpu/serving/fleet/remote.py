@@ -237,6 +237,31 @@ class RemoteReplica:
         finally:
             conn.close()
 
+    def fetch_profilez(self, duration_s: float,
+                       timeout_s: Optional[float] = None
+                       ) -> Optional[Dict[str, Any]]:
+        """``GET /profilez?duration_s=`` on this peer (ISSUE 20
+        federated capture): trigger a bounded tick-phase + jax-trace
+        capture on the peer gateway and return its report dict, or
+        None on any error — never raises. The default timeout covers
+        the capture window plus transport slack (the peer holds the
+        response open for ``duration_s`` wall seconds)."""
+        conn = http.client.HTTPConnection(
+            self.host, self.port,
+            timeout=float(timeout_s) if timeout_s is not None
+            else float(duration_s) + max(self.probe_timeout_s, 5.0))
+        try:
+            conn.request("GET", f"/profilez?duration_s={duration_s}")
+            resp = conn.getresponse()
+            payload = resp.read()
+            if resp.status != 200:
+                return None
+            return json.loads(payload)
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+        finally:
+            conn.close()
+
     @staticmethod
     def _fold_health(doc: Dict[str, Any]) -> Dict[str, Any]:
         """Collapse a peer /healthz doc into the numbers the router and

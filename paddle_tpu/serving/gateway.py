@@ -666,6 +666,10 @@ class Gateway:
         self._draining = False
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # one /profilez capture at a time (ISSUE 20): concurrent
+        # captures would fight over utils.profiler's single-trace
+        # ownership — the second caller gets 409, not a corrupt trace
+        self._profilez_busy = False
         # request-scoped tracing (ISSUE 10): default ON — the whole
         # path is host-side bookkeeping, pinned to change nothing
         # (bit-identical streams, same dispatch/upload counters).
@@ -1218,6 +1222,12 @@ class Gateway:
                 self.dump_traces(obs.run_dir())
             except Exception:
                 pass
+            # ... and the tick-phase rings beside them (ISSUE 20 small
+            # fix: a SIGTERM'd replica leaves its phase trajectory too)
+            try:
+                self.dump_tick_profiles(obs.run_dir())
+            except Exception:
+                pass
         if int(self._c_migrated.value) > mig_before \
                 and self._xfer_grace_s > 0:
             # hold the listener open past the cut-over so the fleet
@@ -1333,6 +1343,26 @@ class Gateway:
             out.append(w.ring.dump(os.path.join(
                 directory,
                 f"reqtrace_{self.name}_{w.replica.name}.json")))
+        return out
+
+    def dump_tick_profiles(self, directory: str) -> List[str]:
+        """Write every replica engine's tick-phase ring to
+        ``tickphase_<gateway>_<replica>.json`` under ``directory``
+        (ISSUE 20: the synchronized dump a ``/profilez`` capture and a
+        drain leave beside the reqtrace rings). No-op for engines
+        running with ``tick_profile`` off."""
+        os.makedirs(directory, exist_ok=True)
+        out = []
+        for w in self._workers:
+            dump = getattr(w.engine, "dump_tick_profile", None)
+            if dump is None or getattr(w.engine, "_prof", None) is None:
+                continue
+            try:
+                out.append(dump(os.path.join(
+                    directory,
+                    f"tickphase_{self.name}_{w.replica.name}.json")))
+            except Exception:
+                pass     # a failed dump only costs the phase artifact
         return out
 
     def prefix_digest_summary(self) -> Dict[str, Any]:
@@ -1452,6 +1482,13 @@ class Gateway:
                 rep["scheduler"] = {"error": repr(e)}
             rep["trace_ring"] = (w.ring.summary()
                                  if w.ring is not None else None)
+            # tick-phase profiler (ISSUE 20), surfaced like the
+            # transition counters: the snapshot's block when it read
+            # cleanly, a minimal enabled-flag otherwise
+            tp = rep["engine"].get("tick_profile") \
+                if isinstance(rep["engine"], dict) else None
+            rep["tick_profile"] = tp if tp is not None else {
+                "enabled": getattr(w.engine, "_prof", None) is not None}
             reps[w.replica.name] = rep
         sup = None
         if self._supervisor is not None:
@@ -1608,6 +1645,9 @@ class Gateway:
         if method == "GET" and path == "/kvz":
             await self._serve_kvz(query, writer)
             return
+        if method == "GET" and path == "/profilez":
+            await self._serve_profilez(query, writer)
+            return
         if method == "POST" and path == "/v1/generate":
             await self._generate(body, headers, reader, writer)
             return
@@ -1647,6 +1687,88 @@ class Gateway:
             writer.write(_http_response(
                 200, blob, ctype="application/octet-stream"))
         await writer.drain()
+
+    async def _serve_profilez(self, query: str, writer):
+        """``GET /profilez?duration_s=N`` (ISSUE 20 capture layer): a
+        BOUNDED on-demand capture — open a ``jax.profiler`` trace
+        through :class:`~..utils.profiler.Profiler` (whose module latch
+        keeps this from corrupting a trace some training loop already
+        owns — contention degrades to timer-only, never an error), let
+        live traffic run for ``duration_s`` wall seconds, stop the
+        trace, then dump every replica engine's tick-phase ring beside
+        it (``tickphase_<gateway>_<replica>.json`` in the run dir).
+        The response reports per-replica phase totals ACCUMULATED
+        DURING THE WINDOW, so a caller gets the slope-vs-intercept
+        split inline even with no run dir configured. One capture at a
+        time (409 otherwise); duration is clamped to 30 s — this is a
+        tap on a serving process, not a profiling session."""
+        dur = _query_param(query, "duration_s")
+        dur = 1.0 if dur is None else max(0.05, min(float(dur), 30.0))
+        if self._profilez_busy:
+            writer.write(_json_response(
+                409, {"error": "capture already in progress"}))
+            await writer.drain()
+            return
+        self._profilez_busy = True
+        try:
+            from ..utils.profiler import Profiler
+            run_dir = obs.run_dir()
+            jax_dir = os.path.join(run_dir, f"jaxprof_{self.name}") \
+                if run_dir else None
+            prof = Profiler(logdir=jax_dir or "",
+                            timer_only=jax_dir is None)
+            before = {}
+            for w in self._workers:
+                p = getattr(w.engine, "_prof", None)
+                if p is not None:
+                    before[w.replica.name] = (
+                        p.ticks, dict(p.totals), p.wall_total_ms)
+            traced = False
+            try:
+                prof.start()
+                traced = not prof.timer_only
+            except Exception:
+                prof = None       # backend without trace support: the
+                                  # tick-ring dump still happens
+            try:
+                await asyncio.sleep(dur)
+            finally:
+                if prof is not None:
+                    try:
+                        prof.stop()
+                    except Exception:
+                        traced = False
+            reps: Dict[str, Any] = {}
+            for w in self._workers:
+                p = getattr(w.engine, "_prof", None)
+                if p is None:
+                    reps[w.replica.name] = {"enabled": False}
+                    continue
+                t0, tot0, w0 = before.get(
+                    w.replica.name, (0, {}, 0.0))
+                reps[w.replica.name] = {
+                    "enabled": True,
+                    "ticks_in_window": p.ticks - t0,
+                    "wall_ms_in_window": round(
+                        p.wall_total_ms - w0, 3),
+                    "phase_ms_in_window": {
+                        k: round(v - tot0.get(k, 0.0), 3)
+                        for k, v in p.totals.items()},
+                }
+            files = self.dump_tick_profiles(run_dir) if run_dir else []
+            obs.record_event("profilez_capture", gateway=self.name,
+                             duration_s=dur,
+                             traced=traced, files=len(files))
+            writer.write(_json_response(200, {
+                "gateway": self.name,
+                "duration_s": dur,
+                "jax_trace": jax_dir if traced else None,
+                "tickphase_files": files,
+                "replicas": reps,
+            }))
+            await writer.drain()
+        finally:
+            self._profilez_busy = False
 
     # ------------------------------------------------------------ generate
     def _parse_request(self, body: bytes,

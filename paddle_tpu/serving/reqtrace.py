@@ -50,7 +50,8 @@ from typing import Any, Dict, List, Optional
 from ..utils import observability as obs
 
 __all__ = ["EVENT_KINDS", "OUTCOMES", "RequestTrace",
-           "RequestTraceRing", "attribution", "validate_ring_doc"]
+           "RequestTraceRing", "attribution", "decode_phase_share",
+           "validate_ring_doc"]
 
 SCHEMA = "reqtrace/1"
 
@@ -168,6 +169,41 @@ def attribution(trace: RequestTrace) -> Dict[str, Optional[float]]:
     }
 
 
+def decode_phase_share(trace: "RequestTrace") -> Optional[Dict[str, float]]:
+    """Per-request decode-phase attribution (ISSUE 20): sum the
+    ``phase`` splits the engine attaches to this request's ``tick``
+    events (present only when the engine runs with ``tick_profile=on``)
+    and normalize to FRACTIONS of the summed tick wall. This is the
+    request-granular face of the engine's tick-phase profiler — "of the
+    ticks that advanced THIS request, what share went to host vs
+    dispatch vs device vs drain". Returns None when no tick carried a
+    phase split (profiler off, or the request never reached decode)."""
+    totals: Dict[str, float] = {}
+    wall = 0.0
+    n = 0
+    for _, k, fields in trace.events:
+        if k != "tick":
+            continue
+        ph = fields.get("phase")
+        if not isinstance(ph, dict):
+            continue
+        w = float(ph.get("wall_ms", 0.0))
+        if w <= 0.0:
+            continue
+        n += 1
+        wall += w
+        for pk, pv in ph.items():
+            if pk == "wall_ms" or not pk.endswith("_ms"):
+                continue
+            totals[pk[:-3]] = totals.get(pk[:-3], 0.0) + float(pv)
+    if n == 0 or wall <= 0.0:
+        return None
+    out = {f"{p}_frac": round(v / wall, 4) for p, v in totals.items()}
+    out["ticks"] = n
+    out["wall_ms"] = round(wall, 3)
+    return out
+
+
 class RequestTraceRing:
     """Bounded per-engine ring of finished request timelines, plus the
     attribution histograms derived from them (registered in the global
@@ -256,6 +292,12 @@ class RequestTraceRing:
             else [],
             **comps,
         }
+        # ISSUE 20: per-request decode phase attribution, present only
+        # when the engine ran with tick_profile on (extra entry keys
+        # are schema-tolerated, like the fleet fields above)
+        share = decode_phase_share(trace)
+        if share is not None:
+            entry["phase_share"] = share
         if retain:
             self._c_retained.inc()
         self._ring.append(entry)

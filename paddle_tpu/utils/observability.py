@@ -66,6 +66,8 @@ __all__ = [
     "SpanTracer", "FlightRecorder",
     "MetricsTimeSeries", "SERIES_SCHEMA",
     "quantile_from_bucket_counts", "validate_series_doc",
+    "TICKPHASE_SCHEMA", "TICK_PHASES", "validate_tickphase_doc",
+    "register_flusher", "unregister_flusher",
     "registry", "tracer", "recorder",
     "counter", "gauge", "histogram", "span", "record_event",
     "configure", "run_dir", "flight_path", "trace_path", "metrics_path",
@@ -899,6 +901,75 @@ def validate_series_doc(doc: Any) -> List[str]:
     return bad
 
 
+# ----------------------------------------------------------- tick phases
+# Tick-phase profiler document schema (ISSUE 20). The ENGINE writes
+# these (``PagedEngine.dump_tick_profile`` → ``tickphase_*.json``);
+# the readers are ``tools/obs_report.py`` (phase_decompose view) and
+# ``tools/trace_export.py``. The validator lives HERE — dependency-free
+# — so the tools can check documents without importing jax.
+TICKPHASE_SCHEMA = "tickphase/1"
+# phase order is the tick's own: host staging/patch-pack → H2D upload
+# → dispatch call → device wait (block-until-ready on the drain
+# boundary) → D2H drain. ``host`` is the RESIDUAL (tick wall minus the
+# explicitly bracketed phases), so the five always sum to the wall.
+TICK_PHASES = ("host", "h2d", "dispatch", "device", "drain")
+
+
+def validate_tickphase_doc(doc: Any) -> List[str]:
+    """Schema check for a dumped tick-phase ring (``obs_report
+    --check`` runs this so the engine's writer and the tools' readers
+    cannot drift apart). Returns a list of problems (empty = valid):
+    schema tag, the ring bound, per-entry phase fields, and the
+    phase-sum-equals-wall invariant (to 1% — the residual construction
+    makes it exact up to rounding)."""
+    bad: List[str] = []
+    if not isinstance(doc, dict):
+        return ["doc is not an object"]
+    if doc.get("schema") != TICKPHASE_SCHEMA:
+        bad.append(f"schema != {TICKPHASE_SCHEMA!r}: "
+                   f"{doc.get('schema')!r}")
+    cap = doc.get("capacity")
+    if not isinstance(cap, int) or cap < 1:
+        bad.append(f"capacity not an int >= 1: {cap!r}")
+        cap = None
+    totals = doc.get("phase_totals_ms")
+    if not isinstance(totals, dict) \
+            or set(totals) != set(TICK_PHASES):
+        bad.append("phase_totals_ms missing or wrong phase set")
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        return bad + ["entries is not a list"]
+    if cap is not None and len(entries) > cap:
+        bad.append(f"ring bound violated: {len(entries)} > "
+                   f"capacity {cap}")
+    prev_tick = None
+    for i, e in enumerate(entries):
+        where = f"entries[{i}]"
+        if not isinstance(e, dict):
+            bad.append(f"{where} not an object")
+            continue
+        for k in ("tick", "t", "wall_ms", "dispatches", "active") \
+                + tuple(f"{p}_ms" for p in TICK_PHASES):
+            if not isinstance(e.get(k), (int, float)):
+                bad.append(f"{where} missing numeric {k!r}")
+        if not all(isinstance(e.get(f"{p}_ms"), (int, float))
+                   for p in TICK_PHASES) \
+                or not isinstance(e.get("wall_ms"), (int, float)):
+            continue
+        wall = e["wall_ms"]
+        ps = sum(e[f"{p}_ms"] for p in TICK_PHASES)
+        if abs(ps - wall) > max(0.01 * wall, 0.01):
+            bad.append(f"{where} phase sum {ps:.4f} != wall "
+                       f"{wall:.4f}")
+        t = e.get("tick")
+        if prev_tick is not None and isinstance(t, (int, float)) \
+                and t <= prev_tick:
+            bad.append(f"{where} tick counter not increasing")
+        if isinstance(t, (int, float)):
+            prev_tick = t
+    return bad
+
+
 # --------------------------------------------------------- process default
 _registry = MetricsRegistry()
 _tracer = SpanTracer()
@@ -909,6 +980,12 @@ _state_lock = threading.Lock()
 # flush their series files (ISSUE 15 small fix: a leaked sampler
 # thread would keep writing into a test's fresh registry)
 _samplers: List["MetricsTimeSeries"] = []
+# registered flushers (ISSUE 20 small fix): callables invoked by
+# reset() BEFORE the substrate is torn down, so ring-shaped state that
+# lives elsewhere (the engines' tick-phase rings) lands in the run dir
+# beside the series files. A flusher must be idempotent and must never
+# raise through reset.
+_flushers: List[Any] = []
 
 
 def _track_sampler(s: "MetricsTimeSeries"):
@@ -921,6 +998,21 @@ def _untrack_sampler(s: "MetricsTimeSeries"):
     with _state_lock:
         if s in _samplers:
             _samplers.remove(s)
+
+
+def register_flusher(fn) -> None:
+    """Register a callable reset() invokes (while the run dir is still
+    configured) before tearing the substrate down — how an engine's
+    tick-phase ring survives a SIGTERM-path reset (ISSUE 20)."""
+    with _state_lock:
+        if fn not in _flushers:
+            _flushers.append(fn)
+
+
+def unregister_flusher(fn) -> None:
+    with _state_lock:
+        if fn in _flushers:
+            _flushers.remove(fn)
 
 
 def registry() -> MetricsRegistry:
@@ -1033,14 +1125,23 @@ def reset() -> None:
     global _registry, _tracer, _recorder, _run_dir
     with _state_lock:
         samplers = list(_samplers)
+        flushers = list(_flushers)
     for s in samplers:
         try:
             s.stop()
             s.flush_series()
         except Exception:
             pass
+    # tick-phase rings (and any other registered ring state) flush
+    # while the run dir is still configured (ISSUE 20 small fix)
+    for fn in flushers:
+        try:
+            fn()
+        except Exception:
+            pass
     with _state_lock:
         _samplers.clear()
+        _flushers.clear()
         _registry = MetricsRegistry()
         _tracer = SpanTracer()
         _recorder = FlightRecorder()

@@ -1,0 +1,314 @@
+"""ISSUE 20: tick-phase profiler — dispatch/device/host attribution.
+
+Contracts pinned here:
+
+- PHASE SUM == WALL: under an injected clock the five phases (host,
+  h2d, dispatch, device, drain) sum EXACTLY to the measured tick wall
+  — host is the residual of the bracketed phases, so there is no
+  unexplained remainder for ``phase_breakdown``/``phase_decompose``
+  to mis-attribute.
+- BITWISE OFF==ON: profile-on greedy+sampled streams are bit-identical
+  to profile-off across engine modes (default fused ring, sync
+  readback, unfused tick, multi-tick dispatch) — the profiler reads
+  clocks and calls ``block_until_ready`` on arrays the next statement
+  would block on anyway; it never changes what the device computes.
+- STEADY CONTRACT UNTOUCHED: with the profiler ON, steady decode
+  ticks keep the ISSUE 19 pins — one dispatch per tick, zero uploads,
+  zero upload bytes.
+- RING BOUND: the per-tick ring holds at most ``profile_ring_len``
+  records with strictly increasing tick counters; the ``tickphase/1``
+  doc round-trips ``obs.validate_tickphase_doc``.
+- FLUSH ON RESET: ``obs.reset()`` (and the gateway drain that calls
+  it) writes ``tickphase_<engine>.json`` into the still-configured
+  run dir via the registered flusher.
+- REQUEST WATERFALL: tick trace events carry the completed tick's
+  phase split; ``decode_phase_share`` folds them into per-request
+  fractions and the trace ring banks them as ``phase_share``.
+
+The ``/profilez`` HTTP capture e2e (gateway + fleet-frontend
+federation) rides behind ``slow`` (``tools/marker_audit.py``
+``test_tick_profile.py.*profilez.*e2e``).
+"""
+import asyncio
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.generation.paged import PagedEngine
+from paddle_tpu.generation.stub import TickStubModel
+from paddle_tpu.serving.reqtrace import (RequestTrace, RequestTraceRing,
+                                         decode_phase_share)
+from paddle_tpu.utils import observability as obs
+
+
+def _cyc(n, start=0):
+    return (np.arange(n) % 5 + 1 + start)[None]
+
+
+def _engine(**kw):
+    base = dict(max_slots=4, num_blocks=32, block_size=8,
+                max_blocks_per_seq=8, prefill_buckets=(16,))
+    base.update(kw)
+    return PagedEngine(TickStubModel(), **base)
+
+
+# greedy + sampled + stop-sequence + eos: the mixed workload the
+# bitwise pins replay across the mode matrix
+SUBS = [
+    ("g", _cyc(6), dict(max_new_tokens=12)),
+    ("s", _cyc(8, 2), dict(max_new_tokens=10, temperature=0.8,
+                           top_k=20, seed=5)),
+    ("st", _cyc(9, 1), dict(max_new_tokens=14,
+                            stop_sequences=[[3, 4]])),
+    ("e", _cyc(5, 3), dict(max_new_tokens=10, eos_token_id=2)),
+]
+
+
+def _drain(eng):
+    for rid, ids, kw in SUBS:
+        eng.submit(rid, ids, **kw)
+    res = eng.run()
+    return res, dict(eng.logprobs)
+
+
+class FakeClock:
+    """Deterministic profiler clock: +1 ms per call, so every
+    bracketed phase costs exactly the number of clock reads its code
+    path makes and the phase math is pinned to exact floats."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 0.001
+        return self.t
+
+
+# ========================================================= phase math
+def test_phase_sum_equals_wall_injected_clock():
+    eng = _engine(tick_profile=True, profile_clock=FakeClock())
+    _drain(eng)
+    prof = eng._prof
+    assert prof.ticks > 0
+    # the residual construction: five phases sum to the wall EXACTLY
+    assert sum(prof.totals.values()) == pytest.approx(
+        prof.wall_total_ms, rel=1e-9)
+    # every bracketed phase actually ran under the fake clock
+    for p in ("h2d", "dispatch", "device", "drain"):
+        assert prof.totals[p] > 0.0, p
+    # per-entry exactness too, and the engine-facing aggregates agree
+    doc = eng.tick_profile_doc()
+    assert obs.validate_tickphase_doc(doc) == []
+    for rec in doc["entries"]:
+        assert sum(rec[f"{p}_ms"] for p in obs.TICK_PHASES) \
+            == pytest.approx(rec["wall_ms"], rel=1e-9)
+    assert eng.tick_phase_totals == prof.totals
+    assert eng.tick_wall_ms_total == prof.wall_total_ms
+
+
+def test_real_clock_sum_within_validator_tolerance():
+    eng = _engine(tick_profile=True)
+    _drain(eng)
+    doc = eng.tick_profile_doc()
+    assert doc["ticks"] > 0
+    assert obs.validate_tickphase_doc(doc) == []
+    # snapshot surface carries the same numbers
+    snap = eng.debug_snapshot()["tick_profile"]
+    assert snap["enabled"] and snap["ticks"] == doc["ticks"]
+    assert _engine().debug_snapshot()["tick_profile"] \
+        == {"enabled": False}
+
+
+# ====================================================== bitwise pins
+@pytest.mark.parametrize("mode_kw", [
+    {},                                # fused ring (default)
+    {"ring_mode": False},              # sync per-tick readback
+    {"fused_tick": False},             # unfused decode path
+    {"ticks_per_dispatch": 4},         # multi-tick dispatch
+], ids=["fused-ring", "sync", "unfused", "multi-tick"])
+def test_profile_on_off_bitwise(mode_kw):
+    res_off, lp_off = _drain(_engine(**mode_kw))
+    res_on, lp_on = _drain(_engine(tick_profile=True, **mode_kw))
+    assert res_on == res_off
+    for rid in lp_off:
+        assert lp_on[rid] == lp_off[rid]
+
+
+def test_steady_tick_contract_with_profiler_on():
+    """ISSUE 19 steady pins stay green with the profiler running:
+    1 dispatch per tick, 0 uploads, 0 bytes."""
+    eng = _engine(block_size=64, max_blocks_per_seq=2,
+                  tick_profile=True)
+    for i in range(4):
+        eng.submit(f"r{i}", _cyc(6), max_new_tokens=100)
+    for _ in range(6):
+        eng.step()
+    d0, u0 = eng.dispatch_count, eng.h2d_uploads
+    b0 = eng.h2d_upload_bytes
+    t0 = eng._prof.ticks
+    for _ in range(20):
+        eng.step()
+    assert eng.dispatch_count - d0 == 20
+    assert eng.h2d_uploads - u0 == 0
+    assert eng.h2d_upload_bytes - b0 == 0
+    # and the ring saw exactly those ticks, each recording 1 dispatch
+    assert eng._prof.ticks - t0 == 20
+    steady = list(eng._prof.ring)[-20:]
+    assert all(r["dispatches"] == 1 and r["uploads"] == 0
+               and r["bytes"] == 0 for r in steady)
+
+
+# ========================================================== ring bound
+def test_ring_bounded_and_monotonic():
+    eng = _engine(tick_profile=True, profile_ring_len=4)
+    _drain(eng)
+    doc = eng.tick_profile_doc()
+    assert eng._prof.ticks > 4          # the run outgrew the ring
+    assert len(doc["entries"]) == 4     # ...which stayed bounded
+    assert doc["capacity"] == 4
+    assert obs.validate_tickphase_doc(doc) == []
+    ticks = [r["tick"] for r in doc["entries"]]
+    assert ticks == sorted(ticks) and len(set(ticks)) == 4
+    # totals keep full-run accounting even after ring eviction
+    assert doc["wall_total_ms"] >= sum(
+        r["wall_ms"] for r in doc["entries"]) - 1e-6
+
+
+# ======================================================== reset flush
+def test_reset_flushes_tickphase_ring(tmp_path):
+    obs.reset()                     # drop flushers stale engines left
+    obs.configure(str(tmp_path))
+    try:
+        eng = _engine(tick_profile=True, profile_clock=FakeClock())
+        _drain(eng)
+        assert glob.glob(str(tmp_path / "tickphase_*.json")) == []
+    finally:
+        obs.reset()                 # the flush under test
+    files = glob.glob(str(tmp_path / "tickphase_*.json"))
+    assert len(files) == 1
+    with open(files[0]) as f:
+        doc = json.load(f)
+    assert obs.validate_tickphase_doc(doc) == []
+    assert doc["ticks"] == eng._prof.ticks > 0
+    # a second reset must not re-run the (cleared) flusher
+    os.remove(files[0])
+    obs.reset()
+    assert glob.glob(str(tmp_path / "tickphase_*.json")) == []
+
+
+# ================================================== request waterfall
+def test_trace_events_carry_phase_and_share():
+    eng = _engine(tick_profile=True)
+    events = []
+    eng.trace_sink = lambda rid, kind, **f: events.append(
+        (rid, kind, f))
+    eng.submit("a", _cyc(6), max_new_tokens=8)
+    eng.run()
+    ticks = [f for rid, kind, f in events
+             if rid == "a" and kind == "tick"]
+    assert ticks
+    with_phase = [f["phase"] for f in ticks if "phase" in f]
+    assert with_phase                # at least the post-first ticks
+    for ph in with_phase:
+        assert set(ph) == {"wall_ms"} | {
+            f"{p}_ms" for p in obs.TICK_PHASES}
+
+    # profiler OFF: tick events stay phase-free (no schema surprise)
+    eng2 = _engine()
+    ev2 = []
+    eng2.trace_sink = lambda rid, kind, **f: ev2.append((kind, f))
+    eng2.submit("a", _cyc(6), max_new_tokens=8)
+    eng2.run()
+    assert all("phase" not in f for k, f in ev2 if k == "tick")
+
+
+def test_decode_phase_share_math_and_ring_entry():
+    t = RequestTrace("req-1")
+    t.ev("queue_enter", slo="interactive")
+    t.ev("tick", n=1, phase={"wall_ms": 4.0, "host_ms": 1.0,
+                             "h2d_ms": 0.0, "dispatch_ms": 2.0,
+                             "device_ms": 0.5, "drain_ms": 0.5})
+    t.ev("tick", n=2, phase={"wall_ms": 6.0, "host_ms": 2.0,
+                             "h2d_ms": 1.0, "dispatch_ms": 1.0,
+                             "device_ms": 1.5, "drain_ms": 0.5})
+    t.ev("tick", n=3)                # no phase: skipped, not crashed
+    share = decode_phase_share(t)
+    assert share["ticks"] == 2 and share["wall_ms"] == 10.0
+    assert share["host_frac"] == pytest.approx(0.3)
+    assert share["dispatch_frac"] == pytest.approx(0.3)
+    assert share["device_frac"] == pytest.approx(0.2)
+    assert share["drain_frac"] == pytest.approx(0.1)
+    assert share["h2d_frac"] == pytest.approx(0.1)
+    # the ring banks it on finish
+    ring = RequestTraceRing(capacity=4, labels={"gateway": "t"})
+    entry = ring.finish(t, "stop", tokens=2)
+    assert entry["phase_share"] == share
+    # and a phase-free trace yields no key at all
+    t2 = RequestTrace("req-2")
+    t2.ev("queue_enter", slo="interactive")
+    assert decode_phase_share(t2) is None
+    assert "phase_share" not in ring.finish(t2, "stop")
+
+
+# ==================================================== /profilez e2e
+@pytest.mark.slow
+def test_profilez_capture_e2e(tmp_path):
+    """The capture layer over real HTTP: a gateway ``/profilez``
+    returns windowed per-replica phase totals + dumps validating
+    tickphase files into the run dir; the fleet frontend federates the
+    same capture to a named peer; concurrent captures 409."""
+    from paddle_tpu.serving import Gateway
+    from paddle_tpu.serving.fleet import FleetFrontend, RemoteReplica
+    from test_gateway import _http, _poll, _sse
+    obs.reset()
+    obs.configure(str(tmp_path))
+
+    async def run():
+        gw = Gateway(_engine(tick_profile=True,
+                             chunk_prefill_tokens=8,
+                             prefill_buckets=(16,)),
+                     name="t-pz")
+        await gw.start()
+        rep = RemoteReplica("p0", "127.0.0.1", gw.port,
+                            probe_interval_s=0.05)
+        fe = FleetFrontend([rep], chunk_tokens=8, name="t-pz-fe")
+        await fe.start()
+        await _poll(rep.healthy, 5)
+        await _sse(gw.port, {"prompt": list(range(1, 10)),
+                             "max_new_tokens": 6, "temperature": 0.0})
+        cap, c409 = await asyncio.gather(
+            _http(gw.port, "GET", "/profilez?duration_s=0.3"),
+            _http(gw.port, "GET", "/profilez?duration_s=0.3"))
+        fed = await _http(fe.port, "GET",
+                          "/profilez?duration_s=0.1&replica=p0")
+        miss = await _http(fe.port, "GET",
+                           "/profilez?duration_s=0.1&replica=nope")
+        await fe.drain()
+        await gw.drain()
+        return cap, c409, fed, miss
+
+    cap, c409, fed, miss = asyncio.run(run())
+    assert sorted((cap[0], c409[0])) == [200, 409]
+    body = json.loads(cap[2] if cap[0] == 200 else c409[2])
+    assert body["gateway"] == "t-pz"
+    assert body["duration_s"] == pytest.approx(0.3)
+    assert body["tickphase_files"]
+    rep0 = body["replicas"]["r0"]
+    assert rep0["enabled"]
+    assert set(rep0["phase_ms_in_window"]) == set(obs.TICK_PHASES)
+    for path in body["tickphase_files"]:
+        with open(path) as f:
+            assert obs.validate_tickphase_doc(json.load(f)) == []
+    st, _, fb = fed
+    assert st == 200
+    fdoc = json.loads(fb)
+    assert fdoc["fleet"] == "t-pz-fe" and fdoc["replica"] == "p0"
+    assert fdoc["report"]["gateway"] == "t-pz"
+    assert fdoc["report"]["replicas"]["r0"]["enabled"]
+    assert miss[0] == 404
+    # drain re-dumped the rings into the run dir beside the traces
+    assert glob.glob(str(tmp_path / "tickphase_t-pz_*.json"))
+    obs.reset()

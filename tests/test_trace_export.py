@@ -1,0 +1,227 @@
+"""ISSUE 20: perfetto/Chrome-trace export of the request waterfalls.
+
+Contracts pinned here:
+
+- SCHEMA: ``export()`` emits a document ``validate_chrome_trace``
+  accepts (the subset perfetto's legacy JSON importer requires) for a
+  synthetic THREE-process fleet failover — frontend + two gateway
+  rings sharing one request id.
+- FLEET STITCH: cross-process events land on ONE wall-clock axis via
+  the ``wall_accept + t_ms/1e3`` convention ``trace_report``'s fleet
+  merge defined — the frontend's ``peer_fail``/``resubmit`` instants
+  sit between gwA's accept and gwB's finish, in hop order, and the
+  acceptance's "mid-stream failover across two gateway processes"
+  renders as one timeline.
+- WATERFALL SHAPE: a retained entry becomes a request span with
+  nested queue_wait / prefill (+ chunk slices) / decode spans and
+  instants only for the punctual kinds; ``phase_share`` rides the
+  request span args.
+- TICK LANES: a ``tickphase/1`` dump becomes its own process with
+  per-phase thread lanes whose widths are the recorded phase times,
+  wall-anchored by ``dumped_wall - clock_now``; the per-source tick
+  cap drops oldest-first.
+- CLI: the file round-trip (``main`` over a run dir with ``--check``)
+  exits 0 and writes a loadable JSON.
+"""
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.generation.paged import PagedEngine
+from paddle_tpu.generation.stub import TickStubModel
+from paddle_tpu.serving.reqtrace import RequestTrace, RequestTraceRing
+from paddle_tpu.utils import observability as obs
+
+
+def _load_tool(name):
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def tx():
+    return _load_tool("trace_export")
+
+
+def _ring(gateway, replica, **kw):
+    return RequestTraceRing(capacity=16,
+                            labels={"gateway": gateway,
+                                    "replica": replica}, **kw)
+
+
+def _fleet_docs():
+    """The synthetic failover: frontend proxies req-x to gwA, gwA
+    dies mid-stream, the frontend resubmits to gwB which finishes."""
+    # gwB finished clean and fast; a low slow-TTFT threshold keeps its
+    # timeline past tail retention so the waterfall has all three hops
+    rings = {"fe": _ring("flt", "frontend"),
+             "a": _ring("gwA", "r0"),
+             "b": _ring("gwB", "r0", slow_ttft_ms=1.0)}
+    t_fe = RequestTrace("req-x")
+    t_fe.ev("accept", t_ms=0.0)
+    t_fe.ev("proxy_to", t_ms=1.0, replica="pA", attempt=0)
+    t_fe.ev("peer_fail", t_ms=30.0, replica="pA",
+            reason="peer_conn_drop")
+    t_fe.ev("resubmit", t_ms=31.0, to_replica="", attempt=1)
+    t_fe.ev("resume_offset", t_ms=31.5, offset=3, committed=3)
+    t_fe.ev("proxy_to", t_ms=32.0, replica="pB", attempt=1)
+    t_a = RequestTrace("req-x")
+    t_a.ev("queue_enter", t_ms=0.0, slo="interactive")
+    t_a.ev("slot_take", t_ms=2.0, slot=0, prefix_hit_tokens=0,
+           blocks=2)
+    t_a.ev("prefill_done", t_ms=5.0)
+    t_a.ev("first_token", t_ms=6.0)
+    t_b = RequestTrace("req-x")
+    t_b.ev("queue_enter", t_ms=0.0, slo="interactive")
+    t_b.ev("slot_take", t_ms=1.0, slot=0, prefix_hit_tokens=3,
+           blocks=2)
+    t_b.ev("prefill_done", t_ms=3.0)
+    t_b.ev("first_token", t_ms=4.0)
+    t_b.ev("tick", t_ms=5.0, n=1,
+           phase={"wall_ms": 2.0, "host_ms": 0.5, "h2d_ms": 0.0,
+                  "dispatch_ms": 1.0, "device_ms": 0.25,
+                  "drain_ms": 0.25})
+    t_b.ev("finish", t_ms=20.0, reason="stop")
+    # one wall-clock axis: frontend accepts first, gwA right after,
+    # gwB at the failover 40ms later
+    t_fe.wall0, t_a.wall0, t_b.wall0 = 100.0, 100.002, 100.040
+    rings["fe"].finish(t_fe, "stop", tokens=9)
+    rings["a"].finish(t_a, "error", tokens=3)
+    rings["b"].finish(t_b, "stop", tokens=6)
+    return [dict(r.to_doc(), _file=f"reqtrace_{k}.json")
+            for k, r in rings.items()]
+
+
+def test_export_fleet_failover_schema_and_order(tx):
+    doc = tx.export(_fleet_docs())
+    assert tx.validate_chrome_trace(doc) == []
+    evs = doc["traceEvents"]
+    assert doc["otherData"]["requests"] == 1
+    assert sorted(doc["otherData"]["sources"]) \
+        == ["flt/frontend", "gwA/r0", "gwB/r0"]
+    # one process lane per source, named via metadata events
+    procs = {e["pid"] for e in evs if e["ph"] == "M"
+             and e["name"] == "process_name"}
+    assert procs == {"flt/frontend", "gwA/r0", "gwB/r0"}
+    # request spans exist on every lane, sharing the req-x thread
+    spans = [e for e in evs if e["ph"] == "X"
+             and e["cat"] == "request"]
+    assert {s["pid"] for s in spans} == procs
+    assert all(s["tid"] == "req-x" for s in spans)
+    # gwB's span args carry the per-request phase share
+    b_span = next(s for s in spans if s["pid"] == "gwB/r0")
+    assert b_span["args"]["phase_share"]["dispatch_frac"] \
+        == pytest.approx(0.5)
+    # nested waterfall on gwB: queue_wait, prefill, decode
+    b_phases = {e["name"] for e in evs if e["ph"] == "X"
+                and e["cat"] == "phase" and e["pid"] == "gwB/r0"}
+    assert b_phases == {"queue_wait", "prefill", "decode"}
+    # ONE wall-clock axis in hop order: gwA accept < peer_fail <
+    # resubmit < gwB accept < gwB finish-span end (the acceptance's
+    # mid-stream failover as one left-to-right timeline)
+    def ts(pid, name, ph="i"):
+        return next(e["ts"] for e in evs
+                    if e["pid"] == pid and e["name"] == name
+                    and e["ph"] == ph)
+    a_accept = next(s["ts"] for s in spans if s["pid"] == "gwA/r0")
+    b_accept = next(s["ts"] for s in spans if s["pid"] == "gwB/r0")
+    fail = ts("flt/frontend", "peer_fail")
+    resub = ts("flt/frontend", "resubmit")
+    assert a_accept < fail < resub < b_accept \
+        < b_accept + b_span["dur"]
+    # instants only for the punctual catalog (ticks are not markers)
+    inst = {e["name"] for e in evs if e["ph"] == "i"}
+    assert "tick" not in inst and "peer_fail" in inst \
+        and "resume_offset" in inst
+    # events globally time-sorted (perfetto's importer expectation)
+    tss = [e["ts"] for e in evs if e["ph"] != "M"]
+    assert tss == sorted(tss)
+
+
+def test_tickphase_lanes_and_cap(tx, capsys):
+    eng = PagedEngine(TickStubModel(), max_slots=4, num_blocks=32,
+                      block_size=8, max_blocks_per_seq=8,
+                      prefill_buckets=(16,), tick_profile=True)
+    eng.submit("a", (np.arange(6) % 5 + 1)[None], max_new_tokens=8)
+    eng.run()
+    doc = eng.tick_profile_doc()
+    assert obs.validate_tickphase_doc(doc) == []
+    doc["_file"] = "tickphase_t_r0.json"
+    out = tx.export([], [doc])
+    assert tx.validate_chrome_trace(out) == []
+    evs = out["traceEvents"]
+    pid = "tickphase:t_r0"
+    assert out["otherData"]["tick_sources"] == ["tickphase_t_r0.json"]
+    ticks = [e for e in evs if e["ph"] == "X" and e["cat"] == "tick"]
+    assert len(ticks) == doc["ticks"]
+    # phase slices stack inside their tick window on per-phase lanes
+    ph = [e for e in evs if e["ph"] == "X" and e["cat"] == "tick_phase"]
+    assert ph and all(e["pid"] == pid for e in ph)
+    assert {e["tid"] for e in ph} <= set(obs.TICK_PHASES)
+    t0 = ticks[0]
+    inside = [e for e in ph if t0["ts"] - 1e-3 <= e["ts"]
+              <= t0["ts"] + t0["dur"] + 1e-3]
+    assert sum(e["dur"] for e in inside) \
+        == pytest.approx(t0["dur"], rel=0.02)
+    # the per-source cap drops oldest ticks, loudly
+    big = dict(doc, entries=[dict(doc["entries"][-1],
+                                  tick=i, t=doc["entries"][-1]["t"])
+                             for i in range(tx.MAX_TICKS_PER_SOURCE
+                                            + 10)])
+    capped = tx.export([], [big])
+    n = sum(1 for e in capped["traceEvents"]
+            if e["ph"] == "X" and e["cat"] == "tick")
+    assert n == tx.MAX_TICKS_PER_SOURCE
+    assert "older dropped" in capsys.readouterr().err
+
+
+def test_cli_roundtrip_over_run_dir(tx, tmp_path):
+    for d in _fleet_docs():
+        with open(tmp_path / d["_file"], "w") as f:
+            json.dump({k: v for k, v in d.items() if k != "_file"}, f)
+    eng = PagedEngine(TickStubModel(), max_slots=4, num_blocks=32,
+                      block_size=8, max_blocks_per_seq=8,
+                      prefill_buckets=(16,), tick_profile=True)
+    eng.submit("a", (np.arange(6) % 5 + 1)[None], max_new_tokens=8)
+    eng.run()
+    assert eng.dump_tick_profile(str(tmp_path / "tickphase_t_r0.json"))
+    out = tmp_path / "trace.json"
+    assert tx.main([str(tmp_path), "-o", str(out), "--check"]) == 0
+    with open(out) as f:
+        doc = json.load(f)
+    assert tx.validate_chrome_trace(doc) == []
+    assert doc["otherData"]["requests"] == 1
+    assert doc["otherData"]["tick_sources"] == ["tickphase_t_r0.json"]
+    # --no-ticks leaves only the request lanes
+    assert tx.main([str(tmp_path), "-o", str(out), "--no-ticks",
+                    "--check"]) == 0
+    with open(out) as f:
+        doc2 = json.load(f)
+    assert doc2["otherData"]["tick_sources"] == []
+    assert all(e.get("cat") not in ("tick", "tick_phase")
+               for e in doc2["traceEvents"])
+
+
+def test_validator_catches_malformed_events(tx):
+    good = {"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 1.0, "dur": 2.0,
+         "pid": "p", "tid": "t"}]}
+    assert tx.validate_chrome_trace(good) == []
+    assert tx.validate_chrome_trace({"traceEvents": 3})
+    assert tx.validate_chrome_trace({"traceEvents": [
+        {"name": "a", "ph": "Z", "ts": 1.0, "pid": "p", "tid": "t"}]})
+    assert tx.validate_chrome_trace({"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 1.0, "dur": -1.0,
+         "pid": "p", "tid": "t"}]})
+    assert tx.validate_chrome_trace({"traceEvents": [
+        {"name": "a", "ph": "i", "ts": 1.0, "s": "x",
+         "pid": "p", "tid": "t"}]})
+    assert tx.validate_chrome_trace({"traceEvents": [
+        {"ph": "X", "ts": 1.0, "dur": 1.0}]})

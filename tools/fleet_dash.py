@@ -107,6 +107,75 @@ def _metric_points(doc: dict, base: str,
     return sorted((t, agg(vs)) for t, vs in by_t.items())
 
 
+def _label_value(full: str, key: str) -> Optional[str]:
+    """Value of label ``key`` in a ``name{k="v",...}`` series key."""
+    if "{" not in full:
+        return None
+    for kv in full.split("{", 1)[1].rstrip("}").split(","):
+        k, _, v = kv.partition("=")
+        if k.strip() == key:
+            return v.strip().strip('"')
+    return None
+
+
+def _phase_share_points(doc: dict) -> Dict[str, List[Tuple[float,
+                                                           float]]]:
+    """{phase: [(t, phase-ms per wall second)]} derived from the
+    ``paged_tick_phase_ms{phase=...}`` histogram SUM deltas a profiled
+    engine exports (ISSUE 20) — cumulative sums subtract like counter
+    samples, so consecutive samples give the windowed phase-time
+    rate."""
+    by_phase: Dict[str, Dict[float, float]] = {}
+    for full, ent in (doc.get("metrics") or {}).items():
+        if full.split("{", 1)[0] != "paged_tick_phase_ms" \
+                or ent.get("kind") != "histogram":
+            continue
+        phase = _label_value(full, "phase")
+        if phase is None:
+            continue
+        merged = by_phase.setdefault(phase, {})
+        samples = list(ent["samples"])
+        for a, b in zip(samples, samples[1:]):
+            dt = b[0] - a[0]
+            if dt > 0:
+                t = round(b[0], 6)
+                merged[t] = merged.get(t, 0.0) \
+                    + max(b[2] - a[2], 0.0) / dt
+    return {p: sorted(m.items()) for p, m in sorted(by_phase.items())}
+
+
+# one unambiguous letter per phase (first letters collide:
+# host/h2d, dispatch/device/drain)
+PHASE_LETTERS = {"host": "H", "h2d": "U", "dispatch": "D",
+                 "device": "C", "drain": "R"}
+
+
+def _phase_row(d: dict, t0: float, t1: float,
+               width: int) -> Optional[str]:
+    """The stacked phase-share row (ISSUE 20): per time bin, the
+    DOMINANT phase's letter (H host, U h2d upload, D dispatch,
+    C device compute, R drain readback) — uppercase when it holds a
+    majority of the tick wall, lowercase for a mere plurality. One
+    glance says "this replica went dispatch-bound at t=40s"."""
+    shares = _phase_share_points(d)
+    if not shares:
+        return None
+    binned = {p: resample(pts, t0, t1, width)
+              for p, pts in shares.items()}
+    out = []
+    for i in range(width):
+        tot = sum(v[i] for v in binned.values()
+                  if v[i] is not None)
+        if tot <= 0:
+            out.append(" ")
+            continue
+        p, v = max(((p, v[i] or 0.0) for p, v in binned.items()),
+                   key=lambda kv: kv[1])
+        ch = PHASE_LETTERS.get(p, p[0].upper())
+        out.append(ch if v / tot > 0.5 else ch.lower())
+    return "".join(out)
+
+
 def doc_time_range(docs: Dict[str, dict]) -> Tuple[float, float]:
     ts = [s[0]
           for d in docs.values()
@@ -137,6 +206,14 @@ def _flight_event(ev: dict, t: float) -> Optional[dict]:
         return {"t": t, "kind": "frontend_kill",
                 "who": ev.get("frontend", "frontend"),
                 "what": "SIGKILL (leaderless failover)"}
+    if kind == "profilez_capture":
+        # an on-demand /profilez capture landed (ISSUE 20) — mark WHEN
+        # the phase rings / jax trace were cut so the sparkline shape
+        # around the marker is what the capture actually saw
+        return {"t": t, "kind": "profilez_capture",
+                "who": ev.get("gateway", "gateway"),
+                "what": f"duration_s={ev.get('duration_s')} "
+                        f"traced={ev.get('traced')}"}
     return None
 
 
@@ -227,6 +304,11 @@ def render(docs: Dict[str, dict], events: Optional[List[dict]] = None,
             peak = max(present) if present else 0.0
             lines.append(f"{name[:12]:<12s} {sparkline(vals)} "
                          f"{label} peak {peak:.1f}")
+        ph = _phase_row(d, t0, t1, width)
+        if ph is not None:
+            lines.append(f"{name[:12]:<12s} {ph} "
+                         f"phase (H host U h2d D dispatch C device "
+                         f"R drain; UPPER = majority)")
         lines.append("")
     marks = list(events or ())
     if marks:
@@ -240,10 +322,11 @@ def render(docs: Dict[str, dict], events: Optional[List[dict]] = None,
                 "!" if ev["kind"].startswith("alert_fire") else \
                 "." if ev["kind"].startswith("alert") else \
                 "#" if ev["kind"].startswith("incident") else \
-                "x" if ev["kind"] == "frontend_kill" else "^"
+                "x" if ev["kind"] == "frontend_kill" else \
+                "P" if ev["kind"] == "profilez_capture" else "^"
         lines.append(f"{'events':<12s} {''.join(row)} "
                      f"(! fire  . resolve  ^ scale  # incident  "
-                     f"x fe-kill)")
+                     f"x fe-kill  P profilez)")
         for ev in marks[-12:]:
             t = ev.get("t")
             lines.append(f"  t={t - t0:7.1f}s  {ev['kind']:<14s} "
@@ -277,16 +360,31 @@ def _live_rows(doc: dict) -> Dict[str, Dict[str, float]]:
 
     def fold(name: str, mdoc: dict):
         tok = q = burn = 0.0
+        ph: Dict[str, float] = {}
         for full, view in (mdoc.get("metrics") or {}).items():
             base = full.split("{", 1)[0]
             if base == "gateway_tokens_total":
                 tok += view.get("rate_per_s", 0.0)
             elif base == "gateway_queue_depth":
                 q += view.get("last", 0.0)
+            elif base == "paged_tick_phase_ms":
+                # windowed phase-ms total = count * mean (ISSUE 20)
+                p = _label_value(full, "phase")
+                if p is not None:
+                    ph[p] = ph.get(p, 0.0) + view.get("count", 0) \
+                        * view.get("mean", 0.0)
         slo = mdoc.get("slo") or {}
         for by_w in (slo.get("burn") or {}).values():
             burn = max([burn] + list(by_w.values()))
+        letter = " "
+        tot = sum(ph.values())
+        if tot > 0:
+            p, v = max(ph.items(), key=lambda kv: kv[1])
+            letter = PHASE_LETTERS.get(p, p[0].upper())
+            if v / tot <= 0.5:
+                letter = letter.lower()
         rows[name] = {"tok_s": tok, "queue": q, "burn": burn,
+                      "phase": letter,
                       "alerts": len(slo.get("active") or ())}
 
     if "replicas" in doc and "totals" in doc:     # federated frontend
@@ -319,10 +417,13 @@ def live(host: str, port: int, watch_s: float, window_s: float,
         else:
             for name, row in _live_rows(doc).items():
                 h = hist.setdefault(name, {"tok_s": [], "queue": [],
-                                           "burn": [], "alerts": 0})
+                                           "burn": [], "phase": [],
+                                           "alerts": 0})
                 for k in ("tok_s", "queue", "burn"):
                     h[k].append(row[k])
                     del h[k][:-width]
+                h["phase"].append(row.get("phase", " "))
+                del h["phase"][:-width]
                 h["alerts"] = row["alerts"]
             if not first:
                 sys.stdout.write("\x1b[2J\x1b[H")
@@ -342,6 +443,10 @@ def live(host: str, port: int, watch_s: float, window_s: float,
                 print(f"{'':<12s} burn  "
                       f"{sparkline(h['burn']):<{width}s} "
                       f"{h['burn'][-1]:8.2f}")
+                if any(c != " " for c in h["phase"]):
+                    print(f"{'':<12s} phase "
+                          f"{''.join(h['phase']):<{width}s} "
+                          f"(H host U h2d D disp C dev R drain)")
             sys.stdout.flush()
         if now >= t_end:
             return 0

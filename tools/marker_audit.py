@@ -165,6 +165,11 @@ MUST_BE_SLOW = (
     # pins and the corrupted-transfer-never-emits pin in
     # test_kvxfer.py.
     r"test_kvxfer\.py.*chaos",
+    # ISSUE 20: the /profilez capture e2e — real HTTP gateway + fleet
+    # frontend federation around a wall-clock capture window (tier-1
+    # keeps the injected-clock phase math, the profile-on/off bitwise
+    # pins and the reset-flush unit in test_tick_profile.py)
+    r"test_tick_profile\.py.*profilez.*e2e",
     r"test_vision_models\.py.*(forward_and_grad|bottleneck_variant"
     r"|grad_through_both_towers)",
     r"TestDeepseekV2Parity.*logits_match_torch",

@@ -120,6 +120,68 @@ def _load_traces(run_dir: str) -> List[dict]:
     return traces
 
 
+def _load_tickphase(run_dir: str) -> List[dict]:
+    """Load + schema-validate the ``tickphase_*.json`` phase rings a
+    profiled engine (or a gateway drain / ``/profilez`` capture)
+    leaves in the run dir (ISSUE 20)."""
+    from paddle_tpu.utils.observability import validate_tickphase_doc
+    docs = []
+    for path in sorted(glob.glob(os.path.join(run_dir,
+                                              "tickphase_*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if validate_tickphase_doc(doc):
+            continue                 # torn/drifted file: skip, not die
+        doc["_file"] = os.path.basename(path)
+        docs.append(doc)
+    return docs
+
+
+def phase_decompose(docs: List[dict]) -> Optional[Dict[str, Any]]:
+    """The ``phase_decompose`` view (ISSUE 20): split tick wall time —
+    and therefore tok/s — into host / h2d / dispatch / device / drain
+    SHARES per profiled engine and fleet-wide, and name the dominant
+    term. This is the slope-vs-intercept read ROADMAP item 1 needs:
+    device share is the slope (model compute), dispatch+host share is
+    the intercept (per-tick machinery) — a tok/s gap attributed to the
+    intercept is a tick-machinery problem, not a kernel problem."""
+    if not docs:
+        return None
+    per: Dict[str, Any] = {}
+    agg_tot: Dict[str, float] = {}
+    agg_wall = 0.0
+    agg_ticks = 0
+    for d in docs:
+        wall = float(d.get("wall_total_ms") or 0.0)
+        tot = {k: float(v) for k, v in
+               (d.get("phase_totals_ms") or {}).items()}
+        name = d.get("engine") or d["_file"]
+        per[name] = {
+            "ticks": int(d.get("ticks") or 0),
+            "wall_ms": round(wall, 3),
+            "shares": {k: round(v / wall, 4) if wall > 0 else 0.0
+                       for k, v in sorted(tot.items())},
+        }
+        agg_wall += wall
+        agg_ticks += int(d.get("ticks") or 0)
+        for k, v in tot.items():
+            agg_tot[k] = agg_tot.get(k, 0.0) + v
+    shares = {k: round(v / agg_wall, 4) if agg_wall > 0 else 0.0
+              for k, v in sorted(agg_tot.items())}
+    dominant = max(shares, key=shares.get) if shares else None
+    return {
+        "sources": [d["_file"] for d in docs],
+        "ticks": agg_ticks,
+        "wall_ms": round(agg_wall, 3),
+        "shares": shares,
+        "dominant": dominant,
+        "per_engine": per,
+    }
+
+
 def summarize(run_dir: str) -> Dict[str, Any]:
     """Parse every artifact in ``run_dir`` into one summary dict (the
     schema ``--check`` pins)."""
@@ -193,6 +255,9 @@ def summarize(run_dir: str) -> Dict[str, Any]:
         },
         "trace_spans": sum(len(tr.get("traceEvents", ()))
                            for tr in traces),
+        # tick-phase decomposition (ISSUE 20): present only when a
+        # profiled engine left tickphase_*.json rings in the run dir
+        "phase_decompose": phase_decompose(_load_tickphase(run_dir)),
         "timeline": timeline,
         "jsonl_tags": sorted(series),
     }
@@ -227,6 +292,16 @@ def render(s: Dict[str, Any]) -> str:
     if c["elastic_restarts"] or c["elastic_preemptions"]:
         lines.append(f"supervisor restarts {c['elastic_restarts']:.0f}   "
                      f"preemptions {c['elastic_preemptions']:.0f}")
+    pd = s.get("phase_decompose")
+    if pd:
+        sh = " ".join(f"{k} {v:.1%}" for k, v in pd["shares"].items())
+        lines.append(f"tick phases ({pd['ticks']} ticks, "
+                     f"{pd['wall_ms']:.0f} ms wall)   {sh}   "
+                     f"dominant: {pd['dominant']}")
+        for name, p in sorted(pd["per_engine"].items()):
+            sh = " ".join(f"{k} {v:.1%}"
+                          for k, v in p["shares"].items())
+            lines.append(f"  {name}: {p['ticks']} ticks   {sh}")
     for fname, reason in s["flight_reasons"]:
         lines.append(f"flight     {fname}: {reason}")
     if s["timeline"]:
@@ -441,7 +516,46 @@ def self_check() -> int:
                    for p in validate_series_doc(broken)),
                "counter regression not caught by the validator")
 
+        # tick-phase ring (ISSUE 20): synthesize one with the library's
+        # validator vocabulary, re-validate, and pin the decompose math
+        from paddle_tpu.utils.observability import (
+            TICK_PHASES, validate_tickphase_doc)
+        tp_doc = {
+            "schema": "tickphase/1", "engine": "chk-e0",
+            "dumped_wall": 1000.0, "clock_now": 10.0, "capacity": 8,
+            "ticks": 2, "wall_total_ms": 10.0,
+            "phase_totals_ms": {"host": 2.0, "h2d": 1.0,
+                                "dispatch": 5.0, "device": 1.5,
+                                "drain": 0.5},
+            "entries": [
+                {"tick": k, "t": 9.0 + k, "wall_ms": 5.0,
+                 "host_ms": 1.0, "h2d_ms": 0.5, "dispatch_ms": 2.5,
+                 "device_ms": 0.75, "drain_ms": 0.25,
+                 "dispatches": 1, "uploads": 0, "bytes": 0,
+                 "patches": 0, "active": 2} for k in range(2)],
+        }
+        problems = validate_tickphase_doc(tp_doc)
+        expect(not problems,
+               f"tickphase schema drift: {problems[:3]}")
+        expect(set(tp_doc["phase_totals_ms"]) == set(TICK_PHASES),
+               "TICK_PHASES vocabulary drifted")
+        broken_tp = json.loads(json.dumps(tp_doc))
+        broken_tp["entries"][0]["host_ms"] = 99.0
+        expect(any("sum" in p
+                   for p in validate_tickphase_doc(broken_tp)),
+               "phase-sum != wall not caught by the validator")
+        with open(os.path.join(run, "tickphase_chk_r0.json"),
+                  "w") as f:
+            json.dump(tp_doc, f)
+
         s = summarize(run)
+        pd = s["phase_decompose"]
+        expect(pd is not None and pd["dominant"] == "dispatch",
+               "phase_decompose missing or dominant term wrong")
+        expect(pd is not None
+               and pd["shares"].get("dispatch") == 0.5
+               and abs(sum(pd["shares"].values()) - 1.0) < 0.01,
+               "phase_decompose shares drifted")
         expect(s["steps_recorded"] == 5, "step_end events lost")
         expect(s["step_ms"]["p50"] > 0, "p50 not computed")
         expect(s["step_ms"]["p99"] >= s["step_ms"]["p50"],

@@ -357,6 +357,10 @@ def _build_gateway(ns):
     if getattr(ns, "patch_fuse", "on") == "off" \
             and engine_kw["delta_transitions"]:
         engine_kw["patch_fuse"] = False
+    # --tick-profile on: per-tick phase attribution (ISSUE 20) — the
+    # rung banks phase_breakdown from the engines' phase totals
+    engine_kw["tick_profile"] = \
+        getattr(ns, "tick_profile", "off") == "on"
 
     chaos = bool(getattr(ns, "chaos", False))
     # host-RAM KV spill tier (ISSUE 17 A/B): --spill on hands every
@@ -736,6 +740,12 @@ async def run_loadgen(ns) -> dict:
         # reaches engines this process constructs
         raise SystemExit("--patch-fuse off requires in-process "
                          "replicas (no --fleet / --url)")
+    if (urls or fleet) and getattr(ns, "tick_profile", "off") == "on":
+        # phase_breakdown is summed from THIS process's engine
+        # objects; fleet replica processes and external servers never
+        # see the knob, so the rung would bank an empty breakdown
+        raise SystemExit("--tick-profile on requires in-process "
+                         "replicas (no --fleet / --url)")
     if int(getattr(ns, "frontends", 1) or 1) > 1 and not fleet:
         raise SystemExit("--frontends needs --fleet: sibling "
                          "frontends share one replica-process fleet")
@@ -760,6 +770,12 @@ async def run_loadgen(ns) -> dict:
     else:
         gw, engines, engine_factory = _build_gateway(ns)
         await gw.start()
+        if gw.sampler is not None:
+            # explicit t0 baseline: the sampler thread's first tick is
+            # a full interval away, and a warm-cache CI run can finish
+            # inside it — without this the tok/s trajectory would need
+            # two timer ticks it never gets
+            gw.sampler.sample()
         targets = [(gw.host, gw.port)]
     # fleet-mode trajectory (ISSUE 15): the frontend's own proxied-
     # token counter lives in THIS process's registry — a local sampler
@@ -770,6 +786,7 @@ async def run_loadgen(ns) -> dict:
         from paddle_tpu.utils import observability as obs
         local_sampler = obs.MetricsTimeSeries(
             name="loadgen", interval_s=0.2, capacity=1024).start()
+        local_sampler.sample()    # t0 baseline (see gateway twin)
     host, port = targets[0]
     # chaos schedule (ISSUE 12): seeded kill/hang points spread evenly
     # over the request stream — deterministic per (--seed,
@@ -1002,6 +1019,7 @@ async def run_loadgen(ns) -> dict:
         "ring": getattr(ns, "ring", "on"),
         "delta": getattr(ns, "delta", "on"),
         "patch_fuse": getattr(ns, "patch_fuse", "on"),
+        "tick_profile": getattr(ns, "tick_profile", "off"),
         "churn": bool(getattr(ns, "churn", False)),
         "targets": len(targets),
         "diurnal": bool(getattr(ns, "diurnal", False)),
@@ -1012,6 +1030,10 @@ async def run_loadgen(ns) -> dict:
     # burn and the windowed tok/s trajectory, so bench.py trend lines
     # capture how the run served — not just its end-of-run throughput
     if gw is not None and gw.sampler is not None:
+        # final sample pairs with the t0 baseline so even a run that
+        # finished inside one sampler interval yields a >=1-point rate
+        # series (deterministic under warm compile caches)
+        gw.sampler.sample()
         traj = _tok_trajectory(gw.sampler)
         if traj is not None:
             rung["tok_s_trajectory"] = traj
@@ -1045,6 +1067,46 @@ async def run_loadgen(ns) -> dict:
             if ticks else 0.0
         rung["prefix_hit_tokens"] = sum(
             e.stats["prefix_hit_tokens"] for e in engines)
+        # ISSUE 20: where the tick wall went — host (staging + patch
+        # flush, h2d broken out as detail), dispatch (python call into
+        # the jit program), device (block-until-ready at the readback
+        # boundary) and drain (D2H copies). host is the residual of
+        # the bracketed phases, so the shares sum to 1.0 of the
+        # measured wall by construction — coverage pins that.
+        if getattr(ns, "tick_profile", "off") == "on":
+            totals = {}
+            wall = 0.0
+            ticks_p = 0
+            for e in engines:
+                pt = e.tick_phase_totals
+                if pt is None:
+                    continue
+                for p, v in pt.items():
+                    totals[p] = totals.get(p, 0.0) + v
+                wall += e.tick_wall_ms_total
+                ticks_p += e._prof.ticks
+            phase_sum = sum(totals.values())
+            rung["phase_breakdown"] = {
+                "ticks": ticks_p,
+                "wall_ms": round(wall, 3),
+                "host_frac": round(
+                    (totals.get("host", 0.0)
+                     + totals.get("h2d", 0.0)) / wall, 4)
+                if wall else 0.0,
+                "h2d_frac": round(
+                    totals.get("h2d", 0.0) / wall, 4) if wall else 0.0,
+                "dispatch_frac": round(
+                    totals.get("dispatch", 0.0) / wall, 4)
+                if wall else 0.0,
+                "device_frac": round(
+                    totals.get("device", 0.0) / wall, 4)
+                if wall else 0.0,
+                "drain_frac": round(
+                    totals.get("drain", 0.0) / wall, 4)
+                if wall else 0.0,
+                "coverage": round(phase_sum / wall, 4)
+                if wall else 0.0,
+            }
         router = gw.health()["router"]
         rung["prefix_route_hits"] = router["prefix_route_hits"]
         rung["prefix_route_misses"] = router["prefix_route_misses"]
@@ -1182,6 +1244,7 @@ async def run_loadgen(ns) -> dict:
             # trajectory plus the peers' federated burn/alert state,
             # read off the SAME probe caches /metricsz serves
             local_sampler.stop()
+            local_sampler.sample()   # final point (see gateway twin)
             traj = _tok_trajectory(local_sampler,
                                    base="fleet_proxied_tokens_total")
             if traj is not None:
@@ -1364,6 +1427,13 @@ def main(argv=None) -> int:
                          "transition, the PR 12 A/B reference); the "
                          "rung records patches_fused and "
                          "dispatches_per_tick")
+    ap.add_argument("--tick-profile", dest="tick_profile",
+                    default="off", choices=("on", "off"),
+                    help="tick-phase profiler on the replica engines "
+                         "(ISSUE 20): per-tick host/h2d/dispatch/"
+                         "device/drain attribution; the rung banks "
+                         "phase_breakdown (requires in-process "
+                         "replicas)")
     ap.add_argument("--spill", default="off", choices=("on", "off"),
                     help="host-RAM KV spill tier (ISSUE 17): one "
                          "shared KVSpillArena across the replicas "

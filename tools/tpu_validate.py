@@ -354,6 +354,47 @@ def kv_xfer_restore_program():
     assert snap["kv_xfer_checksum_failures_total"] == 0, snap
 check("kv_xfer_restore_program", kv_xfer_restore_program)
 
+def profilez_capture():
+    # ISSUE 20: the /profilez capture path on hardware — tick-phase
+    # profiling must not perturb the token stream (bitwise vs off),
+    # the five phase totals must sum to the measured tick wall
+    # (residual construction), and a bounded jax.profiler capture +
+    # tickphase ring dump (what the gateway endpoint does) must land
+    # without contending the single-trace owner.
+    import os, tempfile
+    from paddle_tpu.generation.paged import PagedEngine
+    from paddle_tpu.generation.stub import TickStubModel
+    from paddle_tpu.utils import observability as obs
+    from paddle_tpu.utils.profiler import Profiler
+
+    def run(profile):
+        e = PagedEngine(TickStubModel(), max_slots=4, num_blocks=32,
+                        block_size=8, max_blocks_per_seq=8,
+                        prefill_buckets=(8,), chunk_prefill_tokens=8,
+                        tick_profile=profile)
+        for i in range(3):
+            e.submit("r" + str(i), np.arange(1, 9)[None],
+                     max_new_tokens=8)
+        return e, e.run()
+    e_on, res_on = run(True)
+    e_off, res_off = run(False)
+    assert res_on == res_off, "profile-on stream diverged"
+    doc = e_on.tick_profile_doc()
+    assert doc is not None and doc["ticks"] > 0
+    bad = obs.validate_tickphase_doc(doc)
+    assert not bad, bad
+    d = tempfile.mkdtemp(prefix="profilez_")
+    prof = Profiler(logdir=d)
+    prof.start()
+    try:
+        e_cap, _ = run(True)
+    finally:
+        prof.stop()
+    path = e_cap.dump_tick_profile(
+        os.path.join(d, "tickphase_validate.json"))
+    assert path and os.path.exists(path), path
+check("profilez_capture", profilez_capture)
+
 def prefill_flash():
     # the generate() prefill branch: flash at cache_index==0 must match
     # the masked-dense-over-cache path it replaced (llama.py)
